@@ -1,0 +1,25 @@
+"""cap_tpu — a TPU-native authentication framework.
+
+cap_tpu re-creates the capability surface of the ``cap`` auth library
+(JWT signature verification + claims validation, and OIDC relying-party
+flows — see /root/reference, a pure-Go client library) as a TPU-first
+framework:
+
+- ``cap_tpu.jwt``  — JWT verification: ``KeySet`` implementations,
+  ``Validator`` claims engine, and the batched TPU execution backend
+  (``TPUBatchKeySet.verify_batch``) whose RSA modular exponentiation and
+  elliptic-curve scalar multiplication run as JAX/Pallas kernels.
+- ``cap_tpu.oidc`` — OIDC relying-party: discovery, auth-URL generation,
+  code/PKCE/implicit flows, token exchange, id_token verification,
+  UserInfo, HTTP callback handlers, and an in-process fake IdP for tests.
+- ``cap_tpu.tpu``  — the verify engine: limb-vector bignum, Montgomery
+  modexp, EC kernels, batching/bucketing runtime, mesh sharding.
+- ``cap_tpu.runtime`` — native C++ batch tokenizer (JOSE split, base64url,
+  SHA-2) with a pure-Python fallback.
+
+The pure-CPU path (backed by the ``cryptography`` package) is the default
+and the correctness oracle; the TPU path is gated behind the same KeySet
+interface, mirroring the reference's seam at jwt/keyset.go:27-32.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,51 @@
+"""JWT verification core (capability parity with the reference's jwt/ package).
+
+Public surface mirrors jwt/keyset.go + jwt/jwt.go + jwt/algs.go:
+- :class:`Alg` registry and :func:`supported_signing_algorithm`
+- :class:`KeySet` interface with :class:`StaticKeySet`,
+  :class:`JSONWebKeySet`, :func:`new_oidc_discovery_keyset`
+- :class:`Validator` / :class:`Expected` claims engine
+- :func:`parse_public_key_pem`
+- the TPU extension point: :class:`TPUBatchKeySet` (``verify_batch``)
+"""
+
+from .algs import (
+    Alg,
+    RS256, RS384, RS512, ES256, ES384, ES512, PS256, PS384, PS512, EdDSA,
+    SUPPORTED_ALGORITHMS,
+    supported_signing_algorithm,
+)
+from .jose import ParsedJWS, parse_compact
+from .pem import parse_public_key_pem
+from .keyset import (
+    KeySet,
+    StaticKeySet,
+    JSONWebKeySet,
+    new_oidc_discovery_keyset,
+)
+from .validator import DEFAULT_LEEWAY_SECONDS, Expected, Validator
+
+__all__ = [
+    "Alg", "RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
+    "PS256", "PS384", "PS512", "EdDSA", "SUPPORTED_ALGORITHMS",
+    "supported_signing_algorithm",
+    "ParsedJWS", "parse_compact", "parse_public_key_pem",
+    "KeySet", "StaticKeySet", "JSONWebKeySet", "new_oidc_discovery_keyset",
+    "DEFAULT_LEEWAY_SECONDS", "Expected", "Validator",
+]
+
+
+def __getattr__(name):
+    # TPUBatchKeySet pulls in jax; import lazily so the pure-CPU path has
+    # no accelerator dependency (the reference's pure-Go-path-stays-default
+    # requirement).
+    if name == "TPUBatchKeySet":
+        try:
+            from .tpu_keyset import TPUBatchKeySet
+        except ImportError as e:
+            raise AttributeError(
+                "TPUBatchKeySet requires the cap_tpu.tpu engine "
+                f"(unavailable in this checkout: {e})"
+            ) from e
+        return TPUBatchKeySet
+    raise AttributeError(name)

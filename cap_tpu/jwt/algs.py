@@ -1,0 +1,51 @@
+"""JOSE asymmetric signing algorithm registry (RFC 7518 §3.1).
+
+Parity with jwt/algs.go:6-46: the same ten asymmetric algorithms are
+supported and anything else (including ``none`` and the HMAC family) is
+rejected.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..errors import UnsupportedAlgError
+
+Alg = str
+
+RS256: Alg = "RS256"  # RSASSA-PKCS1-v1.5 using SHA-256
+RS384: Alg = "RS384"  # RSASSA-PKCS1-v1.5 using SHA-384
+RS512: Alg = "RS512"  # RSASSA-PKCS1-v1.5 using SHA-512
+ES256: Alg = "ES256"  # ECDSA using P-256 and SHA-256
+ES384: Alg = "ES384"  # ECDSA using P-384 and SHA-384
+ES512: Alg = "ES512"  # ECDSA using P-521 and SHA-512
+PS256: Alg = "PS256"  # RSASSA-PSS using SHA-256 and MGF1-SHA-256
+PS384: Alg = "PS384"  # RSASSA-PSS using SHA-384 and MGF1-SHA-384
+PS512: Alg = "PS512"  # RSASSA-PSS using SHA-512 and MGF1-SHA-512
+EdDSA: Alg = "EdDSA"  # Ed25519 using SHA-512
+
+SUPPORTED_ALGORITHMS = frozenset({
+    RS256, RS384, RS512,
+    ES256, ES384, ES512,
+    PS256, PS384, PS512,
+    EdDSA,
+})
+
+# Hash function name (hashlib) per algorithm.
+HASH_FOR_ALG = {
+    RS256: "sha256", RS384: "sha384", RS512: "sha512",
+    ES256: "sha256", ES384: "sha384", ES512: "sha512",
+    PS256: "sha256", PS384: "sha384", PS512: "sha512",
+    EdDSA: "sha512",
+}
+
+
+def supported_signing_algorithm(*algs: Alg) -> None:
+    """Raise UnsupportedAlgError if any given alg is not supported."""
+    for a in algs:
+        if a not in SUPPORTED_ALGORITHMS:
+            raise UnsupportedAlgError(f"unsupported signing algorithm {a!r}")
+
+
+def supported(algs: Iterable[Alg]) -> bool:
+    return all(a in SUPPORTED_ALGORITHMS for a in algs)

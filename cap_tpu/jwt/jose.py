@@ -1,0 +1,138 @@
+"""JOSE compact-serialization (JWS) parsing.
+
+The reference delegates to go-jose's ``jose.ParseSigned``
+(jwt/jwt.go:212, jwt/keyset.go:155); this is a from-scratch strict
+parser for the compact form ``b64url(header).b64url(payload).b64url(sig)``
+per RFC 7515:
+- exactly three dot-separated segments;
+- base64url *without* padding, no whitespace;
+- the protected header must be a JSON object;
+- the ``alg`` header must be present and a string.
+
+A native C++ batch version of this parse lives in cap_tpu/runtime; this
+module is the reference implementation and single-token path.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+from dataclasses import dataclass
+from typing import Any, Dict
+
+from ..errors import MalformedTokenError, TokenNotSignedError
+
+_B64URL_CHARS = frozenset(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_"
+)
+
+
+def b64url_decode(segment: str) -> bytes:
+    """Strict unpadded base64url decode (RFC 7515 §2)."""
+    if not set(segment) <= _B64URL_CHARS:
+        raise MalformedTokenError("illegal base64url character")
+    if len(segment) % 4 == 1:
+        raise MalformedTokenError("illegal base64url length")
+    pad = "=" * (-len(segment) % 4)
+    try:
+        return base64.urlsafe_b64decode(segment + pad)
+    except (binascii.Error, ValueError) as e:
+        raise MalformedTokenError(f"invalid base64url segment: {e}") from e
+
+
+def b64url_encode(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode("ascii")
+
+
+@dataclass(frozen=True)
+class ParsedJWS:
+    """A parsed (but unverified) compact JWS."""
+
+    header: Dict[str, Any]       # decoded protected header
+    payload: bytes               # decoded payload bytes
+    signature: bytes             # decoded signature bytes
+    signing_input: bytes         # ascii(b64(header) + "." + b64(payload))
+
+    @property
+    def alg(self) -> str:
+        return self.header["alg"]
+
+    @property
+    def kid(self) -> str | None:
+        kid = self.header.get("kid")
+        return kid if isinstance(kid, str) else None
+
+    def claims(self) -> Dict[str, Any]:
+        """Decode the payload as a JSON claims object (unverified)."""
+        try:
+            claims = json.loads(self.payload)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise MalformedTokenError(f"payload is not valid JSON: {e}") from e
+        if not isinstance(claims, dict):
+            raise MalformedTokenError("payload is not a JSON object")
+        return claims
+
+
+def peek_alg(token: str) -> str:
+    """Return the alg header of a compact JWS, enforcing the same
+    structural rules as :func:`parse_compact` but without decoding the
+    payload segment (cheap header-only inspection)."""
+    if not isinstance(token, str) or not token:
+        raise MalformedTokenError("token is empty")
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise MalformedTokenError(
+            f"compact JWS must have 3 segments, found {len(parts)}"
+        )
+    raw_header, raw_payload, raw_sig = parts
+    header_bytes = b64url_decode(raw_header)
+    try:
+        header = json.loads(header_bytes)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MalformedTokenError(f"protected header is not valid JSON: {e}") from e
+    if not isinstance(header, dict):
+        raise MalformedTokenError("protected header is not a JSON object")
+    alg = header.get("alg")
+    if not isinstance(alg, str) or not alg:
+        raise MalformedTokenError("protected header missing alg parameter")
+    # Validate payload/signature segment charsets without decoding bytes.
+    for seg in (raw_payload, raw_sig):
+        if not set(seg) <= _B64URL_CHARS or len(seg) % 4 == 1:
+            raise MalformedTokenError("illegal base64url segment")
+    if not raw_sig:
+        raise TokenNotSignedError("token must be signed")
+    return alg
+
+
+def parse_compact(token: str) -> ParsedJWS:
+    """Parse a compact-serialization JWS without verifying it."""
+    if not isinstance(token, str) or not token:
+        raise MalformedTokenError("token is empty")
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise MalformedTokenError(
+            f"compact JWS must have 3 segments, found {len(parts)}"
+        )
+    raw_header, raw_payload, raw_sig = parts
+    header_bytes = b64url_decode(raw_header)
+    try:
+        header = json.loads(header_bytes)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MalformedTokenError(f"protected header is not valid JSON: {e}") from e
+    if not isinstance(header, dict):
+        raise MalformedTokenError("protected header is not a JSON object")
+    alg = header.get("alg")
+    if not isinstance(alg, str) or not alg:
+        raise MalformedTokenError("protected header missing alg parameter")
+    payload = b64url_decode(raw_payload)
+    signature = b64url_decode(raw_sig)
+    if len(signature) == 0:
+        raise TokenNotSignedError("token must be signed")
+    signing_input = (raw_header + "." + raw_payload).encode("ascii")
+    return ParsedJWS(
+        header=header,
+        payload=payload,
+        signature=signature,
+        signing_input=signing_input,
+    )

@@ -1,0 +1,130 @@
+"""JWK (RFC 7517/7518) parse and serialize.
+
+The reference gets this from coreos go-oidc's RemoteKeySet; here it is
+implemented directly: RSA (kty=RSA: n,e), EC (kty=EC: crv,x,y on
+P-256/P-384/P-521), and OKP Ed25519 (kty=OKP, crv=Ed25519: x).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
+
+from ..errors import InvalidJWKSError
+from .jose import b64url_decode, b64url_encode
+
+_CURVES = {
+    "P-256": (ec.SECP256R1, 32),
+    "P-384": (ec.SECP384R1, 48),
+    "P-521": (ec.SECP521R1, 66),
+}
+_CURVE_NAME_FOR_KEY = {"secp256r1": "P-256", "secp384r1": "P-384", "secp521r1": "P-521"}
+
+
+class JWK:
+    """One JSON Web Key: the parsed public key plus JOSE metadata."""
+
+    def __init__(self, key, kid: Optional[str] = None, alg: Optional[str] = None,
+                 use: Optional[str] = None):
+        self.key = key
+        self.kid = kid
+        self.alg = alg
+        self.use = use
+
+    def __repr__(self) -> str:
+        return f"JWK(kid={self.kid!r}, alg={self.alg!r}, type={type(self.key).__name__})"
+
+
+def _b64_uint(data: Dict[str, Any], field: str) -> int:
+    v = data.get(field)
+    if not isinstance(v, str):
+        raise InvalidJWKSError(f"jwk missing field {field!r}")
+    return int.from_bytes(b64url_decode(v), "big")
+
+
+def parse_jwk(data: Dict[str, Any]) -> JWK:
+    """Parse one JWK dict into a JWK with a usable public key."""
+    kty = data.get("kty")
+    if kty == "RSA":
+        n = _b64_uint(data, "n")
+        e = _b64_uint(data, "e")
+        try:
+            key = rsa.RSAPublicNumbers(e, n).public_key()
+        except ValueError as err:
+            raise InvalidJWKSError(f"invalid RSA jwk: {err}") from err
+    elif kty == "EC":
+        crv = data.get("crv")
+        if crv not in _CURVES:
+            raise InvalidJWKSError(f"unsupported EC curve {crv!r}")
+        curve_cls, _ = _CURVES[crv]
+        x = _b64_uint(data, "x")
+        y = _b64_uint(data, "y")
+        try:
+            key = ec.EllipticCurvePublicNumbers(x, y, curve_cls()).public_key()
+        except ValueError as err:
+            raise InvalidJWKSError(f"invalid EC jwk: {err}") from err
+    elif kty == "OKP":
+        if data.get("crv") != "Ed25519":
+            raise InvalidJWKSError(f"unsupported OKP curve {data.get('crv')!r}")
+        raw = data.get("x")
+        if not isinstance(raw, str):
+            raise InvalidJWKSError("jwk missing field 'x'")
+        try:
+            key = ed25519.Ed25519PublicKey.from_public_bytes(b64url_decode(raw))
+        except ValueError as err:
+            raise InvalidJWKSError(f"invalid Ed25519 jwk: {err}") from err
+    else:
+        raise InvalidJWKSError(f"unsupported jwk kty {kty!r}")
+    kid = data.get("kid") if isinstance(data.get("kid"), str) else None
+    alg = data.get("alg") if isinstance(data.get("alg"), str) else None
+    use = data.get("use") if isinstance(data.get("use"), str) else None
+    return JWK(key, kid=kid, alg=alg, use=use)
+
+
+def parse_jwks(document: Dict[str, Any]) -> List[JWK]:
+    """Parse a JWKS document ``{"keys": [...]}``."""
+    keys = document.get("keys")
+    if not isinstance(keys, list):
+        raise InvalidJWKSError("jwks document missing 'keys' array")
+    out: List[JWK] = []
+    for entry in keys:
+        if not isinstance(entry, dict):
+            raise InvalidJWKSError("jwks entry is not an object")
+        out.append(parse_jwk(entry))
+    return out
+
+
+def _uint_b64(v: int, length: Optional[int] = None) -> str:
+    n = length if length is not None else max(1, (v.bit_length() + 7) // 8)
+    return b64url_encode(v.to_bytes(n, "big"))
+
+
+def serialize_public_key(key, kid: Optional[str] = None,
+                         alg: Optional[str] = None) -> Dict[str, Any]:
+    """Serialize a public key into a JWK dict (used by the fake IdP and tests)."""
+    out: Dict[str, Any] = {"use": "sig"}
+    if kid:
+        out["kid"] = kid
+    if alg:
+        out["alg"] = alg
+    if isinstance(key, rsa.RSAPublicKey):
+        nums = key.public_numbers()
+        out.update({"kty": "RSA", "n": _uint_b64(nums.n), "e": _uint_b64(nums.e)})
+    elif isinstance(key, ec.EllipticCurvePublicKey):
+        nums = key.public_numbers()
+        crv = _CURVE_NAME_FOR_KEY[key.curve.name]
+        size = _CURVES[crv][1]
+        out.update({
+            "kty": "EC", "crv": crv,
+            "x": _uint_b64(nums.x, size), "y": _uint_b64(nums.y, size),
+        })
+    elif isinstance(key, ed25519.Ed25519PublicKey):
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat,
+        )
+        raw = key.public_bytes(Encoding.Raw, PublicFormat.Raw)
+        out.update({"kty": "OKP", "crv": "Ed25519", "x": b64url_encode(raw)})
+    else:
+        raise InvalidJWKSError(f"cannot serialize key type {type(key).__name__}")
+    return out

@@ -1,0 +1,198 @@
+"""KeySet implementations.
+
+``KeySet`` is THE seam the TPU backend plugs into — the analog of the
+reference's interface at jwt/keyset.go:27-32. Three CPU implementations
+mirror the reference:
+
+- :class:`StaticKeySet` — local public keys, trial-verified in order
+  (jwt/keyset.go:142-173 semantics: no kid routing).
+- :class:`JSONWebKeySet` — remote JWKS URL with kid-matched key cache and
+  refetch-on-miss (the behavior of coreos go-oidc's RemoteKeySet that
+  jwt/keyset.go:109-139 wraps).
+- :func:`new_oidc_discovery_keyset` — OIDC discovery → JWKS
+  (jwt/keyset.go:49-103, including the returned-issuer equality check).
+
+The TPU-accelerated implementation (``TPUBatchKeySet``) lives in
+cap_tpu/jwt/tpu_keyset.py and adds ``verify_batch``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..errors import (
+    InvalidJWKSError,
+    InvalidParameterError,
+    InvalidSignatureError,
+    NilParameterError,
+)
+from ..utils import http as _http
+from .jose import ParsedJWS, parse_compact
+from .jwk import JWK, parse_jwks
+from .verify import key_matches_alg, verify_parsed
+
+
+class KeySet:
+    """Verifies JWT signatures; returns the verified (still unvalidated) claims.
+
+    Subclasses implement :meth:`verify_signature`. Implementations that
+    can batch (the TPU backend) additionally implement
+    :meth:`verify_batch`; the default loops over tokens.
+    """
+
+    def verify_signature(self, token: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        """Verify many tokens; returns one entry per token: either the
+        claims dict or the exception that token failed with. Never raises
+        for per-token failures."""
+        out: List[Any] = []
+        for t in tokens:
+            try:
+                out.append(self.verify_signature(t))
+            except Exception as e:  # noqa: BLE001 - per-token error channel
+                out.append(e)
+        return out
+
+
+class StaticKeySet(KeySet):
+    """KeySet backed by a local list of public keys.
+
+    Matches the reference's trial-verification semantics: every key is
+    tried in order until one verifies (O(keys) signature checks worst
+    case, no kid routing).
+    """
+
+    def __init__(self, public_keys: Sequence[object]):
+        if not public_keys:
+            raise NilParameterError("public keys are required")
+        self._keys = list(public_keys)
+
+    def verify_signature(self, token: str) -> Dict[str, Any]:
+        parsed = parse_compact(token)
+        last_err: Optional[Exception] = None
+        for key in self._keys:
+            try:
+                verify_parsed(parsed, key)
+                return parsed.claims()
+            except InvalidSignatureError as e:
+                last_err = e
+        raise InvalidSignatureError(
+            "no known key successfully validated the token signature"
+        ) from last_err
+
+
+class JSONWebKeySet(KeySet):
+    """KeySet backed by a remote JWKS endpoint.
+
+    Keys are cached; a verification that finds no usable cached key for
+    the token's kid triggers one refetch (key-rotation handling), the
+    same observable behavior as the coreos RemoteKeySet the reference
+    wraps. Thread-safe.
+    """
+
+    def __init__(self, jwks_url: str, jwks_ca_pem: Optional[str] = None):
+        if not jwks_url:
+            raise NilParameterError("jwks_url is required")
+        self._url = jwks_url
+        self._ssl_ctx = _http.ssl_context_for_ca(jwks_ca_pem)
+        self._lock = threading.Lock()
+        self._keys: Optional[List[JWK]] = None
+
+    # -- key cache ---------------------------------------------------------
+
+    def _fetch(self) -> List[JWK]:
+        status, body, _ = _http.get(self._url, self._ssl_ctx)
+        if status != 200:
+            raise InvalidJWKSError(f"jwks fetch failed: status {status}")
+        try:
+            doc = json.loads(body)
+        except ValueError as e:
+            raise InvalidJWKSError(f"jwks is not valid JSON: {e}") from e
+        if not isinstance(doc, dict):
+            raise InvalidJWKSError("jwks is not a JSON object")
+        keys = parse_jwks(doc)
+        with self._lock:
+            self._keys = keys
+        return keys
+
+    def keys(self, refresh: bool = False) -> List[JWK]:
+        with self._lock:
+            cached = self._keys
+        if cached is None or refresh:
+            return self._fetch()
+        return cached
+
+    # -- verification ------------------------------------------------------
+
+    @staticmethod
+    def _candidates(keys: List[JWK], parsed: ParsedJWS) -> List[JWK]:
+        out = []
+        for jwk in keys:
+            if jwk.use not in (None, "", "sig"):
+                continue
+            if parsed.kid is not None and jwk.kid is not None and jwk.kid != parsed.kid:
+                continue
+            if not key_matches_alg(jwk.key, parsed.alg):
+                continue
+            out.append(jwk)
+        return out
+
+    def verify_signature(self, token: str) -> Dict[str, Any]:
+        parsed = parse_compact(token)
+        keys = self.keys()
+        candidates = self._candidates(keys, parsed)
+        last_err: Optional[Exception] = None
+        for jwk in candidates:
+            try:
+                verify_parsed(parsed, jwk.key)
+                return parsed.claims()
+            except InvalidSignatureError as e:
+                last_err = e
+        # kid miss or all candidates failed: refetch once (key rotation).
+        keys = self.keys(refresh=True)
+        for jwk in self._candidates(keys, parsed):
+            try:
+                verify_parsed(parsed, jwk.key)
+                return parsed.claims()
+            except InvalidSignatureError as e:
+                last_err = e
+        raise InvalidSignatureError(
+            "failed to verify id token signature"
+        ) from last_err
+
+
+def new_oidc_discovery_keyset(issuer: str,
+                              issuer_ca_pem: Optional[str] = None) -> JSONWebKeySet:
+    """Build a JWKS keyset from an issuer's OIDC discovery document.
+
+    Fetches ``{issuer}/.well-known/openid-configuration``, requires the
+    document's ``issuer`` to equal the requested issuer, and returns a
+    :class:`JSONWebKeySet` on the advertised ``jwks_uri``.
+    """
+    if not issuer:
+        raise NilParameterError("issuer is required")
+    ctx = _http.ssl_context_for_ca(issuer_ca_pem)
+    well_known = issuer.rstrip("/") + "/.well-known/openid-configuration"
+    status, body, _ = _http.get(well_known, ctx)
+    if status != 200:
+        raise InvalidParameterError(
+            f"discovery request failed: status {status}"
+        )
+    try:
+        doc = json.loads(body)
+    except ValueError as e:
+        raise InvalidParameterError(f"discovery document is not JSON: {e}") from e
+    got_issuer = doc.get("issuer")
+    if got_issuer != issuer:
+        raise InvalidParameterError(
+            f"oidc issuer did not match the issuer returned by provider, "
+            f"expected {issuer!r} got {got_issuer!r}"
+        )
+    jwks_uri = doc.get("jwks_uri")
+    if not isinstance(jwks_uri, str) or not jwks_uri:
+        raise InvalidParameterError("discovery document missing jwks_uri")
+    return JSONWebKeySet(jwks_uri, jwks_ca_pem=issuer_ca_pem)

@@ -1,0 +1,39 @@
+"""PEM public-key parsing (parity with jwt/keyset.go:178-200).
+
+Accepts a PKIX ``PUBLIC KEY`` block or an x509 ``CERTIFICATE`` block and
+returns the contained RSA / ECDSA / Ed25519 public key.
+"""
+
+from __future__ import annotations
+
+from cryptography import x509
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
+from cryptography.hazmat.primitives.serialization import load_pem_public_key
+
+from ..errors import InvalidParameterError
+
+PublicKey = object  # rsa.RSAPublicKey | ec.EllipticCurvePublicKey | ed25519.Ed25519PublicKey
+
+
+def parse_public_key_pem(pem: str | bytes) -> PublicKey:
+    """Parse a PEM-encoded public key or certificate into a public key."""
+    if isinstance(pem, str):
+        pem = pem.encode("utf-8")
+    if b"CERTIFICATE" in pem:
+        try:
+            cert = x509.load_pem_x509_certificate(pem)
+        except ValueError as e:
+            raise InvalidParameterError(f"failed to parse certificate: {e}") from e
+        key = cert.public_key()
+    else:
+        try:
+            key = load_pem_public_key(pem)
+        except (ValueError, TypeError) as e:
+            raise InvalidParameterError(f"failed to parse public key PEM: {e}") from e
+    if not isinstance(
+        key, (rsa.RSAPublicKey, ec.EllipticCurvePublicKey, ed25519.Ed25519PublicKey)
+    ):
+        raise InvalidParameterError(
+            "unsupported public key type (want RSA, ECDSA, or Ed25519)"
+        )
+    return key

@@ -1,0 +1,101 @@
+"""Single-token signature verification on CPU via the ``cryptography`` package.
+
+This is the correctness oracle and default execution path — the analog of
+the reference's go-jose → Go stdlib crypto pipeline
+(jwt/keyset.go:126-139,154-173 → crypto/{rsa,ecdsa,ed25519}). The TPU
+batch engine (cap_tpu/tpu) must match it bit-for-bit, on failures as
+well as successes.
+"""
+
+from __future__ import annotations
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
+from cryptography.hazmat.primitives.asymmetric.utils import encode_dss_signature
+
+from ..errors import InvalidSignatureError, UnsupportedAlgError
+from . import algs
+from .jose import ParsedJWS
+
+_HASHES = {
+    "sha256": hashes.SHA256,
+    "sha384": hashes.SHA384,
+    "sha512": hashes.SHA512,
+}
+
+# ES* algorithms pin both the curve and the raw signature coordinate size
+# (RFC 7518 §3.4): ES256→P-256/32B, ES384→P-384/48B, ES512→P-521/66B.
+_EC_CURVE_FOR_ALG = {
+    algs.ES256: ("secp256r1", 32),
+    algs.ES384: ("secp384r1", 48),
+    algs.ES512: ("secp521r1", 66),
+}
+
+
+def _hash_cls(alg: str):
+    return _HASHES[algs.HASH_FOR_ALG[alg]]
+
+
+def key_matches_alg(key, alg: str) -> bool:
+    """Whether the key type is usable with the given JOSE alg."""
+    if alg in (algs.RS256, algs.RS384, algs.RS512,
+               algs.PS256, algs.PS384, algs.PS512):
+        return isinstance(key, rsa.RSAPublicKey)
+    if alg in _EC_CURVE_FOR_ALG:
+        return (
+            isinstance(key, ec.EllipticCurvePublicKey)
+            and key.curve.name == _EC_CURVE_FOR_ALG[alg][0]
+        )
+    if alg == algs.EdDSA:
+        return isinstance(key, ed25519.Ed25519PublicKey)
+    return False
+
+
+def verify_parsed(parsed: ParsedJWS, key) -> None:
+    """Verify ``parsed.signature`` over ``parsed.signing_input`` with ``key``.
+
+    Raises InvalidSignatureError on any mismatch (wrong key, tampered
+    content, malformed signature encoding, wrong curve/key type).
+    """
+    alg = parsed.alg
+    if alg not in algs.SUPPORTED_ALGORITHMS:
+        raise UnsupportedAlgError(f"unsupported signing algorithm {alg!r}")
+    if not key_matches_alg(key, alg):
+        raise InvalidSignatureError(f"key type does not match alg {alg}")
+
+    try:
+        if alg in (algs.RS256, algs.RS384, algs.RS512):
+            key.verify(
+                parsed.signature, parsed.signing_input,
+                padding.PKCS1v15(), _hash_cls(alg)(),
+            )
+        elif alg in (algs.PS256, algs.PS384, algs.PS512):
+            h = _hash_cls(alg)
+            # Verify with AUTO salt-length recovery: the reference's
+            # rsa.VerifyPSS path accepts any salt length, and real-world
+            # signers commonly use max-length salts.
+            key.verify(
+                parsed.signature, parsed.signing_input,
+                padding.PSS(mgf=padding.MGF1(h()), salt_length=padding.PSS.AUTO),
+                h(),
+            )
+        elif alg in _EC_CURVE_FOR_ALG:
+            _, coord = _EC_CURVE_FOR_ALG[alg]
+            sig = parsed.signature
+            if len(sig) != 2 * coord:
+                raise InvalidSignatureError(
+                    f"bad ECDSA signature length {len(sig)} for {alg}"
+                )
+            r = int.from_bytes(sig[:coord], "big")
+            s = int.from_bytes(sig[coord:], "big")
+            key.verify(
+                encode_dss_signature(r, s), parsed.signing_input,
+                ec.ECDSA(_hash_cls(alg)()),
+            )
+        else:  # EdDSA
+            key.verify(parsed.signature, parsed.signing_input)
+    except InvalidSignature as e:
+        raise InvalidSignatureError("signature verification failed") from e
+    except ValueError as e:
+        raise InvalidSignatureError(f"signature verification failed: {e}") from e

@@ -1,0 +1,136 @@
+"""Test infrastructure: key generation, JWT signing, CA generation.
+
+Analog of the reference's exported test helpers (oidc/testing.go:29-112:
+TestGenerateKeys, TestSignJWT, TestGenerateCA), usable both by this
+repo's tests and by users of the framework. Signing exists ONLY to
+produce fixtures — the framework's job is verification.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
+from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+from cryptography.x509.oid import NameOID
+
+from .jwt import algs
+from .jwt.jose import b64url_encode
+
+_EC_CURVE = {
+    algs.ES256: (ec.SECP256R1, 32),
+    algs.ES384: (ec.SECP384R1, 48),
+    algs.ES512: (ec.SECP521R1, 66),
+}
+_HASH = {
+    "sha256": hashes.SHA256,
+    "sha384": hashes.SHA384,
+    "sha512": hashes.SHA512,
+}
+
+
+def generate_keys(alg: str = algs.ES256, rsa_bits: int = 2048):
+    """Generate a (private, public) key pair suitable for ``alg``."""
+    if alg in (algs.RS256, algs.RS384, algs.RS512,
+               algs.PS256, algs.PS384, algs.PS512):
+        priv = rsa.generate_private_key(public_exponent=65537, key_size=rsa_bits)
+    elif alg in _EC_CURVE:
+        priv = ec.generate_private_key(_EC_CURVE[alg][0]())
+    elif alg == algs.EdDSA:
+        priv = ed25519.Ed25519PrivateKey.generate()
+    else:
+        raise ValueError(f"unsupported alg {alg!r}")
+    return priv, priv.public_key()
+
+
+def sign_jwt(priv, alg: str, claims: Dict[str, Any],
+             kid: Optional[str] = None,
+             extra_headers: Optional[Dict[str, Any]] = None) -> str:
+    """Sign ``claims`` into a compact JWS with the given private key."""
+    header: Dict[str, Any] = {"alg": alg, "typ": "JWT"}
+    if kid:
+        header["kid"] = kid
+    if extra_headers:
+        header.update(extra_headers)
+    signing_input = (
+        b64url_encode(json.dumps(header, separators=(",", ":")).encode())
+        + "."
+        + b64url_encode(json.dumps(claims, separators=(",", ":")).encode())
+    ).encode("ascii")
+
+    hash_cls = _HASH[algs.HASH_FOR_ALG[alg]]
+    if alg in (algs.RS256, algs.RS384, algs.RS512):
+        sig = priv.sign(signing_input, padding.PKCS1v15(), hash_cls())
+    elif alg in (algs.PS256, algs.PS384, algs.PS512):
+        sig = priv.sign(
+            signing_input,
+            padding.PSS(mgf=padding.MGF1(hash_cls()),
+                        salt_length=hash_cls.digest_size),
+            hash_cls(),
+        )
+    elif alg in _EC_CURVE:
+        coord = _EC_CURVE[alg][1]
+        der = priv.sign(signing_input, ec.ECDSA(hash_cls()))
+        r, s = decode_dss_signature(der)
+        sig = r.to_bytes(coord, "big") + s.to_bytes(coord, "big")
+    elif alg == algs.EdDSA:
+        sig = priv.sign(signing_input)
+    else:
+        raise ValueError(f"unsupported alg {alg!r}")
+    return signing_input.decode("ascii") + "." + b64url_encode(sig)
+
+
+def default_claims(issuer: str = "https://example.com/", sub: str = "alice",
+                   aud=("client-id",), now: Optional[float] = None,
+                   ttl: float = 300.0, **extra) -> Dict[str, Any]:
+    """A standard valid claims set for test JWTs."""
+    import time
+
+    t = now if now is not None else time.time()
+    claims: Dict[str, Any] = {
+        "iss": issuer,
+        "sub": sub,
+        "aud": list(aud),
+        "iat": int(t),
+        "nbf": int(t),
+        "exp": int(t + ttl),
+    }
+    claims.update(extra)
+    return claims
+
+
+def generate_ca(common_name: str = "cap-tpu-test-ca") -> Tuple[str, Any, str]:
+    """Generate a self-signed CA; returns (cert_pem, private_key, key_pem)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName("localhost"),
+                x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM).decode()
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+    return cert_pem, key, key_pem

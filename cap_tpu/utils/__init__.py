@@ -1,0 +1,4 @@
+from .base62 import random_base62
+from .strutils import str_list_contains, remove_duplicates_stable
+
+__all__ = ["random_base62", "str_list_contains", "remove_duplicates_stable"]

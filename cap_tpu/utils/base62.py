@@ -1,0 +1,20 @@
+"""Unbiased random base62 strings.
+
+Equivalent of the reference's oidc/internal/base62 (base62.go:12-50):
+rejection-sampled uniform characters (~5.95 bits/char) from a CSPRNG.
+Python's ``secrets.choice`` already rejection-samples internally, so the
+implementation is a straight comprehension over the charset.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+CHARSET = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+
+
+def random_base62(length: int) -> str:
+    """Return a cryptographically random base62 string of ``length`` chars."""
+    if length < 0:
+        raise ValueError("length must be non-negative")
+    return "".join(secrets.choice(CHARSET) for _ in range(length))

@@ -1,0 +1,81 @@
+"""Small HTTP helpers with optional CA pinning.
+
+The reference builds pooled cleanhttp transports with custom RootCAs
+(jwt/keyset.go:204-225, oidc/provider.go:566-618); the Python analog is a
+shared ssl.SSLContext built from the provided CA PEM, used for every
+request a keyset/provider makes.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from ..errors import InvalidCACertError
+
+
+def ssl_context_for_ca(ca_pem: Optional[str]) -> Optional[ssl.SSLContext]:
+    """Build an SSLContext trusting only ``ca_pem`` (None → system default)."""
+    if not ca_pem:
+        return None
+    ctx = ssl.create_default_context()
+    try:
+        ctx.load_verify_locations(cadata=ca_pem)
+    except ssl.SSLError as e:
+        raise InvalidCACertError(f"could not load CA PEM: {e}") from e
+    return ctx
+
+
+def get(url: str, ctx: Optional[ssl.SSLContext] = None,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: float = 30.0) -> Tuple[int, bytes, Dict[str, str]]:
+    """GET a URL; returns (status, body, lowercased headers)."""
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+            return (
+                resp.status,
+                resp.read(),
+                {k.lower(): v for k, v in resp.headers.items()},
+            )
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), {k.lower(): v for k, v in e.headers.items()}
+
+
+def get_json(url: str, ctx: Optional[ssl.SSLContext] = None,
+             timeout: float = 30.0) -> Any:
+    status, body, headers = get(url, ctx, timeout=timeout)
+    if status != 200:
+        raise RuntimeError(f"GET {url}: unexpected status {status}: {body[:200]!r}")
+    content_type = headers.get("content-type", "")
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise RuntimeError(
+            f"GET {url}: expected JSON (content-type {content_type!r}): {e}"
+        ) from e
+
+
+def post_form(url: str, fields: Dict[str, str],
+              ctx: Optional[ssl.SSLContext] = None,
+              headers: Optional[Dict[str, str]] = None,
+              timeout: float = 30.0) -> Tuple[int, bytes, Dict[str, str]]:
+    """POST application/x-www-form-urlencoded fields."""
+    from urllib.parse import urlencode
+
+    data = urlencode(fields).encode("ascii")
+    hdrs = {"Content-Type": "application/x-www-form-urlencoded"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=hdrs, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
+            return (
+                resp.status,
+                resp.read(),
+                {k.lower(): v for k, v in resp.headers.items()},
+            )
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), {k.lower(): v for k, v in e.headers.items()}

@@ -1,0 +1,27 @@
+import pytest
+
+from cap_tpu.errors import UnsupportedAlgError
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.algs import supported_signing_algorithm
+
+
+def test_all_ten_supported():
+    # The same ten asymmetric algorithms as the reference (jwt/algs.go:6-22).
+    assert algs.SUPPORTED_ALGORITHMS == {
+        "RS256", "RS384", "RS512",
+        "ES256", "ES384", "ES512",
+        "PS256", "PS384", "PS512",
+        "EdDSA",
+    }
+    supported_signing_algorithm(*algs.SUPPORTED_ALGORITHMS)
+
+
+@pytest.mark.parametrize("bad", ["none", "HS256", "HS384", "HS512", "rs256", "", "ES521"])
+def test_unsupported_rejected(bad):
+    with pytest.raises(UnsupportedAlgError):
+        supported_signing_algorithm(bad)
+
+
+def test_mixed_lists_rejected():
+    with pytest.raises(UnsupportedAlgError):
+        supported_signing_algorithm("RS256", "none")

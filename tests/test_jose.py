@@ -1,0 +1,58 @@
+import pytest
+
+from cap_tpu.errors import MalformedTokenError, TokenNotSignedError
+from cap_tpu.jwt.jose import b64url_decode, b64url_encode, parse_compact
+from cap_tpu import testing as captest
+from cap_tpu.jwt import algs
+
+
+def test_b64url_roundtrip():
+    for data in [b"", b"a", b"ab", b"abc", bytes(range(256))]:
+        assert b64url_decode(b64url_encode(data)) == data
+
+
+def test_b64url_rejects_padding_and_junk():
+    with pytest.raises(MalformedTokenError):
+        b64url_decode("aGk=")  # explicit padding is illegal in JWS segments
+    with pytest.raises(MalformedTokenError):
+        b64url_decode("a+b/")  # std alphabet not allowed
+    with pytest.raises(MalformedTokenError):
+        b64url_decode("aaaaa")  # length % 4 == 1 is never valid
+
+
+def test_parse_compact_valid():
+    priv, _ = captest.generate_keys(algs.ES256)
+    token = captest.sign_jwt(priv, algs.ES256, {"sub": "x"}, kid="k1")
+    parsed = parse_compact(token)
+    assert parsed.alg == "ES256"
+    assert parsed.kid == "k1"
+    assert parsed.claims() == {"sub": "x"}
+    assert parsed.signing_input.decode() == token.rsplit(".", 1)[0]
+
+
+@pytest.mark.parametrize("bad", [
+    "", "onlyone", "a.b", "a.b.c.d",
+    "!!!.e30.sig", "e30.!!!.c2ln",
+])
+def test_parse_compact_malformed(bad):
+    with pytest.raises(MalformedTokenError):
+        parse_compact(bad)
+
+
+def test_parse_compact_unsigned_rejected():
+    # alg=none style token with empty signature segment
+    header = b64url_encode(b'{"alg":"none"}')
+    payload = b64url_encode(b'{"sub":"x"}')
+    with pytest.raises(TokenNotSignedError):
+        parse_compact(f"{header}.{payload}.")
+
+
+def test_parse_compact_header_must_be_object_with_alg():
+    payload = b64url_encode(b"{}")
+    sig = b64url_encode(b"sig")
+    with pytest.raises(MalformedTokenError):
+        parse_compact(f"{b64url_encode(b'[1]')}.{payload}.{sig}")
+    with pytest.raises(MalformedTokenError):
+        parse_compact(f"{b64url_encode(b'{}')}.{payload}.{sig}")
+    with pytest.raises(MalformedTokenError):
+        parse_compact(f"{b64url_encode(b'not json')}.{payload}.{sig}")

@@ -1,0 +1,211 @@
+"""Validator claims-engine conformance (reference: jwt/jwt_test.go tables)."""
+
+import time
+
+import pytest
+
+from cap_tpu import testing as captest
+from cap_tpu.errors import (
+    ExpiredTokenError,
+    InvalidAudienceError,
+    InvalidIssuedAtError,
+    InvalidIssuerError,
+    InvalidNotBeforeError,
+    InvalidParameterError,
+    InvalidSignatureError,
+    MissingClaimError,
+    NilParameterError,
+    UnsupportedAlgError,
+)
+from cap_tpu.jwt import Expected, StaticKeySet, Validator
+from cap_tpu.jwt.validator import validate_audience
+
+
+@pytest.fixture(scope="module")
+def rs_keys():
+    return captest.generate_keys("RS256")
+
+
+@pytest.fixture(scope="module")
+def es_keys():
+    return captest.generate_keys("ES256")
+
+
+def _validator(pub):
+    return Validator(StaticKeySet([pub]))
+
+
+NOW = 1_700_000_000.0
+
+
+def _expected(**kw):
+    kw.setdefault("now", lambda: NOW)
+    return Expected(**kw)
+
+
+def _claims(**kw):
+    base = {"iss": "https://issuer/", "sub": "alice", "aud": ["aud1"],
+            "iat": int(NOW) - 10, "nbf": int(NOW) - 10, "exp": int(NOW) + 300}
+    base.update(kw)
+    return {k: v for k, v in base.items() if v is not None}
+
+
+def test_requires_keyset():
+    with pytest.raises(NilParameterError):
+        Validator(None)
+
+
+def test_valid_roundtrip(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(priv, "RS256", _claims())
+    claims = _validator(pub).validate(token, _expected(
+        issuer="https://issuer/", subject="alice", audiences=["aud1"],
+        signing_algorithms=["RS256"],
+    ))
+    assert claims["sub"] == "alice"
+
+
+def test_default_alg_is_rs256(rs_keys, es_keys):
+    rs_priv, rs_pub = rs_keys
+    es_priv, es_pub = es_keys
+    token = captest.sign_jwt(rs_priv, "RS256", _claims())
+    # No signing_algorithms given → RS256 expected by default.
+    assert _validator(rs_pub).validate(token, _expected())
+    es_token = captest.sign_jwt(es_priv, "ES256", _claims())
+    with pytest.raises(UnsupportedAlgError):
+        _validator(es_pub).validate(es_token, _expected())
+
+
+def test_unexpected_alg_rejected(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(priv, "RS256", _claims())
+    with pytest.raises(UnsupportedAlgError):
+        _validator(pub).validate(token, _expected(signing_algorithms=["ES256"]))
+    with pytest.raises(UnsupportedAlgError):
+        _validator(pub).validate(token, _expected(signing_algorithms=["none"]))
+
+
+def test_bad_signature_rejected(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(priv, "RS256", _claims())
+    with pytest.raises(InvalidSignatureError):
+        _validator(pub).validate(token[:-6] + "AAAAAA", _expected())
+
+
+def test_wrong_issuer_subject_jti(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(priv, "RS256", _claims(jti="id-1"))
+    v = _validator(pub)
+    assert v.validate(token, _expected(issuer="https://issuer/", id="id-1"))
+    with pytest.raises(InvalidIssuerError):
+        v.validate(token, _expected(issuer="https://other/"))
+    with pytest.raises(InvalidParameterError):
+        v.validate(token, _expected(subject="bob"))
+    with pytest.raises(InvalidParameterError):
+        v.validate(token, _expected(id="id-2"))
+
+
+def test_audience_matching(rs_keys):
+    priv, pub = rs_keys
+    v = _validator(pub)
+    token = captest.sign_jwt(priv, "RS256", _claims(aud=["a", "b"]))
+    assert v.validate(token, _expected(audiences=["b", "z"]))
+    with pytest.raises(InvalidAudienceError):
+        v.validate(token, _expected(audiences=["z"]))
+    # string aud claim form
+    token2 = captest.sign_jwt(priv, "RS256", _claims(aud="solo"))
+    assert v.validate(token2, _expected(audiences=["solo"]))
+
+
+def test_validate_audience_empty_expected_skips():
+    validate_audience([], ["anything"])
+    validate_audience([], [])
+
+
+def test_expired_token(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(priv, "RS256", _claims(exp=int(NOW) - 3600))
+    with pytest.raises(ExpiredTokenError):
+        _validator(pub).validate(token, _expected())
+
+
+def test_exp_within_clock_skew_ok(rs_keys):
+    priv, pub = rs_keys
+    # expired 30s ago but default 60s clock skew applies
+    token = captest.sign_jwt(priv, "RS256", _claims(exp=int(NOW) - 30))
+    assert _validator(pub).validate(token, _expected())
+    with pytest.raises(ExpiredTokenError):
+        _validator(pub).validate(token, _expected(clock_skew_leeway=-1))
+
+
+def test_not_yet_valid(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(priv, "RS256", _claims(nbf=int(NOW) + 3600))
+    with pytest.raises(InvalidNotBeforeError):
+        _validator(pub).validate(token, _expected())
+
+
+def test_issued_in_future(rs_keys):
+    priv, pub = rs_keys
+    # nbf must be valid on its own: with nbf absent it would default to the
+    # (future) iat and the nbf check would fire first, masking the iat check.
+    token = captest.sign_jwt(
+        priv, "RS256", _claims(iat=int(NOW) + 3600, nbf=int(NOW) - 10)
+    )
+    with pytest.raises(InvalidIssuedAtError):
+        _validator(pub).validate(token, _expected())
+
+
+def test_no_time_claims_rejected(rs_keys):
+    priv, pub = rs_keys
+    token = captest.sign_jwt(
+        priv, "RS256", _claims(iat=None, nbf=None, exp=None)
+    )
+    with pytest.raises(MissingClaimError):
+        _validator(pub).validate(token, _expected())
+
+
+def test_missing_exp_defaults_from_iat_plus_leeway(rs_keys):
+    priv, pub = rs_keys
+    # iat 100s ago, no exp → exp defaults to iat + 150s leeway → still valid
+    token = captest.sign_jwt(
+        priv, "RS256", _claims(iat=int(NOW) - 100, nbf=None, exp=None)
+    )
+    assert _validator(pub).validate(token, _expected())
+    # with leeway suppressed (negative) → exp=iat → expired (beyond 60s skew)
+    with pytest.raises(ExpiredTokenError):
+        _validator(pub).validate(token, _expected(expiration_leeway=-1))
+
+
+def test_missing_nbf_defaults_from_exp_minus_leeway(rs_keys):
+    priv, pub = rs_keys
+    # Only exp set, 400s out: nbf defaults to exp-150 → token not yet valid.
+    token = captest.sign_jwt(
+        priv, "RS256", _claims(iat=None, nbf=None, exp=int(NOW) + 400)
+    )
+    with pytest.raises(InvalidNotBeforeError):
+        _validator(pub).validate(token, _expected())
+    # Larger leeway covers it.
+    assert _validator(pub).validate(token, _expected(not_before_leeway=500))
+
+
+def test_real_time_default_now(rs_keys):
+    priv, pub = rs_keys
+    t = time.time()
+    token = captest.sign_jwt(
+        priv, "RS256",
+        {"iss": "i", "iat": int(t), "nbf": int(t), "exp": int(t) + 60},
+    )
+    assert _validator(pub).validate(token, Expected())
+
+
+def test_validate_batch_mixed(rs_keys):
+    priv, pub = rs_keys
+    good = captest.sign_jwt(priv, "RS256", _claims())
+    expired = captest.sign_jwt(priv, "RS256", _claims(exp=int(NOW) - 3600))
+    tampered = good[:-6] + "AAAAAA"
+    v = _validator(pub)
+    results = v.validate_batch([good, expired, tampered], _expected())
+    assert results[0]["sub"] == "alice"
+    assert isinstance(results[1], ExpiredTokenError)
+    assert isinstance(results[2], InvalidSignatureError)
