@@ -74,10 +74,13 @@ class ParsedJWS:
         return claims
 
 
-def peek_alg(token: str) -> str:
-    """Return the alg header of a compact JWS, enforcing the same
-    structural rules as :func:`parse_compact` but without decoding the
-    payload segment (cheap header-only inspection)."""
+def _split_and_header(token: str):
+    """Shared strict structural parse: split, decode+check the header.
+
+    Returns (header_dict, raw_header, raw_payload, raw_sig). Single
+    source of truth for the structural rules — peek_alg, parse_compact,
+    and the C++ runtime conformance tests all key off this behavior.
+    """
     if not isinstance(token, str) or not token:
         raise MalformedTokenError("token is empty")
     parts = token.split(".")
@@ -96,35 +99,26 @@ def peek_alg(token: str) -> str:
     alg = header.get("alg")
     if not isinstance(alg, str) or not alg:
         raise MalformedTokenError("protected header missing alg parameter")
+    return header, raw_header, raw_payload, raw_sig
+
+
+def peek_alg(token: str) -> str:
+    """Return the alg header of a compact JWS, enforcing the same
+    structural rules as :func:`parse_compact` but without decoding the
+    payload segment (cheap header-only inspection)."""
+    header, _, raw_payload, raw_sig = _split_and_header(token)
     # Validate payload/signature segment charsets without decoding bytes.
     for seg in (raw_payload, raw_sig):
         if not set(seg) <= _B64URL_CHARS or len(seg) % 4 == 1:
             raise MalformedTokenError("illegal base64url segment")
     if not raw_sig:
         raise TokenNotSignedError("token must be signed")
-    return alg
+    return header["alg"]
 
 
 def parse_compact(token: str) -> ParsedJWS:
     """Parse a compact-serialization JWS without verifying it."""
-    if not isinstance(token, str) or not token:
-        raise MalformedTokenError("token is empty")
-    parts = token.split(".")
-    if len(parts) != 3:
-        raise MalformedTokenError(
-            f"compact JWS must have 3 segments, found {len(parts)}"
-        )
-    raw_header, raw_payload, raw_sig = parts
-    header_bytes = b64url_decode(raw_header)
-    try:
-        header = json.loads(header_bytes)
-    except (ValueError, UnicodeDecodeError) as e:
-        raise MalformedTokenError(f"protected header is not valid JSON: {e}") from e
-    if not isinstance(header, dict):
-        raise MalformedTokenError("protected header is not a JSON object")
-    alg = header.get("alg")
-    if not isinstance(alg, str) or not alg:
-        raise MalformedTokenError("protected header missing alg parameter")
+    header, raw_header, raw_payload, raw_sig = _split_and_header(token)
     payload = b64url_decode(raw_payload)
     signature = b64url_decode(raw_sig)
     if len(signature) == 0:

@@ -152,14 +152,17 @@ class JSONWebKeySet(KeySet):
                 return parsed.claims()
             except InvalidSignatureError as e:
                 last_err = e
-        # kid miss or all candidates failed: refetch once (key rotation).
-        keys = self.keys(refresh=True)
-        for jwk in self._candidates(keys, parsed):
-            try:
-                verify_parsed(parsed, jwk.key)
-                return parsed.claims()
-            except InvalidSignatureError as e:
-                last_err = e
+        if not candidates:
+            # kid cache miss only → one refetch (key rotation). A failed
+            # verification against cached candidates must NOT hit the
+            # network — forged tokens would amplify into IdP fetches.
+            keys = self.keys(refresh=True)
+            for jwk in self._candidates(keys, parsed):
+                try:
+                    verify_parsed(parsed, jwk.key)
+                    return parsed.claims()
+                except InvalidSignatureError as e:
+                    last_err = e
         raise InvalidSignatureError(
             "failed to verify id token signature"
         ) from last_err

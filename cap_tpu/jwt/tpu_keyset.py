@@ -1,0 +1,298 @@
+"""TPUBatchKeySet — the accelerated KeySet implementation.
+
+The north-star component (BASELINE.json): cap's per-token verify hot
+path lifted into ``verify_batch(tokens)``, dispatched to the JAX/TPU
+engine in cap_tpu/tpu. Gated behind the same ``KeySet`` interface as
+the CPU implementations, so the Validator and the OIDC Provider share
+one accelerated path while pure-CPU stays the default.
+
+Pipeline per batch:
+1. host prep (C++ runtime when built, Python fallback): JOSE split,
+   base64url decode, header alg/kid scan, SHA-2 of the signing input;
+2. kid → key-table row resolution (the "key gather" axis);
+3. bucket by (family, hash): one static-shape device dispatch per
+   bucket, padded to power-of-two sizes to bound XLA recompilation;
+4. RS*/PS* → batched Montgomery modexp; ES*/EdDSA → batched EC kernels
+   (curve tables); anything unbucketable falls back to the CPU oracle;
+5. per-token verdicts: claims dict or the taxonomy error — identical
+   outcomes to the CPU path, on failures as well as successes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..errors import (
+    InvalidParameterError,
+    InvalidSignatureError,
+    MalformedTokenError,
+    NilParameterError,
+)
+from . import algs
+from .jose import ParsedJWS, parse_compact
+from .jwk import JWK
+from .keyset import KeySet
+from .verify import key_matches_alg, verify_parsed
+
+_RS = {algs.RS256: "sha256", algs.RS384: "sha384", algs.RS512: "sha512"}
+_PS = {algs.PS256: "sha256", algs.PS384: "sha384", algs.PS512: "sha512"}
+_ES = {algs.ES256: "P-256", algs.ES384: "P-384", algs.ES512: "P-521"}
+
+_MIN_BUCKET = 128
+
+
+def _pad_size(n: int, max_chunk: int) -> int:
+    """Next power of two ≥ n (≥ _MIN_BUCKET), capped at max_chunk."""
+    size = _MIN_BUCKET
+    while size < n:
+        size *= 2
+    return min(size, max_chunk)
+
+
+class TPUBatchKeySet(KeySet):
+    """KeySet whose batch path runs on the TPU verify engine.
+
+    Construct from JWKs (key + kid metadata). Single-token
+    ``verify_signature`` uses the CPU oracle; ``verify_batch`` buckets
+    and dispatches to the device.
+    """
+
+    def __init__(self, jwks: Sequence[JWK], max_chunk: int = 32768,
+                 cpu_fallback: bool = True):
+        from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
+
+        if not jwks:
+            raise NilParameterError("at least one key is required")
+        self._jwks = list(jwks)
+        self._max_chunk = max_chunk
+        self._cpu_fallback = cpu_fallback
+
+        # Partition keys into family tables; remember each JWK's slot.
+        rsa_numbers, self._rsa_rows = [], {}
+        self._ec_keys: Dict[str, list] = {}
+        self._ec_rows: Dict[str, Dict[int, int]] = {}
+        self._ed_keys, self._ed_rows = [], {}
+        for i, jwk in enumerate(self._jwks):
+            key = jwk.key
+            if isinstance(key, rsa.RSAPublicKey):
+                nums = key.public_numbers()
+                self._rsa_rows[i] = len(rsa_numbers)
+                rsa_numbers.append((nums.n, nums.e))
+            elif isinstance(key, ec.EllipticCurvePublicKey):
+                crv = {"secp256r1": "P-256", "secp384r1": "P-384",
+                       "secp521r1": "P-521"}[key.curve.name]
+                rows = self._ec_rows.setdefault(crv, {})
+                rows[i] = len(self._ec_keys.setdefault(crv, []))
+                self._ec_keys[crv].append(key)
+            elif isinstance(key, ed25519.Ed25519PublicKey):
+                self._ed_rows[i] = len(self._ed_keys)
+                self._ed_keys.append(key)
+
+        self._rsa_table = None
+        if rsa_numbers:
+            from ..tpu.rsa import RSAKeyTable
+            self._rsa_table = RSAKeyTable(rsa_numbers)
+        self._ec_tables: Dict[str, Any] = {}
+        for crv, keys in self._ec_keys.items():
+            try:
+                from ..tpu.ec import ECKeyTable
+                self._ec_tables[crv] = ECKeyTable(crv, keys)
+            except ImportError:
+                pass  # EC engine not built yet → CPU fallback
+        self._ed_table = None
+        if self._ed_keys:
+            try:
+                from ..tpu.ed25519 import Ed25519KeyTable
+                self._ed_table = Ed25519KeyTable(self._ed_keys)
+            except ImportError:
+                pass
+
+        self._by_kid: Dict[str, List[int]] = {}
+        for i, jwk in enumerate(self._jwks):
+            if jwk.kid:
+                self._by_kid.setdefault(jwk.kid, []).append(i)
+
+    # -- single-token path (CPU oracle) -----------------------------------
+
+    def _candidate_indices(self, parsed: ParsedJWS) -> List[int]:
+        if parsed.kid is not None and parsed.kid in self._by_kid:
+            pool = self._by_kid[parsed.kid]
+        else:
+            pool = range(len(self._jwks))
+        return [i for i in pool
+                if key_matches_alg(self._jwks[i].key, parsed.alg)]
+
+    def verify_signature(self, token: str) -> Dict[str, Any]:
+        parsed = parse_compact(token)
+        last: Optional[Exception] = None
+        for i in self._candidate_indices(parsed):
+            try:
+                verify_parsed(parsed, self._jwks[i].key)
+                return parsed.claims()
+            except InvalidSignatureError as e:
+                last = e
+        raise InvalidSignatureError(
+            "no known key successfully validated the token signature"
+        ) from last
+
+    # -- batch path --------------------------------------------------------
+
+    def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        n = len(tokens)
+        results: List[Any] = [None] * n
+        parsed_list: List[Optional[ParsedJWS]] = [None] * n
+        key_for: List[Optional[int]] = [None] * n
+
+        from ..runtime import prep  # C++ when built, Python fallback
+
+        prepped = prep.prepare_batch(tokens)
+
+        for j, p in enumerate(prepped):
+            if isinstance(p, Exception):
+                results[j] = p
+                continue
+            parsed_list[j] = p
+            cands = self._candidate_indices(p)
+            if len(cands) == 1:
+                key_for[j] = cands[0]
+            elif not cands:
+                results[j] = InvalidSignatureError(
+                    "no known key successfully validated the token signature"
+                )
+            # >1 candidate (ambiguous kid / no kid): CPU trial path below.
+
+        buckets: Dict[tuple, List[int]] = {}
+        for j, p in enumerate(parsed_list):
+            if results[j] is not None or p is None:
+                continue
+            if key_for[j] is None:
+                buckets.setdefault(("cpu",), []).append(j)
+            elif p.alg in _RS and self._rsa_table is not None:
+                buckets.setdefault(("rs", _RS[p.alg]), []).append(j)
+            elif p.alg in _PS and self._rsa_table is not None:
+                buckets.setdefault(("ps", _PS[p.alg]), []).append(j)
+            elif p.alg in _ES and _ES[p.alg] in self._ec_tables:
+                buckets.setdefault(("es", p.alg), []).append(j)
+            elif p.alg == algs.EdDSA and self._ed_table is not None:
+                buckets.setdefault(("ed",), []).append(j)
+            else:
+                buckets.setdefault(("cpu",), []).append(j)
+
+        for kind, idxs in buckets.items():
+            if kind[0] == "cpu":
+                self._run_cpu(idxs, parsed_list, results)
+            elif kind[0] in ("rs", "ps"):
+                self._run_rsa(kind[0], kind[1], idxs, parsed_list,
+                              key_for, results)
+            elif kind[0] == "es":
+                self._run_ec(kind[1], idxs, parsed_list, key_for, results)
+            else:
+                self._run_ed(idxs, parsed_list, key_for, results)
+        return results
+
+    # -- bucket runners ----------------------------------------------------
+
+    def _finish(self, idxs, parsed_list, ok_mask, results):
+        for j, ok in zip(idxs, ok_mask):
+            if ok:
+                try:
+                    results[j] = parsed_list[j].claims()
+                except MalformedTokenError as e:
+                    results[j] = e
+            else:
+                results[j] = InvalidSignatureError(
+                    "no known key successfully validated the token signature"
+                )
+
+    def _run_cpu(self, idxs, parsed_list, results):
+        if not self._cpu_fallback:
+            for j in idxs:
+                results[j] = InvalidParameterError(
+                    "token cannot be dispatched to the device engine and "
+                    "CPU fallback is disabled"
+                )
+            return
+        for j in idxs:
+            p = parsed_list[j]
+            last: Optional[Exception] = None
+            done = False
+            for i in self._candidate_indices(p):
+                try:
+                    verify_parsed(p, self._jwks[i].key)
+                    results[j] = p.claims()
+                    done = True
+                    break
+                except InvalidSignatureError as e:
+                    last = e
+            if not done:
+                err = InvalidSignatureError(
+                    "no known key successfully validated the token signature"
+                )
+                err.__cause__ = last
+                results[j] = err
+
+    def _hashes(self, idxs, parsed_list, hash_name):
+        import hashlib
+
+        return [hashlib.new(hash_name, parsed_list[j].signing_input).digest()
+                for j in idxs]
+
+    def _run_rsa(self, kind, hash_name, idxs, parsed_list, key_for, results):
+        from ..tpu import rsa as tpursa
+
+        table = self._rsa_table
+        for lo in range(0, len(idxs), self._max_chunk):
+            chunk = idxs[lo: lo + self._max_chunk]
+            pad = _pad_size(len(chunk), self._max_chunk)
+            sigs = [parsed_list[j].signature for j in chunk]
+            hashes_ = self._hashes(chunk, parsed_list, hash_name)
+            rows = [self._rsa_rows[key_for[j]] for j in chunk]
+            fill = pad - len(chunk)
+            sigs += [b""] * fill
+            hashes_ += [b"\x00" * tpursa.HASH_LEN[hash_name]] * fill
+            key_idx = np.asarray(rows + [0] * fill, np.int32)
+            if kind == "rs":
+                ok = tpursa.verify_pkcs1v15_batch(
+                    table, sigs, hashes_, hash_name, key_idx)
+            else:
+                ok = tpursa.verify_pss_batch(
+                    table, sigs, hashes_, hash_name, key_idx)
+            self._finish(chunk, parsed_list, ok[: len(chunk)], results)
+
+    def _run_ec(self, alg, idxs, parsed_list, key_for, results):
+        from ..tpu import ec as tpuec
+
+        crv = _ES[alg]
+        table = self._ec_tables[crv]
+        hash_name = algs.HASH_FOR_ALG[alg]
+        for lo in range(0, len(idxs), self._max_chunk):
+            chunk = idxs[lo: lo + self._max_chunk]
+            pad = _pad_size(len(chunk), self._max_chunk)
+            sigs = [parsed_list[j].signature for j in chunk]
+            hashes_ = self._hashes(chunk, parsed_list, hash_name)
+            rows = [self._ec_rows[crv][key_for[j]] for j in chunk]
+            fill = pad - len(chunk)
+            sigs += [b"\x00" * (2 * table.coord_bytes)] * fill
+            hashes_ += [b"\x00" * 32] * fill
+            key_idx = np.asarray(rows + [0] * fill, np.int32)
+            ok = tpuec.verify_ecdsa_batch(table, sigs, hashes_, key_idx)
+            self._finish(chunk, parsed_list, ok[: len(chunk)], results)
+
+    def _run_ed(self, idxs, parsed_list, key_for, results):
+        from ..tpu import ed25519 as tpued
+
+        table = self._ed_table
+        for lo in range(0, len(idxs), self._max_chunk):
+            chunk = idxs[lo: lo + self._max_chunk]
+            pad = _pad_size(len(chunk), self._max_chunk)
+            sigs = [parsed_list[j].signature for j in chunk]
+            msgs = [parsed_list[j].signing_input for j in chunk]
+            rows = [self._ed_rows[key_for[j]] for j in chunk]
+            fill = pad - len(chunk)
+            sigs += [b"\x00" * 64] * fill
+            msgs += [b""] * fill
+            key_idx = np.asarray(rows + [0] * fill, np.int32)
+            ok = tpued.verify_ed25519_batch(table, sigs, msgs, key_idx)
+            self._finish(chunk, parsed_list, ok[: len(chunk)], results)

@@ -1,0 +1,7 @@
+"""Native batch-prep runtime.
+
+The reference has no native components (SURVEY.md §2); this package is
+the new framework's native layer: a C++ batch tokenizer (JOSE split,
+base64url decode, header scan, SHA-2 over signing inputs) loaded via
+ctypes, with a pure-Python fallback so the framework works unbuilt.
+"""

@@ -1,0 +1,47 @@
+"""Batch JOSE preparation: C++ fast path with Python fallback.
+
+``prepare_batch(tokens)`` parses every token (strict compact-JWS rules,
+identical to cap_tpu.jwt.jose.parse_compact) and returns one entry per
+token: a ParsedJWS or the exception that token fails with. The native
+implementation (capruntime.so, see cap_tpu/runtime/native/) does the
+splitting, base64url decoding, and SHA-2 hashing in multithreaded C++.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from ..jwt.jose import parse_compact
+
+
+def _prepare_python(tokens: Sequence[str]) -> List[Any]:
+    out: List[Any] = []
+    for t in tokens:
+        try:
+            out.append(parse_compact(t))
+        except Exception as e:  # noqa: BLE001 - per-token error channel
+            out.append(e)
+    return out
+
+
+def prepare_batch(tokens: Sequence[str]) -> List[Any]:
+    native = _load_native()
+    if native is not None:
+        return native.prepare_batch(tokens)
+    return _prepare_python(tokens)
+
+
+_native_mod = None
+_native_tried = False
+
+
+def _load_native():
+    global _native_mod, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from . import native_binding
+            _native_mod = native_binding
+        except Exception:  # noqa: BLE001 - unbuilt native is expected
+            _native_mod = None
+    return _native_mod

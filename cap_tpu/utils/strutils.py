@@ -6,7 +6,7 @@ from typing import Iterable, List
 
 
 def str_list_contains(haystack: Iterable[str], needle: str) -> bool:
-    return needle in list(haystack)
+    return needle in haystack
 
 
 def remove_duplicates_stable(items: Iterable[str], case_sensitive: bool) -> List[str]:

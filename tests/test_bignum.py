@@ -1,0 +1,153 @@
+"""Bignum device-arithmetic parity vs Python int arithmetic."""
+
+import random
+
+import numpy as np
+import pytest
+
+from cap_tpu.tpu import limbs as L
+from cap_tpu.tpu import bignum
+
+rng = random.Random(0xCAB)
+
+
+def rand_ints(n, bits):
+    return [rng.getrandbits(bits) for _ in range(n)]
+
+
+def rand_odd(bits):
+    return rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+
+
+def test_limb_roundtrip():
+    vals = rand_ints(17, 200) + [0, 1, (1 << 208) - 1]
+    arr = L.ints_to_limbs(vals, 13)
+    assert L.limbs_to_ints(arr) == vals
+
+
+def test_bytes_be_roundtrip():
+    chunks = [rng.getrandbits(b * 8).to_bytes(b, "big")
+              for b in (1, 5, 16, 31, 32)]
+    arr = L.bytes_be_to_limbs(chunks, 16)
+    ints = [int.from_bytes(c, "big") for c in chunks]
+    assert L.limbs_to_ints(arr) == ints
+    back = L.limbs_to_bytes_be(arr, 32)
+    assert [b[-len(c):] if len(c) else b"" for b, c in zip(back, chunks)] \
+        == list(chunks)
+
+
+def test_mul_parity():
+    import jax.numpy as jnp
+
+    n, k = 64, 16
+    a_i = rand_ints(n, k * 16)
+    b_i = rand_ints(n, k * 16)
+    a = jnp.asarray(L.ints_to_limbs(a_i, k))
+    b = jnp.asarray(L.ints_to_limbs(b_i, k))
+    out = np.asarray(bignum.mul(a, b))
+    got = L.limbs_to_ints(out)
+    assert got == [x * y for x, y in zip(a_i, b_i)]
+
+
+def test_mul_adversarial_carries():
+    import jax.numpy as jnp
+
+    k = 8
+    top = (1 << (k * 16)) - 1  # all 0xFFFF limbs → worst-case carry ripple
+    vals = [top, top, 1, 0]
+    a = jnp.asarray(L.ints_to_limbs(vals, k))
+    out = np.asarray(bignum.mul(a, a))
+    assert L.limbs_to_ints(out) == [v * v for v in vals]
+
+
+def test_compare_ge_and_sub():
+    import jax.numpy as jnp
+
+    k, n = 8, 6
+    xs = [5, 10, 10, (1 << 128) - 1, 0, 7]
+    ys = [10, 5, 10, (1 << 128) - 2, 0, 7]
+    a = jnp.asarray(L.ints_to_limbs(xs, k))
+    b = jnp.asarray(L.ints_to_limbs(ys, k))
+    ge = np.asarray(bignum.compare_ge(a, b))
+    assert ge.tolist() == [x >= y for x, y in zip(xs, ys)]
+    d = np.asarray(bignum.sub_where(a, b, jnp.asarray(ge)))
+    expect = [x - y if x >= y else x for x, y in zip(xs, ys)]
+    assert L.limbs_to_ints(d) == expect
+
+
+def test_mont_mul_parity():
+    import jax.numpy as jnp
+
+    k, n_tok = 16, 32
+    mod = rand_odd(k * 16 - 7)
+    nprime, r2, _ = bignum.mont_params(mod, k)
+    a_i = [rng.randrange(mod) for _ in range(n_tok)]
+    b_i = [rng.randrange(mod) for _ in range(n_tok)]
+    r_inv = pow(1 << (16 * k), -1, mod)
+    a = jnp.asarray(L.ints_to_limbs(a_i, k))
+    b = jnp.asarray(L.ints_to_limbs(b_i, k))
+    n_arr = jnp.asarray(L.ints_to_limbs([mod] * n_tok, k))
+    np_arr = jnp.asarray(L.ints_to_limbs([nprime] * n_tok, k))
+    out = np.asarray(bignum.mont_mul(a, b, n_arr, np_arr))
+    got = L.limbs_to_ints(out)
+    assert got == [(x * y * r_inv) % mod for x, y in zip(a_i, b_i)]
+
+
+@pytest.mark.parametrize("bits", [256, 2048])
+def test_modexp_65537_parity(bits):
+    import jax.numpy as jnp
+
+    k = L.nlimbs_for_bits(bits)
+    n_tok = 8
+    mods = [rand_odd(bits) for _ in range(4)]
+    idx = [rng.randrange(4) for _ in range(n_tok)]
+    s_i = [rng.randrange(mods[i]) for i in idx]
+    n_arr = jnp.asarray(L.ints_to_limbs([mods[i] for i in idx], k))
+    params = [bignum.mont_params(m, k) for m in mods]
+    np_arr = jnp.asarray(L.ints_to_limbs([params[i][0] for i in idx], k))
+    r2_arr = jnp.asarray(L.ints_to_limbs([params[i][1] for i in idx], k))
+    s = jnp.asarray(L.ints_to_limbs(s_i, k))
+    out = np.asarray(bignum.modexp_65537(s, n_arr, np_arr, r2_arr))
+    got = L.limbs_to_ints(out)
+    assert got == [pow(x, 65537, mods[i]) for x, i in zip(s_i, idx)]
+
+
+def test_modexp_vare_parity():
+    import jax.numpy as jnp
+
+    k, n_tok = 16, 12
+    mods = [rand_odd(k * 16) for _ in range(3)]
+    exps = [3, 17, 65537]
+    idx = [rng.randrange(3) for _ in range(n_tok)]
+    s_i = [rng.randrange(mods[i]) for i in idx]
+    params = [bignum.mont_params(m, k) for m in mods]
+    n_arr = jnp.asarray(L.ints_to_limbs([mods[i] for i in idx], k))
+    np_arr = jnp.asarray(L.ints_to_limbs([params[i][0] for i in idx], k))
+    r2_arr = jnp.asarray(L.ints_to_limbs([params[i][1] for i in idx], k))
+    one_arr = jnp.asarray(L.ints_to_limbs([params[i][2] for i in idx], k))
+    e_arr = jnp.asarray(np.asarray([exps[i] for i in idx], np.uint32))
+    s = jnp.asarray(L.ints_to_limbs(s_i, k))
+    out = np.asarray(bignum.modexp_vare(s, e_arr, n_arr, np_arr, r2_arr,
+                                        one_arr, ebits=17))
+    got = L.limbs_to_ints(out)
+    assert got == [pow(x, exps[i], mods[i]) for x, i in zip(s_i, idx)]
+
+
+def test_modexp_fixed_exponent_parity():
+    import jax.numpy as jnp
+
+    k, n_tok = 8, 6
+    mod = rand_odd(k * 16)
+    nprime, r2, one_m = bignum.mont_params(mod, k)
+    # per-token big exponents (e.g. Fermat p-2 style)
+    e_i = [rng.getrandbits(k * 16 - 1) | 1 for _ in range(n_tok)]
+    s_i = [rng.randrange(mod) for _ in range(n_tok)]
+    s = jnp.asarray(L.ints_to_limbs(s_i, k))
+    e = jnp.asarray(L.ints_to_limbs(e_i, k))
+    n_arr = jnp.asarray(L.ints_to_limbs([mod] * n_tok, k))
+    np_arr = jnp.asarray(L.ints_to_limbs([nprime] * n_tok, k))
+    r2_arr = jnp.asarray(L.ints_to_limbs([r2] * n_tok, k))
+    one_arr = jnp.asarray(L.ints_to_limbs([one_m] * n_tok, k))
+    out = np.asarray(bignum.modexp_fixed_exponent(
+        s, e, n_arr, np_arr, r2_arr, one_arr, ebits=k * 16))
+    assert L.limbs_to_ints(out) == [pow(x, e, mod) for x, e in zip(s_i, e_i)]

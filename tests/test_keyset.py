@@ -151,6 +151,23 @@ def test_jwks_kid_rotation_refetches(keypairs, jwks_server):
     assert _JWKSHandler.hits >= 2
 
 
+def test_jwks_no_refetch_on_forged_token(keypairs, jwks_server):
+    # A forged token whose kid matches a cached key must NOT trigger a
+    # network refetch (IdP-hammering amplification).
+    _, url = jwks_server
+    priv, pub = keypairs["ES256"]
+    _set_jwks([("kid-1", pub)])
+    ks = JSONWebKeySet(url)
+    ks.keys()
+    hits_before = _JWKSHandler.hits
+    good = captest.sign_jwt(priv, "ES256", captest.default_claims(), kid="kid-1")
+    forged = good[:-12] + "AAAAAAAAAAAA"
+    for _ in range(3):
+        with pytest.raises(InvalidSignatureError):
+            ks.verify_signature(forged)
+    assert _JWKSHandler.hits == hits_before
+
+
 def test_jwks_404_rejected(jwks_server):
     _, url = jwks_server
     _JWKSHandler.status = 404
