@@ -179,22 +179,7 @@ def new_oidc_discovery_keyset(issuer: str,
     if not issuer:
         raise NilParameterError("issuer is required")
     ctx = _http.ssl_context_for_ca(issuer_ca_pem)
-    well_known = issuer.rstrip("/") + "/.well-known/openid-configuration"
-    status, body, _ = _http.get(well_known, ctx)
-    if status != 200:
-        raise InvalidParameterError(
-            f"discovery request failed: status {status}"
-        )
-    try:
-        doc = json.loads(body)
-    except ValueError as e:
-        raise InvalidParameterError(f"discovery document is not JSON: {e}") from e
-    got_issuer = doc.get("issuer")
-    if got_issuer != issuer:
-        raise InvalidParameterError(
-            f"oidc issuer did not match the issuer returned by provider, "
-            f"expected {issuer!r} got {got_issuer!r}"
-        )
+    doc = _http.fetch_discovery(issuer, ctx)
     jwks_uri = doc.get("jwks_uri")
     if not isinstance(jwks_uri, str) or not jwks_uri:
         raise InvalidParameterError("discovery document missing jwks_uri")

@@ -1,0 +1,27 @@
+"""OIDC relying-party core.
+
+Capability parity with the reference's ``oidc/`` package: Config,
+Provider (discovery, AuthURL, Exchange, VerifyIDToken, UserInfo),
+Request, Token, IDToken with at_hash/c_hash verification, PKCE S256,
+state/nonce generation, prompts/displays, and the redact-by-default
+secret types — plus the TPU-era addition: the Provider can be handed an
+accelerated KeySet (TPUBatchKeySet) so id_token verification shares the
+batched device path (``verify_id_token_batch``).
+"""
+
+from .config import ClientSecret, Config
+from .display import Display
+from .id import DEFAULT_ID_LENGTH, new_id
+from .id_token import IDToken
+from .pkce import CodeVerifier, S256Verifier, create_code_challenge
+from .prompt import Prompt
+from .provider import Provider
+from .request import REQUEST_EXPIRY_SKEW, Request
+from .token import TOKEN_EXPIRY_SKEW, AccessToken, RefreshToken, Token
+
+__all__ = [
+    "ClientSecret", "Config", "Display", "DEFAULT_ID_LENGTH", "new_id",
+    "IDToken", "CodeVerifier", "S256Verifier", "create_code_challenge",
+    "Prompt", "Provider", "REQUEST_EXPIRY_SKEW", "Request",
+    "TOKEN_EXPIRY_SKEW", "AccessToken", "RefreshToken", "Token",
+]

@@ -1,0 +1,17 @@
+"""HTTP callback handlers for OIDC responses.
+
+Parity with oidc/callback/: AuthCode and Implicit handler factories
+producing WSGI applications (the Python analog of http.HandlerFunc),
+a RequestReader lookup interface keyed by state, and success/error
+response callables.
+"""
+
+from .authcode import auth_code
+from .implicit import implicit
+from .request_reader import RequestReader, SingleRequestReader
+from .response_func import AuthenErrorResponse
+
+__all__ = [
+    "auth_code", "implicit",
+    "RequestReader", "SingleRequestReader", "AuthenErrorResponse",
+]

@@ -1,0 +1,109 @@
+"""Relying-party configuration.
+
+Parity with oidc/config.go:35-239: required client_id + issuer
+(http/https scheme), supported signing algs validated against the
+registry, optional allowed redirect URLs / scopes ("openid" always
+ensured at use sites) / audiences / provider CA / now function.
+ClientSecret redacts itself everywhere (config.go:17-31).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, List, Optional, Sequence
+from urllib.parse import urlparse
+
+from ..errors import (
+    InvalidCACertError,
+    InvalidIssuerError,
+    InvalidParameterError,
+)
+from ..jwt import algs as _algs
+from ..utils.redact import RedactedString
+
+SCOPE_OPENID = "openid"
+
+
+class ClientSecret(RedactedString):
+    redact_label = "ClientSecret"
+
+
+class Config:
+    """Provider (relying party) configuration."""
+
+    def __init__(
+        self,
+        issuer: str,
+        client_id: str,
+        client_secret: str | ClientSecret = "",
+        supported_signing_algs: Sequence[str] = (),
+        allowed_redirect_urls: Sequence[str] = (),
+        *,
+        scopes: Optional[Sequence[str]] = None,
+        audiences: Optional[Sequence[str]] = None,
+        provider_ca: Optional[str] = None,
+        now_func: Optional[Callable[[], float]] = None,
+    ):
+        self.issuer = issuer
+        self.client_id = client_id
+        self.client_secret = (
+            client_secret if isinstance(client_secret, ClientSecret)
+            else ClientSecret(client_secret)
+        )
+        self.supported_signing_algs = list(supported_signing_algs)
+        self.allowed_redirect_urls = list(allowed_redirect_urls)
+        self.scopes = list(scopes) if scopes else []
+        self.audiences = list(audiences) if audiences else []
+        self.provider_ca = provider_ca or ""
+        self.now_func = now_func
+        self.validate()
+
+    def now(self) -> float:
+        """Current Unix time, honoring now_func (config.go:233-239)."""
+        return self.now_func() if self.now_func is not None else _time.time()
+
+    def validate(self) -> None:
+        if not self.client_id:
+            raise InvalidParameterError("client ID is empty")
+        if not self.issuer:
+            raise InvalidParameterError("discovery URL is empty")
+        for u in self.allowed_redirect_urls:
+            try:
+                urlparse(u)
+            except ValueError as e:
+                raise InvalidParameterError(
+                    f"invalid AllowedRedirectURLs provided {u}: {e}"
+                ) from e
+        try:
+            parsed = urlparse(self.issuer)
+        except ValueError as e:
+            raise InvalidIssuerError(f"issuer {self.issuer} is invalid: {e}") from e
+        if parsed.scheme not in ("http", "https"):
+            raise InvalidIssuerError(
+                f"issuer {self.issuer} schema is not http or https"
+            )
+        if not self.supported_signing_algs:
+            raise InvalidParameterError("supported algorithms is empty")
+        for a in self.supported_signing_algs:
+            if a not in _algs.SUPPORTED_ALGORITHMS:
+                raise InvalidParameterError(f"unsupported algorithm {a}")
+        if self.provider_ca:
+            from ..utils.http import ssl_context_for_ca
+
+            try:
+                ssl_context_for_ca(self.provider_ca)
+            except InvalidCACertError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                raise InvalidCACertError(str(e)) from e
+
+
+def encode_certificates(*certs) -> str:
+    """PEM-encode x509 certificates (config.go EncodeCertificates analog)."""
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    if not certs or any(c is None for c in certs):
+        raise InvalidParameterError("no certificates provided")
+    return "".join(
+        c.public_bytes(Encoding.PEM).decode("utf-8") for c in certs
+    )

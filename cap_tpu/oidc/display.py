@@ -1,0 +1,11 @@
+"""OIDC display values (oidc/display.go:9-18)."""
+
+
+class Display(str):
+    pass
+
+
+PAGE = Display("page")
+POPUP = Display("popup")
+TOUCH = Display("touch")
+WAP = Display("wap")

@@ -1,0 +1,11 @@
+"""OIDC prompt values (oidc/prompt.go:9-18)."""
+
+
+class Prompt(str):
+    pass
+
+
+NONE = Prompt("none")
+LOGIN = Prompt("login")
+CONSENT = Prompt("consent")
+SELECT_ACCOUNT = Prompt("select_account")

@@ -59,6 +59,31 @@ def get_json(url: str, ctx: Optional[ssl.SSLContext] = None,
         ) from e
 
 
+def fetch_discovery(issuer: str,
+                    ctx: Optional[ssl.SSLContext] = None) -> Dict[str, Any]:
+    """Fetch {issuer}/.well-known/openid-configuration and enforce the
+    issuer-equality check (single source of the discovery protocol for
+    both the jwt discovery keyset and the oidc Provider)."""
+    from ..errors import InvalidIssuerError
+
+    well_known = issuer.rstrip("/") + "/.well-known/openid-configuration"
+    status, body, _ = get(well_known, ctx)
+    if status != 200:
+        raise InvalidIssuerError(f"discovery request failed: status {status}")
+    try:
+        doc = json.loads(body)
+    except ValueError as e:
+        raise InvalidIssuerError(f"discovery document is not JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise InvalidIssuerError("discovery document is not a JSON object")
+    got = doc.get("issuer")
+    if got != issuer:
+        raise InvalidIssuerError(
+            f"oidc issuer did not match the issuer returned by provider, "
+            f"expected {issuer!r} got {got!r}")
+    return doc
+
+
 def post_form(url: str, fields: Dict[str, str],
               ctx: Optional[ssl.SSLContext] = None,
               headers: Optional[Dict[str, str]] = None,

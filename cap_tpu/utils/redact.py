@@ -1,0 +1,54 @@
+"""Redact-by-default secret string types.
+
+The reference's observability stance is redaction (SURVEY.md §5):
+ClientSecret, IDToken, AccessToken, RefreshToken all render as
+``[REDACTED: …]`` from String()/MarshalJSON. The Python analog: a str
+subclass whose repr/str/format/JSON renderings are redacted; the raw
+value is reachable only via ``.reveal()``. Operations that would leak
+through str-ness (concatenation, equality) operate on the real value —
+matching the reference, where the underlying string type is usable.
+"""
+
+from __future__ import annotations
+
+
+class RedactedString(str):
+    """A string that redacts itself in every rendering channel."""
+
+    redact_label = "secret"
+
+    def reveal(self) -> str:
+        """The actual secret value (deliberate unwrap, like the
+        reference's explicit string conversions in examples)."""
+        return str.__str__(self)
+
+    def _redacted(self) -> str:
+        return f"[REDACTED: {self.redact_label}]"
+
+    def __repr__(self) -> str:  # noqa: D105
+        return self._redacted()
+
+    def __str__(self) -> str:  # noqa: D105
+        return self._redacted()
+
+    def __format__(self, spec: str) -> str:  # noqa: D105
+        return self._redacted().__format__(spec)
+
+    # json.dumps(default=...) can't intercept str subclasses, so redact
+    # via a .__json__-style helper used by our own serializers; for
+    # stdlib json the caller must reveal() deliberately.
+    def to_json(self) -> str:
+        return self._redacted()
+
+    def __eq__(self, other) -> bool:  # noqa: D105
+        if isinstance(other, RedactedString):
+            return self.reveal() == other.reveal()
+        if isinstance(other, str):
+            return self.reveal() == other
+        return NotImplemented
+
+    def __hash__(self) -> int:  # noqa: D105
+        return str.__hash__(self)
+
+    def __bool__(self) -> bool:  # noqa: D105
+        return len(self.reveal()) > 0

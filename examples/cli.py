@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""CLI example: local-listener OIDC login.
+
+Analog of the reference's oidc/examples/cli (main.go:24-307):
+environment-configured (OIDC_CLIENT_ID / OIDC_CLIENT_SECRET /
+OIDC_ISSUER / OIDC_PORT) authorization-code login with optional
+--implicit / --implicit-access-token / --pkce / --max-age / --scopes
+flags. Starts a local callback listener, prints the authorize URL for
+the browser, and waits for the callback (or SIGINT / timeout).
+
+``--demo`` runs fully headless: it starts the in-process TestProvider
+IdP, drives the authorize endpoint itself, and prints the verified
+token — runnable documentation for the whole flow.
+
+Usage:
+    python examples/cli.py --demo [--pkce | --implicit]
+    OIDC_ISSUER=... OIDC_CLIENT_ID=... python examples/cli.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+from wsgiref.simple_server import make_server
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cap_tpu.oidc import Config, Provider, Request, S256Verifier  # noqa: E402
+from cap_tpu.oidc.callback import SingleRequestReader, auth_code, implicit  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--implicit", action="store_true")
+    ap.add_argument("--implicit-access-token", action="store_true")
+    ap.add_argument("--pkce", action="store_true")
+    ap.add_argument("--max-age", type=int, default=None)
+    ap.add_argument("--scopes", default="")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("OIDC_PORT", "0")))
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--demo", action="store_true",
+                    help="run against an in-process TestProvider, headless")
+    args = ap.parse_args()
+
+    idp = None
+    if args.demo:
+        from cap_tpu.oidc.testing import TestProvider
+
+        idp = TestProvider().start()
+        issuer, client_id, client_secret = (
+            idp.issuer(), idp.client_id, idp.client_secret)
+        ca = idp.ca_cert()
+    else:
+        issuer = os.environ.get("OIDC_ISSUER", "")
+        client_id = os.environ.get("OIDC_CLIENT_ID", "")
+        client_secret = os.environ.get("OIDC_CLIENT_SECRET", "")
+        ca = os.environ.get("OIDC_CA_PEM", "")
+        if not issuer or not client_id:
+            print("set OIDC_ISSUER and OIDC_CLIENT_ID (or use --demo)")
+            return 2
+
+    done = threading.Event()
+    outcome = {}
+
+    def success(state, token, environ):
+        outcome["token"] = token
+        done.set()
+        return (200, [("Content-Type", "text/html")],
+                "<h1>Login successful!</h1>You may close this window.")
+
+    def error(state, resp, err, environ):
+        outcome["error"] = resp.error if resp else str(err)
+        done.set()
+        return (401, [("Content-Type", "text/plain")],
+                f"login failed: {outcome['error']}")
+
+    holder = {}
+    server = make_server("127.0.0.1", args.port,
+                         lambda e, s: holder["app"](e, s))
+    server.RequestHandlerClass.log_message = lambda *a: None
+    callback_url = f"http://127.0.0.1:{server.server_address[1]}/callback"
+
+    config = Config(
+        issuer=issuer, client_id=client_id, client_secret=client_secret,
+        supported_signing_algs=["ES256", "RS256"],
+        allowed_redirect_urls=[callback_url],
+        provider_ca=ca or None,
+        scopes=[s for s in args.scopes.split(",") if s],
+    )
+    provider = Provider(config)
+
+    request = Request(
+        300, callback_url,
+        implicit_flow=args.implicit,
+        implicit_access_token=args.implicit_access_token,
+        pkce_verifier=S256Verifier() if args.pkce else None,
+        max_age=args.max_age,
+    )
+    reader = SingleRequestReader(request)
+    if args.implicit or args.implicit_access_token:
+        holder["app"] = implicit(provider, reader, success, error)
+    else:
+        holder["app"] = auth_code(provider, reader, success, error)
+
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = provider.auth_url(request)
+    print(f"Open the following URL in your browser:\n\n  {url}\n")
+
+    if args.demo:
+        # headless: drive the IdP ourselves (it redirects/form-posts back)
+        import re
+        import urllib.request
+        from urllib.parse import urlencode
+
+        from cap_tpu.utils import http as _http
+
+        idp.set_expected_auth_nonce(request.nonce())
+        status, body, _ = _http.get(url, _http.ssl_context_for_ca(ca))
+        if args.implicit or args.implicit_access_token:
+            fields = dict(re.findall(
+                r'name="([^"]+)" value="([^"]+)"', body.decode()))
+            post = urllib.request.Request(
+                callback_url, data=urlencode(fields).encode(), method="POST")
+            post.add_header("Content-Type",
+                            "application/x-www-form-urlencoded")
+            urllib.request.urlopen(post).read()
+
+    if not done.wait(args.timeout):
+        print("timed out waiting for the callback")
+        return 1
+    server.shutdown()
+    try:
+        if "error" in outcome:
+            print(f"login failed: {outcome['error']}")
+            return 1
+        token = outcome["token"]
+        print("id_token claims:")
+        print(json.dumps(token.id_token().claims(), indent=2))
+        if token.valid():
+            ts = token.static_token_source()
+            sub = token.id_token().claims()["sub"]
+            print("userinfo:")
+            print(json.dumps(provider.userinfo(ts, sub), indent=2))
+        return 0
+    finally:
+        if idp is not None:
+            idp.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""SPA example: a small web app with /login, /callback and /success.
+
+Analog of the reference's oidc/examples/spa (main.go:62-174 +
+request_cache.go): a WSGI app holding a mutexed in-memory request
+cache — reads delete expired entries; a successful callback attaches
+the token to the cached request for /success to render.
+
+``--demo`` starts an in-process TestProvider and drives one login
+headlessly.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from urllib.parse import parse_qs
+from wsgiref.simple_server import make_server
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cap_tpu.errors import NotFoundError  # noqa: E402
+from cap_tpu.oidc import Config, Provider, Request  # noqa: E402
+from cap_tpu.oidc.callback import RequestReader, auth_code  # noqa: E402
+
+
+class RequestCache(RequestReader):
+    """Mutexed in-memory request cache (spa/request_cache.go:16-70)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_state = {}
+        self._tokens = {}
+
+    def add(self, request: Request) -> None:
+        with self._lock:
+            self._by_state[request.state()] = request
+
+    def read(self, state: str):
+        with self._lock:
+            req = self._by_state.get(state)
+            if req is None:
+                return None
+            if req.is_expired():
+                del self._by_state[state]
+                return None
+            return req
+
+    def set_token(self, state: str, token) -> None:
+        with self._lock:
+            if state not in self._by_state:
+                raise NotFoundError(f"no request for state {state}")
+            self._tokens[state] = token
+
+    def token(self, state: str):
+        with self._lock:
+            return self._tokens.get(state)
+
+
+def build_app(provider: Provider, cache: RequestCache, callback_url: str):
+    def success(state, token, environ):
+        cache.set_token(state, token)
+        return (302, [("Location", f"/success?state={state}")], b"")
+
+    def error(state, resp, err, environ):
+        label = resp.error if resp else str(err)
+        return (401, [("Content-Type", "text/plain")], f"login failed: {label}")
+
+    callback_app = auth_code(provider, cache, success, error)
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        if path == "/login":
+            request = Request(300, callback_url)
+            cache.add(request)
+            start_response("302 Found",
+                           [("Location", provider.auth_url(request))])
+            return [b""]
+        if path == "/callback":
+            return callback_app(environ, start_response)
+        if path == "/success":
+            q = parse_qs(environ.get("QUERY_STRING", ""))
+            state = (q.get("state") or [""])[0]
+            token = cache.token(state)
+            if token is None:
+                start_response("404 Not Found", [])
+                return [b"no login for that state"]
+            claims = token.id_token().claims()
+            start_response("200 OK", [("Content-Type", "application/json")])
+            return [json.dumps(claims, indent=2).encode()]
+        start_response("200 OK", [("Content-Type", "text/html")])
+        return [b'<a href="/login">Login</a>']
+
+    return app
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("OIDC_PORT", "0")))
+    ap.add_argument("--demo", action="store_true")
+    args = ap.parse_args()
+
+    idp = None
+    if args.demo:
+        from cap_tpu.oidc.testing import TestProvider
+
+        idp = TestProvider().start()
+        issuer, client_id, client_secret, ca = (
+            idp.issuer(), idp.client_id, idp.client_secret, idp.ca_cert())
+    else:
+        issuer = os.environ.get("OIDC_ISSUER", "")
+        client_id = os.environ.get("OIDC_CLIENT_ID", "")
+        client_secret = os.environ.get("OIDC_CLIENT_SECRET", "")
+        ca = os.environ.get("OIDC_CA_PEM", "")
+        if not issuer or not client_id:
+            print("set OIDC_ISSUER and OIDC_CLIENT_ID (or use --demo)")
+            return 2
+
+    holder = {}
+    server = make_server("127.0.0.1", args.port,
+                         lambda e, s: holder["app"](e, s))
+    server.RequestHandlerClass.log_message = lambda *a: None
+    port = server.server_address[1]
+    callback_url = f"http://127.0.0.1:{port}/callback"
+
+    provider = Provider(Config(
+        issuer=issuer, client_id=client_id, client_secret=client_secret,
+        supported_signing_algs=["ES256", "RS256"],
+        allowed_redirect_urls=[callback_url],
+        provider_ca=ca or None,
+    ))
+    cache = RequestCache()
+    holder["app"] = build_app(provider, cache, callback_url)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    print(f"SPA listening on http://localhost:{port} — visit /login")
+
+    if args.demo:
+        import urllib.request
+
+        # a demo "browser": hit /login, follow redirects through the IdP
+        # back to /callback, then fetch /success
+        import ssl
+        import urllib.error
+
+        from cap_tpu.utils import http as _http
+
+        ctx = _http.ssl_context_for_ca(ca)
+        opener = urllib.request.build_opener(
+            urllib.request.HTTPSHandler(context=ctx))
+        resp = opener.open(f"http://127.0.0.1:{port}/login")
+        final = resp.geturl()
+        print("login round trip finished at:", final)
+        body = opener.open(f"http://127.0.0.1:{port}{final[final.index('/success'):]}"
+                           if "/success" in final else final).read()
+        print("verified claims:", body.decode()[:200], "...")
+        server.shutdown()
+        idp.stop()
+        return 0
+
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

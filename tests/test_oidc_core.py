@@ -1,0 +1,237 @@
+"""Config / Request / Token / IDToken / PKCE / ID unit tables."""
+
+import json
+
+import pytest
+
+from cap_tpu import testing as captest
+from cap_tpu.errors import (
+    InvalidAtHashError,
+    InvalidCodeHashError,
+    InvalidIssuerError,
+    InvalidParameterError,
+    UnsupportedChallengeMethodError,
+)
+from cap_tpu.oidc import (
+    ClientSecret,
+    Config,
+    IDToken,
+    Request,
+    S256Verifier,
+    Token,
+    new_id,
+)
+from cap_tpu.oidc.pkce import create_code_challenge
+
+
+# -- Config ----------------------------------------------------------------
+
+def _config(**kw):
+    args = dict(
+        issuer="https://idp.example.com",
+        client_id="client-id",
+        client_secret="hush",
+        supported_signing_algs=["RS256"],
+        allowed_redirect_urls=["https://app/callback"],
+    )
+    args.update(kw)
+    return Config(**args)
+
+
+def test_config_valid():
+    c = _config()
+    assert c.client_id == "client-id"
+    assert isinstance(c.client_secret, ClientSecret)
+
+
+@pytest.mark.parametrize("kw,exc", [
+    ({"client_id": ""}, InvalidParameterError),
+    ({"issuer": ""}, InvalidParameterError),
+    ({"issuer": "ftp://x"}, InvalidIssuerError),
+    ({"supported_signing_algs": []}, InvalidParameterError),
+    ({"supported_signing_algs": ["none"]}, InvalidParameterError),
+    ({"supported_signing_algs": ["HS256"]}, InvalidParameterError),
+])
+def test_config_invalid(kw, exc):
+    with pytest.raises(exc):
+        _config(**kw)
+
+
+def test_config_http_issuer_allowed():
+    assert _config(issuer="http://localhost:8080").issuer
+
+
+def test_client_secret_redacts():
+    s = ClientSecret("super-secret")
+    assert "super-secret" not in str(s)
+    assert "super-secret" not in repr(s)
+    assert "super-secret" not in f"{s}"
+    assert s.reveal() == "super-secret"
+    assert s == "super-secret"
+
+
+# -- IDs / PKCE ------------------------------------------------------------
+
+def test_new_id():
+    a, b = new_id(), new_id()
+    assert len(a) == 20 and a != b
+    assert new_id(prefix="st").startswith("st_")
+
+
+def test_pkce_s256():
+    v = S256Verifier()
+    assert len(v.verifier()) == 43
+    assert v.method() == "S256"
+    import base64
+    import hashlib
+
+    expected = base64.urlsafe_b64encode(
+        hashlib.sha256(v.verifier().encode()).digest()).rstrip(b"=").decode()
+    assert v.challenge() == expected
+    assert v.copy().verifier() == v.verifier()
+    assert "REDACTED" in repr(v)
+
+
+def test_pkce_rejects_bad_method():
+    class Plain:
+        def method(self):
+            return "plain"
+
+        def verifier(self):
+            return "x" * 43
+
+    with pytest.raises(UnsupportedChallengeMethodError):
+        create_code_challenge(Plain())
+
+
+# -- Request ---------------------------------------------------------------
+
+def test_request_defaults():
+    r = Request(60, "https://app/callback")
+    assert r.state().startswith("st_")
+    assert r.nonce().startswith("n_")
+    assert r.state() != r.nonce()
+    assert not r.is_expired()
+
+
+def test_request_expiry():
+    r = Request(0.001, "https://app/cb")
+    import time
+
+    time.sleep(0.01)
+    assert not r.is_expired()  # within the 1s skew
+    r2 = Request(60, "https://app/cb", now_func=lambda: 1000.0)
+    assert r2.expiration() == 1060.0
+
+
+def test_request_implicit_pkce_exclusive():
+    with pytest.raises(InvalidParameterError):
+        Request(60, "https://app/cb", implicit_flow=True,
+                pkce_verifier=S256Verifier())
+
+
+def test_request_state_nonce_must_differ():
+    with pytest.raises(InvalidParameterError):
+        Request(60, "https://app/cb", state="same", nonce="same")
+
+
+def test_request_claims_json_validation():
+    Request(60, "https://app/cb", claims={"id_token": {"email": None}})
+    Request(60, "https://app/cb", claims='{"a": 1}')
+    with pytest.raises(InvalidParameterError):
+        Request(60, "https://app/cb", claims="{not json")
+
+
+def test_request_defensive_copies():
+    r = Request(60, "https://app/cb", scopes=["email"], audiences=["a"])
+    r.scopes().append("mutate")
+    r.audiences().append("mutate")
+    assert r.scopes() == ["email"]
+    assert r.audiences() == ["a"]
+
+
+def test_request_max_age():
+    r = Request(60, "https://app/cb", max_age=100, now_func=lambda: 1000.0)
+    secs, auth_after = r.max_age()
+    assert secs == 100 and auth_after == 900.0
+
+
+# -- Token / IDToken -------------------------------------------------------
+
+def _signed_id_token(alg="ES256", claims=None, **extra):
+    priv, pub = captest.generate_keys(alg)
+    c = captest.default_claims(**(claims or {}))
+    c.update(extra)
+    return captest.sign_jwt(priv, alg, c), pub
+
+
+def test_token_requires_id_token():
+    with pytest.raises(InvalidParameterError):
+        Token("")
+
+
+def test_token_expiry_and_validity():
+    raw, _ = _signed_id_token()
+    t = Token(raw, access_token="at", expiry=2000.0,
+              now_func=lambda: 1000.0)
+    assert t.valid() and not t.is_expired()
+    # within the 10s skew of expiry
+    t2 = Token(raw, access_token="at", expiry=1005.0,
+               now_func=lambda: 1000.0)
+    assert t2.is_expired()
+    # zero expiry → never expires
+    t3 = Token(raw, access_token="at", expiry=0.0)
+    assert t3.valid()
+    # no access token → invalid & expired
+    t4 = Token(raw)
+    assert not t4.valid() and t4.is_expired()
+
+
+def test_token_redaction():
+    raw, _ = _signed_id_token()
+    t = Token(raw, access_token="secret-at", refresh_token="secret-rt")
+    blob = repr(t)
+    assert "secret-at" not in blob and "secret-rt" not in blob
+    assert raw not in blob
+    assert t.access_token().reveal() == "secret-at"
+
+
+def test_id_token_claims_unverified():
+    raw, _ = _signed_id_token()
+    t = IDToken(raw)
+    assert t.claims()["sub"] == "alice"
+    assert "alice" not in str(t)
+
+
+def test_at_hash_verification():
+    import base64
+    import hashlib
+
+    at = "my-access-token"
+    d = hashlib.sha256(at.encode()).digest()
+    at_hash = base64.urlsafe_b64encode(d[:16]).rstrip(b"=").decode()
+    raw, _ = _signed_id_token(at_hash=at_hash)
+    t = IDToken(raw)
+    assert t.verify_access_token(at) is True
+    with pytest.raises(InvalidAtHashError):
+        t.verify_access_token("wrong-token")
+
+
+def test_c_hash_verification():
+    import base64
+    import hashlib
+
+    code = "authz-code"
+    d = hashlib.sha256(code.encode()).digest()
+    c_hash = base64.urlsafe_b64encode(d[:16]).rstrip(b"=").decode()
+    raw, _ = _signed_id_token(c_hash=c_hash)
+    t = IDToken(raw)
+    assert t.verify_authorization_code(code) is True
+    with pytest.raises(InvalidCodeHashError):
+        t.verify_authorization_code("stolen-code")
+
+
+def test_eddsa_hash_claims_unverifiable():
+    at = "tok"
+    raw, _ = _signed_id_token(alg="EdDSA", at_hash="whatever")
+    assert IDToken(raw).verify_access_token(at) is False

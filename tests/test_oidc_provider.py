@@ -1,0 +1,350 @@
+"""Provider end-to-end against the in-process HTTPS TestProvider."""
+
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from cap_tpu.errors import (
+    ExpiredAuthTimeError,
+    ExpiredTokenError,
+    InvalidAudienceError,
+    InvalidAuthorizedPartyError,
+    InvalidFlowError,
+    InvalidIssuerError,
+    InvalidNonceError,
+    InvalidParameterError,
+    InvalidSignatureError,
+    InvalidSubjectError,
+    MissingIDTokenError,
+    UnauthorizedRedirectURIError,
+    UnsupportedAlgError,
+)
+from cap_tpu.oidc import Config, Provider, Request, S256Verifier
+from cap_tpu.oidc.testing import TestProvider
+
+REDIRECT = "https://app.example.com/callback"
+
+
+@pytest.fixture(scope="module")
+def idp():
+    with TestProvider() as tp:
+        yield tp
+
+
+@pytest.fixture()
+def provider(idp):
+    cfg = Config(
+        issuer=idp.issuer(),
+        client_id=idp.client_id,
+        client_secret=idp.client_secret,
+        supported_signing_algs=["ES256"],
+        allowed_redirect_urls=[REDIRECT],
+        provider_ca=idp.ca_cert(),
+    )
+    return Provider(cfg)
+
+
+def test_discovery(provider, idp):
+    assert provider.authorization_endpoint == idp.issuer() + "/authorize"
+    assert provider.jwks_uri.endswith("/.well-known/jwks.json")
+
+
+def test_discovery_issuer_mismatch(idp):
+    cfg = Config(
+        issuer=idp.issuer(), client_id="x", client_secret="y",
+        supported_signing_algs=["ES256"], provider_ca=idp.ca_cert(),
+    )
+    doc = {"issuer": "https://evil.example.com"}
+    with pytest.raises(InvalidIssuerError):
+        Provider(cfg, discovery_doc=doc)
+
+
+def test_auth_url_code_flow(provider):
+    req = Request(60, REDIRECT, scopes=["email", "profile"])
+    url = provider.auth_url(req)
+    q = parse_qs(urlparse(url).query)
+    assert q["response_type"] == ["code"]
+    assert q["client_id"] == [provider.config.client_id]
+    assert q["state"] == [req.state()]
+    assert q["nonce"] == [req.nonce()]
+    assert q["scope"][0].split() == ["openid", "email", "profile"]
+
+
+def test_auth_url_pkce(provider):
+    v = S256Verifier()
+    req = Request(60, REDIRECT, pkce_verifier=v)
+    q = parse_qs(urlparse(provider.auth_url(req)).query)
+    assert q["code_challenge"] == [v.challenge()]
+    assert q["code_challenge_method"] == ["S256"]
+
+
+def test_auth_url_implicit(provider):
+    req = Request(60, REDIRECT, implicit_flow=True,
+                  implicit_access_token=True)
+    q = parse_qs(urlparse(provider.auth_url(req)).query)
+    assert q["response_type"] == ["id_token token"]
+    assert q["response_mode"] == ["form_post"]
+
+
+def test_auth_url_options(provider):
+    req = Request(60, REDIRECT, max_age=30, prompts=["login", "consent"],
+                  display="page", ui_locales=["en-US", "fr"],
+                  acr_values=["phr"], claims={"id_token": {}})
+    q = parse_qs(urlparse(provider.auth_url(req)).query)
+    assert q["max_age"] == ["30"]
+    assert q["prompt"] == ["login consent"]
+    assert q["display"] == ["page"]
+    assert q["ui_locales"] == ["en-US fr"]
+    assert q["acr_values"] == ["phr"]
+    assert "claims" in q
+
+
+def test_auth_url_prompt_none_alone(provider):
+    req = Request(60, REDIRECT, prompts=["none", "login"])
+    with pytest.raises(InvalidParameterError):
+        provider.auth_url(req)
+
+
+def test_auth_url_unauthorized_redirect(provider):
+    req = Request(60, "https://evil.example.com/cb")
+    with pytest.raises(UnauthorizedRedirectURIError):
+        provider.auth_url(req)
+
+
+def test_loopback_redirect_port_agnostic(idp):
+    cfg = Config(
+        issuer=idp.issuer(), client_id=idp.client_id,
+        client_secret=idp.client_secret,
+        supported_signing_algs=["ES256"],
+        allowed_redirect_urls=["http://localhost:3000/cb"],
+        provider_ca=idp.ca_cert(),
+    )
+    p = Provider(cfg)
+    p.valid_redirect("http://localhost:9999/cb")  # different port OK
+    with pytest.raises(UnauthorizedRedirectURIError):
+        p.valid_redirect("http://localhost:9999/other")
+
+
+def test_exchange_full_flow(provider, idp):
+    req = Request(60, REDIRECT)
+    idp.set_expected_auth_nonce(req.nonce())
+    token = provider.exchange(req, req.state(), idp.expected_auth_code)
+    assert token.id_token().claims()["nonce"] == req.nonce()
+    assert token.access_token().reveal() == "test-access-token"
+    assert token.valid()
+
+
+def test_exchange_pkce_flow(provider, idp):
+    v = S256Verifier()
+    req = Request(60, REDIRECT, pkce_verifier=v)
+    idp.set_expected_auth_nonce(req.nonce())
+    idp.set_expected_code_verifier(v.verifier())
+    try:
+        token = provider.exchange(req, req.state(), idp.expected_auth_code)
+        assert token.id_token()
+    finally:
+        idp.expected_code_verifier = None
+
+
+def test_exchange_guards(provider, idp):
+    req = Request(60, REDIRECT)
+    with pytest.raises(InvalidParameterError):
+        provider.exchange(req, "other-state", "code")
+    imp = Request(60, REDIRECT, implicit_flow=True)
+    with pytest.raises(InvalidFlowError):
+        provider.exchange(imp, imp.state(), "code")
+    expired = Request(60, REDIRECT, now_func=lambda: 0.0)
+    expired._now_func = None  # request was created long "ago"
+    with pytest.raises(InvalidParameterError):
+        provider.exchange(expired, expired.state(), "code")
+
+
+def test_exchange_wrong_code(provider, idp):
+    req = Request(60, REDIRECT)
+    with pytest.raises(InvalidParameterError):
+        provider.exchange(req, req.state(), "wrong-code")
+
+
+def test_exchange_token_disabled(provider, idp):
+    idp.set_disable_token(True)
+    try:
+        req = Request(60, REDIRECT)
+        with pytest.raises(InvalidParameterError):
+            provider.exchange(req, req.state(), idp.expected_auth_code)
+    finally:
+        idp.set_disable_token(False)
+
+
+def test_exchange_omit_id_token(provider, idp):
+    idp.set_omit_id_tokens(True)
+    try:
+        req = Request(60, REDIRECT)
+        idp.set_expected_auth_nonce(req.nonce())
+        with pytest.raises(MissingIDTokenError):
+            provider.exchange(req, req.state(), idp.expected_auth_code)
+    finally:
+        idp.set_omit_id_tokens(False)
+
+
+def test_verify_id_token_negative_paths(provider, idp):
+    req = Request(60, REDIRECT)
+    # wrong nonce
+    tok = idp.issue_signed_jwt(nonce="some-other-nonce")
+    with pytest.raises(InvalidNonceError):
+        provider.verify_id_token(tok, req)
+    # expired
+    tok = idp.issue_signed_jwt(nonce=req.nonce(),
+                               extra_claims={"exp": 1000000})
+    with pytest.raises(ExpiredTokenError):
+        provider.verify_id_token(tok, req)
+    # wrong issuer
+    tok = idp.issue_signed_jwt(nonce=req.nonce(),
+                               extra_claims={"iss": "https://evil"})
+    with pytest.raises(InvalidIssuerError):
+        provider.verify_id_token(tok, req)
+    # foreign single audience with no azp → caught by azp rule 3
+    # (audience-intersection check is skipped when no expected audiences
+    # are configured, matching provider.go:460-472 + 479-497)
+    tok = idp.issue_signed_jwt(nonce=req.nonce(),
+                               extra_claims={"aud": ["someone-else"]})
+    with pytest.raises(InvalidAuthorizedPartyError):
+        provider.verify_id_token(tok, req)
+    # configured expected audiences → audience error
+    req_aud = Request(60, REDIRECT, audiences=["expected-aud"])
+    tok = idp.issue_signed_jwt(nonce=req_aud.nonce(),
+                               extra_claims={"aud": ["someone-else"]})
+    with pytest.raises(InvalidAudienceError):
+        provider.verify_id_token(tok, req_aud)
+    # azp present but wrong
+    tok = idp.issue_signed_jwt(nonce=req.nonce(),
+                               extra_claims={"azp": "other-party"})
+    with pytest.raises(InvalidAuthorizedPartyError):
+        provider.verify_id_token(tok, req)
+    # multiple audiences incl. client, azp == client → OK
+    tok = idp.issue_signed_jwt(
+        nonce=req.nonce(),
+        extra_claims={"aud": [idp.client_id, "second"],
+                      "azp": idp.client_id})
+    assert provider.verify_id_token(tok, req)["sub"]
+    # corrupt signature
+    idp.set_invalid_jwt_signature(True)
+    try:
+        tok = idp.issue_signed_jwt(nonce=req.nonce())
+        with pytest.raises(InvalidSignatureError):
+            provider.verify_id_token(tok, req)
+    finally:
+        idp.set_invalid_jwt_signature(False)
+
+
+def test_verify_id_token_unsupported_alg(idp):
+    cfg = Config(
+        issuer=idp.issuer(), client_id=idp.client_id,
+        client_secret=idp.client_secret,
+        supported_signing_algs=["RS256"],  # IdP signs ES256
+        provider_ca=idp.ca_cert(),
+    )
+    p = Provider(cfg)
+    req = Request(60, REDIRECT)
+    tok = idp.issue_signed_jwt(nonce=req.nonce())
+    with pytest.raises(UnsupportedAlgError):
+        p.verify_id_token(tok, req)
+
+
+def test_verify_id_token_max_age(provider, idp):
+    req = Request(60, REDIRECT, max_age=300)
+    tok = idp.issue_signed_jwt(nonce=req.nonce())
+    assert provider.verify_id_token(tok, req)["auth_time"]
+    # auth_time far in the past → beyond max age
+    req2 = Request(60, REDIRECT, max_age=10)
+    tok2 = idp.issue_signed_jwt(
+        nonce=req2.nonce(), extra_claims={"auth_time": 1000000})
+    with pytest.raises(ExpiredAuthTimeError):
+        provider.verify_id_token(tok2, req2)
+    # missing auth_time claim when max_age requested
+    tok3 = idp.issue_signed_jwt(nonce=req2.nonce(),
+                                extra_claims={"auth_time": None})
+    import json
+
+    from cap_tpu.errors import MissingClaimError
+
+    # rebuild without auth_time
+    with pytest.raises(MissingClaimError):
+        priv, _, alg, kid = idp.signing_keys()
+        from cap_tpu import testing as captest
+
+        claims = {k: v for k, v in json.loads(
+            __import__("cap_tpu.jwt.jose", fromlist=["parse_compact"])
+            .parse_compact(tok3).payload) .items() if k != "auth_time"}
+        provider.verify_id_token(
+            captest.sign_jwt(priv, alg, claims, kid=kid), req2)
+
+
+def test_key_rotation_refetch(provider, idp):
+    req = Request(60, REDIRECT)
+    tok = idp.issue_signed_jwt(nonce=req.nonce())
+    assert provider.verify_id_token(tok, req)
+    idp.rotate_signing_keys()
+    try:
+        tok2 = idp.issue_signed_jwt(nonce=req.nonce())
+        assert provider.verify_id_token(tok2, req)["sub"]
+    finally:
+        pass
+
+
+def test_userinfo(provider, idp):
+    class TS:
+        def token(self):
+            return "test-access-token"
+
+    claims = provider.userinfo(TS(), idp.replay_subject)
+    assert claims["sub"] == idp.replay_subject
+    with pytest.raises(InvalidSubjectError):
+        provider.userinfo(TS(), "mallory")
+
+
+def test_userinfo_disabled(provider, idp):
+    idp.set_disable_userinfo(True)
+    try:
+        class TS:
+            def token(self):
+                return "test-access-token"
+
+        from cap_tpu.errors import UserInfoFailedError
+
+        with pytest.raises(UserInfoFailedError):
+            provider.userinfo(TS(), idp.replay_subject)
+    finally:
+        idp.set_disable_userinfo(False)
+
+
+def test_exchange_without_at_hash(provider, idp):
+    # at_hash is OPTIONAL in the code flow: an IdP issuing access tokens
+    # without it must still be loginable (reference (false, nil) parity).
+    idp.set_omit_at_hash(True)
+    try:
+        req = Request(60, REDIRECT)
+        idp.set_expected_auth_nonce(req.nonce())
+        token = provider.exchange(req, req.state(), idp.expected_auth_code)
+        assert "at_hash" not in token.id_token().claims()
+    finally:
+        idp.set_omit_at_hash(False)
+
+
+def test_batch_accepts_idtoken_instances(provider, idp):
+    from cap_tpu.oidc import IDToken
+
+    req = Request(60, REDIRECT)
+    toks = [IDToken(idp.issue_signed_jwt(nonce=req.nonce()))]
+    res = provider.verify_id_token_batch(toks, req)
+    assert isinstance(res[0], dict) and res[0]["sub"]
+
+
+def test_batch_id_token_verification(provider, idp):
+    req = Request(60, REDIRECT)
+    good = [idp.issue_signed_jwt(nonce=req.nonce()) for _ in range(4)]
+    bad_nonce = idp.issue_signed_jwt(nonce="wrong")
+    tampered = good[0][:-10] + "AAAAAAAAAA"
+    res = provider.verify_id_token_batch(good + [bad_nonce, tampered], req)
+    assert all(isinstance(r, dict) for r in res[:4])
+    assert isinstance(res[4], InvalidNonceError)
+    assert isinstance(res[5], InvalidSignatureError)

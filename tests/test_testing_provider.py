@@ -1,0 +1,166 @@
+"""The test infra is itself tested (pattern 6, testing_provider_test.go)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cap_tpu.errors import InvalidJWKSError
+from cap_tpu.jwt import JSONWebKeySet, StaticKeySet
+from cap_tpu.oidc.testing import TestProvider
+from cap_tpu.utils import http as _http
+
+
+@pytest.fixture()
+def idp():
+    with TestProvider() as tp:
+        yield tp
+
+
+def _get(idp, path):
+    return _http.get(idp.issuer() + path,
+                     _http.ssl_context_for_ca(idp.ca_cert()))
+
+
+def test_discovery_endpoint(idp):
+    status, body, _ = _get(idp, "/.well-known/openid-configuration")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["issuer"] == idp.issuer()
+    assert doc["jwks_uri"].endswith("/.well-known/jwks.json")
+
+
+def test_discovery_disabled(idp):
+    idp.set_disable_discovery(True)
+    status, _, _ = _get(idp, "/.well-known/openid-configuration")
+    assert status == 404
+
+
+def test_jwks_endpoint_and_signing(idp):
+    status, body, _ = _get(idp, "/.well-known/jwks.json")
+    assert status == 200
+    assert json.loads(body)["keys"][0]["kid"] == "kid-0"
+    # a token it issues verifies against its JWKS
+    tok = idp.issue_signed_jwt(nonce="n1")
+    ks = JSONWebKeySet(idp.issuer() + "/.well-known/jwks.json",
+                       jwks_ca_pem=idp.ca_cert())
+    assert ks.verify_signature(tok)["nonce"] == "n1"
+
+
+def test_jwks_fault_injection(idp):
+    idp.set_disable_jwks(True)
+    status, _, _ = _get(idp, "/.well-known/jwks.json")
+    assert status == 404
+    idp.set_disable_jwks(False)
+    idp.set_invalid_jwks(True)
+    ks = JSONWebKeySet(idp.issuer() + "/.well-known/jwks.json",
+                       jwks_ca_pem=idp.ca_cert())
+    with pytest.raises(InvalidJWKSError):
+        ks.keys()
+
+
+def test_key_rotation(idp):
+    _, pub0, _, kid0 = idp.signing_keys()
+    idp.rotate_signing_keys()
+    _, pub1, _, kid1 = idp.signing_keys()
+    assert kid0 != kid1
+    tok = idp.issue_signed_jwt()
+    with pytest.raises(Exception):
+        StaticKeySet([pub0]).verify_signature(tok)
+    assert StaticKeySet([pub1]).verify_signature(tok)
+
+
+def test_clock_control(idp):
+    idp.set_now_func(lambda: 1000000.0)
+    tok = idp.issue_signed_jwt()
+    claims = StaticKeySet([idp.signing_keys()[1]]).verify_signature(tok)
+    assert claims["iat"] == 1000000
+    assert claims["exp"] == 1000000 + int(idp.expected_expiry)
+
+
+def test_custom_claims_and_audience(idp):
+    idp.set_custom_claims({"groups": ["a", "b"]})
+    idp.set_custom_audiences(["aud-1", "aud-2"])
+    tok = idp.issue_signed_jwt()
+    claims = StaticKeySet([idp.signing_keys()[1]]).verify_signature(tok)
+    assert claims["groups"] == ["a", "b"]
+    assert claims["aud"] == ["aud-1", "aud-2"]
+
+
+def test_expected_state_override(idp):
+    # inspect the 302 without following it (http.client, no redirects)
+    import http.client
+    from urllib.parse import urlparse
+
+    idp.set_expected_state("forced-state")
+    u = urlparse(idp.issuer())
+    conn = http.client.HTTPSConnection(
+        u.hostname, u.port,
+        context=_http.ssl_context_for_ca(idp.ca_cert()))
+    conn.request("GET", "/authorize?response_type=code&state=real&"
+                        "redirect_uri=https%3A%2F%2Fapp%2Fcb")
+    resp = conn.getresponse()
+    assert resp.status == 302
+    assert "state=forced-state" in resp.getheader("Location")
+    conn.close()
+
+
+def test_token_endpoint_auth(idp):
+    # wrong client secret rejected
+    status, body, _ = _http.post_form(
+        idp.issuer() + "/token",
+        {"grant_type": "authorization_code", "code": idp.expected_auth_code,
+         "client_id": idp.client_id, "client_secret": "wrong"},
+        _http.ssl_context_for_ca(idp.ca_cert()))
+    assert status == 401
+    # basic auth accepted
+    import base64
+
+    basic = base64.b64encode(
+        f"{idp.client_id}:{idp.client_secret}".encode()).decode()
+    status, body, _ = _http.post_form(
+        idp.issuer() + "/token",
+        {"grant_type": "authorization_code", "code": idp.expected_auth_code},
+        _http.ssl_context_for_ca(idp.ca_cert()),
+        headers={"Authorization": f"Basic {basic}"})
+    assert status == 200
+    assert "id_token" in json.loads(body)
+
+
+def test_omit_tokens(idp):
+    idp.set_omit_access_tokens(True)
+    status, body, _ = _http.post_form(
+        idp.issuer() + "/token",
+        {"grant_type": "authorization_code", "code": idp.expected_auth_code,
+         "client_id": idp.client_id, "client_secret": idp.client_secret},
+        _http.ssl_context_for_ca(idp.ca_cert()))
+    payload = json.loads(body)
+    assert "access_token" not in payload and "id_token" in payload
+
+
+def test_userinfo_endpoint(idp):
+    status, body, _ = _http.get(
+        idp.issuer() + "/userinfo",
+        _http.ssl_context_for_ca(idp.ca_cert()),
+        headers={"Authorization": "Bearer anything"})
+    assert status == 200
+    assert json.loads(body)["sub"] == idp.replay_subject
+    # no bearer → 401
+    status, _, _ = _get(idp, "/userinfo")
+    assert status == 401
+    # custom reply
+    idp.set_user_info_reply({"sub": "custom", "plan": "pro"})
+    status, body, _ = _http.get(
+        idp.issuer() + "/userinfo",
+        _http.ssl_context_for_ca(idp.ca_cert()),
+        headers={"Authorization": "Bearer x"})
+    assert json.loads(body)["plan"] == "pro"
+
+
+def test_no_tls_mode():
+    with TestProvider(no_tls=True) as tp:
+        assert tp.issuer().startswith("http://")
+        status, body, _ = _http.get(
+            tp.issuer() + "/.well-known/openid-configuration")
+        assert status == 200
