@@ -1,0 +1,23 @@
+CXX ?= g++
+CXXFLAGS ?= -O3 -march=native -fPIC -shared -pthread -std=c++17 -Wall
+
+NATIVE_DIR := cap_tpu/runtime/native
+NATIVE_SO := $(NATIVE_DIR)/libcapruntime.so
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench: native
+	python bench.py
+
+clean:
+	rm -f $(NATIVE_SO)

@@ -175,6 +175,10 @@ def new_oidc_discovery_keyset(issuer: str,
     Fetches ``{issuer}/.well-known/openid-configuration``, requires the
     document's ``issuer`` to equal the requested issuer, and returns a
     :class:`JSONWebKeySet` on the advertised ``jwks_uri``.
+
+    Discovery failures (bad status, non-JSON document, issuer mismatch)
+    raise :class:`InvalidIssuerError` — the same taxonomy the oidc
+    Provider uses for its discovery step.
     """
     if not issuer:
         raise NilParameterError("issuer is required")

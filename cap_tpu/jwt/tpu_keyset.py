@@ -114,6 +114,24 @@ class TPUBatchKeySet(KeySet):
             if jwk.kid:
                 self._by_kid.setdefault(jwk.kid, []).append(i)
 
+        # kid → family table row, for kids resolving to exactly one key
+        # (ambiguous kids take the trial-verify slow path)
+        self._kid_rsa_row: Dict[str, int] = {}
+        self._kid_ec_row: Dict[str, Dict[str, int]] = {c: {} for c in
+                                                       self._ec_rows}
+        self._kid_ed_row: Dict[str, int] = {}
+        for kid, idxs in self._by_kid.items():
+            if len(idxs) != 1:
+                continue
+            i = idxs[0]
+            if i in self._rsa_rows:
+                self._kid_rsa_row[kid] = self._rsa_rows[i]
+            for crv, rows in self._ec_rows.items():
+                if i in rows:
+                    self._kid_ec_row[crv][kid] = rows[i]
+            if i in self._ed_rows:
+                self._kid_ed_row[kid] = self._ed_rows[i]
+
     # -- single-token path (CPU oracle) -----------------------------------
 
     def _candidate_indices(self, parsed: ParsedJWS) -> List[int]:
@@ -140,6 +158,125 @@ class TPUBatchKeySet(KeySet):
     # -- batch path --------------------------------------------------------
 
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        from ..runtime import prep
+
+        if prep._load_native() is not None:
+            return self._verify_batch_fast(tokens)
+        return self._verify_batch_objects(tokens)
+
+    def _verify_batch_fast(self, tokens: Sequence[str]) -> List[Any]:
+        """Array-native batch path: C++ prep → numpy bucketing/kid gather
+        → device dispatch, with per-token Python only for results."""
+        from ..runtime.native_binding import ALG_NAMES, prepare_batch_arrays
+
+        pb = prepare_batch_arrays(tokens)
+        n = pb.n
+        results: List[Any] = [None] * n
+        ok = pb.status == 0
+        for i in np.nonzero(~ok)[0]:
+            results[int(i)] = pb.error(int(i))
+
+        slow: List[int] = []
+        alg_ids = {name: i for i, name in enumerate(ALG_NAMES)}
+
+        def run_family(alg_name: str, runner) -> None:
+            idx = np.nonzero(ok & (pb.alg_id == alg_ids[alg_name]))[0]
+            if len(idx) == 0:
+                return
+            runner(alg_name, idx)
+
+        def run_rs(alg_name: str, idx: np.ndarray) -> None:
+            self._run_rsa_arrays("rs", _RS[alg_name], idx, pb, results, slow)
+
+        def run_ps(alg_name: str, idx: np.ndarray) -> None:
+            self._run_rsa_arrays("ps", _PS[alg_name], idx, pb, results, slow)
+
+        if self._rsa_table is not None:
+            for a in _RS:
+                run_family(a, run_rs)
+            for a in _PS:
+                run_family(a, run_ps)
+        # families without device tables (or EC/Ed engines not built):
+        slow_set = set(slow)
+        for j in range(n):
+            if ok[j] and results[j] is None and j not in slow_set:
+                slow_set.add(j)
+
+        for j in sorted(slow_set):
+            results[j] = self._verify_one_parsed(pb.parsed(j))
+        return results
+
+    def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
+                        pb, results: List[Any], slow: List[int]) -> None:
+        from ..tpu import rsa as tpursa
+
+        table = self._rsa_table
+        rows = pb.kid_rows(idx, self._kid_rsa_row)
+        if len(table.n_ints) == 1:
+            # single-key family: kid-less tokens have exactly one
+            # candidate — dispatch them to the device (row 0), matching
+            # the object path's single-candidate routing
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        width = 2 * table.k
+        for lo in range(0, len(idx), self._max_chunk):
+            chunk = idx[lo: lo + self._max_chunk]
+            crows = rows[lo: lo + self._max_chunk]
+            m = len(chunk)
+            pad = _pad_size(m, self._max_chunk)
+            sig_mat = np.zeros((pad, width), np.uint8)
+            sig_mat[:m] = pb.sig_matrix(chunk, width)
+            sig_lens = np.zeros(pad, np.int64)
+            sig_lens[:m] = pb.sig_len[chunk]
+            hash_mat = np.zeros((pad, 64), np.uint8)
+            hash_mat[:m] = pb.digest[chunk]
+            key_idx = np.zeros(pad, np.int32)
+            key_idx[:m] = crows
+            if kind == "rs":
+                okv = tpursa.verify_pkcs1v15_arrays(
+                    table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)
+            else:
+                okv = tpursa.verify_pss_arrays(
+                    table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)
+            for j, good in zip(chunk, okv[:m]):
+                j = int(j)
+                if good:
+                    try:
+                        results[j] = pb.claims(j)
+                    except MalformedTokenError as e:
+                        results[j] = e
+                else:
+                    results[j] = InvalidSignatureError(
+                        "no known key successfully validated the token "
+                        "signature")
+
+    def _verify_one_parsed(self, p) -> Any:
+        """CPU trial verification of one parsed token (slow path)."""
+        if not self._cpu_fallback:
+            return InvalidParameterError(
+                "token cannot be dispatched to the device engine and "
+                "CPU fallback is disabled")
+        last: Optional[Exception] = None
+        for i in self._candidate_indices(p):
+            try:
+                verify_parsed(p, self._jwks[i].key)
+                try:
+                    return p.claims()
+                except MalformedTokenError as e:
+                    return e
+            except InvalidSignatureError as e:
+                last = e
+        err = InvalidSignatureError(
+            "no known key successfully validated the token signature")
+        err.__cause__ = last
+        return err
+
+    def _verify_batch_objects(self, tokens: Sequence[str]) -> List[Any]:
         n = len(tokens)
         results: List[Any] = [None] * n
         parsed_list: List[Optional[ParsedJWS]] = [None] * n
@@ -236,8 +373,16 @@ class TPUBatchKeySet(KeySet):
     def _hashes(self, idxs, parsed_list, hash_name):
         import hashlib
 
-        return [hashlib.new(hash_name, parsed_list[j].signing_input).digest()
-                for j in idxs]
+        out = []
+        for j in idxs:
+            p = parsed_list[j]
+            # native-prepped tokens carry the digest already (computed in
+            # multithreaded C++ during prepare_batch)
+            pre = getattr(p, "digest", None)
+            d = pre() if callable(pre) else None
+            out.append(d if d else
+                       hashlib.new(hash_name, p.signing_input).digest())
+        return out
 
     def _run_rsa(self, kind, hash_name, idxs, parsed_list, key_for, results):
         from ..tpu import rsa as tpursa

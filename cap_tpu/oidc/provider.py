@@ -269,7 +269,13 @@ class Provider:
         out: List[Any] = []
         for raw, res in zip(raws, results):
             if isinstance(res, Exception):
-                out.append(res)
+                # same wrapping as the single-token path so callers see
+                # one taxonomy regardless of which API they used
+                if isinstance(res, InvalidSignatureError):
+                    out.append(res)
+                else:
+                    out.append(InvalidSignatureError(
+                        f"failed to verify id token signature: {res}"))
                 continue
             try:
                 self._check_times(res)

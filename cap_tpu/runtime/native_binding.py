@@ -1,0 +1,410 @@
+"""ctypes binding for the native capruntime (jose_native.cpp).
+
+Loads cap_tpu/runtime/native/libcapruntime.so (built via ``make native``)
+and exposes ``prepare_batch(tokens)`` returning, per token, either a
+:class:`NativeParsed` (duck-compatible with jose.ParsedJWS for the batch
+path: alg / kid / signature / signing_input / payload / claims() /
+digest()) or the same taxonomy exception the Python parser raises.
+
+Raises OSError at import when the library is missing — runtime.prep
+catches that and falls back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..errors import MalformedTokenError, TokenNotSignedError
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "native", "libcapruntime.so")
+_lib = ctypes.CDLL(_LIB_PATH)
+
+ALG_NAMES = ["RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
+             "PS256", "PS384", "PS512", "EdDSA"]
+
+_OK, _ERR_SEGMENTS, _ERR_B64, _ERR_HEADER_JSON, _ERR_NO_ALG, _ERR_UNSIGNED = \
+    range(6)
+
+
+class _TokOut(ctypes.Structure):
+    _fields_ = [
+        ("status", ctypes.c_int32),
+        ("alg_id", ctypes.c_int32),
+        ("sig_off", ctypes.c_int64),
+        ("sig_len", ctypes.c_int64),
+        ("payload_off", ctypes.c_int64),
+        ("payload_len", ctypes.c_int64),
+        ("signing_input_len", ctypes.c_int64),
+        ("kid", ctypes.c_uint8 * 160),
+        ("alg_raw", ctypes.c_uint8 * 32),
+        ("digest", ctypes.c_uint8 * 64),
+        ("digest_len", ctypes.c_int32),
+        ("kid_len", ctypes.c_int32),
+        ("alg_len", ctypes.c_int32),
+        ("pad", ctypes.c_int32),
+    ]
+
+
+assert ctypes.sizeof(_TokOut) == _lib.cap_tokout_size(), \
+    "TokOut ABI mismatch between binding and libcapruntime"
+
+_lib.cap_prepare_batch.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ctypes.POINTER(_TokOut), ctypes.POINTER(ctypes.c_uint8),
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+]
+_lib.cap_sha_batch.argtypes = [
+    ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int32,
+]
+
+
+class NativeParsed:
+    """Parsed JWS view over native-decoded buffers (unverified)."""
+
+    __slots__ = ("alg", "kid", "signature", "payload", "signing_input",
+                 "_digest", "_digest_len", "header")
+
+    def __init__(self, alg: str, kid: Optional[str], signature: bytes,
+                 payload: bytes, signing_input: bytes,
+                 digest: bytes):
+        self.alg = alg
+        self.kid = kid
+        self.signature = signature
+        self.payload = payload
+        self.signing_input = signing_input
+        self._digest = digest
+        # only alg/kid are extracted natively; enough for the batch path
+        self.header: Dict[str, Any] = (
+            {"alg": alg, "kid": kid} if kid is not None else {"alg": alg})
+
+    def claims(self) -> Dict[str, Any]:
+        try:
+            claims = json.loads(self.payload)
+        except (ValueError, UnicodeDecodeError) as e:
+            raise MalformedTokenError(f"payload is not valid JSON: {e}") from e
+        if not isinstance(claims, dict):
+            raise MalformedTokenError("payload is not a JSON object")
+        return claims
+
+    def digest(self) -> bytes:
+        """Precomputed SHA-2 of the signing input (empty for EdDSA)."""
+        return self._digest
+
+
+def prepare_batch(tokens: Sequence[str],
+                  n_threads: int = 0) -> List[Any]:
+    n = len(tokens)
+    if n == 0:
+        return []
+    try:
+        encoded = [t.encode("ascii") for t in tokens]
+    except UnicodeEncodeError:
+        # non-ascii tokens: delegate entirely to the Python parser
+        from .prep import _prepare_python
+
+        return _prepare_python(tokens)
+    blob = b"".join(encoded)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    # per-token scratch: decoded payload+sig always fit in token length+8
+    scratch_sizes = np.asarray([len(e) + 8 for e in encoded], np.int64)
+    scratch_offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(scratch_sizes, out=scratch_offsets[1:])
+    scratch = np.empty(int(scratch_offsets[-1]), np.uint8)
+    outs = (_TokOut * n)()
+
+    _lib.cap_prepare_batch(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, outs,
+        scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        scratch_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n_threads,
+    )
+
+    scratch_bytes = scratch.tobytes()
+    results: List[Any] = []
+    for i in range(n):
+        o = outs[i]
+        if o.status == _OK:
+            base = int(scratch_offsets[i])
+            tok_base = int(offsets[i])
+            payload = scratch_bytes[base: base + o.payload_len]
+            sig = scratch_bytes[base + o.sig_off:
+                                base + o.sig_off + o.sig_len]
+            signing_input = blob[tok_base: tok_base + o.signing_input_len]
+            if o.kid_len == -1:
+                kid = None
+            elif o.kid_len == -2:
+                from ..jwt.jose import parse_compact as _pc
+
+                try:
+                    kid = _pc(tokens[i]).kid
+                except Exception:  # noqa: BLE001
+                    kid = None
+            else:
+                kid = bytes(bytearray(o.kid[: o.kid_len])).decode(
+                    "utf-8", "surrogateescape")
+            alg = (ALG_NAMES[o.alg_id] if o.alg_id >= 0
+                   else bytes(bytearray(o.alg_raw[: o.alg_len])).decode(
+                       "utf-8", "surrogateescape"))
+            results.append(NativeParsed(
+                alg, kid, sig, payload, signing_input,
+                bytes(o.digest[: o.digest_len])))
+        elif o.status == _ERR_UNSIGNED:
+            results.append(TokenNotSignedError("token must be signed"))
+        elif o.status == _ERR_SEGMENTS:
+            results.append(MalformedTokenError(
+                "compact JWS must have 3 segments"))
+        elif o.status == _ERR_NO_ALG:
+            results.append(MalformedTokenError(
+                "protected header missing alg parameter"))
+        elif o.status == _ERR_HEADER_JSON:
+            results.append(MalformedTokenError(
+                "protected header is not a JSON object"))
+        else:
+            results.append(MalformedTokenError(
+                "invalid base64url segment"))
+    return results
+
+
+class PreparedBatch:
+    """Structure-of-arrays view of a prepared token batch.
+
+    The zero-copy fast path for ``TPUBatchKeySet``: statuses, alg ids,
+    kid bytes, signatures, and digests stay as numpy arrays so
+    bucketing, key-row lookup, and limb packing are vectorized;
+    per-token Python objects are only created lazily (claims of
+    verified tokens, error objects for failures).
+    """
+
+    __slots__ = ("n", "status", "alg_id", "kid_mat", "kid_len", "sig_off",
+                 "sig_len", "payload_off", "payload_len", "si_len", "digest",
+                 "digest_len", "scratch", "blob", "tok_off", "alg_raw",
+                 "alg_len")
+
+    def __init__(self, n, status, alg_id, kid_mat, kid_len, sig_off, sig_len,
+                 payload_off, payload_len, si_len, digest, digest_len,
+                 scratch, blob, tok_off, alg_raw, alg_len):
+        self.n = n
+        self.status = status
+        self.alg_id = alg_id
+        self.kid_mat = kid_mat
+        self.kid_len = kid_len          # -1 absent, -2 overlong
+        self.sig_off = sig_off          # absolute into scratch
+        self.sig_len = sig_len
+        self.payload_off = payload_off  # absolute into scratch
+        self.payload_len = payload_len
+        self.si_len = si_len
+        self.digest = digest            # [n, 64] uint8
+        self.digest_len = digest_len
+        self.scratch = scratch          # uint8 array (decoded payload+sig)
+        self.blob = blob                # bytes (raw concatenated tokens)
+        self.tok_off = tok_off
+        self.alg_raw = alg_raw          # [n, 32] uint8 (for unknown algs)
+        self.alg_len = alg_len
+
+    # -- vectorized helpers -----------------------------------------------
+
+    def sig_matrix(self, idx: np.ndarray, width: int) -> np.ndarray:
+        """[len(idx), width] uint8: left-aligned raw signature bytes,
+        zero-padded at the tail (pair with sig_len)."""
+        cols = np.arange(width)[None, :]
+        offs = self.sig_off[idx][:, None] + cols
+        lens = self.sig_len[idx][:, None]
+        safe = np.minimum(offs, len(self.scratch) - 1)
+        mat = self.scratch[safe]
+        return np.where(cols < lens, mat, 0).astype(np.uint8)
+
+    def kid_rows(self, idx: np.ndarray, kid_to_row: dict) -> np.ndarray:
+        """Vectorized kid → key-row resolution. Returns row per token;
+        -1 = no kid; -2 = unknown/unresolvable kid.
+
+        One np.unique over (kid bytes ‖ kid length) views, then a dict
+        lookup per *unique* kid — O(m log m + uniques), independent of
+        JWKS size (byte-exact: embedded NULs fine; overlong kids were
+        flagged by the native layer and resolve to -2 → exact slow path).
+        """
+        m = len(idx)
+        lens = self.kid_len[idx]
+        rows = np.full(m, -2, np.int32)
+        rows[lens == -1] = -1
+        present = lens >= 0
+        if not present.any():
+            return rows
+        keyed = np.zeros((m, 164), np.uint8)
+        keyed[present, :160] = self.kid_mat[idx[present]]
+        keyed[present, 160:] = lens[present, None].astype(np.int32).view(
+            np.uint8).reshape(-1, 4)
+        view = np.ascontiguousarray(keyed).view(
+            np.dtype((np.void, 164))).ravel()
+        uniq, inverse = np.unique(view, return_inverse=True)
+        uniq_rows = np.full(len(uniq), -2, np.int32)
+        for u in range(len(uniq)):
+            raw = uniq[u].tobytes()
+            klen = int(np.frombuffer(raw[160:], np.int32)[0])
+            if klen < 0:
+                continue
+            kid = raw[:klen].decode("utf-8", "surrogateescape")
+            uniq_rows[u] = kid_to_row.get(kid, -2)
+        resolved = uniq_rows[inverse]
+        rows[present] = resolved[present]
+        return rows
+
+    # -- lazy per-token materialization -----------------------------------
+
+    def payload_bytes(self, i: int) -> bytes:
+        o, l = int(self.payload_off[i]), int(self.payload_len[i])
+        return self.scratch[o: o + l].tobytes()
+
+    def claims(self, i: int) -> Dict[str, Any]:
+        try:
+            claims = json.loads(self.payload_bytes(i))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise MalformedTokenError(f"payload is not valid JSON: {e}") from e
+        if not isinstance(claims, dict):
+            raise MalformedTokenError("payload is not a JSON object")
+        return claims
+
+    def signature(self, i: int) -> bytes:
+        o, l = int(self.sig_off[i]), int(self.sig_len[i])
+        return self.scratch[o: o + l].tobytes()
+
+    def signing_input(self, i: int) -> bytes:
+        o = int(self.tok_off[i])
+        return self.blob[o: o + int(self.si_len[i])]
+
+    def token(self, i: int) -> str:
+        o, e = int(self.tok_off[i]), int(self.tok_off[i + 1])
+        return self.blob[o:e].decode("ascii")
+
+    def alg(self, i: int) -> str:
+        aid = int(self.alg_id[i])
+        if aid >= 0:
+            return ALG_NAMES[aid]
+        n = int(self.alg_len[i])
+        return self.alg_raw[i, :n].tobytes().decode("utf-8", "surrogateescape")
+
+    def kid(self, i: int) -> Optional[str]:
+        n = int(self.kid_len[i])
+        if n == -1:
+            return None
+        if n == -2:
+            # overlong kid (>160B): not captured natively; re-parse the
+            # original token in Python for the exact value
+            from ..jwt.jose import parse_compact
+
+            try:
+                return parse_compact(self.token(i)).kid
+            except Exception:  # noqa: BLE001
+                return None
+        return self.kid_mat[i, :n].tobytes().decode("utf-8", "surrogateescape")
+
+    def error(self, i: int) -> Exception:
+        s = int(self.status[i])
+        if s == _ERR_UNSIGNED:
+            return TokenNotSignedError("token must be signed")
+        if s == _ERR_SEGMENTS:
+            return MalformedTokenError("compact JWS must have 3 segments")
+        if s == _ERR_NO_ALG:
+            return MalformedTokenError(
+                "protected header missing alg parameter")
+        if s == _ERR_HEADER_JSON:
+            return MalformedTokenError(
+                "protected header is not a JSON object")
+        return MalformedTokenError("invalid base64url segment")
+
+    def parsed(self, i: int) -> "NativeParsed":
+        """Materialize one token as a NativeParsed (slow-path interop)."""
+        return NativeParsed(
+            self.alg(i), self.kid(i), self.signature(i),
+            self.payload_bytes(i), self.signing_input(i),
+            bytes(self.digest[i, : self.digest_len[i]]))
+
+
+_TOKOUT_DTYPE = np.dtype([
+    ("status", np.int32), ("alg_id", np.int32),
+    ("sig_off", np.int64), ("sig_len", np.int64),
+    ("payload_off", np.int64), ("payload_len", np.int64),
+    ("signing_input_len", np.int64),
+    ("kid", np.uint8, 160), ("alg_raw", np.uint8, 32),
+    ("digest", np.uint8, 64), ("digest_len", np.int32),
+    ("kid_len", np.int32), ("alg_len", np.int32), ("pad", np.int32),
+])
+assert _TOKOUT_DTYPE.itemsize == ctypes.sizeof(_TokOut)
+
+
+def prepare_batch_arrays(tokens: Sequence[str],
+                         n_threads: int = 0) -> PreparedBatch:
+    """Prepare a batch into structure-of-arrays form (the fast path)."""
+    n = len(tokens)
+    blob_str = "".join(tokens)
+    blob = blob_str.encode("ascii", "replace")  # non-ascii → malformed anyway
+    lengths = np.fromiter((len(t) for t in tokens), np.int64, count=n)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    scratch_offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lengths + 8, out=scratch_offsets[1:])
+    scratch = np.empty(int(scratch_offsets[-1]) + 1, np.uint8)
+    outs = np.zeros(n, dtype=_TOKOUT_DTYPE)
+
+    _lib.cap_prepare_batch(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        outs.ctypes.data_as(ctypes.POINTER(_TokOut)),
+        scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        scratch_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n_threads,
+    )
+    base = scratch_offsets[:n]
+    return PreparedBatch(
+        n=n,
+        status=outs["status"],
+        alg_id=outs["alg_id"],
+        kid_mat=outs["kid"],
+        kid_len=outs["kid_len"],
+        sig_off=base + outs["sig_off"],
+        sig_len=outs["sig_len"],
+        payload_off=base + outs["payload_off"],
+        payload_len=outs["payload_len"],
+        si_len=outs["signing_input_len"],
+        digest=outs["digest"],
+        digest_len=outs["digest_len"],
+        scratch=scratch,
+        blob=blob,
+        tok_off=offsets,
+        alg_raw=outs["alg_raw"],
+        alg_len=outs["alg_len"],
+    )
+
+
+def sha_batch(chunks: Sequence[bytes], bits: int,
+              n_threads: int = 0) -> List[bytes]:
+    """Batched SHA-256/384/512 over byte chunks via the native library."""
+    n = len(chunks)
+    if n == 0:
+        return []
+    blob = b"".join(chunks)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(c) for c in chunks], out=offsets[1:])
+    out_len = bits // 8
+    out = np.empty(n * out_len, np.uint8)
+    data = np.frombuffer(blob, np.uint8)
+    _lib.cap_sha_batch(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, bits,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_threads,
+    )
+    raw = out.tobytes()
+    return [raw[i * out_len:(i + 1) * out_len] for i in range(n)]

@@ -77,6 +77,28 @@ def bytes_be_to_limbs(chunks: Iterable[bytes], k: int) -> np.ndarray:
     return limbs_be[:, ::-1].T.copy()  # → [k, N] little-endian, limb-first
 
 
+def bytes_matrix_to_limbs(mat: np.ndarray, lens: np.ndarray,
+                          k: int) -> np.ndarray:
+    """Vectorized: left-aligned big-endian byte rows → [k, N] limb array.
+
+    mat: [N, W] uint8 with each row's value occupying its first lens[i]
+    bytes (tail is padding). Values longer than 2*k bytes raise.
+    """
+    n, w = mat.shape
+    width = 2 * k
+    if int(lens.max(initial=0)) > width:
+        raise ValueError("value exceeds limb capacity")
+    cols = np.arange(width)[None, :]
+    src = cols - (width - lens[:, None])          # right-align
+    valid = src >= 0
+    buf = np.where(valid, mat[np.arange(n)[:, None],
+                              np.clip(src, 0, w - 1)], 0)
+    hi = buf[:, 0::2].astype(np.uint32)
+    lo = buf[:, 1::2].astype(np.uint32)
+    limbs_be = (hi << 8) | lo
+    return limbs_be[:, ::-1].T.copy()
+
+
 def limbs_to_bytes_be(limbs: np.ndarray, nbytes: int) -> List[bytes]:
     """[k, N] limb array → N big-endian byte strings of length nbytes."""
     k, n = limbs.shape

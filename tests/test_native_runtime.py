@@ -1,0 +1,169 @@
+"""Native capruntime ↔ Python parser conformance.
+
+The C++ batch tokenizer must agree with cap_tpu.jwt.jose.parse_compact
+on every token — identical verdicts (parsed vs error class), identical
+extracted fields, identical digests — across valid tokens, all malformed
+classes, and adversarial headers.
+"""
+
+import hashlib
+import json
+
+import pytest
+
+from cap_tpu import testing as captest
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.jose import b64url_encode, parse_compact
+from cap_tpu.runtime import prep
+
+native = pytest.importorskip("cap_tpu.runtime.native_binding")
+
+
+def _h(d: dict) -> str:
+    return b64url_encode(json.dumps(d).encode())
+
+
+VALID_TOKENS = []
+for alg in sorted(algs.SUPPORTED_ALGORITHMS):
+    priv, _ = captest.generate_keys(alg)
+    VALID_TOKENS.append(captest.sign_jwt(
+        priv, alg, captest.default_claims(sub=f"u-{alg}"), kid=f"kid-{alg}"))
+
+MALFORMED = [
+    "", "a", "a.b", "a.b.c.d", "..", "a..c",
+    "!!!.e30.c2ln", "e30.!!!.c2ln", "e30.e30.!!!",
+    "aaaaa.e30.c2ln",                       # header len % 4 == 1
+    _h({"alg": "RS256"}) + "." + _h({}) + ".",   # unsigned
+    b64url_encode(b"[1]") + ".e30.c2ln",    # header not an object
+    b64url_encode(b"{}") + ".e30.c2ln",     # no alg
+    b64url_encode(b'{"alg":42}') + ".e30.c2ln",  # alg not a string
+    b64url_encode(b'{"alg":"RS256"') + ".e30.c2ln",  # truncated JSON
+    b64url_encode(b'{"alg":"RS256"} x') + ".e30.c2ln",  # trailing junk
+    b64url_encode(b'not json') + ".e30.c2ln",
+]
+
+TRICKY_VALID = [
+    # duplicate alg keys: last wins (Python json semantics)
+    b64url_encode(b'{"alg":"RS256","alg":"ES256"}') + "." + _h({"a": 1}) + ".c2ln",
+    # nested objects/arrays around alg; unicode escapes in kid
+    b64url_encode(
+        b'{"x":{"alg":"PS256"},"alg":"RS384","arr":[1,{"kid":"no"},null],'
+        b'"kid":"k\\u00e9y","n":1.5e3,"b":true}') + "." + _h({}) + ".c2ln",
+    # kid non-string -> treated as absent
+    b64url_encode(b'{"alg":"EdDSA","kid":123}') + "." + _h({}) + ".c2ln",
+    # unknown alg string (parses fine; alg check happens later)
+    b64url_encode(b'{"alg":"HS256"}') + "." + _h({}) + ".c2ln",
+]
+
+
+def test_valid_tokens_match_python():
+    results = native.prepare_batch(VALID_TOKENS)
+    for tok, res in zip(VALID_TOKENS, results):
+        ref = parse_compact(tok)
+        assert not isinstance(res, Exception), res
+        assert res.alg == ref.alg
+        assert res.kid == ref.kid
+        assert res.signature == ref.signature
+        assert res.payload == ref.payload
+        assert res.signing_input == ref.signing_input
+        if ref.alg != "EdDSA":
+            hname = algs.HASH_FOR_ALG[ref.alg]
+            assert res.digest() == hashlib.new(
+                hname, ref.signing_input).digest()
+        assert res.claims()["sub"] == ref.claims()["sub"]
+
+
+def test_malformed_match_python():
+    results = native.prepare_batch(MALFORMED)
+    for tok, res in zip(MALFORMED, results):
+        try:
+            parse_compact(tok)
+            pytest.fail(f"python accepted {tok!r}")
+        except Exception as ref_exc:
+            assert isinstance(res, Exception), f"native accepted {tok!r}"
+            assert type(res) is type(ref_exc), (
+                f"{tok!r}: native {type(res).__name__} "
+                f"vs python {type(ref_exc).__name__}")
+
+
+def test_tricky_headers_match_python():
+    results = native.prepare_batch(TRICKY_VALID)
+    for tok, res in zip(TRICKY_VALID, results):
+        ref = parse_compact(tok)
+        assert not isinstance(res, Exception), (tok, res)
+        assert res.alg == ref.alg
+        assert res.kid == ref.kid
+
+
+def test_kid_edge_cases_match_python():
+    # empty kid, NUL-embedded kid, overlong kid, unicode-escaped kid
+    cases = [
+        b64url_encode(b'{"alg":"RS256","kid":""}') + "." + _h({}) + ".c2ln",
+        b64url_encode(b'{"alg":"RS256","kid":"a\\u0000b"}') + "." + _h({}) + ".c2ln",
+        b64url_encode(('{"alg":"RS256","kid":"' + "K" * 300 + '"}')
+                      .encode()) + "." + _h({}) + ".c2ln",
+        b64url_encode(b'{"alg":"RS256","kid":"k\\u00e9y"}') + "." + _h({}) + ".c2ln",
+    ]
+    results = native.prepare_batch(cases)
+    pb = native.prepare_batch_arrays(cases)
+    import numpy as np
+
+    for i, (tok, res) in enumerate(zip(cases, results)):
+        ref = parse_compact(tok)
+        assert not isinstance(res, Exception)
+        assert res.kid == ref.kid, (i, res.kid, ref.kid)
+        assert pb.kid(i) == ref.kid, i
+    # kid_rows resolves NUL-embedded kids byte-exactly and routes
+    # empty-kid ("" is a present kid) separately from absent
+    rows = pb.kid_rows(np.arange(4), {"a\x00b": 3, "": 9, "kéy": 1})
+    assert rows[1] == 3 and rows[0] == 9 and rows[3] == 1
+    assert rows[2] == -2  # overlong → slow path
+
+
+def test_mixed_batch_order_preserved():
+    batch = [VALID_TOKENS[0], MALFORMED[0], VALID_TOKENS[1], MALFORMED[10]]
+    results = native.prepare_batch(batch)
+    assert not isinstance(results[0], Exception)
+    assert isinstance(results[1], Exception)
+    assert not isinstance(results[2], Exception)
+    assert isinstance(results[3], Exception)
+
+
+def test_prep_uses_native_when_built():
+    res = prep.prepare_batch(VALID_TOKENS[:2])
+    assert all(not isinstance(r, Exception) for r in res)
+
+
+def test_sha_batch():
+    chunks = [b"", b"abc", b"x" * 1000, bytes(range(256)) * 7]
+    for bits, name in [(256, "sha256"), (384, "sha384"), (512, "sha512")]:
+        got = native.sha_batch(chunks, bits)
+        expect = [hashlib.new(name, c).digest() for c in chunks]
+        assert got == expect
+
+
+def test_fuzz_parity_random_mutations():
+    import random
+
+    rng = random.Random(7)
+    base = VALID_TOKENS[0]
+    cases = []
+    for _ in range(300):
+        chars = list(base)
+        for _ in range(rng.randrange(1, 4)):
+            pos = rng.randrange(len(chars))
+            chars[pos] = rng.choice("AZaz09._-!=")
+        cases.append("".join(chars))
+    results = native.prepare_batch(cases)
+    for tok, res in zip(cases, results):
+        try:
+            ref = parse_compact(tok)
+            ok_ref = True
+        except Exception as e:
+            ok_ref, ref_exc = False, e
+        if ok_ref:
+            assert not isinstance(res, Exception), tok
+            assert res.alg == ref.alg and res.signature == ref.signature
+        else:
+            assert isinstance(res, Exception), tok
+            assert type(res) is type(ref_exc), tok
