@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Headline benchmark: mixed RS256/ES256 JWT verifies/sec on one chip.
+
+Mirrors the north-star config (BASELINE.json): a 16-key JWKS (8 RSA-2048
++ 8 P-256), a large batch of mixed RS256/ES256 tokens, verified through
+``TPUBatchKeySet.verify_batch`` — JOSE prep on host (C++ runtime when
+built), signature math on the device engine.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "verifies/sec", "vs_baseline": N}
+vs_baseline is measured throughput / the 500k verifies/sec target
+(BASELINE.md — the reference publishes no numbers of its own).
+
+Environment knobs: CAP_BENCH_BATCH (default 65536), CAP_BENCH_REPS
+(default 3), CAP_BENCH_UNIQUE (default 1024).
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+BASELINE_TARGET = 500_000.0  # verifies/sec, BASELINE.json north_star
+
+
+def _ensure_native() -> None:
+    """Build the C++ JOSE-prep runtime if it isn't built yet."""
+    so = os.path.join(REPO, "cap_tpu", "runtime", "native",
+                      "libcapruntime.so")
+    if os.path.exists(so):
+        return
+    try:
+        subprocess.run(["make", "-C", REPO, "native"], capture_output=True,
+                       timeout=180, check=False)
+    except Exception:
+        pass  # Python prep fallback still works
+
+
+def _make_fixtures(n_unique: int):
+    """16-key JWKS (8×RSA-2048, 8×P-256) + n_unique mixed signed JWTs."""
+    from cap_tpu import testing as T
+    from cap_tpu.jwt import algs
+    from cap_tpu.jwt.jwk import JWK
+
+    jwks, signers = [], []
+    for i in range(8):
+        priv, pub = T.generate_keys(algs.RS256, rsa_bits=2048)
+        jwks.append(JWK(pub, kid=f"rs-{i}"))
+        signers.append((priv, algs.RS256, f"rs-{i}"))
+    for i in range(8):
+        priv, pub = T.generate_keys(algs.ES256)
+        jwks.append(JWK(pub, kid=f"es-{i}"))
+        signers.append((priv, algs.ES256, f"es-{i}"))
+
+    claims = T.default_claims(ttl=86400.0)
+    tokens = []
+    for j in range(n_unique):
+        priv, alg, kid = signers[j % len(signers)]
+        tokens.append(T.sign_jwt(priv, alg, claims, kid=kid))
+    return jwks, tokens
+
+
+def main() -> None:
+    _ensure_native()
+
+    batch = int(os.environ.get("CAP_BENCH_BATCH", 1 << 16))
+    reps = int(os.environ.get("CAP_BENCH_REPS", 3))
+    n_unique = min(int(os.environ.get("CAP_BENCH_UNIQUE", 1024)), batch)
+
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+    jwks, unique = _make_fixtures(n_unique)
+    tokens = (unique * (batch // len(unique) + 1))[:batch]
+    ks = TPUBatchKeySet(jwks)
+
+    # Warmup: triggers XLA compilation for every bucket shape.
+    out = ks.verify_batch(tokens)
+    bad = sum(1 for r in out if isinstance(r, Exception))
+    if bad:
+        print(json.dumps({"metric": "error",
+                          "value": bad,
+                          "unit": "failed_verifies",
+                          "vs_baseline": 0.0}))
+        return
+
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ks.verify_batch(tokens)
+        rates.append(batch / (time.perf_counter() - t0))
+    value = statistics.median(rates)
+
+    print(json.dumps({
+        "metric": "jwt_verifies_per_sec_rs256_es256_16key_jwks",
+        "value": round(value, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(value / BASELINE_TARGET, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
