@@ -1,0 +1,118 @@
+"""Device mesh construction and shard_map'd verification steps.
+
+Everything here is shape-static: the sharded batch axis must be a
+multiple of the mesh size (callers pad — TPUBatchKeySet already pads
+buckets to power-of-two sizes, so any power-of-two mesh divides them).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tpu import bignum
+
+DP_AXIS = "dp"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = DP_AXIS) -> Mesh:
+    """1-D device mesh over the first ``n_devices`` local devices.
+
+    The batch ("dp") axis is the only sharded axis of this workload;
+    the key table is replicated (see package docstring).
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def _rs256_core(s, n, nprime, r2, expected):
+    """Per-shard RS* verify core: modexp + EM compare + range check.
+
+    All inputs are [K, Nl] limb-first arrays for the local shard of the
+    batch. Returns ([Nl] bool verdicts, [] global valid count).
+    """
+    em = bignum.modexp_65537(s, n, nprime, r2)
+    eq = jnp.all(em == expected, axis=0)
+    in_range = ~bignum.compare_ge(s, n)
+    ok = eq & in_range
+    total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), DP_AXIS)
+    return ok, total
+
+
+def sharded_rs256_verify(mesh: Mesh):
+    """Build the jitted multi-chip RS256 verify step for ``mesh``.
+
+    Returns fn(s, n, nprime, r2, expected) -> (ok[N] bool, total int32)
+    with every [K, N] operand sharded over the batch axis. The key
+    gather (table row → per-token operand) happens before this step, on
+    the host or in a preceding sharded gather; here each chip receives
+    its token shard's operands directly.
+    """
+    spec = P(None, DP_AXIS)
+    fn = jax.shard_map(
+        _rs256_core,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(P(DP_AXIS), P()),
+        # zeros-initialized scan carries inside bignum.mul are unvarying
+        # on entry, varying on exit — the vma check rejects that even
+        # though the program is correct; disable it.
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def _gather_core(tabs, idx):
+    """Replicated-table gather: [nk, K] tables + local [Nl] rows → [K, Nl]."""
+    return tuple(t[idx].T for t in tabs)
+
+
+def sharded_verify_step(mesh: Mesh):
+    """The FULL multi-chip batch-verify step: key gather + modexp + check.
+
+    fn(n_tab, np_tab, r2_tab, key_idx, s, expected) where the [nk, K]
+    tables are replicated across the mesh, and key_idx [N] / s [K, N] /
+    expected [K, N] are sharded over ``dp``. This is the step
+    ``dryrun_multichip`` compiles: it exercises the key-gather (EP
+    analog) and batch-DP shardings together with the psum reduction.
+    """
+    tab_spec = P(None, None)
+    limb_spec = P(None, DP_AXIS)
+
+    def step(n_tab, np_tab, r2_tab, key_idx, s, expected):
+        n, nprime, r2 = _gather_core((n_tab, np_tab, r2_tab), key_idx)
+        return _rs256_core(s, n, nprime, r2, expected)
+
+    fn = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(tab_spec, tab_spec, tab_spec, P(DP_AXIS), limb_spec,
+                  limb_spec),
+        out_specs=(P(DP_AXIS), P()),
+        check_vma=False,  # see sharded_rs256_verify
+    )
+    return jax.jit(fn)
+
+
+def shard_batch_arrays(mesh: Mesh, *arrays):
+    """Place [.., N]-batch arrays with their natural sharding on ``mesh``.
+
+    Arrays with ndim == 1 shard over dp on axis 0; ndim == 2 ([K, N])
+    shard over dp on axis 1. Returns device arrays.
+    """
+    out = []
+    for a in arrays:
+        spec = P(DP_AXIS) if a.ndim == 1 else P(None, DP_AXIS)
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)

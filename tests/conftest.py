@@ -1,15 +1,22 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported
-anywhere, so sharding/pjit paths are exercised without TPU hardware (the
-driver separately dry-runs the multi-chip path; benches run on the real
-chip).
+Forces JAX onto a virtual 8-device CPU mesh BEFORE any backend is
+initialized, so sharding/pjit paths are exercised without TPU hardware
+(the driver separately dry-runs the multi-chip path; benches run on the
+real chip).
+
+Note: plain ``JAX_PLATFORMS=cpu`` env vars are NOT enough in this
+image — the axon sitecustomize registers the TPU backend at interpreter
+startup and pins the platform; ``jax.config.update`` still wins when
+called before first device use.
 """
 
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

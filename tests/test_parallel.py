@@ -1,0 +1,78 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+
+from cap_tpu.parallel import make_mesh, sharded_verify_step
+from cap_tpu.parallel.mesh import shard_batch_arrays
+from cap_tpu.tpu import limbs as L
+from cap_tpu.tpu.rsa import RSAKeyTable, expected_pkcs1v15_em
+
+
+@pytest.fixture(scope="module")
+def rsa_fixture():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    msg = b"parallel test message"
+    privs = [rsa.generate_private_key(public_exponent=65537, key_size=1024)
+             for _ in range(2)]
+    sigs = [p.sign(msg, padding.PKCS1v15(), hashes.SHA256()) for p in privs]
+    table = RSAKeyTable(
+        [(p.public_key().public_numbers().n,
+          p.public_key().public_numbers().e) for p in privs])
+    return table, sigs, hashlib.sha256(msg).digest()
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) >= 8
+
+
+def test_sharded_verify_step_parity(rsa_fixture):
+    import jax.numpy as jnp
+
+    table, sigs, digest = rsa_fixture
+    mesh = make_mesh(8)
+    step = sharded_verify_step(mesh)
+
+    n_tok = 32
+    key_idx = (np.arange(n_tok) % 2).astype(np.int32)
+    sig_rows = np.stack([np.frombuffer(sigs[i], np.uint8) for i in key_idx])
+    lens = np.asarray([len(sigs[i]) for i in key_idx], np.int64)
+    s_host = L.bytes_matrix_to_limbs(sig_rows, lens, table.k)
+    sizes = np.asarray(table.sizes_bytes)[key_idx]
+    expected_host = expected_pkcs1v15_em(
+        [digest] * n_tok, "sha256", sizes, table.k)
+
+    # Corrupt two tokens' signatures (flip a low limb bit).
+    s_host = s_host.copy()
+    s_host[0, 3] ^= 1
+    s_host[0, 17] ^= 1
+
+    key_idx_d, s_d, expected_d = shard_batch_arrays(
+        mesh, key_idx, s_host, expected_host)
+    ok, total = step(jnp.asarray(table.n_tab), jnp.asarray(table.np_tab),
+                     jnp.asarray(table.r2_tab), key_idx_d, s_d, expected_d)
+    ok = np.asarray(ok)
+    want = np.ones(n_tok, bool)
+    want[3] = want[17] = False
+    assert (ok == want).all()
+    assert int(total) == n_tok - 2
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out.all()
+    g.dryrun_multichip(8)
+
+
+def test_make_mesh_too_many_devices():
+    with pytest.raises(ValueError):
+        make_mesh(1_000_000)
