@@ -191,11 +191,17 @@ class TPUBatchKeySet(KeySet):
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
             self._run_rsa_arrays("ps", _PS[alg_name], idx, pb, results, slow)
 
+        def run_es(alg_name: str, idx: np.ndarray) -> None:
+            self._run_ec_arrays(alg_name, idx, pb, results, slow)
+
         if self._rsa_table is not None:
             for a in _RS:
                 run_family(a, run_rs)
             for a in _PS:
                 run_family(a, run_ps)
+        for a, crv in _ES.items():
+            if crv in self._ec_tables:
+                run_family(a, run_es)
         # families without device tables (or EC/Ed engines not built):
         slow_set = set(slow)
         for j in range(n):
@@ -243,6 +249,52 @@ class TPUBatchKeySet(KeySet):
             else:
                 okv = tpursa.verify_pss_arrays(
                     table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)
+            for j, good in zip(chunk, okv[:m]):
+                j = int(j)
+                if good:
+                    try:
+                        results[j] = pb.claims(j)
+                    except MalformedTokenError as e:
+                        results[j] = e
+                else:
+                    results[j] = InvalidSignatureError(
+                        "no known key successfully validated the token "
+                        "signature")
+
+    def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb, results: List[Any],
+                       slow: List[int]) -> None:
+        from ..tpu import ec as tpuec
+        from ..tpu.rsa import HASH_LEN
+
+        crv = _ES[alg]
+        table = self._ec_tables[crv]
+        hash_len = HASH_LEN[algs.HASH_FOR_ALG[alg]]
+        rows = pb.kid_rows(idx, self._kid_ec_row[crv])
+        if len(table.keys) == 1:
+            # kid-less tokens have exactly one candidate on this curve
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        width = 2 * table.coord_bytes
+        for lo in range(0, len(idx), self._max_chunk):
+            chunk = idx[lo: lo + self._max_chunk]
+            crows = rows[lo: lo + self._max_chunk]
+            m = len(chunk)
+            pad = _pad_size(m, self._max_chunk)
+            sig_mat = np.zeros((pad, width), np.uint8)
+            sig_mat[:m] = pb.sig_matrix(chunk, width)
+            sig_lens = np.zeros(pad, np.int64)
+            sig_lens[:m] = pb.sig_len[chunk]
+            hash_mat = np.zeros((pad, 64), np.uint8)
+            hash_mat[:m] = pb.digest[chunk]
+            key_idx = np.zeros(pad, np.int32)
+            key_idx[:m] = crows
+            okv = tpuec.verify_ecdsa_arrays(
+                table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
             for j, good in zip(chunk, okv[:m]):
                 j = int(j)
                 if good:
@@ -408,6 +460,7 @@ class TPUBatchKeySet(KeySet):
 
     def _run_ec(self, alg, idxs, parsed_list, key_for, results):
         from ..tpu import ec as tpuec
+        from ..tpu.rsa import HASH_LEN
 
         crv = _ES[alg]
         table = self._ec_tables[crv]
@@ -420,7 +473,7 @@ class TPUBatchKeySet(KeySet):
             rows = [self._ec_rows[crv][key_for[j]] for j in chunk]
             fill = pad - len(chunk)
             sigs += [b"\x00" * (2 * table.coord_bytes)] * fill
-            hashes_ += [b"\x00" * 32] * fill
+            hashes_ += [b"\x00" * HASH_LEN[hash_name]] * fill
             key_idx = np.asarray(rows + [0] * fill, np.int32)
             ok = tpuec.verify_ecdsa_batch(table, sigs, hashes_, key_idx)
             self._finish(chunk, parsed_list, ok[: len(chunk)], results)

@@ -7,7 +7,6 @@ buckets to power-of-two sizes, so any power-of-two mesh divides them).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import numpy as np

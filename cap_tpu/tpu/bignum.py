@@ -187,18 +187,22 @@ def modexp_vare(s: jnp.ndarray, e: jnp.ndarray, n: jnp.ndarray,
     return mont_mul(x, one, n, nprime)
 
 
-@partial(jax.jit, static_argnames=("ebits",))
+@partial(jax.jit, static_argnames=("ebits", "exit_domain", "s_in_mont"))
 def modexp_fixed_exponent(s: jnp.ndarray, e_limbs: jnp.ndarray,
                           n: jnp.ndarray, nprime: jnp.ndarray,
                           r2: jnp.ndarray, one_mont: jnp.ndarray,
-                          ebits: int) -> jnp.ndarray:
+                          ebits: int, exit_domain: bool = True,
+                          s_in_mont: bool = False) -> jnp.ndarray:
     """s^E mod n for big per-token exponents E given as [KE, N] limbs.
 
-    Used by the EC layer for Fermat inversions (E = p-2 / n-2) and any
-    path that needs a full-width exponent. ebits = static exponent
+    Used by the EC layer for Fermat inversions (E = n-2, broadcast) and
+    any path that needs a full-width exponent. ebits = static exponent
     bit-width. Branchless left-to-right ladder over all ebits bits.
+    exit_domain=False returns the result in Montgomery form (the EC
+    scalar path multiplies it straight into other Montgomery values);
+    s_in_mont=True skips the domain entry for an already-Montgomery s.
     """
-    s_m = mont_mul(s, r2, n, nprime)
+    s_m = s if s_in_mont else mont_mul(s, r2, n, nprime)
 
     def body(i, x):
         bit_idx = ebits - 1 - i
@@ -210,8 +214,42 @@ def modexp_fixed_exponent(s: jnp.ndarray, e_limbs: jnp.ndarray,
         return jnp.where(bit[None, :].astype(bool), mult, x)
 
     x = lax.fori_loop(0, ebits, body, one_mont)
+    if not exit_domain:
+        return x
     one = jnp.zeros_like(s).at[0].set(1)
     return mont_mul(x, one, n, nprime)
+
+
+# ---------------------------------------------------------------------------
+# Modular add/sub (used by the EC layer; operands already reduced < m)
+# ---------------------------------------------------------------------------
+
+def add_mod(a: jnp.ndarray, b: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) mod m over [K, N] normalized limb arrays, a, b < m."""
+    k = a.shape[0]
+    zero_row = jnp.zeros_like(a[:1])
+    t = carry_normalize(jnp.concatenate([a + b, zero_row], axis=0))
+    m_pad = jnp.concatenate([m, zero_row], axis=0)
+    ge = compare_ge(t, m_pad)
+    return sub_where(t, m_pad, ge)[:k]
+
+
+def sub_mod(a: jnp.ndarray, b: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """(a - b) mod m over [K, N] normalized limb arrays, a, b < m."""
+    k = a.shape[0]
+    zero_row = jnp.zeros_like(a[:1])
+    # a + m - b: always non-negative, < 2m.
+    t = carry_normalize(jnp.concatenate([a + m, zero_row], axis=0))
+    b_pad = jnp.concatenate([b, zero_row], axis=0)
+    t = sub_where(t, b_pad, jnp.ones(a.shape[1], dtype=bool))
+    m_pad = jnp.concatenate([m, zero_row], axis=0)
+    ge = compare_ge(t, m_pad)
+    return sub_where(t, m_pad, ge)[:k]
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """[K, N] normalized limbs → [N] bool: value == 0."""
+    return jnp.all(a == 0, axis=0)
 
 
 # ---------------------------------------------------------------------------
