@@ -194,6 +194,9 @@ class TPUBatchKeySet(KeySet):
         def run_es(alg_name: str, idx: np.ndarray) -> None:
             self._run_ec_arrays(alg_name, idx, pb, results, slow)
 
+        def run_ed(alg_name: str, idx: np.ndarray) -> None:
+            self._run_ed_arrays(idx, pb, results, slow)
+
         if self._rsa_table is not None:
             for a in _RS:
                 run_family(a, run_rs)
@@ -202,6 +205,8 @@ class TPUBatchKeySet(KeySet):
         for a, crv in _ES.items():
             if crv in self._ec_tables:
                 run_family(a, run_es)
+        if self._ed_table is not None:
+            run_family(algs.EdDSA, run_ed)
         # families without device tables (or EC/Ed engines not built):
         slow_set = set(slow)
         for j in range(n):
@@ -211,6 +216,21 @@ class TPUBatchKeySet(KeySet):
         for j in sorted(slow_set):
             results[j] = self._verify_one_parsed(pb.parsed(j))
         return results
+
+    @staticmethod
+    def _finish_arrays(chunk, okv, pb, results: List[Any]) -> None:
+        """Write per-token verdicts for one array-path device chunk."""
+        for j, good in zip(chunk, okv):
+            j = int(j)
+            if good:
+                try:
+                    results[j] = pb.claims(j)
+                except MalformedTokenError as e:
+                    results[j] = e
+            else:
+                results[j] = InvalidSignatureError(
+                    "no known key successfully validated the token "
+                    "signature")
 
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
                         pb, results: List[Any], slow: List[int]) -> None:
@@ -249,17 +269,7 @@ class TPUBatchKeySet(KeySet):
             else:
                 okv = tpursa.verify_pss_arrays(
                     table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)
-            for j, good in zip(chunk, okv[:m]):
-                j = int(j)
-                if good:
-                    try:
-                        results[j] = pb.claims(j)
-                    except MalformedTokenError as e:
-                        results[j] = e
-                else:
-                    results[j] = InvalidSignatureError(
-                        "no known key successfully validated the token "
-                        "signature")
+            self._finish_arrays(chunk, okv[:m], pb, results)
 
     def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb, results: List[Any],
                        slow: List[int]) -> None:
@@ -295,17 +305,36 @@ class TPUBatchKeySet(KeySet):
             key_idx[:m] = crows
             okv = tpuec.verify_ecdsa_arrays(
                 table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
-            for j, good in zip(chunk, okv[:m]):
-                j = int(j)
-                if good:
-                    try:
-                        results[j] = pb.claims(j)
-                    except MalformedTokenError as e:
-                        results[j] = e
-                else:
-                    results[j] = InvalidSignatureError(
-                        "no known key successfully validated the token "
-                        "signature")
+            self._finish_arrays(chunk, okv[:m], pb, results)
+
+    def _run_ed_arrays(self, idx: np.ndarray, pb, results: List[Any],
+                       slow: List[int]) -> None:
+        from ..tpu import ed25519 as tpued
+
+        table = self._ed_table
+        rows = pb.kid_rows(idx, self._kid_ed_row)
+        if len(table.keys) == 1:
+            # kid-less tokens have exactly one EdDSA candidate
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        for lo in range(0, len(idx), self._max_chunk):
+            chunk = idx[lo: lo + self._max_chunk]
+            crows = rows[lo: lo + self._max_chunk]
+            m = len(chunk)
+            pad = _pad_size(m, self._max_chunk)
+            sigs = [pb.signature(int(j)) for j in chunk]
+            msgs = [pb.signing_input(int(j)) for j in chunk]
+            fill = pad - m
+            sigs += [b"\x00" * 64] * fill
+            msgs += [b""] * fill
+            key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
+            okv = tpued.verify_ed25519_batch(table, sigs, msgs, key_idx)
+            self._finish_arrays(chunk, okv[:m], pb, results)
 
     def _verify_one_parsed(self, p) -> Any:
         """CPU trial verification of one parsed token (slow path)."""

@@ -151,8 +151,6 @@ class ECKeyTable:
     """
 
     def __init__(self, crv: str, keys: Sequence):
-        import jax.numpy as jnp
-
         self.curve = curve(crv)
         self.keys = list(keys)  # cryptography EllipticCurvePublicKey
         self.coord_bytes = self.curve.coord_bytes

@@ -20,7 +20,6 @@ from cryptography.hazmat.primitives.asymmetric.utils import (
 )
 
 from cap_tpu import testing as captest
-from cap_tpu.jwt import StaticKeySet
 from cap_tpu.jwt.jwk import JWK
 from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
 from cap_tpu.tpu.ec import ECKeyTable, curve, verify_ecdsa_batch
@@ -71,7 +70,6 @@ def test_curve_conformance(crv):
     rows.append(0); want.append(False)
     # s = n - <real s> is a DIFFERENT valid signature (low-s not
     # enforced, matching Go crypto/ecdsa which accepts both halves)
-    r_int = int.from_bytes(good[:cb], "big")
     s_int = int.from_bytes(good[cb:], "big")
     sigs.append(good[:cb] + (cp.n - s_int).to_bytes(cb, "big"))
     rows.append(0); want.append(True)
