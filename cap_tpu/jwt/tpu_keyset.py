@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import telemetry
 from ..errors import (
     InvalidParameterError,
     InvalidSignatureError,
@@ -160,16 +161,20 @@ class TPUBatchKeySet(KeySet):
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
         from ..runtime import prep
 
-        if prep._load_native() is not None:
-            return self._verify_batch_fast(tokens)
-        return self._verify_batch_objects(tokens)
+        telemetry.count("verify_batch.calls")
+        telemetry.count("verify_batch.tokens", len(tokens))
+        with telemetry.span("verify_batch.total"):
+            if prep._load_native() is not None:
+                return self._verify_batch_fast(tokens)
+            return self._verify_batch_objects(tokens)
 
     def _verify_batch_fast(self, tokens: Sequence[str]) -> List[Any]:
         """Array-native batch path: C++ prep → numpy bucketing/kid gather
         → device dispatch, with per-token Python only for results."""
         from ..runtime.native_binding import ALG_NAMES, prepare_batch_arrays
 
-        pb = prepare_batch_arrays(tokens)
+        with telemetry.span("prep.native"):
+            pb = prepare_batch_arrays(tokens)
         n = pb.n
         results: List[Any] = [None] * n
         ok = pb.status == 0
@@ -213,8 +218,11 @@ class TPUBatchKeySet(KeySet):
             if ok[j] and results[j] is None and j not in slow_set:
                 slow_set.add(j)
 
-        for j in sorted(slow_set):
-            results[j] = self._verify_one_parsed(pb.parsed(j))
+        if slow_set:
+            telemetry.count("cpu_fallback.tokens", len(slow_set))
+            with telemetry.span("cpu_fallback"):
+                for j in sorted(slow_set):
+                    results[j] = self._verify_one_parsed(pb.parsed(j))
         return results
 
     @staticmethod
@@ -263,12 +271,16 @@ class TPUBatchKeySet(KeySet):
             hash_mat[:m] = pb.digest[chunk]
             key_idx = np.zeros(pad, np.int32)
             key_idx[:m] = crows
-            if kind == "rs":
-                okv = tpursa.verify_pkcs1v15_arrays(
-                    table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)
-            else:
-                okv = tpursa.verify_pss_arrays(
-                    table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)
+            telemetry.count(f"device.{kind}.tokens", m)
+            with telemetry.span(f"device.{kind}.{hash_name}"):
+                if kind == "rs":
+                    okv = tpursa.verify_pkcs1v15_arrays(
+                        table, sig_mat, sig_lens, hash_mat, hash_name,
+                        key_idx)
+                else:
+                    okv = tpursa.verify_pss_arrays(
+                        table, sig_mat, sig_lens, hash_mat, hash_name,
+                        key_idx)
             self._finish_arrays(chunk, okv[:m], pb, results)
 
     def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb, results: List[Any],
@@ -303,8 +315,10 @@ class TPUBatchKeySet(KeySet):
             hash_mat[:m] = pb.digest[chunk]
             key_idx = np.zeros(pad, np.int32)
             key_idx[:m] = crows
-            okv = tpuec.verify_ecdsa_arrays(
-                table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
+            telemetry.count("device.es.tokens", m)
+            with telemetry.span(f"device.es.{crv}"):
+                okv = tpuec.verify_ecdsa_arrays(
+                    table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
             self._finish_arrays(chunk, okv[:m], pb, results)
 
     def _run_ed_arrays(self, idx: np.ndarray, pb, results: List[Any],
@@ -333,7 +347,9 @@ class TPUBatchKeySet(KeySet):
             sigs += [b"\x00" * 64] * fill
             msgs += [b""] * fill
             key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
-            okv = tpued.verify_ed25519_batch(table, sigs, msgs, key_idx)
+            telemetry.count("device.ed.tokens", m)
+            with telemetry.span("device.ed25519"):
+                okv = tpued.verify_ed25519_batch(table, sigs, msgs, key_idx)
             self._finish_arrays(chunk, okv[:m], pb, results)
 
     def _verify_one_parsed(self, p) -> Any:
@@ -365,7 +381,8 @@ class TPUBatchKeySet(KeySet):
 
         from ..runtime import prep  # C++ when built, Python fallback
 
-        prepped = prep.prepare_batch(tokens)
+        with telemetry.span("prep"):
+            prepped = prep.prepare_batch(tokens)
 
         for j, p in enumerate(prepped):
             if isinstance(p, Exception):

@@ -1,0 +1,21 @@
+"""The verify service layer: the framework's communication backend.
+
+The reference is a pure in-process library — its only "communication
+backend" is HTTPS to the IdP (SURVEY.md §5). The TPU-native framework
+adds a real one: host applications (any language) talk to a colocated
+verify worker that owns the device and the batched KeySet, over a
+length-prefixed binary protocol on TCP/UDS (``protocol``), through an
+adaptive batcher (``batcher``) that trades p99 latency against batch
+throughput. ``worker`` is the server; ``client`` the Python client;
+the C runtime ships a matching native client shim.
+
+Redaction discipline (reference: oidc/config.go:20-31 etc.) carries
+across the wire: the service never logs tokens, keys, or claims —
+telemetry records only counts and timings.
+"""
+
+from .batcher import AdaptiveBatcher
+from .client import VerifyClient
+from .worker import VerifyWorker
+
+__all__ = ["AdaptiveBatcher", "VerifyClient", "VerifyWorker"]

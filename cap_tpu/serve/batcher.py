@@ -1,0 +1,125 @@
+"""Adaptive batching in front of the device engine.
+
+The throughput/latency tension from SURVEY.md §7: >500k verifies/sec
+wants huge device batches, p99 latency wants small ones. The batcher
+resolves it adaptively — submissions from any number of threads or
+connections accumulate in one queue; a dispatcher flushes to
+``KeySet.verify_batch`` as soon as EITHER the batch-size target is
+reached OR the oldest queued token has waited ``max_wait_ms``. Under
+load, flushes are back-to-back full batches (max throughput); when
+idle, a lone token waits at most one wait window (bounded p99).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+from .. import telemetry
+
+
+class _Pending:
+    __slots__ = ("tokens", "results", "event", "ts")
+
+    def __init__(self, tokens: Sequence[str]):
+        self.tokens = tokens
+        self.results: Optional[List[Any]] = None
+        self.event = threading.Event()
+        self.ts = time.monotonic()
+
+
+class AdaptiveBatcher:
+    """Aggregates verify submissions into device-sized batches.
+
+    keyset: anything with ``verify_batch(tokens) -> list`` (claims dict
+    or per-token Exception). target_batch: flush threshold;
+    max_wait_ms: max time the OLDEST submission waits before a flush;
+    max_batch: hard cap per device dispatch.
+    """
+
+    def __init__(self, keyset, target_batch: int = 4096,
+                 max_wait_ms: float = 2.0, max_batch: int = 32768):
+        self._keyset = keyset
+        self._target = target_batch
+        self._max_wait = max_wait_ms / 1000.0
+        self._max_batch = max_batch
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[_Pending] = []
+        self._queued_tokens = 0
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cap-tpu-batcher")
+        self._thread.start()
+
+    # -- submission side --------------------------------------------------
+
+    def submit(self, tokens: Sequence[str]) -> List[Any]:
+        """Block until the batch containing ``tokens`` is verified."""
+        if not tokens:
+            return []
+        p = _Pending(list(tokens))
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.append(p)
+            self._queued_tokens += len(p.tokens)
+            self._cv.notify()
+        p.event.wait()
+        assert p.results is not None
+        return p.results
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+
+    # -- dispatcher -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                # Wait for more work up to the flush condition: the
+                # OLDEST queued submission waits at most max_wait.
+                while (self._queued_tokens < self._target
+                       and not self._closed):
+                    remaining = (self._queue[0].ts + self._max_wait
+                                 - time.monotonic())
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                batch: List[_Pending] = []
+                n = 0
+                while self._queue and n < self._max_batch:
+                    nxt = self._queue[0]
+                    if batch and n + len(nxt.tokens) > self._max_batch:
+                        break
+                    batch.append(self._queue.pop(0))
+                    n += len(nxt.tokens)
+                self._queued_tokens -= n
+            if not batch:
+                continue
+            self._flush(batch, n)
+
+    def _flush(self, batch: List[_Pending], n: int) -> None:
+        tokens: List[str] = []
+        for p in batch:
+            tokens.extend(p.tokens)
+        telemetry.count("batcher.flushes")
+        telemetry.observe("batcher.batch_size", float(n))
+        try:
+            with telemetry.span("batcher.flush"):
+                results = self._keyset.verify_batch(tokens)
+        except Exception as e:  # noqa: BLE001 - fan the failure out
+            results = [e] * len(tokens)
+        off = 0
+        for p in batch:
+            p.results = list(results[off: off + len(p.tokens)])
+            off += len(p.tokens)
+            p.event.set()

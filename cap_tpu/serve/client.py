@@ -1,0 +1,79 @@
+"""Python client for the verify worker (CVB1 protocol).
+
+Mirrors the KeySet surface so a host app can swap a local
+TPUBatchKeySet for a remote worker without code changes:
+``verify_batch`` returns the same per-token claims-dict-or-Exception
+list, with rejected tokens surfaced as RemoteVerifyError (the worker
+sends only the error class + message — never token material).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, List, Optional, Sequence
+
+from ..errors import CapError
+from . import protocol
+
+
+class RemoteVerifyError(CapError):
+    """A token the worker rejected; message is the worker's error."""
+
+
+class VerifyClient:
+    """Blocking client; one socket, pipelined request/response frames."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 uds_path: Optional[str] = None, timeout: float = 30.0):
+        if uds_path is not None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(timeout)
+            self._sock.connect(uds_path)
+        else:
+            self._sock = socket.create_connection((host, port),
+                                                  timeout=timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def ping(self) -> bool:
+        protocol.send_ping(self._sock)
+        ftype, _ = protocol.recv_frame(self._sock)
+        return ftype == protocol.T_PONG
+
+    def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        """Claims dict per verified token; RemoteVerifyError per reject."""
+        if not tokens:
+            return []
+        protocol.send_request(self._sock, tokens)
+        ftype, entries = protocol.recv_frame(self._sock)
+        if ftype != protocol.T_VERIFY_RESP:
+            raise protocol.ProtocolError(f"expected response, got {ftype}")
+        if len(entries) != len(tokens):
+            raise protocol.ProtocolError(
+                f"response count {len(entries)} != request {len(tokens)}")
+        out: List[Any] = []
+        for status, payload in entries:
+            if status == 0:
+                out.append(json.loads(payload.decode()))
+            else:
+                out.append(RemoteVerifyError(payload.decode()))
+        return out
+
+    def verify_signature(self, token: str) -> Any:
+        """Single-token convenience; raises on rejection (KeySet shape)."""
+        res = self.verify_batch([token])[0]
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

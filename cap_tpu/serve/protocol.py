@@ -1,0 +1,123 @@
+"""Wire protocol for the verify service: length-prefixed binary frames.
+
+Deliberately trivial to implement from any language (the C runtime has
+a native client): fixed little-endian framing, no schema compiler.
+
+Frame layout (all integers little-endian):
+
+    magic   u32   0x31425643 ("CVB1")
+    type    u8    1 = verify request, 2 = verify response, 3 = ping,
+                  4 = pong
+    count   u32   number of entries
+    entries:
+      request entry:   len u32, token bytes (UTF-8 compact JWS)
+      response entry:  status u8 (0 = verified, 1 = rejected),
+                       len u32, payload bytes
+                       (claims JSON when verified; error string when
+                       rejected — the error CLASS name plus message,
+                       never the token itself)
+
+Secrets stance: tokens cross this boundary by necessity (the worker
+must verify them); nothing here logs, copies, or echoes them beyond
+the response payload, and error strings never embed token material.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, List, Sequence, Tuple
+
+MAGIC = 0x31425643
+T_VERIFY_REQ = 1
+T_VERIFY_RESP = 2
+T_PING = 3
+T_PONG = 4
+
+_HDR = struct.Struct("<IBI")
+
+MAX_FRAME_ENTRIES = 1 << 20
+MAX_ENTRY_BYTES = 1 << 20
+MAX_FRAME_BYTES = 1 << 28        # aggregate cap: one frame ≤ 256 MiB
+
+
+class ProtocolError(Exception):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def send_request(sock: socket.socket, tokens: Sequence[str]) -> None:
+    parts = [_HDR.pack(MAGIC, T_VERIFY_REQ, len(tokens))]
+    for t in tokens:
+        raw = t.encode()
+        parts.append(struct.pack("<I", len(raw)))
+        parts.append(raw)
+    sock.sendall(b"".join(parts))
+
+
+def send_response(sock: socket.socket, results: Sequence[Any]) -> None:
+    """results: claims dict (verified) or Exception (rejected)."""
+    parts = [_HDR.pack(MAGIC, T_VERIFY_RESP, len(results))]
+    for r in results:
+        if isinstance(r, Exception):
+            payload = f"{type(r).__name__}: {r}".encode()
+            parts.append(struct.pack("<BI", 1, len(payload)))
+        else:
+            payload = json.dumps(r, separators=(",", ":")).encode()
+            parts.append(struct.pack("<BI", 0, len(payload)))
+        parts.append(payload)
+    sock.sendall(b"".join(parts))
+
+
+def send_ping(sock: socket.socket) -> None:
+    sock.sendall(_HDR.pack(MAGIC, T_PING, 0))
+
+
+def send_pong(sock: socket.socket) -> None:
+    sock.sendall(_HDR.pack(MAGIC, T_PONG, 0))
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
+    """Read one frame → (type, entries).
+
+    Request entries are token strings; response entries are
+    (status, payload-bytes) pairs.
+    """
+    magic, ftype, count = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic 0x{magic:08x}")
+    if count > MAX_FRAME_ENTRIES:
+        raise ProtocolError(f"frame too large: {count} entries")
+    entries: List[Any] = []
+    total = 0
+    if ftype == T_VERIFY_REQ:
+        for _ in range(count):
+            (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+            total += ln
+            if ln > MAX_ENTRY_BYTES or total > MAX_FRAME_BYTES:
+                raise ProtocolError(f"frame too large ({total} bytes)")
+            entries.append(_recv_exact(sock, ln).decode())
+    elif ftype == T_VERIFY_RESP:
+        for _ in range(count):
+            status, ln = struct.unpack("<BI", _recv_exact(sock, 5))
+            total += ln
+            if ln > MAX_ENTRY_BYTES or total > MAX_FRAME_BYTES:
+                raise ProtocolError(f"frame too large ({total} bytes)")
+            entries.append((status, _recv_exact(sock, ln)))
+    elif ftype in (T_PING, T_PONG):
+        pass
+    else:
+        raise ProtocolError(f"unknown frame type {ftype}")
+    return ftype, entries

@@ -1,0 +1,111 @@
+"""The verify worker: owns the device engine, serves the wire protocol.
+
+The TPU-native analog of "the process that owns the accelerator": host
+applications connect over TCP (or a Unix socket) and stream verify
+requests; all connections share ONE AdaptiveBatcher → ONE
+TPUBatchKeySet → one device, so concurrent small callers coalesce into
+full device batches (SURVEY.md §2.6, §7 step 7).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional, Tuple
+
+from .. import telemetry
+from . import protocol
+from .batcher import AdaptiveBatcher
+
+
+class VerifyWorker:
+    """Serve ``keyset.verify_batch`` over the CVB1 protocol.
+
+    keyset: typically a TPUBatchKeySet; anything with verify_batch.
+    host/port: TCP bind (port 0 → ephemeral, see ``address``);
+    uds_path: serve on a Unix socket instead of TCP.
+    """
+
+    def __init__(self, keyset, host: str = "127.0.0.1", port: int = 0,
+                 uds_path: Optional[str] = None,
+                 target_batch: int = 4096, max_wait_ms: float = 2.0,
+                 max_batch: int = 32768):
+        self._batcher = AdaptiveBatcher(
+            keyset, target_batch=target_batch, max_wait_ms=max_wait_ms,
+            max_batch=max_batch)
+        self._uds_path = uds_path
+        if uds_path is not None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                os.unlink(uds_path)        # stale socket from a restart
+            except FileNotFoundError:
+                pass
+            self._sock.bind(uds_path)
+            self._addr: Tuple[str, int] = (uds_path, 0)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self._addr = self._sock.getsockname()
+        self._sock.listen(128)
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="cap-tpu-accept")
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        """(host, port) for TCP, (path, 0) for UDS."""
+        return self._addr
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._uds_path is not None:
+            try:
+                os.unlink(self._uds_path)
+            except OSError:
+                pass
+        self._batcher.close()
+
+    # -- internals --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            telemetry.count("worker.connections")
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="cap-tpu-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # UDS
+        try:
+            while True:
+                try:
+                    ftype, entries = protocol.recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                if ftype == protocol.T_PING:
+                    protocol.send_pong(conn)
+                    continue
+                if ftype != protocol.T_VERIFY_REQ:
+                    return  # protocol violation → drop the connection
+                telemetry.count("worker.requests")
+                telemetry.count("worker.tokens", len(entries))
+                results = self._batcher.submit(entries)
+                protocol.send_response(conn, results)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

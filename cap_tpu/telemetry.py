@@ -1,0 +1,159 @@
+"""Instrumentation: per-batch timings, counters, latency quantiles.
+
+The reference has no tracing or metrics of any kind — its only
+"observability" is the ``const op`` error-prefix convention
+(/root/reference/oidc/provider.go:58) and redaction of secrets
+(SURVEY.md §5). For a batched TPU verify engine that trades latency for
+throughput, real instrumentation is required: this module provides a
+process-local :class:`Recorder` with named counters and duration
+histograms, ``span()`` context managers around pipeline stages (host
+prep, kid gather, per-family device dispatch), and p50/p95/p99
+summaries.
+
+Redaction discipline carries over from the reference
+(/root/reference/oidc/config.go:20-31): recorders store ONLY metric
+names and numbers — never tokens, keys, claims, or any request payload.
+
+Telemetry is off by default (zero overhead beyond one attribute check
+on the hot path); enable with ``telemetry.enable()`` or scoped via
+``telemetry.recording()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional
+
+
+class Recorder:
+    """Thread-safe counters + duration/value histograms."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._series: Dict[str, List[float]] = {}
+
+    # -- write side -------------------------------------------------------
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._series.setdefault(name, []).append(float(value))
+
+    @contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        """Time a block; the duration lands in the ``name`` series (s)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    # -- read side --------------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def series(self, name: str) -> List[float]:
+        with self._lock:
+            return list(self._series.get(name, []))
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-series {count, total, mean, p50, p95, p99, max}."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            items = [(k, list(v)) for k, v in self._series.items()]
+        for name, vals in items:
+            vals.sort()
+            n = len(vals)
+            if n == 0:
+                continue
+            total = sum(vals)
+            out[name] = {
+                "count": float(n),
+                "total": total,
+                "mean": total / n,
+                "p50": _quantile(vals, 0.50),
+                "p95": _quantile(vals, 0.95),
+                "p99": _quantile(vals, 0.99),
+                "max": vals[-1],
+            }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._series.clear()
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile on an already-sorted list."""
+    n = len(sorted_vals)
+    idx = min(n - 1, max(0, int(round(q * (n - 1)))))
+    return sorted_vals[idx]
+
+
+# -- module-level switchboard ---------------------------------------------
+
+_recorder: Optional[Recorder] = None
+
+
+def enable(recorder: Optional[Recorder] = None) -> Recorder:
+    """Turn telemetry on (idempotent); returns the active recorder."""
+    global _recorder
+    if recorder is not None:
+        _recorder = recorder
+    elif _recorder is None:
+        _recorder = Recorder()
+    return _recorder
+
+
+def disable() -> None:
+    global _recorder
+    _recorder = None
+
+
+def active() -> Optional[Recorder]:
+    """The live recorder, or None when telemetry is off."""
+    return _recorder
+
+
+@contextmanager
+def recording(recorder: Optional[Recorder] = None) -> Iterator[Recorder]:
+    """Scoped telemetry: enable for the block, restore the prior state."""
+    global _recorder
+    prev = _recorder
+    rec = recorder if recorder is not None else Recorder()
+    _recorder = rec
+    try:
+        yield rec
+    finally:
+        _recorder = prev
+
+
+def count(name: str, n: int = 1) -> None:
+    rec = _recorder
+    if rec is not None:
+        rec.count(name, n)
+
+
+def observe(name: str, value: float) -> None:
+    rec = _recorder
+    if rec is not None:
+        rec.observe(name, value)
+
+
+@contextmanager
+def span(name: str) -> Iterator[None]:
+    rec = _recorder
+    if rec is None:
+        yield
+        return
+    with rec.span(name):
+        yield

@@ -8,9 +8,17 @@ arithmetic:
   (batch N rides the 128-wide vector lanes; the TPU has no 64-bit
   scalar multiplier, so limbs are sized such that a limb product fits
   exactly in uint32 and column sums of split hi/lo parts stay < 2^25);
-- schoolbook convolution with split hi/lo accumulation (exact in
-  uint32), carry normalization via `lax.while_loop` (data-dependent
-  ripple depth, almost always 2-3 passes);
+- multiplication is a fully-vectorized convolution: the [K, K, N]
+  partial-product tensor is skew-reshaped so anti-diagonals become
+  columns, and one reduction produces all 2K-1 output columns — no
+  sequential limb loop, no dynamic slices (for large K the j-axis is
+  blocked to bound the materialized tensor);
+- carries/borrows resolve in FIXED depth: one ripple pass brings
+  pending carries to {0,1}, then a Kogge-Stone-style carry-lookahead
+  over the limb axis (``lax.associative_scan``, log₂K steps) delivers
+  exact propagation even for adversarial all-0xFFFF ripple chains —
+  there is no data-dependent ``while_loop`` anywhere, so XLA sees one
+  static dataflow graph per bucket;
 - separated Montgomery multiplication: T = a·b, m = T·N' mod R,
   t = (T + m·n)/R, one conditional subtract — all batched, with
   per-token moduli (gathered from a device-resident JWKS key table);
@@ -35,51 +43,101 @@ U32 = jnp.uint32
 I32 = jnp.int32
 
 
+def _shift_up(x: jnp.ndarray) -> jnp.ndarray:
+    """Shift one limb toward the most-significant end (row 0 ← zero)."""
+    return jnp.concatenate([jnp.zeros_like(x[:1]), x[:-1]], axis=0)
+
+
+def _carry_lookahead(digits: jnp.ndarray, carry_in: jnp.ndarray,
+                     propagate_at: int) -> jnp.ndarray:
+    """Exact resolution of unit carries/borrows in log₂K steps.
+
+    digits: [K, N] values in [0, 2^16); carry_in: [K, N] {0,1} unit
+    carries arriving AT each limb; propagate_at: the digit value that
+    forwards an incoming carry (0xFFFF for carries, 0 for borrows).
+    Returns u [K, N]: the total unit adjustment arriving at each limb,
+    u_i = carry_in_i | (prop_{i-1} & u_{i-1}) — a Kogge-Stone-style
+    prefix over the limb axis via ``lax.associative_scan``.
+    """
+    prop_below = _shift_up(digits == propagate_at)
+
+    def combine(left, right):
+        gl, ql = left
+        gr, qr = right
+        return gr | (qr & gl), ql & qr
+
+    u, _ = lax.associative_scan(
+        combine, (carry_in.astype(bool), prop_below), axis=0)
+    return u.astype(U32)
+
+
 def carry_normalize(v: jnp.ndarray) -> jnp.ndarray:
-    """Propagate carries until every limb is < 2^16.
+    """Propagate carries until every limb is < 2^16 (exact, fixed depth).
 
     v: [K, N] uint32 with limbs possibly up to 2^32-1. The top limb must
     have headroom for the final carry (callers allocate a spare limb).
-    Runs a vectorized ripple pass under while_loop; random data converges
-    in 2 passes, adversarial all-0xFFFF patterns take up to K.
+    One ripple pass reduces pending carries to {0,1}; a carry-lookahead
+    scan resolves them exactly — adversarial all-0xFFFF ripple chains
+    included — with no data-dependent control flow.
     """
+    # Pass 1: any u32 digit < 2^32 → digit < 2^17, carry ≤ 2^16.
+    v1 = (v & LIMB_MASK) + _shift_up(v >> LIMB_BITS)
+    # Pass 2 split: digits < 2^16, unit carries ∈ {0,1}.
+    l2 = v1 & LIMB_MASK
+    c2 = _shift_up(v1 >> LIMB_BITS)
+    u = _carry_lookahead(l2, c2, LIMB_MASK)
+    # l2 + u ≤ 2^16; the == 2^16 case masks to 0 with its carry already
+    # delivered to the limb above by the lookahead.
+    return (l2 + u) & LIMB_MASK
 
-    def cond(x):
-        return jnp.any(x > LIMB_MASK)
 
-    def body(x):
-        carries = x >> LIMB_BITS
-        shifted = jnp.concatenate(
-            [jnp.zeros_like(carries[:1]), carries[:-1]], axis=0
-        )
-        return (x & LIMB_MASK) + shifted
+def _anti_diag_tree(rows: jnp.ndarray) -> jnp.ndarray:
+    """Sum rows of a [J, W, N] tensor where row j sits at limb offset j.
 
-    return lax.while_loop(cond, body, v)
+    Pairwise log-tree: at level l paired rows differ by a 2^l-limb
+    offset, so each merge is a static pad + add (no reshapes, no
+    gathers — everything fuses). Returns [W + J - 1, N].
+    """
+    stride = 1
+    while rows.shape[0] > 1:
+        if rows.shape[0] % 2:
+            rows = jnp.pad(rows, ((0, 1), (0, 0), (0, 0)))
+        even = jnp.pad(rows[0::2], ((0, 0), (0, stride), (0, 0)))
+        odd = jnp.pad(rows[1::2], ((0, 0), (stride, 0), (0, 0)))
+        rows = even + odd
+        stride *= 2
+    return rows[0]
+
+
+_MUL_BLOCK_J = 64  # bounds the [Bj, K+1, N] partial-product tensor
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Full product of two [K, N] limb arrays → [2K+1, N] normalized.
 
-    Schoolbook convolution: for each limb j of b, add a·b_j into the
-    accumulator at offset j, with each 32-bit partial product split into
-    16-bit hi/lo halves so column sums stay exact in uint32
-    (≤ 2K terms < 2^16 each → < 2^25 for K ≤ 256, i.e. RSA-4096).
+    Vectorized convolution: the partial-product tensor b_j·a_i is
+    split into 16-bit hi/lo halves (exact in u32), folded into a
+    [Bj, K+1, N] row tensor per j-block (blocking bounds the
+    materialized tensor for RSA-sized K), and anti-diagonal-summed by
+    the static pad/add log-tree. Column sums stay exact: ≤ 2K terms
+    < 2^16 each → < 2^25 for K ≤ 256 (RSA-4096).
     """
     k, n = a.shape
+    if k <= _MUL_BLOCK_J:
+        p = b[:, None, :] * a[None, :, :]                 # [K, K, N]
+        rows = (jnp.pad(p & LIMB_MASK, ((0, 0), (0, 1), (0, 0)))
+                + jnp.pad(p >> LIMB_BITS, ((0, 0), (1, 0), (0, 0))))
+        c = _anti_diag_tree(rows)[: 2 * k]   # tail beyond 2K is zero
+        return carry_normalize(jnp.pad(c, ((0, 1), (0, 0))))
+
     acc = jnp.zeros((2 * k + 1, n), dtype=U32)
-
-    def body(j, acc):
-        bj = lax.dynamic_slice_in_dim(b, j, 1, axis=0)  # [1, N]
-        p = a * bj                                       # exact in uint32
-        zero_row = jnp.zeros((1, n), dtype=U32)
-        lo = jnp.concatenate([p & LIMB_MASK, zero_row], axis=0)   # [K+1, N]
-        hi = jnp.concatenate([zero_row, p >> LIMB_BITS], axis=0)  # [K+1, N]
-        window = lax.dynamic_slice_in_dim(acc, j, k + 1, axis=0)
-        return lax.dynamic_update_slice_in_dim(
-            acc, window + lo + hi, j, axis=0
-        )
-
-    acc = lax.fori_loop(0, k, body, acc)
+    for j0 in range(0, k, _MUL_BLOCK_J):
+        bj = min(_MUL_BLOCK_J, k - j0)
+        p = b[j0:j0 + bj, None, :] * a[None, :, :]        # [Bj, K, N]
+        rows = (jnp.pad(p & LIMB_MASK, ((0, 0), (0, 1), (0, 0)))
+                + jnp.pad(p >> LIMB_BITS, ((0, 0), (1, 0), (0, 0))))
+        c = _anti_diag_tree(rows)[: k + bj]  # offsets j0 .. j0+k+bj-1
+        acc = acc.at[j0: j0 + k + bj].add(c)
     return carry_normalize(acc)
 
 
@@ -101,21 +159,17 @@ def compare_ge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def sub_where(a: jnp.ndarray, b: jnp.ndarray,
               mask: jnp.ndarray) -> jnp.ndarray:
-    """Where mask: a - b (requires a >= b there); else a. [K, N] inputs."""
+    """Where mask: a - b (requires a >= b there); else a. [K, N] inputs.
+
+    Normalized (< 2^16-digit) inputs; exact fixed-depth borrow
+    resolution via the same lookahead scan as ``carry_normalize``
+    (a zero digit propagates an incoming borrow).
+    """
     d = a.astype(I32) - jnp.where(mask[None, :], b, 0).astype(I32)
-
-    def cond(x):
-        return jnp.any(x < 0)
-
-    def body(x):
-        borrow = (x < 0).astype(I32)
-        repaid = x + borrow * (LIMB_MASK + 1)
-        shifted = jnp.concatenate(
-            [jnp.zeros_like(borrow[:1]), borrow[:-1]], axis=0
-        )
-        return repaid - shifted
-
-    return lax.while_loop(cond, body, d).astype(U32)
+    lo = (d & LIMB_MASK).astype(U32)            # d mod 2^16, two's compl.
+    borrow = _shift_up((d < 0).astype(U32))     # unit borrows arriving AT i
+    u = _carry_lookahead(lo, borrow, 0)
+    return (lo.astype(I32) - u.astype(I32)).astype(U32) & LIMB_MASK
 
 
 def mont_mul(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray,
@@ -144,24 +198,42 @@ def mont_sqr(a, n, nprime):
     return mont_mul(a, a, n, nprime)
 
 
+def mont_mul_lazy(a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray,
+                  nprime: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product WITHOUT the conditional subtract.
+
+    Requires R = 2^(16K) ≥ 4n (callers allocate one spare limb beyond
+    the modulus width). For inputs < 2n (as values; canonical digits),
+    the output t = (ab + mn)/R < 2n — so a whole modexp chain runs with
+    no compares/subtractions at all, and one final reduction
+    canonicalizes. Classic subtraction-free Montgomery.
+    """
+    k = a.shape[0]
+    t_full = mul(a, b)                       # [2K+1, N]
+    m = mul(t_full[:k], nprime)[:k]
+    mn = mul(m, n)
+    s = carry_normalize(t_full + mn)         # low K limbs exactly 0
+    return s[k: 2 * k]
+
+
 @partial(jax.jit, static_argnames=("to_mont",))
 def modexp_65537(s: jnp.ndarray, n: jnp.ndarray, nprime: jnp.ndarray,
                  r2: jnp.ndarray, to_mont: bool = True) -> jnp.ndarray:
     """s^65537 mod n for the whole batch (the RSA fast path).
 
-    s, n, nprime, r2: [K, N]; r2 = R² mod n per token. 19 Montgomery
-    multiplies: domain entry, 16 squarings, ·s, domain exit.
+    s, n, nprime, r2: [K, N]; r2 = R² mod n per token; R ≥ 4n (the key
+    table allocates the spare limb). 19 subtraction-free Montgomery
+    multiplies (domain entry, 16 unrolled squarings, ·s, domain exit),
+    then ONE canonicalizing conditional subtract.
     """
-    s_m = mont_mul(s, r2, n, nprime) if to_mont else s
+    s_m = mont_mul_lazy(s, r2, n, nprime) if to_mont else s
     x = s_m
-
-    def body(_, x):
-        return mont_sqr(x, n, nprime)
-
-    x = lax.fori_loop(0, 16, body, x)
-    x = mont_mul(x, s_m, n, nprime)
+    for _ in range(16):                      # static unroll: one graph
+        x = mont_mul_lazy(x, x, n, nprime)
+    x = mont_mul_lazy(x, s_m, n, nprime)
     one = jnp.zeros_like(s).at[0].set(1)
-    return mont_mul(x, one, n, nprime)       # leave Montgomery domain
+    x = mont_mul_lazy(x, one, n, nprime)     # leave domain; x ≤ n
+    return sub_where(x, n, compare_ge(x, n))
 
 
 @partial(jax.jit, static_argnames=("ebits",))
@@ -218,6 +290,54 @@ def modexp_fixed_exponent(s: jnp.ndarray, e_limbs: jnp.ndarray,
         return x
     one = jnp.zeros_like(s).at[0].set(1)
     return mont_mul(x, one, n, nprime)
+
+
+def batch_mont_inverse(x_m: jnp.ndarray, n1: jnp.ndarray, npp1: jnp.ndarray,
+                       nr2_1: jnp.ndarray, none1: jnp.ndarray,
+                       nm2_1: jnp.ndarray, nbits: int,
+                       min_width: int = 128) -> jnp.ndarray:
+    """Simultaneous inversion of a whole batch (Montgomery domain).
+
+    Montgomery's product-tree trick: pair-multiply up to a ``min_width``
+    root, invert the root with ONE Fermat ladder, then walk back down —
+    ~3 multiplies per element instead of a 2·nbits-multiply ladder per
+    element. Replaces the dominant per-token s⁻¹ cost of ECDSA verify
+    (the reference's crypto/ecdsa.Verify inverts per call).
+
+    x_m: [K, N] nonzero values in Montgomery form, N a power of two.
+    n1/npp1/nr2_1/none1/nm2_1: [K, 1] broadcastable modulus constants
+    (modulus, N', R², R mod n, and the Fermat exponent n−2).
+    Returns [K, N]: per-element inverses, Montgomery form.
+    """
+    k, n_batch = x_m.shape
+
+    def bc(c, width):
+        return jnp.broadcast_to(c, (k, width))
+
+    levels = [x_m]
+    cur = x_m
+    while cur.shape[1] > min_width and cur.shape[1] % 2 == 0:
+        half = cur.shape[1] // 2
+        cur = mont_mul(cur[:, 0::2], cur[:, 1::2], bc(n1, half),
+                       bc(npp1, half))
+        levels.append(cur)
+
+    w = cur.shape[1]
+    root_inv = modexp_fixed_exponent(
+        cur, bc(nm2_1, w), bc(n1, w), bc(npp1, w), bc(nr2_1, w),
+        bc(none1, w), ebits=nbits, exit_domain=False, s_in_mont=True)
+
+    inv = root_inv
+    for lvl in levels[-2::-1]:
+        width = lvl.shape[1]
+        half = width // 2
+        left = lvl[:, 0::2]
+        right = lvl[:, 1::2]
+        nh, nph = bc(n1, half), bc(npp1, half)
+        inv_left = mont_mul(inv, right, nh, nph)
+        inv_right = mont_mul(inv, left, nh, nph)
+        inv = jnp.stack([inv_left, inv_right], axis=2).reshape(k, width)
+    return inv
 
 
 # ---------------------------------------------------------------------------

@@ -6,17 +6,21 @@ arithmetic over the limb machinery in ``bignum``:
 
 - per-curve Montgomery constants for BOTH the field (mod p) and the
   scalar group (mod n), broadcast across the batch;
-- w = s⁻¹ mod n by Fermat (branchless ladder, exponent n−2);
-- u1·G + u2·Q by Shamir's trick: one shared double-and-add ladder with
-  a branchless 4-way addend select over {∅, G, Q, G+Q}; Q and G+Q are
-  per-key affine rows precomputed host-side into a device-resident
-  table and gathered per token (the key-gather axis, SURVEY.md §2.6);
-- Jacobian a=-3 doubling + mixed Jacobian/affine addition — both
-  complete for the inputs the ladder produces, EXCEPT the same-x
-  exceptional cases (addend == ±accumulator), which are flagged per
-  token and re-verified on the CPU oracle (unreachable for honest
-  signatures, adversarially constructible — parity must hold there
-  too);
+- w = s⁻¹ mod n via Montgomery's simultaneous-inversion product tree
+  (``bignum.batch_mont_inverse``): ~3 multiplies per token instead of
+  a 2·nbits-multiply Fermat ladder per token;
+- u1·G + u2·Q by interleaved fixed-window recoding (w = 4): scalars
+  split into 4-bit digits d_i, and the sum becomes
+  Σ d1_i·(2^{4i}G) + Σ d2_i·(2^{4i}Q) — every 2^{4i}-multiple is
+  PRECOMPUTED host-side (G per curve; Q per key, into the
+  device-resident key table — the key-gather axis, SURVEY.md §2.6),
+  so the device ladder is just 2·⌈nbits/4⌉ mixed additions with
+  per-token table gathers and ZERO doublings;
+- mixed Jacobian/affine addition — complete for the inputs the ladder
+  produces, EXCEPT the same-x exceptional cases (addend ==
+  ±accumulator), which are flagged per token and re-verified on the
+  CPU oracle (unreachable for honest signatures, adversarially
+  constructible — parity must hold there too);
 - the final check is projective: accept iff X ≡ r·Z² or, when
   r + n < p, X ≡ (r+n)·Z² (mod p) — no field inversion anywhere.
 
@@ -97,7 +101,10 @@ class CurveParams:
         r_mod_p = pone
         self.gx_m = L.int_to_limbs(self.gx * r_mod_p % self.p, k)
         self.gy_m = L.int_to_limbs(self.gy * r_mod_p % self.p, k)
+        # 4-bit interleaved-window recoding: ⌈nbits/4⌉ digit positions.
+        self.n_windows = (self.nbits + 3) // 4
         self._dev_consts = None
+        self._g_tables = None
 
     def device_consts(self):
         """Cached [K, 1] device arrays of every broadcast curve constant
@@ -132,6 +139,39 @@ class CurveParams:
         y3 = (lam * (x1 - x3) - y1) % p
         return x3, y3
 
+    def window_rows(self, point: Tuple[int, int]):
+        """Host precompute of the 4-bit window table for one point.
+
+        Returns (rows_x, rows_y): [n_windows·15, K] uint32 limb rows in
+        field-Montgomery form; row i·15 + (d−1) holds d·2^{4i}·point.
+        Never hits infinity: the point has prime order n and
+        d·2^{4i} < 16·2^nbits is never ≡ 0 (mod n) for d ∈ [1, 15].
+        """
+        r_mod_p = L.limbs_to_int(self.pone_limbs)
+        nw, k = self.n_windows, self.k
+        rows_x = np.empty((nw * 15, k), np.uint32)
+        rows_y = np.empty((nw * 15, k), np.uint32)
+        base = point
+        for i in range(nw):
+            acc = None
+            for d in range(1, 16):
+                acc = self.affine_add(acc, base)
+                x, y = acc
+                rows_x[i * 15 + d - 1] = L.int_to_limbs(
+                    x * r_mod_p % self.p, k)
+                rows_y[i * 15 + d - 1] = L.int_to_limbs(
+                    y * r_mod_p % self.p, k)
+            for _ in range(4):
+                base = self.affine_add(base, base)
+        return rows_x, rows_y
+
+    def g_tables(self):
+        """Cached device window table for the fixed base point G."""
+        if self._g_tables is None:
+            gx_rows, gy_rows = self.window_rows((self.gx, self.gy))
+            self._g_tables = (jnp.asarray(gx_rows), jnp.asarray(gy_rows))
+        return self._g_tables
+
 
 _CURVES_CACHE: Dict[str, CurveParams] = {}
 
@@ -145,9 +185,10 @@ def curve(name: str) -> CurveParams:
 class ECKeyTable:
     """Device-resident table of EC public keys for one curve.
 
-    Rows hold Q and the Shamir precompute G+Q in affine field-Montgomery
-    form; ``gq_inf`` marks the (degenerate, adversarial-only) key
-    Q == −G whose G+Q is the point at infinity.
+    Per key, the full 4-bit interleaved-window table (d·2^{4i}·Q for
+    d ∈ [1,15], i ∈ [0, n_windows)) in affine field-Montgomery form —
+    the scalar-mult ladder then needs no doublings at all, only gathers
+    + mixed adds (the key-gather axis, SURVEY.md §2.6).
     """
 
     def __init__(self, crv: str, keys: Sequence):
@@ -156,31 +197,17 @@ class ECKeyTable:
         self.coord_bytes = self.curve.coord_bytes
         cp = self.curve
         k = cp.k
-        r_mod_p = L.limbs_to_int(cp.pone_limbs)
-
         nk = len(self.keys)
-        qx = np.empty((nk, k), np.uint32)
-        qy = np.empty((nk, k), np.uint32)
-        gqx = np.empty((nk, k), np.uint32)
-        gqy = np.empty((nk, k), np.uint32)
-        gq_inf = np.zeros(nk, bool)
+        rows = cp.n_windows * 15
+        qx_rows = np.empty((nk * rows, k), np.uint32)
+        qy_rows = np.empty((nk * rows, k), np.uint32)
         for i, key in enumerate(self.keys):
             nums = key.public_numbers()
-            qx[i] = L.int_to_limbs(nums.x * r_mod_p % cp.p, k)
-            qy[i] = L.int_to_limbs(nums.y * r_mod_p % cp.p, k)
-            gq = cp.affine_add((cp.gx, cp.gy), (nums.x, nums.y))
-            if gq is None:
-                gq_inf[i] = True
-                gqx[i] = 0
-                gqy[i] = 0
-            else:
-                gqx[i] = L.int_to_limbs(gq[0] * r_mod_p % cp.p, k)
-                gqy[i] = L.int_to_limbs(gq[1] * r_mod_p % cp.p, k)
-        self.qx_tab = jnp.asarray(qx)
-        self.qy_tab = jnp.asarray(qy)
-        self.gqx_tab = jnp.asarray(gqx)
-        self.gqy_tab = jnp.asarray(gqy)
-        self.gq_inf = jnp.asarray(gq_inf)
+            rx, ry = cp.window_rows((nums.x, nums.y))
+            qx_rows[i * rows:(i + 1) * rows] = rx
+            qy_rows[i * rows:(i + 1) * rows] = ry
+        self.tqx = jnp.asarray(qx_rows)
+        self.tqy = jnp.asarray(qy_rows)
 
 
 # ---------------------------------------------------------------------------
@@ -258,68 +285,82 @@ def _jac_madd(X1, Y1, Z1, x2, y2, p, pp, one_m):
     return X3, Y3, Z3, degenerate
 
 
-@partial(jax.jit, static_argnames=("nbits",))
-def _ecdsa_core(r, s, e, qx, qy, gqx, gqy, gq_inf,
+@partial(jax.jit, static_argnames=("nbits", "n_windows"))
+def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
                 p, pp, pr2, pone, n, npp, nr2, none_, nm2, gx, gy,
-                nbits: int):
+                nbits: int, n_windows: int):
     """Batched ECDSA verify core.
 
-    r, s, e: [K, N] plain limb values (signature halves, hash int).
-    qx..gqy: [K, N] gathered per-token affine key rows (field-Mont).
-    gq_inf: [N] bool. Remaining args: [K, 1] curve constants (broadcast
-    on-device here — transferred once per curve, not per batch).
+    r, s, e: [K, N] plain limb values (signature halves, hash int);
+    N must be a power of two (the batch-inverse tree pairs it down).
+    key_idx: [N] int32 rows into the per-key window tables
+    tqx/tqy: [nk·n_windows·15, K]; tgx/tgy: [n_windows·15, K] for G.
+    Remaining args: [K, 1] curve constants (broadcast on-device here —
+    transferred once per curve, not per batch).
     Returns (ok [N], degenerate [N]).
     """
     from . import bignum as B
 
     k = r.shape[0]
     shape = r.shape
-    (p, pp, pr2, pone, n, npp, nr2, none_, nm2, gx, gy) = (
+    n1, npp1, nr21, none1, nm21 = n, npp, nr2, none_, nm2
+    (p, pp, pr2, pone, n, npp, nr2) = (
         jnp.broadcast_to(a, shape)
-        for a in (p, pp, pr2, pone, n, npp, nr2, none_, nm2, gx, gy))
+        for a in (p, pp, pr2, pone, n, npp, nr2))
 
     # 1. Range checks: 1 <= r, s < n.
     r_ok = ~B.is_zero(r) & ~B.compare_ge(r, n)
     s_ok = ~B.is_zero(s) & ~B.compare_ge(s, n)
 
-    # 2. w = s^(n-2) mod n (Fermat), kept in n-Montgomery form.
-    w_m = B.modexp_fixed_exponent(s, nm2, n, npp, nr2, none_,
-                                  ebits=nbits, exit_domain=False)
+    # 2. w = s⁻¹ mod n via the batch product-tree inverse (Montgomery
+    #    domain). Invalid s (0 or ≥ n) is replaced by 1 so the tree
+    #    stays invertible; those tokens are rejected by s_ok anyway.
+    one_plain = jnp.zeros_like(r).at[0].set(1)
+    s_safe = jnp.where(s_ok[None, :], s, one_plain)
+    s_m = B.mont_mul(s_safe, nr2, n, npp)
+    w_m = B.batch_mont_inverse(s_m, n1, npp1, nr21, none1, nm21,
+                               nbits=nbits)
 
     # 3. u1 = e·w mod n, u2 = r·w mod n (plain limb values: montmul of a
     #    plain operand with a Montgomery operand cancels the R factor).
     u1 = B.mont_mul(e, w_m, n, npp)
     u2 = B.mont_mul(r, w_m, n, npp)
 
-    # 4. Shamir ladder: R = u1·G + u2·Q.
+    # 4. Interleaved-window ladder: R = Σ d1_i·(2^{4i}G) + d2_i·(2^{4i}Q).
+    #    4-bit digits, little-endian across limbs (LIMB_BITS = 16 → 4
+    #    nibbles per limb); no doublings — all multiples precomputed.
+    def nibbles(u):
+        return jnp.stack(
+            [(u >> (4 * j)) & 15 for j in range(4)], axis=1
+        ).reshape(4 * k, shape[1]).astype(jnp.int32)
+
+    dig1 = nibbles(u1)
+    dig2 = nibbles(u2)
+    key_base = key_idx.astype(jnp.int32) * (n_windows * 15)
+
     zeros = jnp.zeros_like(r)
-    X0, Y0, Z0 = pone, pone, zeros          # point at infinity
+    X0, Y0, Z0 = pone, pone, zeros          # point at infinity (Z = 0)
     deg0 = jnp.zeros(r.shape[1], dtype=bool)
 
-    def ladder_body(i, carry):
+    def add_from_table(carry, tab_x, tab_y, d, row0):
         X, Y, Z, deg = carry
-        bit_idx = nbits - 1 - i
-        limb = bit_idx // L.LIMB_BITS
-        shift = bit_idx % L.LIMB_BITS
-        b1 = ((u1[limb] >> shift) & 1) > 0
-        b2 = ((u2[limb] >> shift) & 1) > 0
+        has = d > 0
+        idx = row0 + jnp.where(has, d - 1, 0)
+        ax = jnp.take(tab_x, idx, axis=0).T      # [K, N]
+        ay = jnp.take(tab_y, idx, axis=0).T
+        Xa, Ya, Za, dd = _jac_madd(X, Y, Z, ax, ay, p, pp, pone)
+        sel = has[None, :]
+        return (jnp.where(sel, Xa, X), jnp.where(sel, Ya, Y),
+                jnp.where(sel, Za, Z), deg | (dd & has))
 
-        Xd, Yd, Zd = _jac_double(X, Y, Z, p, pp)
+    def ladder_body(i, carry):
+        d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
+        d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
+        carry = add_from_table(carry, tgx, tgy, d1, i * 15)
+        carry = add_from_table(carry, tqx, tqy, d2, key_base + i * 15)
+        return carry
 
-        both = b1 & b2
-        # addend select: G (b1 only), Q (b2 only), G+Q (both)
-        ax = jnp.where(both[None, :], gqx, jnp.where(b1[None, :], gx, qx))
-        ay = jnp.where(both[None, :], gqy, jnp.where(b1[None, :], gy, qy))
-        Xa, Ya, Za, d = _jac_madd(Xd, Yd, Zd, ax, ay, p, pp, pone)
-
-        has_add = (b1 | b2) & ~(both & gq_inf)
-        X = jnp.where(has_add[None, :], Xa, Xd)
-        Y = jnp.where(has_add[None, :], Ya, Yd)
-        Z = jnp.where(has_add[None, :], Za, Zd)
-        deg = deg | (d & has_add)
-        return X, Y, Z, deg
-
-    X, Y, Z, deg = lax.fori_loop(0, nbits, ladder_body,
+    X, Y, Z, deg = lax.fori_loop(0, n_windows, ladder_body,
                                  (X0, Y0, Z0, deg0))
 
     not_inf = ~B.is_zero(Z)
@@ -368,21 +409,28 @@ def verify_ecdsa_arrays(table: ECKeyTable, sig_mat: np.ndarray,
     e_limbs = L.bytes_matrix_to_limbs(
         hash_mat[:, :hash_len], np.full(n_tok, hash_len, np.int64), k)
 
-    idx = jnp.asarray(key_idx, jnp.int32)
-    qx = table.qx_tab[idx].T
-    qy = table.qy_tab[idx].T
-    gqx = table.gqx_tab[idx].T
-    gqy = table.gqy_tab[idx].T
-    gq_inf = table.gq_inf[idx]
+    # Pad the batch to a power of two ≥ 128: the inverse tree pairs the
+    # batch down, and pow-2 buckets bound XLA recompilation. Padding
+    # rows have r = s = 0 → forced invalid, discarded below.
+    n_pad = 128
+    while n_pad < n_tok:
+        n_pad *= 2
+    if n_pad != n_tok:
+        fill = n_pad - n_tok
+        r_limbs = np.pad(r_limbs, ((0, 0), (0, fill)))
+        s_limbs = np.pad(s_limbs, ((0, 0), (0, fill)))
+        e_limbs = np.pad(e_limbs, ((0, 0), (0, fill)))
+        key_idx = np.pad(np.asarray(key_idx, np.int32), (0, fill))
 
     ok, deg = _ecdsa_core(
         jnp.asarray(r_limbs), jnp.asarray(s_limbs), jnp.asarray(e_limbs),
-        qx, qy, gqx, gqy, gq_inf,
+        jnp.asarray(key_idx, jnp.int32),
+        table.tqx, table.tqy, *cp.g_tables(),
         *cp.device_consts(),
-        nbits=cp.nbits,
+        nbits=cp.nbits, n_windows=cp.n_windows,
     )
-    ok = np.asarray(ok) & len_ok
-    deg = np.asarray(deg)
+    ok = np.asarray(ok)[:n_tok] & len_ok
+    deg = np.asarray(deg)[:n_tok]
 
     for j in np.nonzero(deg & len_ok)[0]:
         ok[j] = _cpu_verify_one(table, int(key_idx[j]),

@@ -10,6 +10,10 @@ arithmetic over the limb machinery in ``bignum``:
   formulas, which are COMPLETE for edwards25519 (d is non-square,
   -1 is a square mod p) — unlike the Weierstrass ladder in ``ec``,
   there are no degenerate cases and no CPU re-verification;
+- [S]B + [k](-A) by interleaved fixed-window recoding (w = 4): all
+  d·2^{4i} multiples are precomputed host-side as affine triples
+  (B per process, -A per key in the device-resident table), so the
+  ladder is 2·64 complete mixed additions with ZERO doublings;
 - the verification equation is checked the way Go does it
   (encoding comparison): compute R' = [S]B + [k](-A), normalize to
   affine with one batched Fermat inversion, re-encode, and compare
@@ -47,6 +51,7 @@ D_CONST = (-121665 * pow(121666, -1, P)) % P
 SQRT_M1 = pow(2, (P - 1) // 4, P)
 K = 16                       # 256 bits of 16-bit limbs
 NBITS = 253                  # max bit length of S and k (both < 2^253)
+N_WINDOWS = (NBITS + 3) // 4  # 4-bit interleaved-window positions
 
 _BY = 4 * pow(5, -1, P) % P
 
@@ -96,6 +101,42 @@ assert _B_POINT is not None
 _IDENTITY = (0, 1)
 
 
+def _window_triple_rows(pt: Tuple[int, int]) -> np.ndarray:
+    """4-bit window table of one point as Montgomery affine triples.
+
+    Returns [3, N_WINDOWS·16, K] uint32: row i·16 + d holds the
+    (y-x, y+x, 2dxy) triple of d·2^{4i}·pt, with d = 0 the identity
+    (the complete formulas absorb identity addends, so the ladder
+    needs no skip mask). Low-order pt (adversarial keys) may produce
+    identity rows elsewhere too — equally harmless.
+    """
+    cc = consts()
+    rows = np.empty((3, N_WINDOWS * 16, K), np.uint32)
+    base = pt
+    for i in range(N_WINDOWS):
+        acc = _IDENTITY
+        for d in range(16):
+            if d:
+                acc = _edw_add(acc, base)
+            for t, v in enumerate(_triple_limbs(acc, cc.pone_int)):
+                rows[t, i * 16 + d] = v
+        for _ in range(4):
+            base = _edw_add(base, base)
+    return rows
+
+
+_B_TABLE = None
+
+
+def b_table():
+    """Cached device window table for the basepoint B: 3× [NW·16, K]."""
+    global _B_TABLE
+    if _B_TABLE is None:
+        rows = _window_triple_rows(_B_POINT)
+        _B_TABLE = tuple(jnp.asarray(rows[t]) for t in range(3))
+    return _B_TABLE
+
+
 class _FieldConsts:
     """Cached [K, 1] device constants for the edwards25519 field."""
 
@@ -112,10 +153,9 @@ class _FieldConsts:
             pm2=L.int_to_limbs(P - 2, K),     # Fermat exponent
             l=L.int_to_limbs(L_ORDER, K),
         )
-        b_trip = _triple_limbs(_B_POINT, pone)
         self.dev = tuple(jnp.asarray(v)[:, None] for v in (
             host["p"], host["pp"], host["pr2"], host["pone"], host["pm2"],
-            host["l"], *b_trip))
+            host["l"]))
 
 
 def _triple_limbs(pt: Tuple[int, int], r_mod_p: int) -> List[np.ndarray]:
@@ -138,10 +178,12 @@ def consts() -> _FieldConsts:
 class Ed25519KeyTable:
     """Device-resident table of Ed25519 public keys.
 
-    Rows hold -A and the Shamir precompute B+(-A) as affine triples
-    (y-x, y+x, 2dxy) in field-Montgomery form. Undecodable keys get
-    identity rows and an ``invalid`` flag (their tokens verify False,
-    matching Go's decode-failure behavior).
+    Per key, the full 4-bit interleaved-window table of -A (d·2^{4i}
+    multiples as affine triples (y-x, y+x, 2dxy), field-Montgomery
+    form) — the ladder then needs no doublings, only gathers + complete
+    mixed adds. Undecodable keys get identity tables and an ``invalid``
+    flag (their tokens verify False, matching Go's decode-failure
+    behavior).
     """
 
     def __init__(self, keys: Sequence):
@@ -150,30 +192,24 @@ class Ed25519KeyTable:
             PublicFormat,
         )
 
-        cc = consts()
         self.keys = list(keys)  # cryptography Ed25519PublicKey
         nk = len(self.keys)
         self.key_bytes: List[bytes] = [
             k.public_bytes(Encoding.Raw, PublicFormat.Raw)
             for k in self.keys]
 
-        na = np.empty((3, nk, K), np.uint32)
-        dd = np.empty((3, nk, K), np.uint32)
+        rows = N_WINDOWS * 16
+        na = np.empty((3, nk * rows, K), np.uint32)
         invalid = np.zeros(nk, bool)
         for i, raw in enumerate(self.key_bytes):
             a = decode_point(raw)
             if a is None:
                 invalid[i] = True
-                neg_a = d_pt = _IDENTITY
+                neg_a = _IDENTITY
             else:
                 neg_a = ((P - a[0]) % P, a[1])
-                d_pt = _edw_add(_B_POINT, neg_a)
-            for t, v in enumerate(_triple_limbs(neg_a, cc.pone_int)):
-                na[t, i] = v
-            for t, v in enumerate(_triple_limbs(d_pt, cc.pone_int)):
-                dd[t, i] = v
-        self.na_tab = jnp.asarray(na)       # [3, nk, K]
-        self.d_tab = jnp.asarray(dd)
+            na[:, i * rows:(i + 1) * rows] = _window_triple_rows(neg_a)
+        self.tna = tuple(jnp.asarray(na[t]) for t in range(3))
         self.invalid = invalid
 
 
@@ -220,65 +256,69 @@ def _edw_madd(X, Y, Z, T, ym, yp, t2, p, pp):
 
 
 @jax.jit
-def _ed25519_core(s, kk, yr, sign_r, bad_key,
-                  na_ym, na_yp, na_t2, d_ym, d_yp, d_t2,
-                  p, pp, pr2, pone, pm2, l_, b_ym, b_yp, b_t2):
+def _ed25519_core(s, kk, yr, sign_r, bad_key, key_idx,
+                  ta_ym, ta_yp, ta_t2, tb_ym, tb_yp, tb_t2,
+                  p, pp, pr2, pone, pm2, l_):
     """Batched Ed25519 verify core.
 
     s, kk: [K, N] plain scalar limbs (S half of the signature;
-    k = H(R‖A‖M) mod L). yr: [K, N] limbs of the R encoding's y value
-    (sign bit cleared); sign_r: [N] its sign bit. bad_key: [N] bool.
-    na_*/d_*: [K, N] gathered per-token addend triples for -A and
-    B+(-A). Remaining args: [K, 1] field constants and the basepoint
-    triple (broadcast on-device — transferred once, not per batch).
+    k = H(R‖A‖M) mod L); N a power of two (batch-inverse tree).
+    yr: [K, N] limbs of the R encoding's y value (sign bit cleared);
+    sign_r: [N] its sign bit. bad_key: [N] bool. key_idx: [N] int32.
+    ta_*: [nk·NW·16, K] per-key window tables of -A; tb_*: [NW·16, K]
+    the basepoint window table. Remaining args: [K, 1] field constants
+    (broadcast on-device — transferred once, not per batch).
     Returns ok [N].
     """
     from . import bignum as B
 
     shape = s.shape
-    (p, pp, pr2, pone, pm2, l_, b_ym, b_yp, b_t2) = (
-        jnp.broadcast_to(a, shape)
-        for a in (p, pp, pr2, pone, pm2, l_, b_ym, b_yp, b_t2))
+    p1, pp1, pr21, pone1, pm21 = p, pp, pr2, pone, pm2
+    (p, pp, pone, l_) = (
+        jnp.broadcast_to(a, shape) for a in (p, pp, pone, l_))
 
     # 1. S must be canonical: S < L (Go: Scalar.SetCanonicalBytes).
     s_ok = ~B.compare_ge(s, l_)
 
-    # 2. Shamir ladder: R' = [S]B + [k](-A), identity start.
+    # 2. Interleaved-window ladder: R' = Σ d1_i·(2^{4i}B) +
+    #    d2_i·(2^{4i}(-A)). Digit 0 rows hold the identity and the
+    #    formulas are complete, so every iteration adds unconditionally.
+    k = shape[0]
+
+    def nibbles(u):
+        return jnp.stack(
+            [(u >> (4 * j)) & 15 for j in range(4)], axis=1
+        ).reshape(4 * k, shape[1]).astype(jnp.int32)
+
+    dig1 = nibbles(s)        # [S]B digits
+    dig2 = nibbles(kk)       # [k](-A) digits
+    key_base = key_idx.astype(jnp.int32) * (N_WINDOWS * 16)
+
     zeros = jnp.zeros_like(s)
     X0, Y0, Z0, T0 = zeros, pone, pone, zeros
 
+    def add_from_table(pt, tab_ym, tab_yp, tab_t2, idx):
+        X, Y, Z, T = pt
+        ym = jnp.take(tab_ym, idx, axis=0).T
+        yp = jnp.take(tab_yp, idx, axis=0).T
+        t2 = jnp.take(tab_t2, idx, axis=0).T
+        return _edw_madd(X, Y, Z, T, ym, yp, t2, p, pp)
+
     def ladder_body(i, carry):
-        X, Y, Z, T = carry
-        bit_idx = NBITS - 1 - i
-        limb = bit_idx // L.LIMB_BITS
-        shift = bit_idx % L.LIMB_BITS
-        b1 = ((s[limb] >> shift) & 1) > 0
-        b2 = ((kk[limb] >> shift) & 1) > 0
+        d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
+        d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
+        carry = add_from_table(carry, tb_ym, tb_yp, tb_t2, i * 16 + d1)
+        carry = add_from_table(carry, ta_ym, ta_yp, ta_t2,
+                               key_base + i * 16 + d2)
+        return carry
 
-        X, Y, Z, T = _edw_double(X, Y, Z, T, p, pp)
+    X, Y, Z, T = lax.fori_loop(0, N_WINDOWS, ladder_body,
+                               (X0, Y0, Z0, T0))
 
-        both = b1 & b2
-        sel = both[None, :]
-        ym = jnp.where(sel, d_ym, jnp.where(b1[None, :], b_ym, na_ym))
-        yp = jnp.where(sel, d_yp, jnp.where(b1[None, :], b_yp, na_yp))
-        t2 = jnp.where(sel, d_t2, jnp.where(b1[None, :], b_t2, na_t2))
-        Xa, Ya, Za, Ta = _edw_madd(X, Y, Z, T, ym, yp, t2, p, pp)
-
-        has_add = (b1 | b2)[None, :]
-        X = jnp.where(has_add, Xa, X)
-        Y = jnp.where(has_add, Ya, Y)
-        Z = jnp.where(has_add, Za, Z)
-        T = jnp.where(has_add, Ta, T)
-        return X, Y, Z, T
-
-    X, Y, Z, T = lax.fori_loop(0, NBITS, ladder_body, (X0, Y0, Z0, T0))
-
-    # 3. Affine normalize: one batched Fermat inversion of Z (Z ≠ 0
+    # 3. Affine normalize: batch product-tree inversion of Z (Z ≠ 0
     #    always — Edwards completeness), then leave the Montgomery
     #    domain and re-encode.
-    zinv = B.modexp_fixed_exponent(Z, pm2, p, pp, pr2, pone,
-                                   ebits=255, exit_domain=False,
-                                   s_in_mont=True)
+    zinv = B.batch_mont_inverse(Z, p1, pp1, pr21, pone1, pm21, nbits=255)
     one = jnp.zeros_like(s).at[0].set(1)
     x = B.mont_mul(B.mont_mul(X, zinv, p, pp), one, p, pp)
     y = B.mont_mul(B.mont_mul(Y, zinv, p, pp), one, p, pp)
@@ -332,15 +372,28 @@ def verify_ed25519_batch(table: Ed25519KeyTable, sigs: Sequence[bytes],
     r_mat[:, 31] &= 0x7F
     yr_limbs = _le_bytes_to_limbs(r_mat)
     k_limbs = L.ints_to_limbs(k_ints, K)
+    key_rows = np.asarray(key_idx, np.int32)
+    bad = table.invalid[key_rows]
 
-    idx = jnp.asarray(np.asarray(key_idx, np.int32))
-    na = table.na_tab[:, idx].transpose(0, 2, 1)   # [3, K, N]
-    dd = table.d_tab[:, idx].transpose(0, 2, 1)
-    bad = jnp.asarray(table.invalid)[idx]
+    # Pad the batch to a power of two ≥ 128 for the inverse tree /
+    # bucket-shape stability. Padding rows compute on key row 0 and are
+    # discarded below.
+    n_pad = 128
+    while n_pad < n_tok:
+        n_pad *= 2
+    if n_pad != n_tok:
+        fill = n_pad - n_tok
+        s_limbs = np.pad(s_limbs, ((0, 0), (0, fill)))
+        k_limbs = np.pad(k_limbs, ((0, 0), (0, fill)))
+        yr_limbs = np.pad(yr_limbs, ((0, 0), (0, fill)))
+        sign_r = np.pad(sign_r, (0, fill))
+        key_rows = np.pad(key_rows, (0, fill))
+        bad = np.pad(bad, (0, fill))
 
     ok = _ed25519_core(
         jnp.asarray(s_limbs), jnp.asarray(k_limbs),
-        jnp.asarray(yr_limbs), jnp.asarray(sign_r), bad,
-        na[0], na[1], na[2], dd[0], dd[1], dd[2],
+        jnp.asarray(yr_limbs), jnp.asarray(sign_r), jnp.asarray(bad),
+        jnp.asarray(key_rows),
+        *table.tna, *b_table(),
         *consts().dev)
-    return np.asarray(ok) & len_ok
+    return np.asarray(ok)[:n_tok] & len_ok

@@ -38,6 +38,23 @@ DIGEST_INFO_PREFIX = {
 HASH_LEN = {"sha256": 32, "sha384": 48, "sha512": 64}
 
 
+def _use_rns() -> bool:
+    """RNS/MXU modexp on accelerators; limb/VPU path elsewhere.
+
+    Override with CAP_TPU_RNS=1/0 (tests force 1 on CPU to pin RNS
+    parity; CPU default stays on the limb path, which compiles much
+    faster there).
+    """
+    import os
+
+    v = os.environ.get("CAP_TPU_RNS")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
 class RSAKeyTable:
     """Device-resident table of RSA public keys in Montgomery form.
 
@@ -54,9 +71,11 @@ class RSAKeyTable:
         self.e_ints = [e for _, e in public_numbers]
         self.sizes_bytes = [(n.bit_length() + 7) // 8 for n in self.n_ints]
         need = L.nlimbs_for_bits(max(n.bit_length() for n in self.n_ints))
-        self.k = k if k is not None else max(need, 8)
-        if self.k < need:
-            raise ValueError("k too small for largest modulus")
+        # One spare limb beyond the modulus width → R ≥ 2^16·n ≥ 4n, the
+        # precondition for the subtraction-free Montgomery chain.
+        self.k = k if k is not None else max(need + 1, 8)
+        if self.k <= need:
+            raise ValueError("k too small for lazy Montgomery headroom")
 
         nk = len(self.n_ints)
         n_tab = np.empty((nk, self.k), np.uint32)
@@ -79,6 +98,25 @@ class RSAKeyTable:
         self.e_arr = np.asarray(self.e_ints, np.uint32)
         self.all_f4 = all(e == 65537 for e in self.e_ints)
         self.max_ebits = max(e.bit_length() for e in self.e_ints)
+        self._rns = None
+
+    def rns(self):
+        """Lazily-built RNS engine (ctx + per-key table); e=65537 only.
+
+        Context bit-width rounds up to a 256-bit grid so mixed-size
+        JWKS reuse cached contexts.
+        """
+        if self._rns is None:
+            from . import rns as rns_mod
+
+            nbits = max(n.bit_length() for n in self.n_ints)
+            nbits = ((nbits + 255) // 256) * 256
+            try:
+                ctx = rns_mod.context(nbits, self.k)
+                self._rns = (ctx, rns_mod.RNSKeyTable(ctx, self.n_ints))
+            except rns_mod.RNSUnsupportedKey:
+                self._rns = (None, None)   # degenerate key → limb path
+        return self._rns
 
 
 def _gather_limb_first(tab, idx):
@@ -196,11 +234,20 @@ def verify_pkcs1v15_arrays(table: RSAKeyTable, sig_mat: np.ndarray,
     safe_lens = np.where(len_ok, sig_lens, 0)
     s_limbs = L.bytes_matrix_to_limbs(
         np.where(len_ok[:, None], sig_mat, 0), safe_lens, table.k)
-    em = modexp_for_table(table, s_limbs, key_idx)
-    expected = jnp.asarray(
-        expected_pkcs1v15_em_mat(hash_mat, hash_name, sizes, table.k))
-    eq = jnp.all(em == expected, axis=0)
+    expected_np = expected_pkcs1v15_em_mat(hash_mat, hash_name, sizes,
+                                           table.k)
     in_range = s_in_range_mask(table, s_limbs, key_idx)
+    if table.all_f4 and _use_rns():
+        # MXU path: modexp + EM compare entirely in RNS form.
+        from . import rns as rns_mod
+
+        ctx, rtab = table.rns()
+        if ctx is not None:
+            eq = rns_mod.verify_em_equals(ctx, rtab, s_limbs, expected_np,
+                                          key_idx)
+            return eq & np.asarray(in_range) & len_ok & em_len_ok
+    em = modexp_for_table(table, s_limbs, key_idx)
+    eq = jnp.all(em == jnp.asarray(expected_np), axis=0)
     return np.asarray(eq & in_range) & len_ok & em_len_ok
 
 
@@ -245,12 +292,19 @@ def verify_pkcs1v15_batch(table: RSAKeyTable, sigs: Sequence[bytes],
     s_limbs = L.bytes_be_to_limbs(
         [s if ok else b"" for s, ok in zip(sigs, len_ok)], table.k
     )
-    em = modexp_for_table(table, s_limbs, key_idx)
-    expected = jnp.asarray(
-        expected_pkcs1v15_em(msg_hashes, hash_name, sizes, table.k)
-    )
-    eq = jnp.all(em == expected, axis=0)
+    expected_np = expected_pkcs1v15_em(msg_hashes, hash_name, sizes,
+                                       table.k)
     in_range = s_in_range_mask(table, s_limbs, key_idx)
+    if table.all_f4 and _use_rns():
+        from . import rns as rns_mod
+
+        ctx, rtab = table.rns()
+        if ctx is not None:
+            eq = rns_mod.verify_em_equals(ctx, rtab, s_limbs, expected_np,
+                                          np.asarray(key_idx, np.int32))
+            return eq & np.asarray(in_range) & len_ok & em_len_ok
+    em = modexp_for_table(table, s_limbs, key_idx)
+    eq = jnp.all(em == jnp.asarray(expected_np), axis=0)
     ok = np.asarray(eq & in_range)
     return ok & len_ok & em_len_ok
 

@@ -97,7 +97,9 @@ def test_mont_mul_parity():
 def test_modexp_65537_parity(bits):
     import jax.numpy as jnp
 
-    k = L.nlimbs_for_bits(bits)
+    # One spare limb beyond the modulus width: the lazy-Montgomery chain
+    # requires R ≥ 4n (RSAKeyTable allocates this the same way).
+    k = L.nlimbs_for_bits(bits) + 1
     n_tok = 8
     mods = [rand_odd(bits) for _ in range(4)]
     idx = [rng.randrange(4) for _ in range(n_tok)]
@@ -131,6 +133,26 @@ def test_modexp_vare_parity():
                                         one_arr, ebits=17))
     got = L.limbs_to_ints(out)
     assert got == [pow(x, exps[i], mods[i]) for x, i in zip(s_i, idx)]
+
+
+def test_batch_mont_inverse():
+    import jax.numpy as jnp
+
+    k = 16
+    p = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+    nprime, r2, one_m = bignum.mont_params(p, k)
+    r_mod = 1 << (16 * k)
+    n_tok = 256
+    xs = [rng.randrange(1, p) for _ in range(n_tok)]
+    xm = jnp.asarray(L.ints_to_limbs([x * r_mod % p for x in xs], k))
+
+    def c(v):
+        return jnp.asarray(L.int_to_limbs(v, k))[:, None]
+
+    inv = np.asarray(bignum.batch_mont_inverse(
+        xm, c(p), c(nprime), c(r2), c(one_m), c(p - 2), nbits=256))
+    got = L.limbs_to_ints(inv)
+    assert got == [pow(x, -1, p) * r_mod % p for x in xs]
 
 
 def test_modexp_fixed_exponent_parity():

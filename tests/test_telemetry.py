@@ -1,0 +1,110 @@
+"""Telemetry subsystem: counters, spans, quantiles, hot-path wiring.
+
+The reference has no instrumentation (SURVEY.md §5); these tests cover
+the freshly-built one: recorder semantics, scoped enablement, zero
+overhead when off, and that verify_batch emits stage timings/counters
+without ever recording token or key material.
+"""
+
+import threading
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu import testing as captest
+from cap_tpu.jwt.jwk import JWK
+from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def test_counters_and_series():
+    rec = telemetry.Recorder()
+    rec.count("a")
+    rec.count("a", 4)
+    rec.observe("lat", 0.5)
+    rec.observe("lat", 1.5)
+    assert rec.counters() == {"a": 5}
+    assert rec.series("lat") == [0.5, 1.5]
+
+
+def test_span_records_duration():
+    rec = telemetry.Recorder()
+    with rec.span("s"):
+        pass
+    vals = rec.series("s")
+    assert len(vals) == 1 and vals[0] >= 0.0
+
+
+def test_summary_quantiles():
+    rec = telemetry.Recorder()
+    for i in range(100):
+        rec.observe("x", float(i))
+    s = rec.summary()["x"]
+    assert s["count"] == 100
+    assert s["p50"] == pytest.approx(50.0, abs=1)
+    assert s["p99"] == pytest.approx(98.0, abs=1)
+    assert s["max"] == 99.0
+    assert s["mean"] == pytest.approx(49.5)
+
+
+def test_module_noop_when_disabled():
+    assert telemetry.active() is None
+    telemetry.count("never")  # must not raise
+    with telemetry.span("never"):
+        pass
+    assert telemetry.active() is None
+
+
+def test_recording_scope_restores_previous():
+    outer = telemetry.enable()
+    with telemetry.recording() as rec:
+        assert telemetry.active() is rec
+        telemetry.count("inner")
+    assert telemetry.active() is outer
+    assert "inner" not in outer.counters()
+    assert rec.counters()["inner"] == 1
+
+
+def test_thread_safety():
+    rec = telemetry.Recorder()
+
+    def work():
+        for _ in range(1000):
+            rec.count("n")
+            rec.observe("v", 1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.counters()["n"] == 4000
+    assert rec.summary()["v"]["count"] == 4000
+
+
+def test_verify_batch_emits_stage_metrics():
+    priv, pub = captest.generate_keys("RS256", rsa_bits=2048)
+    ks = TPUBatchKeySet([JWK(pub, kid="k0")])
+    tokens = [captest.sign_jwt(priv, "RS256", captest.default_claims(),
+                               kid="k0")] * 4
+
+    with telemetry.recording() as rec:
+        out = ks.verify_batch(tokens)
+    assert all(isinstance(r, dict) for r in out)
+
+    counters = rec.counters()
+    assert counters["verify_batch.calls"] == 1
+    assert counters["verify_batch.tokens"] == 4
+    summ = rec.summary()
+    assert "verify_batch.total" in summ
+    # a prep span from one of the two paths must be present
+    assert any(k.startswith("prep") for k in summ)
+    # no metric name may carry payload material
+    for name in list(counters) + list(summ):
+        assert "eyJ" not in name and len(name) < 80

@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""Stage-level profile of the headline benchmark using cap_tpu.telemetry.
+
+Runs RS256-only, ES256-only, and mixed batches and prints the per-stage
+summary so optimization targets the real bottleneck.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cap_tpu import telemetry
+from cap_tpu import testing as T
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.jwk import JWK
+from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+BATCH = int(os.environ.get("CAP_PROF_BATCH", 1 << 14))
+
+
+def make(alg_list):
+    jwks, signers = [], []
+    for i, alg in enumerate(alg_list):
+        kw = {"rsa_bits": 2048} if alg == "RS256" else {}
+        priv, pub = T.generate_keys(alg, **kw)
+        jwks.append(JWK(pub, kid=f"k-{i}"))
+        signers.append((priv, alg, f"k-{i}"))
+    claims = T.default_claims(ttl=86400.0)
+    uniq = [T.sign_jwt(p, a, claims, kid=k) for p, a, k in signers]
+    toks = (uniq * (BATCH // len(uniq) + 1))[:BATCH]
+    return TPUBatchKeySet(jwks), toks
+
+
+def run(name, alg_list):
+    ks, toks = make(alg_list)
+    ks.verify_batch(toks)  # warmup/compile
+    with telemetry.recording() as rec:
+        t0 = time.perf_counter()
+        ks.verify_batch(toks)
+        dt = time.perf_counter() - t0
+    print(f"== {name}: {BATCH} tokens in {dt:.3f}s = {BATCH/dt:,.0f}/s")
+    for k, s in sorted(rec.summary().items()):
+        print(f"   {k:24s} n={int(s['count']):3d} total={s['total']:.3f}s "
+              f"mean={s['mean']*1e3:.1f}ms")
+    for k, v in sorted(rec.counters().items()):
+        print(f"   {k:24s} = {v}")
+
+
+if __name__ == "__main__":
+    run("RS256 x8keys", ["RS256"] * 8)
+    run("ES256 x8keys", ["ES256"] * 8)
+    run("mixed 8+8", ["RS256"] * 8 + ["ES256"] * 8)

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""TPU microbench: RNS (MXU) vs limb (VPU) RS256 modexp throughput."""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+import random
+
+from cap_tpu.tpu import limbs as L
+from cap_tpu.tpu import rns
+from cap_tpu.tpu.rsa import RSAKeyTable, verify_pkcs1v15_arrays
+
+N = int(os.environ.get("CAP_PROF_BATCH", 1 << 14))
+rng = random.Random(9)
+
+
+def modulus(bits):
+    from cryptography.hazmat.primitives.asymmetric import rsa as crsa
+
+    priv = crsa.generate_private_key(public_exponent=65537, key_size=bits)
+    return priv.public_key().public_numbers().n
+
+
+def bench(label, fn):
+    fn()  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label}: {N} tokens in {dt*1e3:.1f}ms = {N/dt:,.0f}/s")
+
+
+def main():
+    bits = 2048
+    mods = [modulus(bits) for _ in range(8)]
+    k = L.nlimbs_for_bits(bits) + 1
+    idx = np.asarray([i % 8 for i in range(N)], np.int32)
+    s = [rng.randrange(mods[i]) for i in idx]
+    want = [pow(x, 65537, mods[i]) for x, i in zip(s, idx)]
+    sl = L.ints_to_limbs(s, k)
+    el = L.ints_to_limbs(want, k)
+
+    ctx = rns.context(2048, k)
+    rtab = rns.RNSKeyTable(ctx, mods)
+
+    def rns_fn():
+        ok = rns.verify_em_equals(ctx, rtab, sl, el, idx)
+        assert ok.all()
+
+    bench("RNS  RS2048 modexp+cmp", rns_fn)
+
+    table = RSAKeyTable([(n, 65537) for n in mods])
+    from cap_tpu.tpu.rsa import modexp_for_table
+
+    def limb_fn():
+        em = modexp_for_table(table, sl, idx)
+        em.block_until_ready()
+
+    bench("limb RS2048 modexp    ", limb_fn)
+
+
+if __name__ == "__main__":
+    main()

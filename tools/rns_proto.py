@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Numpy prototype of RNS-Montgomery modexp (validation only).
+
+Validates the exact scheme the TPU engine uses before it's written in
+JAX: two RNS bases of ~13-bit primes, Bajard fast base extension with
+floor-approximated alpha (error {-1,0}) on the A->B direction and an
+offset-0.5 exact alpha on the B->A direction, f32-exact 7-bit-split
+matmuls, Barrett guess-then-fix channel reduction, and a shifted
+comparison window at the end instead of any RNS->binary conversion.
+"""
+
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def sieve_primes(lo, hi):
+    n = hi
+    mask = np.ones(n, bool)
+    mask[:2] = False
+    for i in range(2, int(n ** 0.5) + 1):
+        if mask[i]:
+            mask[i * i:: i] = False
+    return [p for p in range(lo, hi) if mask[p]]
+
+
+def pick_base(primes, min_bits, skip=0):
+    out = []
+    bits = 0.0
+    i = skip
+    while bits < min_bits:
+        p = primes[i]
+        out.append(p)
+        bits += np.log2(p)
+        i += 1
+    return out, i
+
+
+class Base:
+    def __init__(self, ms):
+        self.m = np.array(ms, np.int64)
+        self.I = len(ms)
+        self.prod = 1
+        for p in ms:
+            self.prod *= int(p)
+        # (M/m_i)^{-1} mod m_i  and  M/m_i mod (other base channels)
+        self.Mi = [self.prod // int(p) for p in ms]
+        self.inv_Mi = np.array([pow(M % int(p), -1, int(p))
+                                for M, p in zip(self.Mi, ms)], np.int64)
+        self.inv_f = (1.0 / self.m).astype(np.float32)
+
+
+def ext_matrix(src: Base, dst: Base):
+    """W[j, i] = (src.M / src.m[i]) mod dst.m[j]."""
+    W = np.empty((dst.I, src.I), np.int64)
+    for i, Mi in enumerate(src.Mi):
+        W[:, i] = np.array([Mi % int(m) for m in dst.m], np.int64)
+    return W
+
+
+def split7(x):
+    return x >> 7, x & 127
+
+
+def exact_split_matmul(W, sig):
+    """Simulate the 4x bf16 matmul with f32 accumulation; assert exact."""
+    Wh, Wl = split7(W)
+    sh, sl = split7(sig)
+    outs = []
+    for a in (Wh, Wl):
+        for b in (sh, sl):
+            af = a.astype(np.float32)
+            bf = b.astype(np.float32)
+            c = af @ bf                      # f32 accumulation
+            ci = a @ b                       # exact int reference
+            assert np.all(c == ci.astype(np.float32)), "f32 inexact!"
+            assert ci.max() < (1 << 24)
+            outs.append(ci)
+    hh, hl, lh, ll = outs
+    return hh, hl + lh, ll                    # weights 2^14, 2^7, 2^0
+
+
+def mod_fix(x, m):
+    """Barrett guess-then-fix: exact x mod m for x < 2^31, m < 2^13."""
+    xf = x.astype(np.float32)
+    q = np.floor(xf * (1.0 / m.astype(np.float32))).astype(np.int64)
+    r = x - q * m
+    r = np.where(r < 0, r + m, r)
+    r = np.where(r < 0, r + m, r)
+    r = np.where(r >= m, r - m, r)
+    r = np.where(r >= m, r - m, r)
+    assert np.all((0 <= r) & (r < m)), (x.max(), m)
+    return r
+
+
+def extend(sig, src: Base, dst: Base, W, A_mod_dst, offset):
+    """Base extension with approximated alpha. sig: [I_src, N]."""
+    hh, mid, ll = exact_split_matmul(W, sig)
+    # alpha estimate
+    s = (sig.astype(np.float32) * src.inv_f[:, None]).sum(0)
+    alpha = np.floor(s + offset).astype(np.int64)   # offset<0: A->B floor
+    m = dst.m[:, None]
+    rhh = mod_fix(hh, m)
+    rmid = mod_fix(mid, m)
+    rll = mod_fix(ll, m)
+    c14 = (1 << 14) % m
+    c7 = (1 << 7) % m
+    comb = rhh * c14 + rmid * c7 + rll            # < 3*2^26
+    comb = mod_fix(comb, m)
+    # subtract alpha * (src.prod mod dst.m): keep positive
+    corr = (alpha[None, :] % m) * (A_mod_dst[:, None] % m)  # < 2^26
+    corr = mod_fix(corr, m)
+    out = mod_fix(comb - corr + m, m)
+    return out, alpha
+
+
+class RNSMont:
+    def __init__(self, n_int, nbits):
+        primes = sieve_primes(1 << 12, 1 << 13)
+        random.Random(7).shuffle(primes)
+        msA, used = pick_base(primes, nbits + 8)
+        msB, _ = pick_base(primes, nbits + 8, skip=used)
+        self.A = Base(msA)
+        self.B = Base(msB)
+        self.n = n_int
+        self.W_AB = ext_matrix(self.A, self.B)
+        self.W_BA = ext_matrix(self.B, self.A)
+        self.Amod_B = np.array([self.A.prod % int(m) for m in self.B.m],
+                               np.int64)
+        self.Bmod_A = np.array([self.B.prod % int(m) for m in self.A.m],
+                               np.int64)
+        self.n_A = np.array([n_int % int(m) for m in self.A.m], np.int64)
+        self.n_B = np.array([n_int % int(m) for m in self.B.m], np.int64)
+        # per-channel merged constant: (-n^{-1} mod A)_i * inv_Mi mod a_i
+        npr = [(-pow(n_int, -1, int(m))) % int(m) for m in self.A.m]
+        self.sig_c = (np.array(npr, np.int64) * self.A.inv_Mi) % self.A.m
+        self.invA_B = np.array(
+            [pow(self.A.prod % int(m), -1, int(m)) for m in self.B.m],
+            np.int64)
+        self.A2_n = (self.A.prod * self.A.prod) % n_int
+
+    def to_rns(self, xs):
+        xA = np.array([[x % int(m) for x in xs] for m in self.A.m],
+                      np.int64)
+        xB = np.array([[x % int(m) for x in xs] for m in self.B.m],
+                      np.int64)
+        return xA, xB
+
+    def redc(self, xA, xB):
+        """(xA,xB) -> t = x*A^{-1} mod n (+ c*n), both bases."""
+        mA = self.A.m[:, None]
+        mB = self.B.m[:, None]
+        sig = mod_fix(xA * self.sig_c[:, None], mA)
+        qB, _ = extend(sig, self.A, self.B, self.W_AB, self.Amod_B,
+                       offset=-1e-4)
+        # t_B = (x + q*n) * A^{-1} mod b
+        t = mod_fix(xB + mod_fix(qB * self.n_B[:, None], mB), mB)
+        t = mod_fix(t * self.invA_B[:, None], mB)
+        # back-extend t to A (exact alpha: offset 0.5)
+        sig2 = mod_fix(t * self.B.inv_Mi[:, None], mB)
+        tA, _ = extend(sig2, self.B, self.A, self.W_BA, self.Bmod_A,
+                       offset=0.5 - 1e-4)
+        return tA, t
+
+    def mul_redc(self, aA, aB, bA, bB):
+        pA = mod_fix(aA * bA, self.A.m[:, None])
+        pB = mod_fix(aB * bB, self.B.m[:, None])
+        return self.redc(pA, pB)
+
+    def modexp_65537(self, xs):
+        sA, sB = self.to_rns(xs)
+        a2A, a2B = self.to_rns([self.A2_n] * len(xs))
+        xA, xB = self.mul_redc(sA, sB, a2A, a2B)      # enter domain
+        x0A, x0B = xA, xB
+        for _ in range(16):
+            xA, xB = self.mul_redc(xA, xB, xA, xB)
+        xA, xB = self.mul_redc(xA, xB, x0A, x0B)
+        oneA, oneB = self.to_rns([1] * len(xs))
+        return self.mul_redc(xA, xB, oneA, oneB)      # exit; < c*n
+
+    def matches(self, xA, xB, expected_ints):
+        """x == expected + c*n for c in 0..3, checked in base B."""
+        ok = np.zeros(len(expected_ints), bool)
+        for c in range(4):
+            eB = np.array([[(e + c * self.n) % int(m) for e in expected_ints]
+                           for m in self.B.m], np.int64)
+            ok |= np.all(xB == eB, axis=0)
+        return ok
+
+
+def main():
+    rng = random.Random(1)
+    for bits in (2048, 1024):
+        p = rng.getrandbits(bits // 2) | (1 << (bits // 2 - 1)) | 1
+        q = rng.getrandbits(bits // 2) | (1 << (bits // 2 - 1)) | 1
+        n = p * q
+        eng = RNSMont(n, bits)
+        xs = [rng.randrange(n) for _ in range(64)] + [0, 1, n - 1, n // 2]
+        xA, xB = eng.modexp_65537(xs)
+        want = [pow(x, 65537, n) for x in xs]
+        ok = eng.matches(xA, xB, want)
+        assert ok.all(), np.nonzero(~ok)
+        # negative control
+        bad = eng.matches(xA, xB, [w ^ 1 for w in want])
+        assert not bad.any()
+        print(f"RNS modexp {bits}-bit OK  "
+              f"(I_A={eng.A.I}, I_B={eng.B.I})")
+
+
+if __name__ == "__main__":
+    main()
