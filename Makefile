@@ -3,14 +3,19 @@ CXXFLAGS ?= -O3 -march=native -fPIC -shared -pthread -std=c++17 -Wall
 
 NATIVE_DIR := cap_tpu/runtime/native
 NATIVE_SO := $(NATIVE_DIR)/libcapruntime.so
+CLIENT_DIR := cap_tpu/serve/native
+CLIENT_SO := $(CLIENT_DIR)/libcapclient.so
 
 .PHONY: all native test bench clean
 
 all: native
 
-native: $(NATIVE_SO)
+native: $(NATIVE_SO) $(CLIENT_SO)
 
 $(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+$(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
 test: native
@@ -20,4 +25,4 @@ bench: native
 	python bench.py
 
 clean:
-	rm -f $(NATIVE_SO)
+	rm -f $(NATIVE_SO) $(CLIENT_SO)

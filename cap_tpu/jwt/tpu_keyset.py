@@ -182,6 +182,12 @@ class TPUBatchKeySet(KeySet):
             results[int(i)] = pb.error(int(i))
 
         slow: List[int] = []
+        # Two-phase device interaction: every bucket's program is
+        # DISPATCHED first (async — jax queues them back-to-back), then
+        # one materializing sync wave collects verdicts. This pays the
+        # host↔device round-trip latency once per batch instead of once
+        # per bucket.
+        pending: List[tuple] = []
         alg_ids = {name: i for i, name in enumerate(ALG_NAMES)}
 
         def run_family(alg_name: str, runner) -> None:
@@ -191,16 +197,18 @@ class TPUBatchKeySet(KeySet):
             runner(alg_name, idx)
 
         def run_rs(alg_name: str, idx: np.ndarray) -> None:
-            self._run_rsa_arrays("rs", _RS[alg_name], idx, pb, results, slow)
+            self._run_rsa_arrays("rs", _RS[alg_name], idx, pb, pending,
+                                 slow)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
-            self._run_rsa_arrays("ps", _PS[alg_name], idx, pb, results, slow)
+            self._run_rsa_arrays("ps", _PS[alg_name], idx, pb, pending,
+                                 slow)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
-            self._run_ec_arrays(alg_name, idx, pb, results, slow)
+            self._run_ec_arrays(alg_name, idx, pb, pending, slow)
 
         def run_ed(alg_name: str, idx: np.ndarray) -> None:
-            self._run_ed_arrays(idx, pb, results, slow)
+            self._run_ed_arrays(idx, pb, pending, slow)
 
         if self._rsa_table is not None:
             for a in _RS:
@@ -212,6 +220,11 @@ class TPUBatchKeySet(KeySet):
                 run_family(a, run_es)
         if self._ed_table is not None:
             run_family(algs.EdDSA, run_ed)
+
+        with telemetry.span("device.sync"):
+            for chunk, m, fin in pending:
+                self._finish_arrays(chunk, fin()[:m], pb, results)
+
         # families without device tables (or EC/Ed engines not built):
         slow_set = set(slow)
         for j in range(n):
@@ -241,7 +254,8 @@ class TPUBatchKeySet(KeySet):
                     "signature")
 
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
-                        pb, results: List[Any], slow: List[int]) -> None:
+                        pb, pending: List[tuple],
+                        slow: List[int]) -> None:
         from ..tpu import rsa as tpursa
 
         table = self._rsa_table
@@ -272,19 +286,19 @@ class TPUBatchKeySet(KeySet):
             key_idx = np.zeros(pad, np.int32)
             key_idx[:m] = crows
             telemetry.count(f"device.{kind}.tokens", m)
-            with telemetry.span(f"device.{kind}.{hash_name}"):
+            with telemetry.span(f"dispatch.{kind}.{hash_name}"):
                 if kind == "rs":
-                    okv = tpursa.verify_pkcs1v15_arrays(
+                    fin = tpursa.verify_pkcs1v15_arrays_pending(
                         table, sig_mat, sig_lens, hash_mat, hash_name,
                         key_idx)
                 else:
-                    okv = tpursa.verify_pss_arrays(
+                    fin = tpursa.verify_pss_arrays_pending(
                         table, sig_mat, sig_lens, hash_mat, hash_name,
                         key_idx)
-            self._finish_arrays(chunk, okv[:m], pb, results)
+            pending.append((chunk, m, fin))
 
-    def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb, results: List[Any],
-                       slow: List[int]) -> None:
+    def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb,
+                       pending: List[tuple], slow: List[int]) -> None:
         from ..tpu import ec as tpuec
         from ..tpu.rsa import HASH_LEN
 
@@ -316,13 +330,13 @@ class TPUBatchKeySet(KeySet):
             key_idx = np.zeros(pad, np.int32)
             key_idx[:m] = crows
             telemetry.count("device.es.tokens", m)
-            with telemetry.span(f"device.es.{crv}"):
-                okv = tpuec.verify_ecdsa_arrays(
+            with telemetry.span(f"dispatch.es.{crv}"):
+                fin = tpuec.verify_ecdsa_arrays_pending(
                     table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
-            self._finish_arrays(chunk, okv[:m], pb, results)
+            pending.append((chunk, m, fin))
 
-    def _run_ed_arrays(self, idx: np.ndarray, pb, results: List[Any],
-                       slow: List[int]) -> None:
+    def _run_ed_arrays(self, idx: np.ndarray, pb,
+                       pending: List[tuple], slow: List[int]) -> None:
         from ..tpu import ed25519 as tpued
 
         table = self._ed_table
@@ -348,9 +362,10 @@ class TPUBatchKeySet(KeySet):
             msgs += [b""] * fill
             key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
             telemetry.count("device.ed.tokens", m)
-            with telemetry.span("device.ed25519"):
-                okv = tpued.verify_ed25519_batch(table, sigs, msgs, key_idx)
-            self._finish_arrays(chunk, okv[:m], pb, results)
+            with telemetry.span("dispatch.ed25519"):
+                fin = tpued.verify_ed25519_batch_pending(
+                    table, sigs, msgs, key_idx)
+            pending.append((chunk, m, fin))
 
     def _verify_one_parsed(self, p) -> Any:
         """CPU trial verification of one parsed token (slow path)."""

@@ -384,6 +384,79 @@ def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     return ok, deg & r_ok & s_ok
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _ec_prep(sig_bytes, dig, k: int):
+    """Device: raw signature/digest bytes → (r, s, e) limb arrays.
+
+    sig_bytes: [N, 2·cb] u8 (r ‖ s halves, each cb = 2k bytes wide);
+    dig: [N, hlen] u8. e is the hash as an integer, left-zero-padded
+    (hlen ≤ 2k for every supported alg/curve pairing).
+    """
+    cb = sig_bytes.shape[1] // 2
+    r = L.bytes_to_limbs_device(sig_bytes[:, :cb])
+    s = L.bytes_to_limbs_device(sig_bytes[:, cb:])
+    hlen = dig.shape[1]
+    e_mat = jnp.zeros((dig.shape[0], 2 * k), jnp.uint8)
+    e_mat = e_mat.at[:, 2 * k - hlen:].set(dig)
+    e = L.bytes_to_limbs_device(e_mat)
+    return r, s, e
+
+
+def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
+                                sig_lens: np.ndarray,
+                                hash_mat: np.ndarray, hash_len: int,
+                                key_idx: np.ndarray):
+    """Dispatch the ES* device work; return a finalize() → [N] bool.
+
+    Asynchronous dispatch (see verify_pkcs1v15_arrays_pending);
+    degenerate-flagged tokens are re-verified on the CPU oracle inside
+    finalize, preserving bit-exact parity.
+    """
+    cp = table.curve
+    k = cp.k
+    cb = cp.coord_bytes
+    n_tok = sig_mat.shape[0]
+
+    len_ok = sig_lens == 2 * cb
+    safe = np.where(len_ok[:, None], sig_mat[:, : 2 * cb], 0)
+
+    # Pad the batch to a power of two ≥ 128: the inverse tree pairs the
+    # batch down, and pow-2 buckets bound XLA recompilation. Padding
+    # rows have r = s = 0 → forced invalid, discarded below. Only raw
+    # bytes cross the wire; limb conversion happens on device.
+    n_pad = 128
+    while n_pad < n_tok:
+        n_pad *= 2
+    dig = hash_mat[:, :hash_len]
+    if n_pad != n_tok:
+        fill = n_pad - n_tok
+        safe = np.pad(safe, ((0, fill), (0, 0)))
+        dig = np.pad(dig, ((0, fill), (0, 0)))
+        key_idx = np.pad(np.asarray(key_idx, np.int32), (0, fill))
+
+    r_limbs, s_limbs, e_limbs = _ec_prep(
+        jnp.asarray(safe), jnp.asarray(np.ascontiguousarray(dig)), k=k)
+
+    ok_dev, deg_dev = _ecdsa_core(
+        r_limbs, s_limbs, e_limbs,
+        jnp.asarray(key_idx, jnp.int32),
+        table.tqx, table.tqy, *cp.g_tables(),
+        *cp.device_consts(),
+        nbits=cp.nbits, n_windows=cp.n_windows,
+    )
+
+    def finalize() -> np.ndarray:
+        ok = np.asarray(ok_dev)[:n_tok] & len_ok
+        deg = np.asarray(deg_dev)[:n_tok]
+        for j in np.nonzero(deg & len_ok)[0]:
+            ok[j] = _cpu_verify_one(table, int(key_idx[j]),
+                                    sig_mat[j, : 2 * cb].tobytes(),
+                                    hash_mat[j, :hash_len].tobytes())
+        return ok
+
+    return finalize
+
+
 def verify_ecdsa_arrays(table: ECKeyTable, sig_mat: np.ndarray,
                         sig_lens: np.ndarray, hash_mat: np.ndarray,
                         hash_len: int,
@@ -395,48 +468,8 @@ def verify_ecdsa_arrays(table: ECKeyTable, sig_mat: np.ndarray,
     digests; key_idx: [N] table rows. Degenerate-flagged tokens are
     re-verified on the CPU oracle for bit-exact parity.
     """
-    cp = table.curve
-    k = cp.k
-    cb = cp.coord_bytes
-    n_tok = sig_mat.shape[0]
-
-    len_ok = sig_lens == 2 * cb
-    safe = np.where(len_ok[:, None], sig_mat[:, : 2 * cb], 0)
-    r_limbs = L.bytes_matrix_to_limbs(
-        safe[:, :cb], np.full(n_tok, cb, np.int64), k)
-    s_limbs = L.bytes_matrix_to_limbs(
-        safe[:, cb:], np.full(n_tok, cb, np.int64), k)
-    e_limbs = L.bytes_matrix_to_limbs(
-        hash_mat[:, :hash_len], np.full(n_tok, hash_len, np.int64), k)
-
-    # Pad the batch to a power of two ≥ 128: the inverse tree pairs the
-    # batch down, and pow-2 buckets bound XLA recompilation. Padding
-    # rows have r = s = 0 → forced invalid, discarded below.
-    n_pad = 128
-    while n_pad < n_tok:
-        n_pad *= 2
-    if n_pad != n_tok:
-        fill = n_pad - n_tok
-        r_limbs = np.pad(r_limbs, ((0, 0), (0, fill)))
-        s_limbs = np.pad(s_limbs, ((0, 0), (0, fill)))
-        e_limbs = np.pad(e_limbs, ((0, 0), (0, fill)))
-        key_idx = np.pad(np.asarray(key_idx, np.int32), (0, fill))
-
-    ok, deg = _ecdsa_core(
-        jnp.asarray(r_limbs), jnp.asarray(s_limbs), jnp.asarray(e_limbs),
-        jnp.asarray(key_idx, jnp.int32),
-        table.tqx, table.tqy, *cp.g_tables(),
-        *cp.device_consts(),
-        nbits=cp.nbits, n_windows=cp.n_windows,
-    )
-    ok = np.asarray(ok)[:n_tok] & len_ok
-    deg = np.asarray(deg)[:n_tok]
-
-    for j in np.nonzero(deg & len_ok)[0]:
-        ok[j] = _cpu_verify_one(table, int(key_idx[j]),
-                                sig_mat[j, : 2 * cb].tobytes(),
-                                hash_mat[j, :hash_len].tobytes())
-    return ok
+    return verify_ecdsa_arrays_pending(table, sig_mat, sig_lens,
+                                       hash_mat, hash_len, key_idx)()
 
 
 def _cpu_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,

@@ -342,14 +342,15 @@ def _le_bytes_to_limbs(mat: np.ndarray) -> np.ndarray:
     return (lo | (hi << 8)).T.copy()
 
 
-def verify_ed25519_batch(table: Ed25519KeyTable, sigs: Sequence[bytes],
-                         msgs: Sequence[bytes],
-                         key_idx: np.ndarray) -> np.ndarray:
-    """[N] bool verdicts for one EdDSA bucket.
+def verify_ed25519_batch_pending(table: Ed25519KeyTable,
+                                 sigs: Sequence[bytes],
+                                 msgs: Sequence[bytes],
+                                 key_idx: np.ndarray):
+    """Dispatch the EdDSA device work; return a finalize() → [N] bool.
 
     sigs: raw 64-byte JOSE signatures (R ‖ S); msgs: signing inputs;
     key_idx: [N] table rows. k = SHA-512(R ‖ A ‖ M) mod L is computed
-    here (host), everything else on device.
+    here (host), everything else on device, asynchronously.
     """
     n_tok = len(sigs)
     len_ok = np.fromiter((len(sg) == 64 for sg in sigs), bool, n_tok)
@@ -390,10 +391,17 @@ def verify_ed25519_batch(table: Ed25519KeyTable, sigs: Sequence[bytes],
         key_rows = np.pad(key_rows, (0, fill))
         bad = np.pad(bad, (0, fill))
 
-    ok = _ed25519_core(
+    ok_dev = _ed25519_core(
         jnp.asarray(s_limbs), jnp.asarray(k_limbs),
         jnp.asarray(yr_limbs), jnp.asarray(sign_r), jnp.asarray(bad),
         jnp.asarray(key_rows),
         *table.tna, *b_table(),
         *consts().dev)
-    return np.asarray(ok)[:n_tok] & len_ok
+    return lambda: np.asarray(ok_dev)[:n_tok] & len_ok
+
+
+def verify_ed25519_batch(table: Ed25519KeyTable, sigs: Sequence[bytes],
+                         msgs: Sequence[bytes],
+                         key_idx: np.ndarray) -> np.ndarray:
+    """[N] bool verdicts for one EdDSA bucket (synchronous wrapper)."""
+    return verify_ed25519_batch_pending(table, sigs, msgs, key_idx)()

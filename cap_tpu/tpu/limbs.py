@@ -77,6 +77,39 @@ def bytes_be_to_limbs(chunks: Iterable[bytes], k: int) -> np.ndarray:
     return limbs_be[:, ::-1].T.copy()  # → [k, N] little-endian, limb-first
 
 
+def right_align_bytes(mat: np.ndarray, lens: np.ndarray,
+                      width: int) -> np.ndarray:
+    """Vectorized: left-aligned [N, W] byte rows → right-aligned [N, width].
+
+    Row i's value occupies its first lens[i] bytes; output rows are
+    zero-padded on the left (big-endian integer layout).
+    """
+    n, w = mat.shape
+    if int(lens.max(initial=0)) > width:
+        raise ValueError("value exceeds capacity")
+    cols = np.arange(width)[None, :]
+    src = cols - (width - lens[:, None])
+    valid = src >= 0
+    return np.where(valid, mat[np.arange(n)[:, None],
+                               np.clip(src, 0, w - 1)], 0).astype(np.uint8)
+
+
+def bytes_to_limbs_device(mat):
+    """Device: [N, 2K] u8 right-aligned big-endian → [K, N] u32 limbs.
+
+    The host ships raw bytes (half the wire size of u32 limb arrays —
+    host↔device bandwidth is the scarce resource on tunneled setups);
+    the big-endian-bytes → little-endian-limbs transform runs on
+    device.
+    """
+    import jax.numpy as jnp
+
+    m = mat.astype(jnp.uint32)
+    hi = m[:, 0::2]
+    lo = m[:, 1::2]
+    return ((hi << 8) | lo)[:, ::-1].T
+
+
 def bytes_matrix_to_limbs(mat: np.ndarray, lens: np.ndarray,
                           k: int) -> np.ndarray:
     """Vectorized: left-aligned big-endian byte rows → [k, N] limb array.
@@ -84,15 +117,7 @@ def bytes_matrix_to_limbs(mat: np.ndarray, lens: np.ndarray,
     mat: [N, W] uint8 with each row's value occupying its first lens[i]
     bytes (tail is padding). Values longer than 2*k bytes raise.
     """
-    n, w = mat.shape
-    width = 2 * k
-    if int(lens.max(initial=0)) > width:
-        raise ValueError("value exceeds limb capacity")
-    cols = np.arange(width)[None, :]
-    src = cols - (width - lens[:, None])          # right-align
-    valid = src >= 0
-    buf = np.where(valid, mat[np.arange(n)[:, None],
-                              np.clip(src, 0, w - 1)], 0)
+    buf = right_align_bytes(mat, lens, 2 * k)
     hi = buf[:, 0::2].astype(np.uint32)
     lo = buf[:, 1::2].astype(np.uint32)
     limbs_be = (hi << 8) | lo

@@ -227,21 +227,23 @@ def _mod_fix(x: jnp.ndarray, m: jnp.ndarray, m_f: jnp.ndarray,
 
 
 def _split_matmul(w_pair, x: jnp.ndarray):
-    """Σ W·x via four exact bf16 matmuls → (hh, mid, ll) f32→i32.
+    """Σ W·x via ONE exact bf16 matmul → (hh, mid, ll) f32→i32.
 
-    w_pair: (Wh, Wl) bf16 [J, I]; x: [I, N] i32 < 2^13.
-    Weights: hh·2^14 + mid·2^7 + ll.
+    w_pair: (Wh, Wl) bf16 [J, I] 7-bit halves; x: [I, N] i32 < 2^14.
+    The four half-products are packed into a single [2J, I] @ [I, 2N]
+    matmul (better MXU utilization than four small dispatches); the
+    quadrants recombine with weights hh·2^14 + mid·2^7 + ll.
     """
     wh, wl = w_pair
-    xh = (x >> 7).astype(BF16)
-    xl = (x & 127).astype(BF16)
-
-    def mm(a, b):
-        return jnp.dot(a, b, preferred_element_type=F32).astype(I32)
-
-    hh = mm(wh, xh)
-    mid = mm(wh, xl) + mm(wl, xh)
-    ll = mm(wl, xl)
+    j = wh.shape[0]
+    n = x.shape[1]
+    w_cat = jnp.concatenate([wh, wl], axis=0)            # [2J, I]
+    x_cat = jnp.concatenate(
+        [(x >> 7).astype(BF16), (x & 127).astype(BF16)], axis=1)
+    c = jnp.dot(w_cat, x_cat, preferred_element_type=F32).astype(I32)
+    hh = c[:j, :n]
+    mid = c[:j, n:] + c[j:, :n]
+    ll = c[j:, n:]
     return hh, mid, ll
 
 
@@ -363,12 +365,21 @@ def _rns_verify_core(ctx: RNSContext, s_limbs, expected_limbs,
     return ok
 
 
+def verify_em_equals_device(ctx: RNSContext, table: RNSKeyTable,
+                            s_limbs: np.ndarray,
+                            expected_limbs: np.ndarray,
+                            key_idx: np.ndarray) -> jnp.ndarray:
+    """Async: device [N] bool, s^65537 mod n == expected (e=65537)."""
+    idx = jnp.asarray(key_idx, I32)
+    return _rns_verify_core(
+        ctx, jnp.asarray(s_limbs), jnp.asarray(expected_limbs),
+        table.sig_c[idx].T, table.n_B[idx].T,
+        table.a2_A[idx].T, table.a2_B[idx].T)
+
+
 def verify_em_equals(ctx: RNSContext, table: RNSKeyTable,
                      s_limbs: np.ndarray, expected_limbs: np.ndarray,
                      key_idx: np.ndarray) -> np.ndarray:
     """[N] bool: s^65537 mod n == expected, for e=65537 key tables."""
-    idx = jnp.asarray(key_idx, I32)
-    return np.asarray(_rns_verify_core(
-        ctx, jnp.asarray(s_limbs), jnp.asarray(expected_limbs),
-        table.sig_c[idx].T, table.n_B[idx].T,
-        table.a2_A[idx].T, table.a2_B[idx].T))
+    return np.asarray(verify_em_equals_device(
+        ctx, table, s_limbs, expected_limbs, key_idx))

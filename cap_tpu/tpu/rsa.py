@@ -38,6 +38,35 @@ DIGEST_INFO_PREFIX = {
 HASH_LEN = {"sha256": 32, "sha384": 48, "sha512": 64}
 
 
+from .limbs import bytes_to_limbs_device
+
+
+def _expected_em_device(dig, sizes, k: int, hash_name: str):
+    """Device construction of the PKCS#1 v1.5 expected EM limbs.
+
+    dig: [N, hlen] u8 digests; sizes: [N] i32 per-token emLen. Builds
+    EM = 00 01 FF.. 00 DigestInfo ‖ H right-aligned in [N, 2k] bytes —
+    entirely on device, so only the digest crosses the wire.
+    """
+    import jax.numpy as jnp
+
+    prefix = DIGEST_INFO_PREFIX[hash_name]
+    h_len = HASH_LEN[hash_name]
+    t_len = len(prefix) + h_len
+    width = 2 * k
+    n = dig.shape[0]
+    cols = jnp.arange(width, dtype=jnp.int32)[None, :]
+    start = (width - sizes.astype(jnp.int32))[:, None]
+    val = jnp.zeros((n, width), jnp.uint8)
+    val = jnp.where(cols == start + 1, jnp.uint8(1), val)
+    val = jnp.where((cols >= start + 2) & (cols < width - t_len - 1),
+                    jnp.uint8(0xFF), val)
+    pref = jnp.asarray(np.frombuffer(prefix, np.uint8))
+    val = val.at[:, width - t_len: width - h_len].set(pref[None, :])
+    val = val.at[:, width - h_len:].set(dig)
+    return bytes_to_limbs_device(val)
+
+
 def _use_rns() -> bool:
     """RNS/MXU modexp on accelerators; limb/VPU path elsewhere.
 
@@ -214,6 +243,71 @@ def expected_pkcs1v15_em_mat(hash_mat: np.ndarray, hash_name: str,
     return ((hi << 8) | lo)[:, ::-1].T.copy()
 
 
+def verify_pkcs1v15_arrays_pending(table: RSAKeyTable, sig_mat: np.ndarray,
+                                   sig_lens: np.ndarray,
+                                   hash_mat: np.ndarray, hash_name: str,
+                                   key_idx: np.ndarray):
+    """Dispatch the RS* device work; return a finalize() → [N] bool.
+
+    Dispatch is asynchronous — callers can launch every bucket's device
+    program before the first materializing sync (one ~RTT to the
+    accelerator instead of one per bucket).
+    """
+    import jax.numpy as jnp
+
+    from . import bignum  # noqa: F401
+
+    sizes = np.asarray(table.sizes_bytes, np.int64)[key_idx]
+    len_ok = sig_lens == sizes
+    em_len_ok = sizes >= len(DIGEST_INFO_PREFIX[hash_name]) + \
+        HASH_LEN[hash_name] + 11
+    host_mask = len_ok & em_len_ok
+    # Wire-minimal H2D: raw right-aligned signature bytes + digests +
+    # per-token sizes; limb conversion and expected-EM construction run
+    # on device (_rs_prep).
+    safe_lens = np.where(len_ok, sig_lens, 0)
+    aligned = L.right_align_bytes(
+        np.where(len_ok[:, None], sig_mat, 0), safe_lens, 2 * table.k)
+    h_len = HASH_LEN[hash_name]
+    dig = np.ascontiguousarray(hash_mat[:, :h_len])
+    s_limbs, expected = _rs_prep(
+        jnp.asarray(aligned), jnp.asarray(dig),
+        jnp.asarray(sizes, jnp.int32), k=table.k, hash_name=hash_name)
+    in_range = s_in_range_mask(table, s_limbs, key_idx)
+    if table.all_f4 and _use_rns():
+        # MXU path: modexp + EM compare entirely in RNS form.
+        from . import rns as rns_mod
+
+        ctx, rtab = table.rns()
+        if ctx is not None:
+            eq = rns_mod.verify_em_equals_device(
+                ctx, rtab, s_limbs, expected, key_idx)
+            return lambda: np.asarray(eq & in_range) & host_mask
+    em = modexp_for_table(table, s_limbs, key_idx)
+    eq = jnp.all(em == expected, axis=0) & in_range
+    return lambda: np.asarray(eq) & host_mask
+
+
+def _rs_prep_impl(sig_bytes, dig, sizes, k: int, hash_name: str):
+    return (bytes_to_limbs_device(sig_bytes),
+            _expected_em_device(dig, sizes, k, hash_name))
+
+
+_rs_prep_cache: dict = {}
+
+
+def _rs_prep(sig_bytes, dig, sizes, k: int, hash_name: str):
+    """Jitted device prep: sig bytes → limbs, digest → expected EM."""
+    import jax
+
+    key = "rs_prep"
+    fn = _rs_prep_cache.get(key)
+    if fn is None:
+        fn = jax.jit(_rs_prep_impl, static_argnames=("k", "hash_name"))
+        _rs_prep_cache[key] = fn
+    return fn(sig_bytes, dig, sizes, k=k, hash_name=hash_name)
+
+
 def verify_pkcs1v15_arrays(table: RSAKeyTable, sig_mat: np.ndarray,
                            sig_lens: np.ndarray, hash_mat: np.ndarray,
                            hash_name: str,
@@ -223,38 +317,14 @@ def verify_pkcs1v15_arrays(table: RSAKeyTable, sig_mat: np.ndarray,
     sig_mat: [N, W] left-aligned signature bytes; sig_lens: [N];
     hash_mat: [N, ≥hlen] digests; key_idx: [N] table rows.
     """
-    import jax.numpy as jnp
-
-    from . import bignum
-
-    sizes = np.asarray(table.sizes_bytes, np.int64)[key_idx]
-    len_ok = sig_lens == sizes
-    em_len_ok = sizes >= len(DIGEST_INFO_PREFIX[hash_name]) + \
-        HASH_LEN[hash_name] + 11
-    safe_lens = np.where(len_ok, sig_lens, 0)
-    s_limbs = L.bytes_matrix_to_limbs(
-        np.where(len_ok[:, None], sig_mat, 0), safe_lens, table.k)
-    expected_np = expected_pkcs1v15_em_mat(hash_mat, hash_name, sizes,
-                                           table.k)
-    in_range = s_in_range_mask(table, s_limbs, key_idx)
-    if table.all_f4 and _use_rns():
-        # MXU path: modexp + EM compare entirely in RNS form.
-        from . import rns as rns_mod
-
-        ctx, rtab = table.rns()
-        if ctx is not None:
-            eq = rns_mod.verify_em_equals(ctx, rtab, s_limbs, expected_np,
-                                          key_idx)
-            return eq & np.asarray(in_range) & len_ok & em_len_ok
-    em = modexp_for_table(table, s_limbs, key_idx)
-    eq = jnp.all(em == jnp.asarray(expected_np), axis=0)
-    return np.asarray(eq & in_range) & len_ok & em_len_ok
+    return verify_pkcs1v15_arrays_pending(
+        table, sig_mat, sig_lens, hash_mat, hash_name, key_idx)()
 
 
-def verify_pss_arrays(table: RSAKeyTable, sig_mat: np.ndarray,
-                      sig_lens: np.ndarray, hash_mat: np.ndarray,
-                      hash_name: str, key_idx: np.ndarray) -> np.ndarray:
-    """Array-native PS* verify: device modexp, host EM/MGF1 check."""
+def verify_pss_arrays_pending(table: RSAKeyTable, sig_mat: np.ndarray,
+                              sig_lens: np.ndarray, hash_mat: np.ndarray,
+                              hash_name: str, key_idx: np.ndarray):
+    """Dispatch the PS* modexp; finalize() runs the host EM/MGF1 check."""
     n_tok = sig_mat.shape[0]
     sizes = np.asarray(table.sizes_bytes, np.int64)[key_idx]
     mod_bits = np.asarray([n.bit_length() for n in table.n_ints])[key_idx]
@@ -263,16 +333,30 @@ def verify_pss_arrays(table: RSAKeyTable, sig_mat: np.ndarray,
     s_limbs = L.bytes_matrix_to_limbs(
         np.where(len_ok[:, None], sig_mat, 0), safe_lens, table.k)
     em_dev = modexp_for_table(table, s_limbs, key_idx)
-    in_range = np.asarray(s_in_range_mask(table, s_limbs, key_idx))
-    em_bytes = L.limbs_to_bytes_be(np.asarray(em_dev), 2 * table.k)
-    h_len = HASH_LEN[hash_name]
-    out = np.zeros(n_tok, bool)
-    for j in range(n_tok):
-        if not (len_ok[j] and in_range[j]):
-            continue
-        out[j] = pss_check_em(em_bytes[j], hash_mat[j, :h_len].tobytes(),
-                              int(mod_bits[j]) - 1, hash_name)
-    return out
+    in_range_dev = s_in_range_mask(table, s_limbs, key_idx)
+
+    def finalize() -> np.ndarray:
+        in_range = np.asarray(in_range_dev)
+        em_bytes = L.limbs_to_bytes_be(np.asarray(em_dev), 2 * table.k)
+        h_len = HASH_LEN[hash_name]
+        out = np.zeros(n_tok, bool)
+        for j in range(n_tok):
+            if not (len_ok[j] and in_range[j]):
+                continue
+            out[j] = pss_check_em(em_bytes[j],
+                                  hash_mat[j, :h_len].tobytes(),
+                                  int(mod_bits[j]) - 1, hash_name)
+        return out
+
+    return finalize
+
+
+def verify_pss_arrays(table: RSAKeyTable, sig_mat: np.ndarray,
+                      sig_lens: np.ndarray, hash_mat: np.ndarray,
+                      hash_name: str, key_idx: np.ndarray) -> np.ndarray:
+    """Array-native PS* verify: device modexp, host EM/MGF1 check."""
+    return verify_pss_arrays_pending(table, sig_mat, sig_lens, hash_mat,
+                                     hash_name, key_idx)()
 
 
 def verify_pkcs1v15_batch(table: RSAKeyTable, sigs: Sequence[bytes],

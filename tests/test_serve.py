@@ -178,3 +178,24 @@ def test_end_to_end_real_keyset():
         assert isinstance(res[1], RemoteVerifyError)
     finally:
         w.close()
+
+
+def test_native_client_roundtrip():
+    """The C ABI client shim against a live worker (built via make)."""
+    pytest.importorskip("ctypes")
+    try:
+        from cap_tpu.serve.native_client import NativeVerifyClient
+    except ImportError:
+        pytest.skip("libcapclient.so not built")
+    ks = StubKeySet()
+    w = VerifyWorker(ks, target_batch=8, max_wait_ms=5.0)
+    try:
+        host, port = w.address
+        with NativeVerifyClient(host, port) as c:
+            assert c.ping()
+            res = c.verify_batch(["n1.ok", "n2.bad"] * 3)
+        assert res[0] == {"sub": "n1.ok"}
+        assert isinstance(res[1], RemoteVerifyError)
+        assert res[4] == {"sub": "n1.ok"}
+    finally:
+        w.close()

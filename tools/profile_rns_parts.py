@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Component timings for the RNS REDC on the real chip."""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cap_tpu.tpu import rns
+
+N = 16384
+ctx = rns.context(2048, 129)
+I = ctx.A.count
+print("channels per base:", I)
+
+rngnp = np.random.default_rng(0)
+x = jnp.asarray(rngnp.integers(0, 4000, size=(I, N)), jnp.int32)
+sig = jnp.asarray(rngnp.integers(0, 4000, size=(I, N)), jnp.int32)
+
+
+def timeit(label, fn, *args):
+    f = jax.jit(fn)
+    r = f(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        r = f(*args)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / 10
+    print(f"{label:28s} {dt*1e3:8.2f} ms")
+
+
+def matmuls(sig):
+    return rns._split_matmul(ctx.W_AB, sig)
+
+
+def modfix(x):
+    m = ctx.dA["m"][:, None]
+    return rns._mod_fix(x, m, ctx.dA["m_f"][:, None],
+                        ctx.dA["inv_f"][:, None])
+
+
+def extend(sig):
+    return rns._extend(sig, ctx.dA, ctx.dB, ctx.W_AB, ctx.Amod_B, -1e-4)
+
+
+def alpha_only(sig):
+    return jnp.floor(jnp.sum(sig.astype(jnp.float32)
+                             * ctx.dA["inv_f"][:, None], axis=0) - 1e-4)
+
+
+def redc(xA, xB):
+    consts = (ctx.dA, ctx.dB, ctx.W_AB, ctx.W_BA, ctx.Amod_B,
+              ctx.Bmod_A, ctx.invA_B)
+    sig_c = jnp.ones((I, N), jnp.int32)
+    n_B = jnp.full((ctx.B.count, N), 3001, jnp.int32)
+    return rns._redc(xA, xB, sig_c, n_B, consts)
+
+
+timeit("4x split matmuls", matmuls, sig)
+timeit("mod_fix (one)", modfix, x)
+timeit("alpha sum", alpha_only, sig)
+timeit("extend (A->B)", extend, sig)
+xB = jnp.asarray(rngnp.integers(0, 4000, size=(ctx.B.count, N)), jnp.int32)
+timeit("full redc", redc, x, xB)
