@@ -208,6 +208,15 @@ class ECKeyTable:
             qy_rows[i * rows:(i + 1) * rows] = ry
         self.tqx = jnp.asarray(qx_rows)
         self.tqy = jnp.asarray(qy_rows)
+        self._rns = None
+
+    def rns(self):
+        """Lazily-built RNS-form window tables (accelerator path)."""
+        if self._rns is None:
+            from . import ec_rns
+
+            self._rns = ec_rns.ECRNSKeyTable(self.curve.name, self.keys)
+        return self._rns
 
 
 # ---------------------------------------------------------------------------
@@ -437,13 +446,31 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
     r_limbs, s_limbs, e_limbs = _ec_prep(
         jnp.asarray(safe), jnp.asarray(np.ascontiguousarray(dig)), k=k)
 
-    ok_dev, deg_dev = _ecdsa_core(
-        r_limbs, s_limbs, e_limbs,
-        jnp.asarray(key_idx, jnp.int32),
-        table.tqx, table.tqy, *cp.g_tables(),
-        *cp.device_consts(),
-        nbits=cp.nbits, n_windows=cp.n_windows,
-    )
+    from .rns import use_rns
+
+    if use_rns():
+        # RNS/MXU point arithmetic (carry-free ladder); scalar math
+        # stays in the limb engine inside the same jit.
+        from . import ec_rns
+
+        rtab = table.rns()
+        consts = cp.device_consts()
+        ok_dev, deg_dev = ec_rns._ecdsa_rns_core(
+            r_limbs, s_limbs, e_limbs,
+            jnp.asarray(key_idx, jnp.int32),
+            rtab.tqx, rtab.tqy,
+            *ec_rns.g_residue_tables(cp.name),
+            *consts[4:9],
+            crv=cp.name, nbits=cp.nbits, n_windows=cp.n_windows,
+        )
+    else:
+        ok_dev, deg_dev = _ecdsa_core(
+            r_limbs, s_limbs, e_limbs,
+            jnp.asarray(key_idx, jnp.int32),
+            table.tqx, table.tqy, *cp.g_tables(),
+            *cp.device_consts(),
+            nbits=cp.nbits, n_windows=cp.n_windows,
+        )
 
     def finalize() -> np.ndarray:
         ok = np.asarray(ok_dev)[:n_tok] & len_ok

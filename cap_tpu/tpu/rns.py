@@ -160,6 +160,21 @@ def _split_mat(w: np.ndarray):
     return (jnp.asarray(w >> 7, BF16), jnp.asarray(w & 127, BF16))
 
 
+def use_rns() -> bool:
+    """RNS/MXU engines on accelerators; limb/VPU path elsewhere.
+
+    Override with CAP_TPU_RNS=1/0 (tests force 1 on CPU to pin RNS
+    parity; CPU default stays on the limb path, which compiles much
+    faster there).
+    """
+    import os
+
+    v = os.environ.get("CAP_TPU_RNS")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    return jax.default_backend() not in ("cpu",)
+
+
 class RNSUnsupportedKey(ValueError):
     """A modulus shares a factor with an RNS base prime (or is even).
 

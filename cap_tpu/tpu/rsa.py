@@ -68,20 +68,9 @@ def _expected_em_device(dig, sizes, k: int, hash_name: str):
 
 
 def _use_rns() -> bool:
-    """RNS/MXU modexp on accelerators; limb/VPU path elsewhere.
+    from .rns import use_rns
 
-    Override with CAP_TPU_RNS=1/0 (tests force 1 on CPU to pin RNS
-    parity; CPU default stays on the limb path, which compiles much
-    faster there).
-    """
-    import os
-
-    v = os.environ.get("CAP_TPU_RNS")
-    if v is not None:
-        return v not in ("0", "false", "no")
-    import jax
-
-    return jax.default_backend() not in ("cpu",)
+    return use_rns()
 
 
 class RSAKeyTable:
