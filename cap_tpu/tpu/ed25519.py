@@ -211,6 +211,16 @@ class Ed25519KeyTable:
             na[:, i * rows:(i + 1) * rows] = _window_triple_rows(neg_a)
         self.tna = tuple(jnp.asarray(na[t]) for t in range(3))
         self.invalid = invalid
+        self._rns = None
+
+    def rns(self):
+        """Lazily-built RNS-form window tables (accelerator path)."""
+        if self._rns is None:
+            from . import ed25519_rns
+
+            decoded = [decode_point(raw) for raw in self.key_bytes]
+            self._rns = ed25519_rns.Ed25519RNSKeyTable(decoded)
+        return self._rns
 
 
 # ---------------------------------------------------------------------------
@@ -391,12 +401,25 @@ def verify_ed25519_batch_pending(table: Ed25519KeyTable,
         key_rows = np.pad(key_rows, (0, fill))
         bad = np.pad(bad, (0, fill))
 
-    ok_dev = _ed25519_core(
-        jnp.asarray(s_limbs), jnp.asarray(k_limbs),
-        jnp.asarray(yr_limbs), jnp.asarray(sign_r), jnp.asarray(bad),
-        jnp.asarray(key_rows),
-        *table.tna, *b_table(),
-        *consts().dev)
+    from .rns import use_rns
+
+    if use_rns():
+        from . import ed25519_rns
+
+        rtab = table.rns()
+        ok_dev = ed25519_rns._ed25519_rns_core(
+            jnp.asarray(s_limbs), jnp.asarray(k_limbs),
+            jnp.asarray(yr_limbs), jnp.asarray(sign_r), jnp.asarray(bad),
+            jnp.asarray(key_rows),
+            *rtab.tna, *ed25519_rns.b_table_rns(),
+            *consts().dev)
+    else:
+        ok_dev = _ed25519_core(
+            jnp.asarray(s_limbs), jnp.asarray(k_limbs),
+            jnp.asarray(yr_limbs), jnp.asarray(sign_r), jnp.asarray(bad),
+            jnp.asarray(key_rows),
+            *table.tna, *b_table(),
+            *consts().dev)
     return lambda: np.asarray(ok_dev)[:n_tok] & len_ok
 
 

@@ -1,0 +1,278 @@
+"""Ed25519 point arithmetic in RNS form (MXU path).
+
+Same design as ``ec_rns``: the extended-Edwards ladder runs on
+carry-free residue pairs (complete a = -1 mixed additions, 7 rmuls
+each, window tables as A-domain residues including identity rows at
+digit 0 — no masks, no infinity lane needed). The finish converts
+(X, Y, Z) back to 16-bit limbs via CRT reconstruction
+(``rns.RNSToLimbs``) and reuses the limb engine's batched inversion +
+encoding comparison, which needs canonical bytes (x's parity is not a
+residue-domain property).
+
+Value bounds: every rmul output < 3p; sums grow to ≤ 10p between
+multiplies; A ≥ 2^14·p keeps λ₁λ₂p²/A ≪ p (max product pair 10·9).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import limbs as L
+from .ec_rns import radd, rmul, rsel, rsub
+from .ed25519 import (
+    _B_POINT,
+    _IDENTITY,
+    _edw_add,
+    K,
+    L_ORDER,
+    N_WINDOWS,
+    P,
+    consts,
+)
+from .rns import (
+    _Base,
+    _ext_matrix,
+    _sieve_primes,
+    _split_mat,
+    I32,
+    RNSToLimbs,
+)
+
+
+class Ed25519RNSContext:
+    """Field context for p = 2^255−19 (duck-typed like ECRNSContext)."""
+
+    def __init__(self):
+        primes = _sieve_primes(1 << 12, 1 << 14)
+        need = 255 + 16
+        msA, bits, i = [], 0.0, 0
+        while bits < need:
+            msA.append(primes[i])
+            bits += np.log2(primes[i])
+            i += 1
+        msB, bits = [], 0.0
+        while bits < need:
+            msB.append(primes[i])
+            bits += np.log2(primes[i])
+            i += 1
+        self.A = _Base(msA)
+        self.B = _Base(msB)
+
+        def dev_base(base: _Base):
+            return dict(
+                m=jnp.asarray(base.m, I32),
+                m_f=jnp.asarray(base.m, jnp.float32),
+                inv_f=jnp.asarray(1.0 / base.m, jnp.float32),
+                inv_Mi=jnp.asarray(base.inv_Mi, I32),
+            )
+
+        self.dA = dev_base(self.A)
+        self.dB = dev_base(self.B)
+        self.W_AB = _split_mat(_ext_matrix(self.A, self.B))
+        self.W_BA = _split_mat(_ext_matrix(self.B, self.A))
+        self.Amod_B = jnp.asarray(
+            [self.A.prod % int(m) for m in self.B.m], I32)
+        self.Bmod_A = jnp.asarray(
+            [self.B.prod % int(m) for m in self.A.m], I32)
+        self.invA_B = jnp.asarray(
+            [pow(self.A.prod % int(m), -1, int(m)) for m in self.B.m], I32)
+        ppr = [(-pow(P, -1, int(m))) % int(m) for m in self.A.m]
+        self.sig_c = jnp.asarray(
+            [(v * int(inv)) % int(m) for v, inv, m in
+             zip(ppr, self.A.inv_Mi, self.A.m)], I32)[:, None]
+        self.p_B = jnp.asarray([P % int(m) for m in self.B.m],
+                               I32)[:, None]
+        maxc = 16
+        self.cp_A = jnp.asarray(
+            [[(c * P) % int(m) for m in self.A.m] for c in range(maxc)],
+            I32)
+        self.cp_B = jnp.asarray(
+            [[(c * P) % int(m) for m in self.B.m] for c in range(maxc)],
+            I32)
+        self.consts = (self.dA, self.dB, self.W_AB, self.W_BA,
+                       self.Amod_B, self.Bmod_A, self.invA_B)
+        self.a_mod_p = self.A.prod % P
+        self.to_limbs = RNSToLimbs(self.A, 17)   # values < 3p < 2^257
+
+    def residues_of(self, x: int) -> np.ndarray:
+        return np.asarray(
+            [x % int(m) for m in self.A.m]
+            + [x % int(m) for m in self.B.m], np.int64)
+
+
+_CTX: Optional[Ed25519RNSContext] = None
+
+
+def ctx() -> Ed25519RNSContext:
+    global _CTX
+    if _CTX is None:
+        _CTX = Ed25519RNSContext()
+    return _CTX
+
+
+def _one_dom(c: Ed25519RNSContext):
+    one = c.a_mod_p
+    return (jnp.asarray([one % int(m) for m in c.A.m], I32)[:, None],
+            jnp.asarray([one % int(m) for m in c.B.m], I32)[:, None])
+
+
+def _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2):
+    """Complete mixed addition, RNS pairs. State bounds < 3p in/out."""
+    a = rmul(c, rsub(c, Y, X, 4), ym)
+    b = rmul(c, radd(c, Y, X), yp)
+    cc = rmul(c, T, t2)
+    d = radd(c, Z, Z)
+    e = rsub(c, b, a, 4)
+    f = rsub(c, d, cc, 4)
+    g = radd(c, d, cc)
+    h = radd(c, b, a)
+    return (rmul(c, e, f), rmul(c, g, h), rmul(c, f, g), rmul(c, e, h))
+
+
+def _window_triple_residue_rows(c: Ed25519RNSContext,
+                                pt: Tuple[int, int]) -> np.ndarray:
+    """[3, NW·16, I_A+I_B] A-domain triples of d·2^{4i}·pt (d=0: id)."""
+    nw = N_WINDOWS
+    ia, ib = c.A.count, c.B.count
+    rows = np.empty((3, nw * 16, ia + ib), np.int32)
+    am = c.a_mod_p
+    base = pt
+    for i in range(nw):
+        acc = _IDENTITY
+        for d in range(16):
+            if d:
+                acc = _edw_add(acc, base)
+            x, y = acc
+            vals = ((y - x) % P, (y + x) % P, _t2_of(x, y))
+            for t, v in enumerate(vals):
+                rows[t, i * 16 + d] = c.residues_of(v * am % P)
+        for _ in range(4):
+            base = _edw_add(base, base)
+    return rows
+
+
+def _t2_of(x: int, y: int) -> int:
+    from .ed25519 import D_CONST
+
+    return 2 * D_CONST * x % P * y % P
+
+
+_B_TABLE_RNS = None
+
+
+def b_table_rns():
+    global _B_TABLE_RNS
+    if _B_TABLE_RNS is None:
+        rows = _window_triple_residue_rows(ctx(), _B_POINT)
+        _B_TABLE_RNS = tuple(jnp.asarray(rows[t]) for t in range(3))
+    return _B_TABLE_RNS
+
+
+class Ed25519RNSKeyTable:
+    """Per-key window tables of -A as A-domain residue triples."""
+
+    def __init__(self, keys_decoded):
+        """keys_decoded: list of (x, y) affine points or None (invalid),
+        matching Ed25519KeyTable's decode results."""
+        c = ctx()
+        nk = len(keys_decoded)
+        rows = N_WINDOWS * 16
+        ia, ib = c.A.count, c.B.count
+        ta = np.empty((3, nk * rows, ia + ib), np.int32)
+        for i, a in enumerate(keys_decoded):
+            neg_a = _IDENTITY if a is None else ((P - a[0]) % P, a[1])
+            ta[:, i * rows:(i + 1) * rows] = \
+                _window_triple_residue_rows(c, neg_a)
+        self.tna = tuple(jnp.asarray(ta[t]) for t in range(3))
+
+
+@jax.jit
+def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
+                      ta_ym, ta_yp, ta_t2, tb_ym, tb_yp, tb_t2,
+                      p, pp, pr2, pone, pm2, l_):
+    """Ed25519 verify: RNS ladder + limb-domain finish.
+
+    Same contract as ed25519._ed25519_core; tables are RNS residue
+    rows [·, I_A + I_B].
+    """
+    from . import bignum as B
+
+    c = ctx()
+    shape = s.shape
+    k = shape[0]
+    p1, pp1, pr21, pone1, pm21 = p, pp, pr2, pone, pm2
+    pb = jnp.broadcast_to(p, shape)
+    ppb = jnp.broadcast_to(pp, shape)
+    l_b = jnp.broadcast_to(l_, shape)
+
+    s_ok = ~B.compare_ge(s, l_b)
+
+    def nibbles(u):
+        return jnp.stack(
+            [(u >> (4 * j)) & 15 for j in range(4)], axis=1
+        ).reshape(4 * k, shape[1]).astype(jnp.int32)
+
+    dig1 = nibbles(s)
+    dig2 = nibbles(kk)
+    key_base = key_idx.astype(jnp.int32) * (N_WINDOWS * 16)
+
+    ia = c.A.count
+    n_tok = shape[1]
+
+    def gather3(ta, tb, tc, idx):
+        g = [jnp.take(t, idx, axis=0).T for t in (ta, tb, tc)]
+        return [(v[:ia], v[ia:]) for v in g]
+
+    one_d = _one_dom(c)
+    zA = jnp.zeros((c.A.count, n_tok), I32)
+    zB = jnp.zeros((c.B.count, n_tok), I32)
+    one_b = (jnp.broadcast_to(one_d[0], zA.shape),
+             jnp.broadcast_to(one_d[1], zB.shape))
+    X = (zA, zB)
+    Y = one_b
+    Z = one_b
+    T = (zA, zB)
+
+    def ladder_body(i, state):
+        X, Y, Z, T = state
+        d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
+        d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
+        ym, yp, t2 = gather3(tb_ym, tb_yp, tb_t2, i * 16 + d1)
+        X, Y, Z, T = _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
+        ym, yp, t2 = gather3(ta_ym, ta_yp, ta_t2,
+                             key_base + i * 16 + d2)
+        X, Y, Z, T = _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
+        return X, Y, Z, T
+
+    X, Y, Z, T = lax.fori_loop(0, N_WINDOWS, ladder_body, (X, Y, Z, T))
+
+    # RNS → limbs, canonicalize mod p, then the limb-domain finish.
+    def to_canonical(v_pair):
+        v = c.to_limbs(v_pair[0])               # [17, N], value < 3p
+        p_pad = jnp.concatenate(
+            [jnp.broadcast_to(p1, (k, n_tok)),
+             jnp.zeros((1, n_tok), jnp.uint32)], axis=0)
+        for _ in range(2):
+            v = B.sub_where(v, p_pad, B.compare_ge(v, p_pad))
+        return v[:k]
+
+    Xl = to_canonical(X)
+    Yl = to_canonical(Y)
+    Zl = to_canonical(Z)
+
+    z_m = B.mont_mul(Zl, jnp.broadcast_to(pr2, shape), pb, ppb)
+    zinv = B.batch_mont_inverse(z_m, p1, pp1, pr21, pone1, pm21,
+                                nbits=255)
+    # x = X·(z⁻¹·R)·R⁻¹ etc: one montmul cancels the R factor.
+    x = B.mont_mul(Xl, zinv, pb, ppb)
+    y = B.mont_mul(Yl, zinv, pb, ppb)
+
+    enc_ok = jnp.all(y == yr, axis=0) & ((x[0] & 1) == sign_r)
+    return s_ok & enc_ok & ~bad_key

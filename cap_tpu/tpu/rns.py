@@ -346,6 +346,85 @@ def _limbs_to_rns(limbs: jnp.ndarray, t_pair, dev) -> jnp.ndarray:
                + fix(ll2))
 
 
+class RNSToLimbs:
+    """Device CRT reconstruction: base-A residues → 16-bit limb arrays.
+
+    value = Σ_i σ_i·(A/a_i) − α·A with σ_i = x_i·(A/a_i)⁻¹ mod a_i and
+    α = ⌊Σ σ_i/a_i⌉ (exact via the +0.5 offset — values are ≪ A). The
+    Σ is a fixed matmul against the limb rows of A/a_i, split 7+7 / 8+8
+    bits for f32 exactness, with every weighted part scattered across
+    two adjacent limbs so u32 accumulators never overflow.
+
+    Valid for values < max_c·p ≪ A (the engines' tracked bounds).
+    """
+
+    def __init__(self, base: _Base, k_out: int):
+        self.base = base
+        self.k_out = k_out
+        bits = int(np.ceil(np.log2(float(base.count)))) + \
+            sum(int(m).bit_length() for m in base.m)
+        self.k2 = (bits + 15) // 16 + 1
+        t16 = np.empty((self.k2, base.count), np.int64)
+        for i, mi in enumerate(base.Mi):
+            v = mi
+            for ll in range(self.k2):
+                t16[ll, i] = v & 0xFFFF
+                v >>= 16
+        # 8-bit halves of the limb rows, as bf16 [K2, I]
+        self.t_hi = jnp.asarray(t16 >> 8, BF16)
+        self.t_lo = jnp.asarray(t16 & 0xFF, BF16)
+        a_limbs = np.zeros(self.k2, np.uint32)
+        v = base.prod
+        for ll in range(self.k2):
+            a_limbs[ll] = v & 0xFFFF
+            v >>= 16
+        self.a_limbs = jnp.asarray(a_limbs)
+        self.inv_f = jnp.asarray(1.0 / base.m, F32)
+        self.inv_Mi = jnp.asarray(base.inv_Mi, I32)
+        self.m = jnp.asarray(base.m, I32)
+        self.m_f = jnp.asarray(base.m, F32)
+        self.minv_f = jnp.asarray(1.0 / base.m, F32)
+
+    def __call__(self, x_a: jnp.ndarray) -> jnp.ndarray:
+        """[I, N] base-A residues → [k_out, N] u32 limbs of the value."""
+        from . import bignum as B
+
+        sig = _mod_fix(x_a * self.inv_Mi[:, None], self.m[:, None],
+                       self.m_f[:, None], self.minv_f[:, None])
+        alpha = jnp.floor(
+            jnp.sum(sig.astype(F32) * self.inv_f[:, None], axis=0)
+            + 0.5).astype(I32)                        # exact: value ≪ A
+
+        sh = (sig >> 7).astype(BF16)
+        sl = (sig & 127).astype(BF16)
+
+        def mm(a, b):
+            return jnp.dot(a, b, preferred_element_type=F32).astype(
+                jnp.uint32)
+
+        hh = mm(self.t_hi, sh)     # weight 2^15
+        hl = mm(self.t_hi, sl)     # weight 2^8
+        lh = mm(self.t_lo, sh)     # weight 2^7
+        ll = mm(self.t_lo, sl)     # weight 2^0
+
+        def spread(v, shift):
+            # v·2^shift at limb l → low bits at l, high bits at l+1
+            lo = (v << shift) & 0xFFFF
+            hi = v >> (16 - shift)
+            return lo + jnp.concatenate(
+                [jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+
+        acc = (spread(hh, 15) + spread(hl, 8) + spread(lh, 7) + ll)
+        acc = B.carry_normalize(
+            jnp.pad(acc, ((0, 1), (0, 0))))           # [K2+1, N]
+        corr = B.carry_normalize(
+            alpha[None, :].astype(jnp.uint32)
+            * jnp.pad(self.a_limbs, (0, 1))[:, None])
+        out = B.sub_where(acc, corr,
+                          jnp.ones(acc.shape[1], dtype=bool))
+        return out[: self.k_out]
+
+
 @partial(jax.jit, static_argnums=(0,))
 def _rns_verify_core(ctx: RNSContext, s_limbs, expected_limbs,
                      sig_c, n_B, a2_A, a2_B):
