@@ -88,12 +88,20 @@ def main() -> None:
                           "vs_baseline": 0.0}))
         return
 
-    rates = []
+    rates, lats = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
         ks.verify_batch(tokens)
-        rates.append(batch / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        rates.append(batch / dt)
+        lats.append(dt)
     value = statistics.median(rates)
+
+    # p50/p99 batch latency (BASELINE.md tracked metric) → stderr so
+    # stdout stays the single driver-consumed JSON line.
+    lats.sort()
+    print(f"batch_latency_s p50={lats[len(lats) // 2]:.3f} "
+          f"max={lats[-1]:.3f} batch={batch}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "jwt_verifies_per_sec_rs256_es256_16key_jwks",

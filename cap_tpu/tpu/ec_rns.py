@@ -166,6 +166,23 @@ def rmul(c: ECRNSContext, a, b):
     return _redc(pA, pB, c.sig_c, c.p_B, c.consts)
 
 
+def rmul_many(c: ECRNSContext, pairs):
+    """Batch independent rmuls through ONE REDC (concat along batch).
+
+    The base-extension matmuls and channel fixes are shape-agnostic,
+    so k independent multiplies cost one dispatch over [I, k·N] —
+    bigger matmuls, fewer kernel launches.
+    """
+    n = pairs[0][0][0].shape[1]
+    pA = _fixA(c, jnp.concatenate([a[0] * b[0] for a, b in pairs],
+                                  axis=1))
+    pB = _fixB(c, jnp.concatenate([a[1] * b[1] for a, b in pairs],
+                                  axis=1))
+    tA, tB = _redc(pA, pB, c.sig_c, c.p_B, c.consts)
+    return [(tA[:, i * n:(i + 1) * n], tB[:, i * n:(i + 1) * n])
+            for i in range(len(pairs))]
+
+
 def radd(c: ECRNSContext, a, b):
     """a + b (bounds add)."""
     return (_fixA(c, a[0] + b[0]), _fixB(c, a[1] + b[1]))
@@ -211,24 +228,24 @@ def _madd_rns(c: ECRNSContext, X1, Y1, Z1, inf1, x2, y2):
     Degenerate same-x cases flagged (CPU oracle re-verifies), matching
     the limb engine's contract.
     """
+    # Independent multiplies within a dependency layer share one REDC.
     z1z1 = rmul(c, Z1, Z1)                       # < 3p
-    u2 = rmul(c, x2, z1z1)                       # < 3p
-    z1_3 = rmul(c, Z1, z1z1)                     # < 3p
-    s2 = rmul(c, y2, z1_3)                       # < 3p
+    u2, z1_3 = rmul_many(c, [(x2, z1z1), (Z1, z1z1)])        # < 3p
     h = rsub(c, u2, X1, 16)                      # < 19p
-    hh = rmul(c, h, h)                           # < 3p
+    zh = radd(c, Z1, h)                          # < 30p
+    s2, hh, zh2 = rmul_many(
+        c, [(y2, z1_3), (h, h), (zh, zh)])       # < 3p each
     i4 = radd(c, radd(c, hh, hh), radd(c, hh, hh))   # < 12p
-    j = rmul(c, h, i4)                           # < 3p
     s2y1 = rsub(c, s2, Y1, 16)                   # < 19p
     rr = radd(c, s2y1, s2y1)                     # < 38p
-    v = rmul(c, X1, i4)                          # < 3p
-    r2_ = rmul(c, rr, rr)                        # < 3p
+    j, v, r2_ = rmul_many(
+        c, [(h, i4), (X1, i4), (rr, rr)])        # < 3p each
     vv = radd(c, v, v)                           # < 6p
     X3 = rsub(c, rsub(c, r2_, j, 4), vv, 8)      # < 15p
-    y1j = rmul(c, Y1, j)                         # < 3p
-    Y3 = rsub(c, rmul(c, rr, rsub(c, v, X3, 16), ), radd(c, y1j, y1j), 8)
-    zh = radd(c, Z1, h)                          # < 30p
-    Z3 = rsub(c, rsub(c, rmul(c, zh, zh), z1z1, 4), hh, 4)   # < 11p
+    y1j, t5 = rmul_many(
+        c, [(Y1, j), (rr, rsub(c, v, X3, 16))])  # < 3p each
+    Y3 = rsub(c, t5, radd(c, y1j, y1j), 8)       # < 11p
+    Z3 = rsub(c, rsub(c, zh2, z1z1, 4), hh, 4)   # < 11p
 
     deg = ~inf1 & congruent_zero(c, h, 20)       # same-x (incl. inverse)
     return X3, Y3, Z3, deg

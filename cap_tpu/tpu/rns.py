@@ -459,6 +459,60 @@ def _rns_verify_core(ctx: RNSContext, s_limbs, expected_limbs,
     return ok
 
 
+@partial(jax.jit, static_argnums=(0, 1))
+def _rns_modexp_em_core(ctx: RNSContext, k_out: int, s_limbs,
+                        sig_c, n_B, a2_A, a2_B, n_limbs):
+    """s^65537 mod n as LIMBS (for host-side EM checks, e.g. PSS).
+
+    Same RNS chain as the verify core, then CRT reconstruction back to
+    limbs and canonicalization below the per-token modulus.
+    """
+    from . import bignum as B
+
+    dA, dB = ctx.dA, ctx.dB
+    consts = (dA, dB, ctx.W_AB, ctx.W_BA, ctx.Amod_B, ctx.Bmod_A,
+              ctx.invA_B)
+    sA = _limbs_to_rns(s_limbs, ctx.T_A, dA)
+    sB = _limbs_to_rns(s_limbs, ctx.T_B, dB)
+    xA, xB = _mul_redc(sA, sB, a2_A, a2_B, sig_c, n_B, consts, dA, dB)
+    x0A, x0B = xA, xB
+    for _ in range(16):
+        xA, xB = _mul_redc(xA, xB, xA, xB, sig_c, n_B, consts, dA, dB)
+    xA, xB = _mul_redc(xA, xB, x0A, x0B, sig_c, n_B, consts, dA, dB)
+    xA, xB = _redc(xA, xB, sig_c, n_B, consts)   # exit domain; < 3n
+
+    conv = _to_limbs_for(ctx, k_out)
+    v = conv(xA)                                  # [k_out, N]
+    n_pad = jnp.concatenate(
+        [n_limbs, jnp.zeros_like(n_limbs[:1])], axis=0)
+    for _ in range(2):
+        v = B.sub_where(v, n_pad, B.compare_ge(v, n_pad))
+    return v[: n_limbs.shape[0]]
+
+
+_TO_LIMBS_CACHE: Dict[Tuple[int, int], "RNSToLimbs"] = {}
+
+
+def _to_limbs_for(ctx: RNSContext, k_out: int) -> "RNSToLimbs":
+    key = (id(ctx), k_out)
+    if key not in _TO_LIMBS_CACHE:
+        _TO_LIMBS_CACHE[key] = RNSToLimbs(ctx.A, k_out)
+    return _TO_LIMBS_CACHE[key]
+
+
+def modexp_em_device(ctx: RNSContext, table: RNSKeyTable,
+                     s_limbs, key_idx: np.ndarray,
+                     n_limbs_gathered) -> jnp.ndarray:
+    """Async device [K, N] limbs of s^65537 mod n (PSS path)."""
+    idx = jnp.asarray(key_idx, I32)
+    k = s_limbs.shape[0]
+    return _rns_modexp_em_core(
+        ctx, k + 1, jnp.asarray(s_limbs),
+        table.sig_c[idx].T, table.n_B[idx].T,
+        table.a2_A[idx].T, table.a2_B[idx].T,
+        n_limbs_gathered)
+
+
 def verify_em_equals_device(ctx: RNSContext, table: RNSKeyTable,
                             s_limbs: np.ndarray,
                             expected_limbs: np.ndarray,

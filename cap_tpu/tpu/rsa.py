@@ -314,14 +314,29 @@ def verify_pss_arrays_pending(table: RSAKeyTable, sig_mat: np.ndarray,
                               sig_lens: np.ndarray, hash_mat: np.ndarray,
                               hash_name: str, key_idx: np.ndarray):
     """Dispatch the PS* modexp; finalize() runs the host EM/MGF1 check."""
+    import jax.numpy as jnp
+
     n_tok = sig_mat.shape[0]
     sizes = np.asarray(table.sizes_bytes, np.int64)[key_idx]
     mod_bits = np.asarray([n.bit_length() for n in table.n_ints])[key_idx]
     len_ok = sig_lens == sizes
     safe_lens = np.where(len_ok, sig_lens, 0)
-    s_limbs = L.bytes_matrix_to_limbs(
-        np.where(len_ok[:, None], sig_mat, 0), safe_lens, table.k)
-    em_dev = modexp_for_table(table, s_limbs, key_idx)
+    aligned = L.right_align_bytes(
+        np.where(len_ok[:, None], sig_mat, 0), safe_lens, 2 * table.k)
+    s_limbs = bytes_to_limbs_device(jnp.asarray(aligned))
+    if table.all_f4 and _use_rns():
+        from . import rns as rns_mod
+
+        ctx, rtab = table.rns()
+        if ctx is not None:
+            idx = jnp.asarray(key_idx, jnp.int32)
+            n_gath = table.n_tab[idx].T
+            em_dev = rns_mod.modexp_em_device(ctx, rtab, s_limbs,
+                                              key_idx, n_gath)
+        else:
+            em_dev = modexp_for_table(table, s_limbs, key_idx)
+    else:
+        em_dev = modexp_for_table(table, s_limbs, key_idx)
     in_range_dev = s_in_range_mask(table, s_limbs, key_idx)
 
     def finalize() -> np.ndarray:
