@@ -39,13 +39,13 @@ def __getattr__(name):
     # TPUBatchKeySet pulls in jax; import lazily so the pure-CPU path has
     # no accelerator dependency (the reference's pure-Go-path-stays-default
     # requirement).
-    if name == "TPUBatchKeySet":
+    if name in ("TPUBatchKeySet", "TPURemoteKeySet"):
         try:
-            from .tpu_keyset import TPUBatchKeySet
+            from . import tpu_keyset
         except ImportError as e:
             raise AttributeError(
-                "TPUBatchKeySet requires the cap_tpu.tpu engine "
+                f"{name} requires the cap_tpu.tpu engine "
                 f"(unavailable in this checkout: {e})"
             ) from e
-        return TPUBatchKeySet
+        return getattr(tpu_keyset, name)
     raise AttributeError(name)

@@ -388,6 +388,9 @@ class TPUBatchKeySet(KeySet):
         err.__cause__ = last
         return err
 
+    def kids(self) -> set:
+        return set(self._by_kid)
+
     def _verify_batch_objects(self, tokens: Sequence[str]) -> List[Any]:
         n = len(tokens)
         results: List[Any] = [None] * n
@@ -555,3 +558,70 @@ class TPUBatchKeySet(KeySet):
             key_idx = np.asarray(rows + [0] * fill, np.int32)
             ok = tpued.verify_ed25519_batch(table, sigs, msgs, key_idx)
             self._finish(chunk, parsed_list, ok[: len(chunk)], results)
+
+
+class TPURemoteKeySet(KeySet):
+    """Remote-JWKS-backed accelerated KeySet (key-rotation aware).
+
+    The device analog of the reference's remote JWKS path
+    (jwt/keyset.go:109-122 → coreos RemoteKeySet): keys come from a
+    JWKS endpoint and live in device tables; a batch whose tokens
+    present UNKNOWN kids triggers at most one refetch + table rebuild,
+    and failed signatures against known kids never hit the network
+    (forged tokens must not amplify into IdP fetches).
+
+    Table rebuilds re-run the host-side window-table precompute, so
+    rotation is expected to be rare relative to batch volume.
+    """
+
+    def __init__(self, jwks_url: str, jwks_ca_pem: Optional[str] = None,
+                 max_chunk: int = 32768):
+        from .keyset import JSONWebKeySet
+
+        self._remote = JSONWebKeySet(jwks_url, jwks_ca_pem)
+        self._max_chunk = max_chunk
+        self._ks: Optional[TPUBatchKeySet] = None
+        self._kids: set = set()
+        import threading
+
+        self._lock = threading.Lock()
+
+    def _ensure(self, refresh: bool = False) -> TPUBatchKeySet:
+        jwks = self._remote.keys(refresh=refresh)
+        with self._lock:
+            kids = {j.kid for j in jwks if j.kid}
+            if self._ks is None or refresh:
+                self._ks = TPUBatchKeySet(jwks, max_chunk=self._max_chunk)
+                self._kids = kids
+            return self._ks
+
+    def verify_signature(self, token: str) -> Dict[str, Any]:
+        ks = self._ensure()
+        try:
+            return ks.verify_signature(token)
+        except InvalidSignatureError:
+            parsed = parse_compact(token)
+            if parsed.kid is not None and parsed.kid not in self._kids:
+                return self._ensure(refresh=True).verify_signature(token)
+            raise
+
+    def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        ks = self._ensure()
+        results = ks.verify_batch(tokens)
+        missed: List[int] = []
+        for i, r in enumerate(results):
+            if not isinstance(r, InvalidSignatureError):
+                continue
+            try:
+                parsed = parse_compact(tokens[i])
+            except Exception:  # noqa: BLE001 - malformed keeps its error
+                continue
+            if parsed.kid is not None and parsed.kid not in self._kids:
+                missed.append(i)
+        if missed:
+            telemetry.count("jwks.rotation_refetch")
+            ks = self._ensure(refresh=True)
+            retry = ks.verify_batch([tokens[i] for i in missed])
+            for i, r in zip(missed, retry):
+                results[i] = r
+        return results

@@ -151,3 +151,69 @@ def test_es_falls_back_to_cpu_until_ec_engine(tpu_keyset, rsa_jwks):
     tok = captest.sign_jwt(es_priv, "ES256", captest.default_claims(), kid="es")
     res = ks.verify_batch([tok])
     assert isinstance(res[0], dict)
+
+
+def test_remote_keyset_rotation():
+    """TPURemoteKeySet: unknown kid triggers ONE refetch + table rebuild;
+    bad signatures against known kids never refetch (no amplification)."""
+    import json as jsonlib
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from cap_tpu.jwt.jwk import serialize_public_key
+    from cap_tpu.jwt.tpu_keyset import TPURemoteKeySet
+
+    priv1, pub1 = captest.generate_keys("ES256")
+    priv2, pub2 = captest.generate_keys("ES256")
+    state = {"keys": [serialize_public_key(pub1, kid="gen1")],
+             "fetches": 0}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            state["fetches"] += 1
+            body = jsonlib.dumps({"keys": state["keys"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/jwks"
+        ks = TPURemoteKeySet(url)
+        claims = captest.default_claims()
+        tok1 = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
+        out = ks.verify_batch([tok1] * 4)
+        assert all(isinstance(r, dict) for r in out)
+        fetches_before = state["fetches"]
+
+        # forged token with a KNOWN kid: must fail with NO refetch
+        forged = tok1[:-8] + ("AAAAAAAA" if not tok1.endswith("AAAAAAAA")
+                              else "BBBBBBBB")
+        out = ks.verify_batch([forged])
+        assert isinstance(out[0], Exception)
+        assert state["fetches"] == fetches_before
+
+        # rotate: new signing key, new kid → one refetch, then verifies.
+        # tok1 still verifies in THIS batch (it matched the cached key
+        # before the refetch — same semantics as the reference's cached
+        # RemoteKeySet).
+        state["keys"] = [serialize_public_key(pub2, kid="gen2")]
+        tok2 = captest.sign_jwt(priv2, "ES256", claims, kid="gen2")
+        out = ks.verify_batch([tok2, tok1])
+        assert isinstance(out[0], dict)
+        assert isinstance(out[1], dict)
+        assert state["fetches"] == fetches_before + 1
+
+        # next batch: gen1 is gone from the rebuilt table → unknown kid
+        # → one more refetch, still rejected (IdP dropped the key)
+        out = ks.verify_batch([tok1])
+        assert isinstance(out[0], Exception)
+        assert state["fetches"] == fetches_before + 2
+    finally:
+        srv.shutdown()
