@@ -461,7 +461,7 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
             rtab.tqx, rtab.tqy,
             *ec_rns.g_residue_tables(cp.name),
             *consts[4:9],
-            crv=cp.name, nbits=cp.nbits, n_windows=cp.n_windows,
+            crv=cp.name, nbits=cp.nbits,
         )
     else:
         ok_dev, deg_dev = _ecdsa_core(

@@ -51,11 +51,15 @@ from .rns import (
 )
 
 
+W_BITS = 8          # window width: byte-aligned digits, 255-entry rows
+
+
 class ECRNSContext:
     """Per-curve RNS bases, extension/conversion matrices, constants."""
 
     def __init__(self, cp: CurveParams):
         self.cp = cp
+        self.n_windows = (cp.nbits + W_BITS - 1) // W_BITS
         primes = _sieve_primes(1 << 12, 1 << 14)
         need = cp.p.bit_length() + 16          # A ≥ 2^14·p (and slack)
         msA, bits, i = [], 0.0, 0
@@ -262,10 +266,10 @@ def _one_dom(c: ECRNSContext):
 # The batched verify core
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("crv", "nbits", "n_windows"))
+@partial(jax.jit, static_argnames=("crv", "nbits"))
 def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
                     n, npp, nr2, none_, nm2,
-                    crv: str, nbits: int, n_windows: int):
+                    crv: str, nbits: int):
     """ECDSA verify: scalar math in limbs, point math in RNS.
 
     r, s, e: [K, N] limb values; key_idx [N]; tq*/tg*: window tables
@@ -291,15 +295,18 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     u1 = B.mont_mul(e, w_m, nb, nppb)
     u2 = B.mont_mul(r, w_m, nb, nppb)
 
-    # 2. window digits
-    def nibbles(u):
-        return jnp.stack(
-            [(u >> (4 * j)) & 15 for j in range(4)], axis=1
-        ).reshape(4 * k, shape[1]).astype(jnp.int32)
+    # 2. window digits (byte-aligned: 2 digits per 16-bit limb)
+    n_windows = c.n_windows
+    per = (1 << W_BITS) - 1
 
-    dig1 = nibbles(u1)
-    dig2 = nibbles(u2)
-    key_base = key_idx.astype(jnp.int32) * (n_windows * 15)
+    def bytes_of(u):
+        return jnp.stack(
+            [(u >> (8 * j)) & 255 for j in range(2)], axis=1
+        ).reshape(2 * k, shape[1]).astype(jnp.int32)
+
+    dig1 = bytes_of(u1)
+    dig2 = bytes_of(u2)
+    key_base = key_idx.astype(jnp.int32) * (n_windows * per)
 
     ia = c.A.count
 
@@ -343,8 +350,8 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     def ladder_body(i, state):
         d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
-        state = add_from_table(state, tgx, tgy, d1, i * 15)
-        state = add_from_table(state, tqx, tqy, d2, key_base + i * 15)
+        state = add_from_table(state, tgx, tgy, d1, i * per)
+        state = add_from_table(state, tqx, tqy, d2, key_base + i * per)
         return state
 
     X, Y, Z, inf, deg = lax.fori_loop(
@@ -395,7 +402,7 @@ class ECRNSKeyTable:
         a_prod = c.A.prod
         p = cp.p
         nk = len(keys)
-        rows = cp.n_windows * 15
+        rows = self.ctx.n_windows * ((1 << W_BITS) - 1)
         ia, ib = c.A.count, c.B.count
         tqx = np.empty((nk * rows, ia + ib), np.int32)
         tqy = np.empty((nk * rows, ia + ib), np.int32)
@@ -410,23 +417,29 @@ class ECRNSKeyTable:
 
 def _window_residue_rows(c: ECRNSContext, point) -> Tuple[np.ndarray,
                                                           np.ndarray]:
-    """Host: 4-bit window table of d·2^{4i}·point as A-domain residues."""
+    """Host: 8-bit window table of d·2^{8i}·point as A-domain residues.
+
+    Row i·255 + (d−1) holds d·2^{8i}·point; byte-aligned digits halve
+    the ladder length vs 4-bit windows at the cost of bigger (still
+    small) tables and a ~30ms/key host precompute.
+    """
     cp = c.cp
     p = cp.p
     a_mod = c.A.prod % p
-    nw = cp.n_windows
+    nw = c.n_windows
     ia, ib = c.A.count, c.B.count
-    rx = np.empty((nw * 15, ia + ib), np.int32)
-    ry = np.empty((nw * 15, ia + ib), np.int32)
+    per = (1 << W_BITS) - 1
+    rx = np.empty((nw * per, ia + ib), np.int32)
+    ry = np.empty((nw * per, ia + ib), np.int32)
     base = point
     for i in range(nw):
         acc = None
-        for d in range(1, 16):
+        for d in range(1, per + 1):
             acc = cp.affine_add(acc, base)
             x, y = acc
-            rx[i * 15 + d - 1] = c.residues_of(x * a_mod % p)
-            ry[i * 15 + d - 1] = c.residues_of(y * a_mod % p)
-        for _ in range(4):
+            rx[i * per + d - 1] = c.residues_of(x * a_mod % p)
+            ry[i * per + d - 1] = c.residues_of(y * a_mod % p)
+        for _ in range(W_BITS):
             base = cp.affine_add(base, base)
     return rx, ry
 

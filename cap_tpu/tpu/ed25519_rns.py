@@ -32,10 +32,14 @@ from .ed25519 import (
     _edw_add,
     K,
     L_ORDER,
-    N_WINDOWS,
+    NBITS,
     P,
     consts,
 )
+
+W_BITS = 8                          # byte-aligned window digits
+NW8 = (NBITS + W_BITS - 1) // W_BITS  # 32 windows
+PER = 1 << W_BITS                   # 256 entries incl. identity at d=0
 from .rns import (
     _Base,
     _ext_matrix,
@@ -143,21 +147,21 @@ def _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2):
 def _window_triple_residue_rows(c: Ed25519RNSContext,
                                 pt: Tuple[int, int]) -> np.ndarray:
     """[3, NW·16, I_A+I_B] A-domain triples of d·2^{4i}·pt (d=0: id)."""
-    nw = N_WINDOWS
+    nw = NW8
     ia, ib = c.A.count, c.B.count
-    rows = np.empty((3, nw * 16, ia + ib), np.int32)
+    rows = np.empty((3, nw * PER, ia + ib), np.int32)
     am = c.a_mod_p
     base = pt
     for i in range(nw):
         acc = _IDENTITY
-        for d in range(16):
+        for d in range(PER):
             if d:
                 acc = _edw_add(acc, base)
             x, y = acc
             vals = ((y - x) % P, (y + x) % P, _t2_of(x, y))
             for t, v in enumerate(vals):
-                rows[t, i * 16 + d] = c.residues_of(v * am % P)
-        for _ in range(4):
+                rows[t, i * PER + d] = c.residues_of(v * am % P)
+        for _ in range(W_BITS):
             base = _edw_add(base, base)
     return rows
 
@@ -187,7 +191,7 @@ class Ed25519RNSKeyTable:
         matching Ed25519KeyTable's decode results."""
         c = ctx()
         nk = len(keys_decoded)
-        rows = N_WINDOWS * 16
+        rows = NW8 * PER
         ia, ib = c.A.count, c.B.count
         ta = np.empty((3, nk * rows, ia + ib), np.int32)
         for i, a in enumerate(keys_decoded):
@@ -218,14 +222,14 @@ def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
 
     s_ok = ~B.compare_ge(s, l_b)
 
-    def nibbles(u):
+    def bytes_of(u):
         return jnp.stack(
-            [(u >> (4 * j)) & 15 for j in range(4)], axis=1
-        ).reshape(4 * k, shape[1]).astype(jnp.int32)
+            [(u >> (8 * j)) & 255 for j in range(2)], axis=1
+        ).reshape(2 * k, shape[1]).astype(jnp.int32)
 
-    dig1 = nibbles(s)
-    dig2 = nibbles(kk)
-    key_base = key_idx.astype(jnp.int32) * (N_WINDOWS * 16)
+    dig1 = bytes_of(s)
+    dig2 = bytes_of(kk)
+    key_base = key_idx.astype(jnp.int32) * (NW8 * PER)
 
     ia = c.A.count
     n_tok = shape[1]
@@ -248,14 +252,14 @@ def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
         X, Y, Z, T = state
         d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
-        ym, yp, t2 = gather3(tb_ym, tb_yp, tb_t2, i * 16 + d1)
+        ym, yp, t2 = gather3(tb_ym, tb_yp, tb_t2, i * PER + d1)
         X, Y, Z, T = _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
         ym, yp, t2 = gather3(ta_ym, ta_yp, ta_t2,
-                             key_base + i * 16 + d2)
+                             key_base + i * PER + d2)
         X, Y, Z, T = _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
         return X, Y, Z, T
 
-    X, Y, Z, T = lax.fori_loop(0, N_WINDOWS, ladder_body, (X, Y, Z, T))
+    X, Y, Z, T = lax.fori_loop(0, NW8, ladder_body, (X, Y, Z, T))
 
     # RNS → limbs, canonicalize mod p, then the limb-domain finish.
     def to_canonical(v_pair):

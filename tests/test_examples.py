@@ -1,0 +1,33 @@
+"""Runnable-documentation tier (reference test strategy §4 pattern 5:
+Example* functions double as docs and smoke tests — here the example
+apps run headless against the in-process IdP)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *flags):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script),
+         "--demo", *flags],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, (script, flags, r.stdout[-1500:],
+                               r.stderr[-1500:])
+    return r.stdout
+
+
+@pytest.mark.parametrize("flags", [(), ("--pkce",), ("--implicit",)])
+def test_cli_example_flows(flags):
+    out = _run("cli.py", *flags)
+    assert "Login successful" in out or "token" in out.lower()
+
+
+def test_spa_example_flow():
+    out = _run("spa.py")
+    assert '"iss"' in out or "success" in out.lower()
