@@ -60,7 +60,10 @@ class ECRNSContext:
     def __init__(self, cp: CurveParams):
         self.cp = cp
         self.n_windows = (cp.nbits + W_BITS - 1) // W_BITS
-        primes = _sieve_primes(1 << 12, 1 << 14)
+        # 13-bit primes only: with m < 2^13, products of lazily-grown
+        # digits (c₁m)·(c₂m) stay < 2^31 for c₁c₂ ≤ 32, which lets
+        # radd/rsub skip their Barrett fixes entirely.
+        primes = _sieve_primes(1 << 12, 1 << 13)
         need = cp.p.bit_length() + 16          # A ≥ 2^14·p (and slack)
         msA, bits, i = [], 0.0, 0
         while bits < need:
@@ -188,16 +191,27 @@ def rmul_many(c: ECRNSContext, pairs):
 
 
 def radd(c: ECRNSContext, a, b):
-    """a + b (bounds add)."""
-    return (_fixA(c, a[0] + b[0]), _fixB(c, a[1] + b[1]))
+    """a + b — LAZY: digits grow (c₁+c₂)·m, no Barrett fix.
+
+    Safe because channel moduli are < 2^13 and ``rmul`` fixes its
+    products (which stay < 2^31 while the digit-growth product
+    c₁c₂ ≤ 32 — the ladder's worst pair is far below that).
+    """
+    return (a[0] + b[0], a[1] + b[1])
 
 
-def rsub(c: ECRNSContext, a, b, cmul: int):
-    """a + cmul·p − b: cmul·p must dominate b's value bound."""
-    return (_fixA(c, a[0] + c.cp_A[cmul][:, None] - b[0]
-                  + c.dA["m"][:, None]),
-            _fixB(c, a[1] + c.cp_B[cmul][:, None] - b[1]
-                  + c.dB["m"][:, None]))
+def rsub(c: ECRNSContext, a, b, cmul: int, guard: int = 4):
+    """a + cmul·p − b — LAZY (no fix). cmul·p must dominate b's VALUE
+    bound; ``guard``·m must dominate b's DIGIT bound."""
+    ga = guard * c.dA["m"][:, None]
+    gb = guard * c.dB["m"][:, None]
+    return (a[0] + c.cp_A[cmul][:, None] - b[0] + ga,
+            a[1] + c.cp_B[cmul][:, None] - b[1] + gb)
+
+
+def rfix(c: ECRNSContext, x):
+    """Canonicalize digits (< m) of a lazily-grown pair."""
+    return (_fixA(c, x[0]), _fixB(c, x[1]))
 
 
 def rsel(mask, a, b):
@@ -207,11 +221,16 @@ def rsel(mask, a, b):
 
 
 def congruent_zero(c: ECRNSContext, x, max_c: int):
-    """[N] bool: value(x) ≡ 0 (mod p), for values < max_c·p."""
-    ok = jnp.zeros(x[0].shape[1], bool)
+    """[N] bool: value(x) ≡ 0 (mod p), for values < max_c·p.
+
+    Accepts lazily-grown digits (fixes internally before comparing).
+    """
+    xa = _fixA(c, x[0])
+    xb = _fixB(c, x[1])
+    ok = jnp.zeros(xa.shape[1], bool)
     for cc in range(max_c):
-        ok = ok | (jnp.all(x[0] == c.cp_A[cc][:, None], axis=0)
-                   & jnp.all(x[1] == c.cp_B[cc][:, None], axis=0))
+        ok = ok | (jnp.all(xa == c.cp_A[cc][:, None], axis=0)
+                   & jnp.all(xb == c.cp_B[cc][:, None], axis=0))
     return ok
 
 
@@ -228,28 +247,35 @@ def req(c: ECRNSContext, x, y, slack: int):
 def _madd_rns(c: ECRNSContext, X1, Y1, Z1, inf1, x2, y2):
     """(X1:Y1:Z1) + (x2, y2) with explicit infinity lane.
 
-    Bounds: X1, Y1 < 15p, Z1 < 11p in; same out. x2, y2 < p (tables).
+    State in/out is digit-canonical (< m) with values < 15p (X, Y) /
+    11p (Z); x2, y2 < p (tables). Between multiplies the adds/subs are
+    LAZY — digit bounds (in units of m) are tracked alongside value
+    bounds (units of p) below; every rmul product stays < 32·m² < 2^31
+    and outputs digit-canonical; the three results are re-fixed.
     Degenerate same-x cases flagged (CPU oracle re-verifies), matching
     the limb engine's contract.
     """
     # Independent multiplies within a dependency layer share one REDC.
-    z1z1 = rmul(c, Z1, Z1)                       # < 3p
-    u2, z1_3 = rmul_many(c, [(x2, z1z1), (Z1, z1z1)])        # < 3p
-    h = rsub(c, u2, X1, 16)                      # < 19p
-    zh = radd(c, Z1, h)                          # < 30p
+    z1z1 = rmul(c, Z1, Z1)                       # < 3p, digits ≤ m
+    u2, z1_3 = rmul_many(c, [(x2, z1z1), (Z1, z1z1)])        # < 3p, ≤ m
+    h = rsub(c, u2, X1, 16, guard=1)             # < 19p, ≤ 3m
+    zh = radd(c, Z1, h)                          # < 30p, ≤ 4m
     s2, hh, zh2 = rmul_many(
-        c, [(y2, z1_3), (h, h), (zh, zh)])       # < 3p each
-    i4 = radd(c, radd(c, hh, hh), radd(c, hh, hh))   # < 12p
-    s2y1 = rsub(c, s2, Y1, 16)                   # < 19p
-    rr = radd(c, s2y1, s2y1)                     # < 38p
+        c, [(y2, z1_3), (h, h), (zh, zh)])       # 9m², 16m² ✓ → ≤ m
+    i4 = radd(c, radd(c, hh, hh), radd(c, hh, hh))   # < 12p, ≤ 4m
+    s2y1 = rsub(c, s2, Y1, 16, guard=1)          # < 19p, ≤ 3m
+    rr = rfix(c, radd(c, s2y1, s2y1))            # < 38p, ≤ m (fixed)
     j, v, r2_ = rmul_many(
-        c, [(h, i4), (X1, i4), (rr, rr)])        # < 3p each
-    vv = radd(c, v, v)                           # < 6p
-    X3 = rsub(c, rsub(c, r2_, j, 4), vv, 8)      # < 15p
+        c, [(h, i4), (X1, i4), (rr, rr)])        # 12m², 4m², m² ✓ → ≤ m
+    vv = radd(c, v, v)                           # < 6p, ≤ 2m
+    X3 = rfix(c, rsub(c, rsub(c, r2_, j, 4, guard=1), vv, 8,
+                      guard=2))                  # < 15p, ≤ m (fixed)
     y1j, t5 = rmul_many(
-        c, [(Y1, j), (rr, rsub(c, v, X3, 16))])  # < 3p each
-    Y3 = rsub(c, t5, radd(c, y1j, y1j), 8)       # < 11p
-    Z3 = rsub(c, rsub(c, zh2, z1z1, 4), hh, 4)   # < 11p
+        c, [(Y1, j), (rr, rsub(c, v, X3, 16, guard=1))])   # 3m² ✓ → ≤ m
+    Y3 = rfix(c, rsub(c, t5, radd(c, y1j, y1j), 8,
+                      guard=2))                  # < 11p, ≤ m (fixed)
+    Z3 = rfix(c, rsub(c, rsub(c, zh2, z1z1, 4, guard=1), hh, 4,
+                      guard=1))                  # < 11p, ≤ m (fixed)
 
     deg = ~inf1 & congruent_zero(c, h, 20)       # same-x (incl. inverse)
     return X3, Y3, Z3, deg

@@ -54,7 +54,9 @@ class Ed25519RNSContext:
     """Field context for p = 2^255−19 (duck-typed like ECRNSContext)."""
 
     def __init__(self):
-        primes = _sieve_primes(1 << 12, 1 << 14)
+        # 13-bit primes: required by the lazy (fix-free) adds/subs —
+        # see ECRNSContext.
+        primes = _sieve_primes(1 << 12, 1 << 13)
         need = 255 + 16
         msA, bits, i = [], 0.0, 0
         while bits < need:
@@ -134,13 +136,15 @@ def _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2):
     """
     from .ec_rns import rmul_many
 
+    # Lazy digit bounds (units of m): state ≤ m in; products ≤ 12m².
     a, b, cc = rmul_many(
-        c, [(rsub(c, Y, X, 4), ym), (radd(c, Y, X), yp), (T, t2)])
-    d = radd(c, Z, Z)
-    e = rsub(c, b, a, 4)
-    f = rsub(c, d, cc, 4)
-    g = radd(c, d, cc)
-    h = radd(c, b, a)
+        c, [(rsub(c, Y, X, 4, guard=1), ym),
+            (radd(c, Y, X), yp), (T, t2)])
+    d = radd(c, Z, Z)                        # ≤ 2m
+    e = rsub(c, b, a, 4, guard=1)            # ≤ 3m
+    f = rsub(c, d, cc, 4, guard=1)           # ≤ 4m
+    g = radd(c, d, cc)                       # ≤ 3m
+    h = radd(c, b, a)                        # ≤ 2m
     return tuple(rmul_many(c, [(e, f), (g, h), (f, g), (e, h)]))
 
 
