@@ -104,6 +104,36 @@ def sharded_verify_step(mesh: Mesh):
     return jax.jit(fn)
 
 
+def sharded_rns_verify_step(mesh: Mesh, ctx):
+    """Multi-chip RS256 verify on the RNS/MXU engine.
+
+    fn(s_limbs, expected, sig_c, n_B, a2_A, a2_B) → (ok[N], total):
+    every operand is [·, N] sharded over the batch axis; the RNS
+    context's fixed extension/conversion matrices are compile-time
+    constants replicated to every chip. The data-parallel analog of
+    the limb step in ``sharded_verify_step``, on the engine the
+    benchmark actually uses.
+    """
+    from ..tpu import rns
+
+    limb_spec = P(None, DP_AXIS)
+
+    def core(s_limbs, expected, sig_c, n_B, a2_A, a2_B):
+        ok = rns._rns_verify_core(ctx, s_limbs, expected, sig_c, n_B,
+                                  a2_A, a2_B)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), DP_AXIS)
+        return ok, total
+
+    fn = jax.shard_map(
+        core,
+        mesh=mesh,
+        in_specs=(limb_spec,) * 6,
+        out_specs=(P(DP_AXIS), P()),
+        check_vma=False,  # see sharded_rs256_verify
+    )
+    return jax.jit(fn)
+
+
 def shard_batch_arrays(mesh: Mesh, *arrays):
     """Place [.., N]-batch arrays with their natural sharding on ``mesh``.
 
