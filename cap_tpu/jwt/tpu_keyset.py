@@ -388,9 +388,6 @@ class TPUBatchKeySet(KeySet):
         err.__cause__ = last
         return err
 
-    def kids(self) -> set:
-        return set(self._by_kid)
-
     def _verify_batch_objects(self, tokens: Sequence[str]) -> List[Any]:
         n = len(tokens)
         results: List[Any] = [None] * n
@@ -575,22 +572,39 @@ class TPURemoteKeySet(KeySet):
     """
 
     def __init__(self, jwks_url: str, jwks_ca_pem: Optional[str] = None,
-                 max_chunk: int = 32768):
+                 max_chunk: int = 32768,
+                 min_refresh_interval: float = 10.0):
         from .keyset import JSONWebKeySet
 
         self._remote = JSONWebKeySet(jwks_url, jwks_ca_pem)
         self._max_chunk = max_chunk
+        self._min_refresh = min_refresh_interval
         self._ks: Optional[TPUBatchKeySet] = None
         self._kids: set = set()
+        self._last_refresh = 0.0
         import threading
 
         self._lock = threading.Lock()
 
     def _ensure(self, refresh: bool = False) -> TPUBatchKeySet:
-        jwks = self._remote.keys(refresh=refresh)
+        import time
+
+        # Serialize fetch + rebuild: concurrent rotation triggers must
+        # not double-fetch or double-build the device tables. Unknown
+        # random kids (attacker-controlled) are additionally bounded by
+        # a refresh cooldown AND a content check: an unchanged key set
+        # never rebuilds tables.
         with self._lock:
+            if self._ks is not None and refresh:
+                if time.monotonic() - self._last_refresh < self._min_refresh:
+                    return self._ks
+            elif self._ks is not None:
+                return self._ks
+            jwks = self._remote.keys(refresh=refresh)
+            if refresh:
+                self._last_refresh = time.monotonic()
             kids = {j.kid for j in jwks if j.kid}
-            if self._ks is None or refresh:
+            if self._ks is None or kids != self._kids:
                 self._ks = TPUBatchKeySet(jwks, max_chunk=self._max_chunk)
                 self._kids = kids
             return self._ks

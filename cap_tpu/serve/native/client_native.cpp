@@ -31,6 +31,11 @@ constexpr uint8_t kVerifyReq = 1;
 constexpr uint8_t kVerifyResp = 2;
 constexpr uint8_t kPing = 3;
 constexpr uint8_t kPong = 4;
+// Mirror protocol.py's limits: reject hostile/corrupt lengths instead
+// of allocating them (a bad_alloc escaping extern "C" would terminate
+// the embedding process).
+constexpr uint32_t kMaxEntryBytes = 1u << 20;
+constexpr uint64_t kMaxFrameBytes = 1ull << 28;
 
 struct Client {
   int fd = -1;
@@ -149,19 +154,25 @@ int cap_client_verify(void* handle, const char** tokens,
   if (magic != kMagic || hdr[4] != kVerifyResp || n != count) return -1;
 
   uint64_t off = 0;
+  char sink[65536];
   for (uint32_t i = 0; i < count; i++) {
     uint8_t entry[5];
     if (!recv_all(c->fd, entry, 5)) return -1;
     uint32_t ln;
     std::memcpy(&ln, entry + 1, 4);
+    if (ln > kMaxEntryBytes || off + ln > kMaxFrameBytes) return -1;
     statuses[i] = entry[0];
     payload_off[i] = off;
     if (off + ln <= payload_cap) {
       if (!recv_all(c->fd, payload_buf + off, ln)) return -1;
     } else {
-      // drain so the connection stays usable, then report size
-      std::vector<char> sink(ln);
-      if (!recv_all(c->fd, sink.data(), ln)) return -1;
+      // drain in bounded chunks so the connection stays usable, then
+      // report the required size via payload_off[count]
+      for (uint32_t left = ln; left;) {
+        uint32_t take = left < sizeof(sink) ? left : sizeof(sink);
+        if (!recv_all(c->fd, sink, take)) return -1;
+        left -= take;
+      }
     }
     off += ln;
   }

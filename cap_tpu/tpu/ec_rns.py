@@ -41,106 +41,23 @@ from jax import lax
 from . import limbs as L
 from .ec import CurveParams, ECKeyTable, curve
 from .rns import (
+    FieldRNSContext,
     I32,
-    _Base,
-    _ext_matrix,
     _mod_fix,
     _redc,
-    _sieve_primes,
-    _split_mat,
 )
 
 
 W_BITS = 8          # window width: byte-aligned digits, 255-entry rows
 
 
-class ECRNSContext:
-    """Per-curve RNS bases, extension/conversion matrices, constants."""
+class ECRNSContext(FieldRNSContext):
+    """Per-curve field context (shared construction in FieldRNSContext)."""
 
     def __init__(self, cp: CurveParams):
+        super().__init__(cp.p, cp.k)
         self.cp = cp
         self.n_windows = (cp.nbits + W_BITS - 1) // W_BITS
-        # 13-bit primes only: with m < 2^13, products of lazily-grown
-        # digits (c₁m)·(c₂m) stay < 2^31 for c₁c₂ ≤ 32, which lets
-        # radd/rsub skip their Barrett fixes entirely.
-        primes = _sieve_primes(1 << 12, 1 << 13)
-        need = cp.p.bit_length() + 16          # A ≥ 2^14·p (and slack)
-        msA, bits, i = [], 0.0, 0
-        while bits < need:
-            msA.append(primes[i])
-            bits += np.log2(primes[i])
-            i += 1
-        msB, bits = [], 0.0
-        while bits < need:
-            msB.append(primes[i])
-            bits += np.log2(primes[i])
-            i += 1
-        self.A = _Base(msA)
-        self.B = _Base(msB)
-
-        def dev_base(base: _Base):
-            return dict(
-                m=jnp.asarray(base.m, I32),
-                m_f=jnp.asarray(base.m, jnp.float32),
-                inv_f=jnp.asarray(1.0 / base.m, jnp.float32),
-                inv_Mi=jnp.asarray(base.inv_Mi, I32),
-            )
-
-        self.dA = dev_base(self.A)
-        self.dB = dev_base(self.B)
-        self.W_AB = _split_mat(_ext_matrix(self.A, self.B))
-        self.W_BA = _split_mat(_ext_matrix(self.B, self.A))
-        self.Amod_B = jnp.asarray(
-            [self.A.prod % int(m) for m in self.B.m], I32)
-        self.Bmod_A = jnp.asarray(
-            [self.B.prod % int(m) for m in self.A.m], I32)
-        self.invA_B = jnp.asarray(
-            [pow(self.A.prod % int(m), -1, int(m)) for m in self.B.m], I32)
-
-        p = cp.p
-        ppr = [(-pow(p, -1, int(m))) % int(m) for m in self.A.m]
-        self.sig_c = jnp.asarray(
-            [(v * int(inv)) % int(m) for v, inv, m in
-             zip(ppr, self.A.inv_Mi, self.A.m)], I32)[:, None]
-        self.p_B = jnp.asarray([p % int(m) for m in self.B.m],
-                               I32)[:, None]
-        # c·p residue rows for congruence tests and positive subtracts.
-        maxc = 32
-        self.cp_A = jnp.asarray(
-            [[(c * p) % int(m) for m in self.A.m] for c in range(maxc)],
-            I32)
-        self.cp_B = jnp.asarray(
-            [[(c * p) % int(m) for m in self.B.m] for c in range(maxc)],
-            I32)
-        # A² mod p (plain residues): one rmul with it lifts a plain
-        # value into the A-domain.
-        a2 = (self.A.prod * self.A.prod) % p
-        self.A2 = (jnp.asarray([a2 % int(m) for m in self.A.m],
-                               I32)[:, None],
-                   jnp.asarray([a2 % int(m) for m in self.B.m],
-                               I32)[:, None])
-        # limb→RNS conversion matrices for this curve's K.
-        k = cp.k
-
-        def conv_mat(base: _Base):
-            t = np.empty((base.count, k), np.int64)
-            for ll in range(k):
-                t[:, ll] = np.asarray(
-                    [pow(2, 16 * ll, int(m)) for m in base.m], np.int64)
-            return _split_mat(t)
-
-        self.T_A = conv_mat(self.A)
-        self.T_B = conv_mat(self.B)
-        self.consts = (self.dA, self.dB, self.W_AB, self.W_BA,
-                       self.Amod_B, self.Bmod_A, self.invA_B)
-
-    # -- host-side packing -------------------------------------------------
-
-    def residues_of(self, x: int) -> np.ndarray:
-        """Plain host int → concatenated [I_A + I_B] residue row."""
-        return np.asarray(
-            [x % int(m) for m in self.A.m]
-            + [x % int(m) for m in self.B.m], np.int64)
 
 
 _CTX: Dict[str, ECRNSContext] = {}
@@ -423,10 +340,7 @@ class ECRNSKeyTable:
     def __init__(self, crv: str, keys: Sequence):
         self.ctx = ctx_for(crv)
         self.cp = self.ctx.cp
-        cp = self.cp
         c = self.ctx
-        a_prod = c.A.prod
-        p = cp.p
         nk = len(keys)
         rows = self.ctx.n_windows * ((1 << W_BITS) - 1)
         ia, ib = c.A.count, c.B.count

@@ -40,77 +40,15 @@ from .ed25519 import (
 W_BITS = 8                          # byte-aligned window digits
 NW8 = (NBITS + W_BITS - 1) // W_BITS  # 32 windows
 PER = 1 << W_BITS                   # 256 entries incl. identity at d=0
-from .rns import (
-    _Base,
-    _ext_matrix,
-    _sieve_primes,
-    _split_mat,
-    I32,
-    RNSToLimbs,
-)
+
+from .rns import FieldRNSContext, I32  # noqa: E402
 
 
-class Ed25519RNSContext:
-    """Field context for p = 2^255−19 (duck-typed like ECRNSContext)."""
+class Ed25519RNSContext(FieldRNSContext):
+    """Field context for p = 2^255−19 (shared FieldRNSContext build)."""
 
     def __init__(self):
-        # 13-bit primes: required by the lazy (fix-free) adds/subs —
-        # see ECRNSContext.
-        primes = _sieve_primes(1 << 12, 1 << 13)
-        need = 255 + 16
-        msA, bits, i = [], 0.0, 0
-        while bits < need:
-            msA.append(primes[i])
-            bits += np.log2(primes[i])
-            i += 1
-        msB, bits = [], 0.0
-        while bits < need:
-            msB.append(primes[i])
-            bits += np.log2(primes[i])
-            i += 1
-        self.A = _Base(msA)
-        self.B = _Base(msB)
-
-        def dev_base(base: _Base):
-            return dict(
-                m=jnp.asarray(base.m, I32),
-                m_f=jnp.asarray(base.m, jnp.float32),
-                inv_f=jnp.asarray(1.0 / base.m, jnp.float32),
-                inv_Mi=jnp.asarray(base.inv_Mi, I32),
-            )
-
-        self.dA = dev_base(self.A)
-        self.dB = dev_base(self.B)
-        self.W_AB = _split_mat(_ext_matrix(self.A, self.B))
-        self.W_BA = _split_mat(_ext_matrix(self.B, self.A))
-        self.Amod_B = jnp.asarray(
-            [self.A.prod % int(m) for m in self.B.m], I32)
-        self.Bmod_A = jnp.asarray(
-            [self.B.prod % int(m) for m in self.A.m], I32)
-        self.invA_B = jnp.asarray(
-            [pow(self.A.prod % int(m), -1, int(m)) for m in self.B.m], I32)
-        ppr = [(-pow(P, -1, int(m))) % int(m) for m in self.A.m]
-        self.sig_c = jnp.asarray(
-            [(v * int(inv)) % int(m) for v, inv, m in
-             zip(ppr, self.A.inv_Mi, self.A.m)], I32)[:, None]
-        self.p_B = jnp.asarray([P % int(m) for m in self.B.m],
-                               I32)[:, None]
-        maxc = 16
-        self.cp_A = jnp.asarray(
-            [[(c * P) % int(m) for m in self.A.m] for c in range(maxc)],
-            I32)
-        self.cp_B = jnp.asarray(
-            [[(c * P) % int(m) for m in self.B.m] for c in range(maxc)],
-            I32)
-        self.consts = (self.dA, self.dB, self.W_AB, self.W_BA,
-                       self.Amod_B, self.Bmod_A, self.invA_B)
-        self.a_mod_p = self.A.prod % P
-        self.to_limbs = RNSToLimbs(self.A, 17)   # values < 3p < 2^257
-
-    def residues_of(self, x: int) -> np.ndarray:
-        return np.asarray(
-            [x % int(m) for m in self.A.m]
-            + [x % int(m) for m in self.B.m], np.int64)
+        super().__init__(P, K)      # to_limbs k_out = K+1 (< 3p < 2^257)
 
 
 _CTX: Optional[Ed25519RNSContext] = None

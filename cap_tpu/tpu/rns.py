@@ -346,6 +346,92 @@ def _limbs_to_rns(limbs: jnp.ndarray, t_pair, dev) -> jnp.ndarray:
                + fix(ll2))
 
 
+class FieldRNSContext:
+    """Shared RNS context for a fixed prime field (EC / Edwards engines).
+
+    Two bases of 13-bit primes (the lazy fix-free adds/subs in the
+    point ladders require m < 2^13 so digit-growth products stay below
+    2^31), extension + conversion matrices, the merged σ constant for
+    REDC, c·p residue rows for congruence tests/positive subtracts,
+    the A-domain entry constant A² mod p, and a CRT reconstructor.
+    """
+
+    def __init__(self, p: int, k_limbs: int, slack_bits: int = 16,
+                 maxc: int = 32):
+        self.p_int = p
+        primes = _sieve_primes(1 << 12, 1 << 13)
+        need = p.bit_length() + slack_bits
+        msA, bits, i = [], 0.0, 0
+        while bits < need:
+            msA.append(primes[i])
+            bits += np.log2(primes[i])
+            i += 1
+        msB, bits = [], 0.0
+        while bits < need:
+            msB.append(primes[i])
+            bits += np.log2(primes[i])
+            i += 1
+        self.A = _Base(msA)
+        self.B = _Base(msB)
+
+        def dev_base(base: _Base):
+            return dict(
+                m=jnp.asarray(base.m, I32),
+                m_f=jnp.asarray(base.m, F32),
+                inv_f=jnp.asarray(1.0 / base.m, F32),
+                inv_Mi=jnp.asarray(base.inv_Mi, I32),
+            )
+
+        self.dA = dev_base(self.A)
+        self.dB = dev_base(self.B)
+        self.W_AB = _split_mat(_ext_matrix(self.A, self.B))
+        self.W_BA = _split_mat(_ext_matrix(self.B, self.A))
+        self.Amod_B = jnp.asarray(
+            [self.A.prod % int(m) for m in self.B.m], I32)
+        self.Bmod_A = jnp.asarray(
+            [self.B.prod % int(m) for m in self.A.m], I32)
+        self.invA_B = jnp.asarray(
+            [pow(self.A.prod % int(m), -1, int(m)) for m in self.B.m],
+            I32)
+        ppr = [(-pow(p, -1, int(m))) % int(m) for m in self.A.m]
+        self.sig_c = jnp.asarray(
+            [(v * int(inv)) % int(m) for v, inv, m in
+             zip(ppr, self.A.inv_Mi, self.A.m)], I32)[:, None]
+        self.p_B = jnp.asarray([p % int(m) for m in self.B.m],
+                               I32)[:, None]
+        self.cp_A = jnp.asarray(
+            [[(c * p) % int(m) for m in self.A.m] for c in range(maxc)],
+            I32)
+        self.cp_B = jnp.asarray(
+            [[(c * p) % int(m) for m in self.B.m] for c in range(maxc)],
+            I32)
+        self.consts = (self.dA, self.dB, self.W_AB, self.W_BA,
+                       self.Amod_B, self.Bmod_A, self.invA_B)
+        self.a_mod_p = self.A.prod % p
+        a2 = (self.A.prod * self.A.prod) % p
+        self.A2 = (jnp.asarray([a2 % int(m) for m in self.A.m],
+                               I32)[:, None],
+                   jnp.asarray([a2 % int(m) for m in self.B.m],
+                               I32)[:, None])
+
+        def conv_mat(base: _Base):
+            t = np.empty((base.count, k_limbs), np.int64)
+            for ll in range(k_limbs):
+                t[:, ll] = np.asarray(
+                    [pow(2, 16 * ll, int(m)) for m in base.m], np.int64)
+            return _split_mat(t)
+
+        self.T_A = conv_mat(self.A)
+        self.T_B = conv_mat(self.B)
+        self.to_limbs = RNSToLimbs(self.A, k_limbs + 1)
+
+    def residues_of(self, x: int) -> np.ndarray:
+        """Plain host int → concatenated [I_A + I_B] residue row."""
+        return np.asarray(
+            [x % int(m) for m in self.A.m]
+            + [x % int(m) for m in self.B.m], np.int64)
+
+
 class RNSToLimbs:
     """Device CRT reconstruction: base-A residues → 16-bit limb arrays.
 

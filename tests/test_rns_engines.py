@@ -1,0 +1,125 @@
+"""RNS-engine conformance with the accelerator path FORCED on CPU.
+
+The CI suite runs on CPU where use_rns() defaults off (the limb path
+compiles much faster there) — these tests pin the RNS engines' parity
+against the CPU oracle for every family that has one: ECDSA
+(ES256/ES384/ES512 incl. tamper, cross-key, degenerate r/s), Ed25519
+(incl. non-canonical S and bad keys), and the PSS modexp-to-limbs
+path. Small key counts/batches keep CPU compile time bounded.
+"""
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _force_rns(monkeypatch):
+    monkeypatch.setenv("CAP_TPU_RNS", "1")
+    yield
+
+
+from cap_tpu import testing as captest  # noqa: E402
+from cap_tpu.errors import InvalidSignatureError  # noqa: E402
+from cap_tpu.jwt.jwk import JWK  # noqa: E402
+from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet  # noqa: E402
+
+
+def _parity(jwks, batch):
+    """Batch verdicts must equal the keyset's own single-token CPU
+    path (which carries the kid-routing semantics), and, for tokens
+    with consistent kids, the trial-verify StaticKeySet oracle."""
+    ks = TPUBatchKeySet(jwks)
+    res = ks.verify_batch(batch)
+    for i, (t, r) in enumerate(zip(batch, res)):
+        try:
+            ks.verify_signature(t)
+            want = True
+        except Exception:  # noqa: BLE001 - oracle verdict only
+            want = False
+        assert (not isinstance(r, Exception)) == want, (i, type(r), r)
+    return res
+
+
+@pytest.mark.parametrize("alg", ["ES256", "ES384", "ES512"])
+def test_ecdsa_rns_parity(alg):
+    jwks, privs = [], []
+    for i in range(2):
+        priv, pub = captest.generate_keys(alg)
+        jwks.append(JWK(pub, kid=f"k{i}"))
+        privs.append(priv)
+    claims = captest.default_claims()
+    toks = [captest.sign_jwt(privs[i % 2], alg, claims, kid=f"k{i % 2}")
+            for i in range(6)]
+    tam = toks[0][:-8] + ("AAAAAAAA" if not toks[0].endswith("AAAAAAAA")
+                          else "BBBBBBBB")
+    cross = captest.sign_jwt(privs[0], alg, claims, kid="k1")  # wrong kid
+    res = _parity(jwks, toks + [tam, cross])
+    assert isinstance(res[-2], InvalidSignatureError)
+    assert isinstance(res[-1], InvalidSignatureError)
+
+
+def test_ecdsa_rns_degenerate_rs():
+    """r = 0 / s = 0 / r,s ≥ n style forgeries must reject (range)."""
+    import base64
+
+    priv, pub = captest.generate_keys("ES256")
+    good = captest.sign_jwt(priv, "ES256", captest.default_claims(),
+                            kid="k0")
+    head, payload, _ = good.split(".")
+    zero_sig = base64.urlsafe_b64encode(b"\x00" * 64).rstrip(b"=").decode()
+    ff_sig = base64.urlsafe_b64encode(b"\xff" * 64).rstrip(b"=").decode()
+    bad1 = f"{head}.{payload}.{zero_sig}"
+    bad2 = f"{head}.{payload}.{ff_sig}"
+    res = _parity([JWK(pub, kid="k0")], [good, bad1, bad2])
+    assert not isinstance(res[0], Exception)
+    assert isinstance(res[1], Exception) and isinstance(res[2], Exception)
+
+
+def test_ed25519_rns_parity():
+    jwks, privs = [], []
+    for i in range(2):
+        priv, pub = captest.generate_keys("EdDSA")
+        jwks.append(JWK(pub, kid=f"e{i}"))
+        privs.append(priv)
+    claims = captest.default_claims()
+    toks = [captest.sign_jwt(privs[i % 2], "EdDSA", claims, kid=f"e{i % 2}")
+            for i in range(6)]
+    tam = toks[0][:-8] + ("AAAAAAAA" if not toks[0].endswith("AAAAAAAA")
+                          else "BBBBBBBB")
+    res = _parity(jwks, toks + [tam])
+    assert isinstance(res[-1], InvalidSignatureError)
+
+
+def test_ed25519_rns_noncanonical_s():
+    """S + L forgeries (signature malleability) must reject."""
+    import base64
+
+    from cap_tpu.tpu.ed25519 import L_ORDER
+
+    priv, pub = captest.generate_keys("EdDSA")
+    good = captest.sign_jwt(priv, "EdDSA", captest.default_claims(),
+                            kid="e0")
+    head, payload, sig_b64 = good.split(".")
+    sig = base64.urlsafe_b64decode(sig_b64 + "==")
+    s_int = int.from_bytes(sig[32:], "little")
+    forged = sig[:32] + ((s_int + L_ORDER) % (1 << 256)).to_bytes(
+        32, "little")
+    forged_b64 = base64.urlsafe_b64encode(forged).rstrip(b"=").decode()
+    res = _parity([JWK(pub, kid="e0")],
+                  [good, f"{head}.{payload}.{forged_b64}"])
+    assert not isinstance(res[0], Exception)
+    assert isinstance(res[1], Exception)
+
+
+def test_pss_rns_parity():
+    jwks, privs = [], []
+    for i in range(2):
+        priv, pub = captest.generate_keys("PS256", rsa_bits=1024)
+        jwks.append(JWK(pub, kid=f"p{i}"))
+        privs.append(priv)
+    claims = captest.default_claims()
+    toks = [captest.sign_jwt(privs[i % 2], "PS256", claims, kid=f"p{i % 2}")
+            for i in range(4)]
+    tam = toks[0][:-8] + ("AAAAAAAA" if not toks[0].endswith("AAAAAAAA")
+                          else "BBBBBBBB")
+    res = _parity(jwks, toks + [tam])
+    assert isinstance(res[-1], InvalidSignatureError)

@@ -185,7 +185,7 @@ def test_remote_keyset_rotation():
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     try:
         url = f"http://127.0.0.1:{srv.server_address[1]}/jwks"
-        ks = TPURemoteKeySet(url)
+        ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
         claims = captest.default_claims()
         tok1 = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
         out = ks.verify_batch([tok1] * 4)
@@ -215,5 +215,21 @@ def test_remote_keyset_rotation():
         out = ks.verify_batch([tok1])
         assert isinstance(out[0], Exception)
         assert state["fetches"] == fetches_before + 2
+
+        # attacker-style random unknown kids: the refresh cooldown and
+        # the unchanged-content check bound fetches and table rebuilds
+        ks2 = TPURemoteKeySet(url, min_refresh_interval=1000.0)
+        ks2.verify_batch([tok2])               # builds table, 1 fetch
+        fetches = state["fetches"]
+        table_obj = ks2._ks
+        forged2 = captest.sign_jwt(priv1, "ES256", claims, kid="evil-1")
+        forged3 = captest.sign_jwt(priv1, "ES256", claims, kid="evil-2")
+        out = ks2.verify_batch([forged2])
+        assert isinstance(out[0], Exception)
+        out = ks2.verify_batch([forged3])
+        assert isinstance(out[0], Exception)
+        assert state["fetches"] <= fetches + 1   # cooldown caps fetches
+        assert ks2._ks is table_obj              # content unchanged →
+        #                                          no table rebuild
     finally:
         srv.shutdown()
