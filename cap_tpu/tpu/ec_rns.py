@@ -140,14 +140,14 @@ def rsel(mask, a, b):
 def congruent_zero(c: ECRNSContext, x, max_c: int):
     """[N] bool: value(x) ≡ 0 (mod p), for values < max_c·p.
 
+    Base A alone decides: every value in play is ≪ prod(A), so its
+    A-residues determine it uniquely — no need to compare base B.
     Accepts lazily-grown digits (fixes internally before comparing).
     """
     xa = _fixA(c, x[0])
-    xb = _fixB(c, x[1])
     ok = jnp.zeros(xa.shape[1], bool)
     for cc in range(max_c):
-        ok = ok | (jnp.all(xa == c.cp_A[cc][:, None], axis=0)
-                   & jnp.all(xb == c.cp_B[cc][:, None], axis=0))
+        ok = ok | jnp.all(xa == c.cp_A[cc][:, None], axis=0)
     return ok
 
 
