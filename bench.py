@@ -21,9 +21,9 @@ quantiles go to stderr for the full picture.
 """
 
 import json
+import math
 import os
 import statistics
-import subprocess
 import sys
 import time
 
@@ -39,11 +39,8 @@ def _ensure_native() -> None:
                       "libcapruntime.so")
     if os.path.exists(so):
         return
-    try:
-        subprocess.run(["make", "-C", REPO, "native"], capture_output=True,
-                       timeout=180, check=False)
-    except Exception:
-        pass  # Python prep fallback still works
+    from cap_tpu._build import build_native
+    build_native()
 
 
 def _make_fixtures(n_unique: int):
@@ -100,20 +97,30 @@ def main() -> None:
         dt = time.perf_counter() - t0
         rates.append(batch / dt)
         lats.append(dt)
-    value = max(rates)
+    value = max(rates)                       # peak rep (tunnel variance)
+    median = statistics.median(rates)
 
     # Per-rep rates + batch latency quantiles (BASELINE.md tracked
     # metric) → stderr so stdout stays the single driver JSON line.
-    lats.sort()
+    slats = sorted(lats)
+    p99 = slats[max(0, math.ceil(0.99 * len(slats)) - 1)]  # nearest rank
     print(f"reps={[round(r, 0) for r in rates]} "
-          f"batch_latency_s p50={lats[len(lats) // 2]:.3f} "
-          f"max={lats[-1]:.3f} batch={batch}", file=sys.stderr)
+          f"batch_latency_s p50={slats[len(slats) // 2]:.3f} "
+          f"p99={p99:.3f} max={slats[-1]:.3f} batch={batch}",
+          file=sys.stderr)
 
+    # value = peak rep; value_median alongside so downstream consumers
+    # see typical throughput, not just the best tunnel window
+    # (ADVICE r1); p99 batch latency is the BASELINE.json tracked
+    # latency metric.
     print(json.dumps({
         "metric": "jwt_verifies_per_sec_rs256_es256_16key_jwks",
         "value": round(value, 1),
         "unit": "verifies/sec",
         "vs_baseline": round(value / BASELINE_TARGET, 4),
+        "value_median": round(median, 1),
+        "p99_batch_latency_s": round(p99, 3),
+        "batch": batch,
     }))
 
 

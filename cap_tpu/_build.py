@@ -1,0 +1,33 @@
+"""Build the native runtime libraries on demand.
+
+The compiled ``.so`` artifacts are not committed (they are unreviewable
+and go stale silently); ``make native`` produces them, and the ctypes
+bindings call :func:`build_native` on first use when the library is
+missing. Failures are non-fatal — every native component has a pure
+Python fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_lock = threading.Lock()
+_done = False
+
+
+def build_native(timeout: float = 180.0) -> None:
+    """Run ``make -C <repo> native`` once, quietly, best-effort."""
+    global _done
+    with _lock:
+        if _done:
+            return
+        _done = True
+        try:
+            subprocess.run(["make", "-C", _REPO, "native"],
+                           capture_output=True, timeout=timeout,
+                           check=False)
+        except Exception:  # noqa: BLE001 - fallbacks handle absence
+            pass

@@ -600,9 +600,13 @@ class TPURemoteKeySet(KeySet):
                     return self._ks
             elif self._ks is not None:
                 return self._ks
-            jwks = self._remote.keys(refresh=refresh)
             if refresh:
+                # Stamp BEFORE the fetch: a failing IdP (slow connect
+                # timeout) must also respect the cooldown, or an
+                # attacker feeding unknown kids makes every batch block
+                # on a doomed fetch while holding the lock.
                 self._last_refresh = time.monotonic()
+            jwks = self._remote.keys(refresh=refresh)
             kids = {j.kid for j in jwks if j.kid}
             if self._ks is None or kids != self._kids:
                 self._ks = TPUBatchKeySet(jwks, max_chunk=self._max_chunk)
@@ -634,8 +638,17 @@ class TPURemoteKeySet(KeySet):
                 missed.append(i)
         if missed:
             telemetry.count("jwks.rotation_refetch")
-            ks = self._ensure(refresh=True)
-            retry = ks.verify_batch([tokens[i] for i in missed])
-            for i, r in zip(missed, retry):
-                results[i] = r
+            # A failed refetch (IdP hiccup, network error) must not
+            # discard the whole batch's verdicts: behind AdaptiveBatcher
+            # one attacker token with a random kid would otherwise fan
+            # the exception out to every coalesced caller. Keep the
+            # original per-token InvalidSignatureError results instead.
+            try:
+                ks = self._ensure(refresh=True)
+                retry = ks.verify_batch([tokens[i] for i in missed])
+            except Exception:  # noqa: BLE001 - network/IdP failure
+                telemetry.count("jwks.rotation_refetch_failed")
+            else:
+                for i, r in zip(missed, retry):
+                    results[i] = r
         return results

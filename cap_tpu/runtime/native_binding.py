@@ -23,6 +23,10 @@ from ..errors import MalformedTokenError, TokenNotSignedError
 
 _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "native", "libcapruntime.so")
+if not os.path.exists(_LIB_PATH):
+    # Build artifacts are not committed (ADVICE r1): build on first use.
+    from .._build import build_native
+    build_native()
 _lib = ctypes.CDLL(_LIB_PATH)
 
 ALG_NAMES = ["RS256", "RS384", "RS512", "ES256", "ES384", "ES512",

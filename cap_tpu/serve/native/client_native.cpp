@@ -39,7 +39,20 @@ constexpr uint64_t kMaxFrameBytes = 1ull << 28;
 
 struct Client {
   int fd = -1;
+  // Set on any transport/parse error: the socket may hold unread
+  // response bytes, so a retry on the same handle would misparse
+  // subsequent frames. Poisoned handles fail fast instead.
+  bool dead = false;
 };
+
+int poison(Client* c) {
+  c->dead = true;
+  if (c->fd >= 0) {
+    ::close(c->fd);
+    c->fd = -1;
+  }
+  return -1;
+}
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -111,14 +124,16 @@ void* cap_client_connect_uds(const char* path) {
 // Liveness probe. 1 on pong, 0 on failure.
 int cap_client_ping(void* handle) {
   auto* c = static_cast<Client*>(handle);
+  if (c->dead) return 0;
   std::string frame;
   put_u32(frame, kMagic);
   frame.push_back(static_cast<char>(kPing));
   put_u32(frame, 0);
-  if (!send_all(c->fd, frame.data(), frame.size())) return 0;
+  if (!send_all(c->fd, frame.data(), frame.size())) return poison(c), 0;
   uint8_t hdr[9];
-  if (!recv_all(c->fd, hdr, 9)) return 0;
-  return hdr[4] == kPong;
+  if (!recv_all(c->fd, hdr, 9)) return poison(c), 0;
+  if (hdr[4] != kPong) return poison(c), 0;
+  return 1;
 }
 
 // Verify a batch.
@@ -135,6 +150,7 @@ int cap_client_verify(void* handle, const char** tokens,
                       uint8_t* statuses, char* payload_buf,
                       uint64_t payload_cap, uint64_t* payload_off) {
   auto* c = static_cast<Client*>(handle);
+  if (c->dead) return -1;
   std::string frame;
   frame.reserve(9 + 512 * count);
   put_u32(frame, kMagic);
@@ -144,33 +160,33 @@ int cap_client_verify(void* handle, const char** tokens,
     put_u32(frame, token_lens[i]);
     frame.append(tokens[i], token_lens[i]);
   }
-  if (!send_all(c->fd, frame.data(), frame.size())) return -1;
+  if (!send_all(c->fd, frame.data(), frame.size())) return poison(c);
 
   uint8_t hdr[9];
-  if (!recv_all(c->fd, hdr, 9)) return -1;
+  if (!recv_all(c->fd, hdr, 9)) return poison(c);
   uint32_t magic, n;
   std::memcpy(&magic, hdr, 4);
   std::memcpy(&n, hdr + 5, 4);
-  if (magic != kMagic || hdr[4] != kVerifyResp || n != count) return -1;
+  if (magic != kMagic || hdr[4] != kVerifyResp || n != count) return poison(c);
 
   uint64_t off = 0;
   char sink[65536];
   for (uint32_t i = 0; i < count; i++) {
     uint8_t entry[5];
-    if (!recv_all(c->fd, entry, 5)) return -1;
+    if (!recv_all(c->fd, entry, 5)) return poison(c);
     uint32_t ln;
     std::memcpy(&ln, entry + 1, 4);
-    if (ln > kMaxEntryBytes || off + ln > kMaxFrameBytes) return -1;
+    if (ln > kMaxEntryBytes || off + ln > kMaxFrameBytes) return poison(c);
     statuses[i] = entry[0];
     payload_off[i] = off;
     if (off + ln <= payload_cap) {
-      if (!recv_all(c->fd, payload_buf + off, ln)) return -1;
+      if (!recv_all(c->fd, payload_buf + off, ln)) return poison(c);
     } else {
       // drain in bounded chunks so the connection stays usable, then
       // report the required size via payload_off[count]
       for (uint32_t left = ln; left;) {
         uint32_t take = left < sizeof(sink) ? left : sizeof(sink);
-        if (!recv_all(c->fd, sink, take)) return -1;
+        if (!recv_all(c->fd, sink, take)) return poison(c);
         left -= take;
       }
     }

@@ -20,6 +20,9 @@ _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native",
 
 def _load():
     if not os.path.exists(_SO):
+        from .._build import build_native
+        build_native()
+    if not os.path.exists(_SO):
         raise ImportError(f"{_SO} not built (run: make native)")
     lib = ctypes.CDLL(_SO)
     lib.cap_client_connect.restype = ctypes.c_void_p

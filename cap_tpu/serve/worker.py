@@ -95,6 +95,12 @@ class VerifyWorker:
                     ftype, entries = protocol.recv_frame(conn)
                 except (ConnectionError, OSError):
                     return
+                except (protocol.ProtocolError, UnicodeDecodeError):
+                    # Malformed frame (attacker-spammable): drop the
+                    # connection quietly instead of letting the
+                    # exception escape the thread as stderr noise.
+                    telemetry.count("worker.protocol_errors")
+                    return
                 if ftype == protocol.T_PING:
                     protocol.send_pong(conn)
                     continue

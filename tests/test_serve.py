@@ -199,3 +199,33 @@ def test_native_client_roundtrip():
         assert res[4] == {"sub": "n1.ok"}
     finally:
         w.close()
+
+
+def test_worker_drops_malformed_frames_quietly(stub_worker):
+    """A garbage frame (bad magic / non-UTF8 token bytes) drops the
+    connection without an unhandled-exception traceback and bumps the
+    worker.protocol_errors counter (ADVICE r1)."""
+    import socket
+    import struct
+
+    ks, w = stub_worker
+    host, port = w.address
+    with telemetry.recording() as rec:
+        # bad magic
+        s = socket.create_connection((host, port))
+        s.sendall(b"\xde\xad\xbe\xef" + b"\x01" + struct.pack("<I", 0))
+        assert s.recv(1) == b""      # worker closed the connection
+        s.close()
+
+        # valid header, token bytes that are not UTF-8
+        s = socket.create_connection((host, port))
+        s.sendall(struct.pack("<IBI", 0x31425643, 1, 1)
+                  + struct.pack("<I", 4) + b"\xff\xfe\xff\xfe")
+        assert s.recv(1) == b""
+        s.close()
+    assert rec.counters().get("worker.protocol_errors", 0) >= 2
+
+    # the worker still serves new connections afterwards
+    with VerifyClient(host, port) as c:
+        assert c.ping()
+        assert c.verify_batch(["z.ok"])[0] == {"sub": "z.ok"}

@@ -233,3 +233,49 @@ def test_remote_keyset_rotation():
         #                                          no table rebuild
     finally:
         srv.shutdown()
+
+
+def test_remote_keyset_refetch_failure_keeps_verdicts():
+    """A failed rotation refetch (IdP down) must NOT discard the batch's
+    verdicts: known-key results stay dicts, the unknown-kid token keeps
+    its per-token InvalidSignatureError (ADVICE r1, medium)."""
+    import json as jsonlib
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from cap_tpu.jwt.jwk import serialize_public_key
+    from cap_tpu.jwt.tpu_keyset import TPURemoteKeySet
+
+    priv1, pub1 = captest.generate_keys("ES256")
+    evil_priv, _ = captest.generate_keys("ES256")  # NOT in the JWKS
+    state = {"keys": [serialize_public_key(pub1, kid="gen1")]}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = jsonlib.dumps({"keys": state["keys"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/jwks"
+    ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
+    claims = captest.default_claims()
+    good = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
+    assert isinstance(ks.verify_batch([good])[0], dict)
+
+    # IdP goes away; a batch with one attacker token (unknown kid)
+    # plus legitimate tokens must still return per-token verdicts.
+    srv.shutdown()
+    srv.server_close()
+    evil = captest.sign_jwt(evil_priv, "ES256", claims, kid="no-such-kid")
+    out = ks.verify_batch([good, evil, good])
+    assert isinstance(out[0], dict)
+    assert isinstance(out[1], InvalidSignatureError)
+    assert isinstance(out[2], dict)

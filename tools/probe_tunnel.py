@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Characterize the host<->device tunnel: bandwidth vs chunk size, whether
+concurrent transfer streams aggregate, and H2D/compute overlap.
+
+Methodology per docs/PERF.md: block_until_ready does not actually block on
+this stack; only value materialization (np.asarray) truly syncs.  So every
+measurement ends with a materializing read of a tiny reduction of the
+transferred data.
+"""
+
+import os
+import sys
+import time
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _sync(dev_arrays):
+    """Materialize a scalar that depends on every array (true sync)."""
+    tot = 0.0
+    for d in dev_arrays:
+        tot += float(jnp.sum(d[:: max(1, d.size // 4)].astype(jnp.float32)))
+    return tot
+
+
+@jax.jit
+def _touch(x):
+    return jnp.sum(x.astype(jnp.float32))
+
+
+def bw_single(size_mb: float, reps: int = 3) -> float:
+    """One-stream H2D bandwidth, MB/s (best of reps)."""
+    n = int(size_mb * (1 << 20))
+    best = 0.0
+    for r in range(reps):
+        x = np.random.randint(0, 255, n, dtype=np.uint8)
+        t0 = time.perf_counter()
+        d = jax.device_put(x)
+        s = _touch(d)
+        float(s)
+        dt = time.perf_counter() - t0
+        best = max(best, size_mb / dt)
+    return best
+
+
+def bw_threads(n_threads: int, size_mb_each: float, reps: int = 3) -> float:
+    """Aggregate H2D bandwidth with n_threads concurrent device_put calls."""
+    n = int(size_mb_each * (1 << 20))
+    xs = [np.random.randint(0, 255, n, dtype=np.uint8)
+          for _ in range(n_threads)]
+    best = 0.0
+    for r in range(reps):
+        out = [None] * n_threads
+
+        def work(i):
+            out[i] = jax.device_put(xs[i])
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for d in out:
+            float(_touch(d))
+        dt = time.perf_counter() - t0
+        best = max(best, n_threads * size_mb_each / dt)
+    return best
+
+
+def overlap_test(size_mb: float = 4.0):
+    """Does H2D overlap with device compute?
+
+    Time (a) compute alone, (b) transfer alone, (c) dispatch compute then
+    transfer concurrently.  If (c) ~= max(a, b), overlap works.
+    """
+    n = int(size_mb * (1 << 20))
+
+    @jax.jit
+    def burn(a):
+        # ~enough matmuls to take O(100ms+)
+        for _ in range(8):
+            a = jnp.tanh(a @ a)
+        return jnp.sum(a)
+
+    a = jax.device_put(np.random.rand(2048, 2048).astype(np.float32))
+    float(burn(a))  # compile
+
+    t0 = time.perf_counter()
+    float(burn(a))
+    t_compute = time.perf_counter() - t0
+
+    x = np.random.randint(0, 255, n, dtype=np.uint8)
+    t0 = time.perf_counter()
+    d = jax.device_put(x)
+    float(_touch(d))
+    t_xfer = time.perf_counter() - t0
+
+    x2 = np.random.randint(0, 255, n, dtype=np.uint8)
+    t0 = time.perf_counter()
+    fut = burn(a)          # dispatched async
+    d2 = jax.device_put(x2)
+    float(_touch(d2))
+    float(fut)
+    t_both = time.perf_counter() - t0
+    return t_compute, t_xfer, t_both
+
+
+def main():
+    print(f"devices: {jax.devices()}", flush=True)
+    # warm up dispatch path
+    float(_touch(jax.device_put(np.zeros(1024, np.uint8))))
+
+    print("-- chunk size sweep (single stream, best-of-3, MB/s) --",
+          flush=True)
+    for mb in (0.25, 1, 4, 16, 64):
+        r = bw_single(mb)
+        print(f"  {mb:>6} MB: {r:8.1f} MB/s", flush=True)
+
+    print("-- concurrent streams (4 MB each, best-of-3, aggregate MB/s) --",
+          flush=True)
+    for nt in (1, 2, 4, 8, 16):
+        r = bw_threads(nt, 4.0)
+        print(f"  {nt:>2} threads: {r:8.1f} MB/s", flush=True)
+
+    print("-- dtype check (16MB, u8 vs i32 same byte count) --", flush=True)
+    n = 16 << 20
+    x8 = np.random.randint(0, 255, n, dtype=np.uint8)
+    x32 = np.random.randint(0, 2**31 - 1, n // 4, dtype=np.int32)
+    for name, x in (("u8", x8), ("i32", x32)):
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            d = jax.device_put(x)
+            float(_touch(d))
+            best = max(best, 16.0 / (time.perf_counter() - t0))
+        print(f"  {name}: {best:8.1f} MB/s", flush=True)
+
+    print("-- overlap test --", flush=True)
+    tc, tx, tb = overlap_test(8.0)
+    print(f"  compute={tc:.3f}s xfer={tx:.3f}s both={tb:.3f}s "
+          f"(sum={tc+tx:.3f}, overlap {'YES' if tb < 0.75*(tc+tx) else 'NO'})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
