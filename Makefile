@@ -26,3 +26,9 @@ bench: native
 
 clean:
 	rm -f $(NATIVE_SO) $(CLIENT_SO)
+
+test-all: native
+	python -m pytest tests/ -q -m ""
+
+golden-go:
+	python tools/gen_go_golden.py

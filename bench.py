@@ -69,6 +69,9 @@ def _make_fixtures(n_unique: int):
 
 def main() -> None:
     _ensure_native()
+    from cap_tpu import compile_cache
+
+    compile_cache.enable()
 
     batch = int(os.environ.get("CAP_BENCH_BATCH", 1 << 16))
     reps = int(os.environ.get("CAP_BENCH_REPS", 4))

@@ -58,10 +58,16 @@ class TPUBatchKeySet(KeySet):
     Construct from JWKs (key + kid metadata). Single-token
     ``verify_signature`` uses the CPU oracle; ``verify_batch`` buckets
     and dispatches to the device.
+
+    ``mesh``: an optional ``jax.sharding.Mesh`` — every packed chunk
+    (RS*/ES*/EdDSA) then shards along the batch axis across the mesh's
+    devices with replicated key tables (SURVEY.md §2.6 batch-DP +
+    key-gather; validated on the virtual 8-device mesh by
+    tests/test_parallel.py and the driver's dryrun_multichip).
     """
 
     def __init__(self, jwks: Sequence[JWK], max_chunk: int = 32768,
-                 cpu_fallback: bool = True):
+                 cpu_fallback: bool = True, mesh=None):
         from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
 
         if not jwks:
@@ -69,6 +75,7 @@ class TPUBatchKeySet(KeySet):
         self._jwks = list(jwks)
         self._max_chunk = max_chunk
         self._cpu_fallback = cpu_fallback
+        self._mesh = mesh
 
         # Partition keys into family tables; remember each JWK's slot.
         rsa_numbers, self._rsa_rows = [], {}
@@ -182,12 +189,18 @@ class TPUBatchKeySet(KeySet):
             results[int(i)] = pb.error(int(i))
 
         slow: List[int] = []
-        # Two-phase device interaction: every bucket's program is
-        # DISPATCHED first (async — jax queues them back-to-back), then
-        # one materializing sync wave collects verdicts. This pays the
-        # host↔device round-trip latency once per batch instead of once
-        # per bucket.
+        # Two-phase device interaction: every bucket's device work is
+        # DISPATCHED first, then one materializing sync wave collects
+        # verdicts. Hot families (RS*, ES*) go through the PACKED path:
+        # one u8 record transfer + one compiled program per chunk, and
+        # every chunk's [pad] bool verdict is concatenated device-side
+        # so the whole batch costs ONE host↔device materialization.
+        # Compute-heavy families dispatch first so their device time
+        # overlaps the later families' H2D transfers (the wire is the
+        # binding resource — docs/PERF.md).
         pending: List[tuple] = []
+        packed_parts: List[Any] = []      # device [pad] bool arrays
+        packed_meta: List[tuple] = []     # (n_slots, consume(arrs))
         alg_ids = {name: i for i, name in enumerate(ALG_NAMES)}
 
         def run_family(alg_name: str, runner) -> None:
@@ -197,31 +210,53 @@ class TPUBatchKeySet(KeySet):
             runner(alg_name, idx)
 
         def run_rs(alg_name: str, idx: np.ndarray) -> None:
-            self._run_rsa_arrays("rs", _RS[alg_name], idx, pb, pending,
-                                 slow)
+            self._run_rsa_packed(_RS[alg_name], idx, pb, packed_parts,
+                                 packed_meta, pending, slow, results)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
             self._run_rsa_arrays("ps", _PS[alg_name], idx, pb, pending,
                                  slow)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
-            self._run_ec_arrays(alg_name, idx, pb, pending, slow)
+            self._run_ec_packed(alg_name, idx, pb, packed_parts,
+                                packed_meta, pending, slow, results)
 
         def run_ed(alg_name: str, idx: np.ndarray) -> None:
-            self._run_ed_arrays(idx, pb, pending, slow)
+            self._run_ed_packed(idx, pb, packed_parts, packed_meta,
+                                pending, slow, results)
 
-        if self._rsa_table is not None:
-            for a in _RS:
-                run_family(a, run_rs)
-            for a in _PS:
-                run_family(a, run_ps)
         for a, crv in _ES.items():
             if crv in self._ec_tables:
                 run_family(a, run_es)
         if self._ed_table is not None:
             run_family(algs.EdDSA, run_ed)
+        if self._rsa_table is not None:
+            for a in _RS:
+                run_family(a, run_rs)
+            for a in _PS:
+                run_family(a, run_ps)
 
         with telemetry.span("device.sync"):
+            if packed_parts:
+                import jax.numpy as jnp
+
+                flat_dev = (jnp.concatenate(packed_parts)
+                            if len(packed_parts) > 1 else packed_parts[0])
+                # Overlap the host-side claims JSON parsing with the
+                # device drain (transfers + compute are still in
+                # flight; only np.asarray below truly blocks).
+                with telemetry.span("claims.prefetch"):
+                    pb.prefetch_claims(
+                        i for i in np.nonzero(ok)[0]
+                        if results[int(i)] is None)
+                flat = np.asarray(flat_dev)
+                off = 0
+                for n_slots, consume in packed_meta:
+                    arrs = []
+                    for sz in n_slots:
+                        arrs.append(flat[off:off + sz])
+                        off += sz
+                    consume(arrs)
             for chunk, m, fin in pending:
                 self._finish_arrays(chunk, fin()[:m], pb, results)
 
@@ -252,6 +287,126 @@ class TPUBatchKeySet(KeySet):
                 results[j] = InvalidSignatureError(
                     "no known key successfully validated the token "
                     "signature")
+
+    def _chunk_tokens(self, rec_width: int) -> int:
+        """Tokens per packed chunk: target ~5 MB transfers (the tunnel's
+        bandwidth sweet spot, tools/probe_tunnel.py), pow-2 for shape
+        reuse, capped at max_chunk."""
+        c = 1024
+        while c * 2 * rec_width <= (5 << 20):
+            c *= 2
+        return min(self._max_chunk, max(1024, c))
+
+    def _run_rsa_packed(self, hash_name: str, idx: np.ndarray, pb,
+                        packed_parts: List[Any],
+                        packed_meta: List[tuple],
+                        pending: List[tuple],
+                        slow: List[int], results: List[Any]) -> None:
+        from ..tpu import rsa as tpursa
+
+        table = self._rsa_table
+        if len(table.n_ints) > 255:        # kid row must fit a u8
+            return self._run_rsa_arrays("rs", hash_name, idx, pb,
+                                        pending, slow)
+        rows = pb.kid_rows(idx, self._kid_rsa_row)
+        if len(table.n_ints) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        width = 2 * table.k
+        h_len = tpursa.HASH_LEN[hash_name]
+        chunk_n = self._chunk_tokens(width + h_len + tpursa.RS_REC_EXTRA)
+        for lo in range(0, len(idx), chunk_n):
+            chunk = idx[lo: lo + chunk_n]
+            crows = rows[lo: lo + chunk_n]
+            m = len(chunk)
+            pad = _pad_size(m, chunk_n)
+            sig_mat = np.zeros((pad, width), np.uint8)
+            sig_mat[:m] = pb.sig_matrix(chunk, width)
+            sig_lens = np.zeros(pad, np.int64)
+            sig_lens[:m] = pb.sig_len[chunk]
+            hash_mat = np.zeros((pad, 64), np.uint8)
+            hash_mat[:m] = pb.digest[chunk]
+            key_idx = np.zeros(pad, np.int32)
+            key_idx[:m] = crows
+            telemetry.count("device.rs.tokens", m)
+            with telemetry.span(f"dispatch.rs.{hash_name}"):
+                rec = tpursa.rs_packed_records(
+                    table, sig_mat, sig_lens, hash_mat, hash_name,
+                    key_idx)
+                ok_dev = tpursa.verify_rs_packed_pending(
+                    table, rec, hash_name, mesh=self._mesh)
+            packed_parts.append(ok_dev)
+
+            def consume(arrs, chunk=chunk, m=m):
+                self._finish_arrays(chunk, arrs[0][:m], pb, results)
+
+            packed_meta.append(([pad], consume))
+
+    def _run_ec_packed(self, alg: str, idx: np.ndarray, pb,
+                       packed_parts: List[Any],
+                       packed_meta: List[tuple],
+                       pending: List[tuple],
+                       slow: List[int], results: List[Any]) -> None:
+        from ..tpu import ec as tpuec
+        from ..tpu.rsa import HASH_LEN
+
+        crv = _ES[alg]
+        table = self._ec_tables[crv]
+        if len(table.keys) > 255:
+            return self._run_ec_arrays(alg, idx, pb, pending, slow)
+        hash_len = HASH_LEN[algs.HASH_FOR_ALG[alg]]
+        rows = pb.kid_rows(idx, self._kid_ec_row[crv])
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        cb = table.curve.coord_bytes
+        width = 2 * cb
+        chunk_n = self._chunk_tokens(width + hash_len + tpuec.ES_REC_EXTRA)
+        for lo in range(0, len(idx), chunk_n):
+            chunk = idx[lo: lo + chunk_n]
+            crows = rows[lo: lo + chunk_n]
+            m = len(chunk)
+            pad = _pad_size(m, chunk_n)
+            sig_mat = np.zeros((pad, width), np.uint8)
+            sig_mat[:m] = pb.sig_matrix(chunk, width)
+            sig_lens = np.zeros(pad, np.int64)
+            sig_lens[:m] = pb.sig_len[chunk]
+            hash_mat = np.zeros((pad, 64), np.uint8)
+            hash_mat[:m] = pb.digest[chunk]
+            key_idx = np.zeros(pad, np.int32)
+            key_idx[:m] = crows
+            telemetry.count("device.es.tokens", m)
+            with telemetry.span(f"dispatch.es.{crv}"):
+                rec = tpuec.es_packed_records(
+                    table, sig_mat, sig_lens, hash_mat, hash_len,
+                    key_idx)
+                ok_dev, deg_dev = tpuec.verify_es_packed_pending(
+                    table, rec, hash_len, mesh=self._mesh)
+            packed_parts.append(ok_dev)
+            packed_parts.append(deg_dev)
+
+            def consume(arrs, chunk=chunk, m=m, rec=rec, crows=crows,
+                        table=table, cb=cb, hash_len=hash_len):
+                okv = np.array(arrs[0][:m])
+                deg = arrs[1][:m]
+                for j in np.nonzero(deg)[0]:
+                    okv[j] = tpuec._cpu_verify_one(
+                        table, int(crows[j]),
+                        rec[j, : 2 * cb].tobytes(),
+                        rec[j, 2 * cb: 2 * cb + hash_len].tobytes())
+                self._finish_arrays(chunk, okv, pb, results)
+
+            packed_meta.append(([pad, pad], consume))
 
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
                         pb, pending: List[tuple],
@@ -334,6 +489,49 @@ class TPUBatchKeySet(KeySet):
                 fin = tpuec.verify_ecdsa_arrays_pending(
                     table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
             pending.append((chunk, m, fin))
+
+    def _run_ed_packed(self, idx: np.ndarray, pb,
+                       packed_parts: List[Any],
+                       packed_meta: List[tuple],
+                       pending: List[tuple],
+                       slow: List[int], results: List[Any]) -> None:
+        from ..tpu import ed25519 as tpued
+
+        table = self._ed_table
+        if len(table.keys) > 255:
+            return self._run_ed_arrays(idx, pb, pending, slow)
+        rows = pb.kid_rows(idx, self._kid_ed_row)
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        chunk_n = self._chunk_tokens(64 + 32 + tpued.ED_REC_EXTRA)
+        for lo in range(0, len(idx), chunk_n):
+            chunk = idx[lo: lo + chunk_n]
+            crows = rows[lo: lo + chunk_n]
+            m = len(chunk)
+            pad = _pad_size(m, chunk_n)
+            sigs = [pb.signature(int(j)) for j in chunk]
+            msgs = [pb.signing_input(int(j)) for j in chunk]
+            fill = pad - m
+            sigs += [b""] * fill
+            msgs += [b""] * fill
+            key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
+            telemetry.count("device.ed.tokens", m)
+            with telemetry.span("dispatch.ed25519"):
+                rec = tpued.ed_packed_records(table, sigs, msgs, key_idx)
+                ok_dev = tpued.verify_ed_packed_pending(
+                    table, rec, mesh=self._mesh)
+            packed_parts.append(ok_dev)
+
+            def consume(arrs, chunk=chunk, m=m):
+                self._finish_arrays(chunk, arrs[0][:m], pb, results)
+
+            packed_meta.append(([pad], consume))
 
     def _run_ed_arrays(self, idx: np.ndarray, pb,
                        pending: List[tuple], slow: List[int]) -> None:

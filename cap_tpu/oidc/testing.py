@@ -96,6 +96,11 @@ class TestProvider:
         provider = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 so clients exercise keep-alive connection reuse
+            # (the pooled-transport behavior the reference gets from
+            # cleanhttp, oidc/provider.go:566-618).
+            protocol_version = "HTTP/1.1"
+
             def do_GET(self):  # noqa: N802
                 provider._handle(self)
 
@@ -319,6 +324,7 @@ class TestProvider:
         h.send_response(status)
         h.send_header("Content-Type", content_type)
         h.send_header("Cache-Control", "no-store")
+        h.send_header("Content-Length", str(len(body)))  # keep-alive
         for k, v in (headers or {}).items():
             h.send_header(k, v)
         h.end_headers()
@@ -392,6 +398,7 @@ class TestProvider:
             {"state": state, "code": self.expected_auth_code})
         h.send_response(302)
         h.send_header("Location", location)
+        h.send_header("Content-Length", "0")  # keep-alive framing
         h.end_headers()
 
     def _with_hash_claims(self, nonce: str, access_token: str = "",

@@ -1,0 +1,54 @@
+"""Mesh placement helpers for the packed verify programs.
+
+The packed dispatch functions (rsa/ec/ed25519 ``verify_*_packed_pending``)
+take every device table as an explicit argument, so multi-chip execution
+needs exactly two placements (SURVEY.md §2.6 "sharded bignum kernels"):
+
+- the packed record matrix sharded along the batch axis
+  (``PartitionSpec(axis, None)``) — token data parallelism over ICI;
+- the key/window tables replicated (``PartitionSpec()``) — the key
+  gather then runs locally on every shard.
+
+XLA's GSPMD propagation partitions the whole verify program from those
+input shardings; the jit-captured RNS context constants replicate
+automatically. Validated on the virtual 8-device CPU mesh by
+tests/test_parallel.py and the driver's dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+_replicated_cache: Dict[Tuple[int, int], Any] = {}
+
+
+def batch_axis(mesh) -> str:
+    """The mesh axis the batch shards over (its first axis)."""
+    return mesh.axis_names[0]
+
+
+def shard_batch(mesh, arr):
+    """Place a host array sharded along axis 0 of the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = PartitionSpec(batch_axis(mesh), *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def replicated(mesh, arr):
+    """Mesh-replicated copy of a device array, cached per (mesh, array).
+
+    Cache keys are object ids; both the mesh (owned by the KeySet) and
+    the table arrays (owned by the key tables) outlive the cache entry's
+    usefulness, so ids are stable.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    key = (id(mesh), id(arr))
+    out = _replicated_cache.get(key)
+    if out is None:
+        out = jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))
+        _replicated_cache[key] = out
+    return out

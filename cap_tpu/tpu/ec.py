@@ -542,3 +542,113 @@ def verify_ecdsa_batch(table: ECKeyTable, sigs: Sequence[bytes],
         hash_mat[j] = np.frombuffer(h[:hash_len], np.uint8)
     return verify_ecdsa_arrays(table, sig_mat, sig_lens, hash_mat,
                                hash_len, key_idx)
+
+
+# ---------------------------------------------------------------------------
+# Packed single-transfer dispatch (see rsa.py's packed section: one u8
+# record matrix per chunk, one jitted program, sync deferred to the
+# batch-wide wave)
+# ---------------------------------------------------------------------------
+
+ES_REC_EXTRA = 2          # trailing bytes per record: flags, key row
+
+
+def es_packed_records(table: ECKeyTable, sig_mat: np.ndarray,
+                      sig_lens: np.ndarray, hash_mat: np.ndarray,
+                      hash_len: int, key_idx: np.ndarray) -> np.ndarray:
+    """Host: packed [N, 2·cb + hash_len + 2] u8 records for one ES* chunk.
+
+    Row layout: signature r‖s bytes (2·cb) ‖ digest (hash_len) ‖
+    validity flag u8 ‖ key row u8.
+    """
+    cb = table.curve.coord_bytes
+    len_ok = (sig_lens == 2 * cb).astype(np.uint8)
+    safe = np.where(len_ok[:, None] != 0, sig_mat[:, :2 * cb], 0)
+    rec = np.empty((sig_mat.shape[0], 2 * cb + hash_len + ES_REC_EXTRA),
+                   np.uint8)
+    rec[:, :2 * cb] = safe
+    rec[:, 2 * cb:2 * cb + hash_len] = hash_mat[:, :hash_len]
+    rec[:, 2 * cb + hash_len] = len_ok
+    rec[:, 2 * cb + hash_len + 1] = key_idx.astype(np.uint8)
+    return rec
+
+
+def _es_packed_rns_impl(packed, tqx, tqy, g_tabs, consts, *, crv: str,
+                        nbits: int, k: int, cb: int, hlen: int):
+    from . import ec_rns
+
+    sig = packed[:, :2 * cb]
+    dig = packed[:, 2 * cb:2 * cb + hlen]
+    flags = packed[:, 2 * cb + hlen] != 0
+    idx = packed[:, 2 * cb + hlen + 1].astype(jnp.int32)
+    r, s, e = _ec_prep(sig, dig, k=k)
+    ok, deg = ec_rns._ecdsa_rns_core(r, s, e, idx, tqx, tqy, *g_tabs,
+                                     *consts, crv=crv, nbits=nbits)
+    return ok & flags, deg & flags
+
+
+def _es_packed_limb_impl(packed, tqx, tqy, g_tabs, consts, *, nbits: int,
+                         n_windows: int, k: int, cb: int, hlen: int):
+    sig = packed[:, :2 * cb]
+    dig = packed[:, 2 * cb:2 * cb + hlen]
+    flags = packed[:, 2 * cb + hlen] != 0
+    idx = packed[:, 2 * cb + hlen + 1].astype(jnp.int32)
+    r, s, e = _ec_prep(sig, dig, k=k)
+    ok, deg = _ecdsa_core(r, s, e, idx, tqx, tqy, *g_tabs, *consts,
+                          nbits=nbits, n_windows=n_windows)
+    return ok & flags, deg & flags
+
+
+_es_packed_jits: Dict[str, object] = {}
+
+
+def _es_packed_jit(name: str, impl, static_names):
+    fn = _es_packed_jits.get(name)
+    if fn is None:
+        fn = jax.jit(impl, static_argnames=static_names)
+        _es_packed_jits[name] = fn
+    return fn
+
+
+def verify_es_packed_pending(table: ECKeyTable, rec: np.ndarray,
+                             hash_len: int, mesh=None):
+    """Dispatch one packed ES* chunk; returns device ([N] ok, [N] deg).
+
+    Degenerate-flagged tokens (deg True) must be re-verified on the CPU
+    oracle by the caller after the sync wave — same contract as
+    verify_ecdsa_arrays_pending. With a mesh the record shards along
+    the batch axis; tables replicate (SURVEY.md §2.6).
+    """
+    cp = table.curve
+    if mesh is not None:
+        from ..parallel.place import replicated, shard_batch
+
+        dev = shard_batch(mesh, rec)
+        place = lambda a: replicated(mesh, a)  # noqa: E731
+    else:
+        dev = jax.device_put(rec)
+        place = lambda a: a  # noqa: E731
+
+    from .rns import use_rns
+
+    if use_rns():
+        from . import ec_rns
+
+        rtab = table.rns()
+        consts = cp.device_consts()
+        fn = _es_packed_jit("rns", _es_packed_rns_impl,
+                            ("crv", "nbits", "k", "cb", "hlen"))
+        return fn(dev, place(rtab.tqx), place(rtab.tqy),
+                  tuple(place(a) for a in
+                        ec_rns.g_residue_tables(cp.name)),
+                  tuple(place(a) for a in consts[4:9]),
+                  crv=cp.name, nbits=cp.nbits,
+                  k=cp.k, cb=cp.coord_bytes, hlen=hash_len)
+    fn = _es_packed_jit("limb", _es_packed_limb_impl,
+                        ("nbits", "n_windows", "k", "cb", "hlen"))
+    return fn(dev, place(table.tqx), place(table.tqy),
+              tuple(place(a) for a in cp.g_tables()),
+              tuple(place(a) for a in cp.device_consts()),
+              nbits=cp.nbits,
+              n_windows=cp.n_windows, k=cp.k, cb=cp.coord_bytes,
+              hlen=hash_len)

@@ -198,6 +198,46 @@ def _madd_rns(c: ECRNSContext, X1, Y1, Z1, inf1, x2, y2):
     return X3, Y3, Z3, deg
 
 
+def _jadd_rns(c: ECRNSContext, X1, Y1, Z1, inf1, X2, Y2, Z2, inf2):
+    """Full Jacobian + Jacobian addition (2007-bl), RNS form.
+
+    Runs ONCE per verify batch (merging the two ladder accumulators),
+    so bounds are kept simple with eager rfixes. Inputs are
+    digit-canonical with values < 15p (X), < 11p (Y, Z) — the ladder's
+    invariants. Outputs match those invariants. Same-x pairs (P = ±Q)
+    are flagged degenerate for the CPU oracle, like _madd_rns.
+    """
+    z1z1, z2z2, z1z2 = rmul_many(
+        c, [(Z1, Z1), (Z2, Z2), (Z1, Z2)])           # < 3p, ≤ m
+    u1, u2, z1c, z2c = rmul_many(
+        c, [(X1, z2z2), (X2, z1z1), (Z1, z1z1), (Z2, z2z2)])  # < 3p, ≤ m
+    s1, s2 = rmul_many(c, [(Y1, z2c), (Y2, z1c)])    # < 3p, ≤ m
+    h = rsub(c, u2, u1, 4, guard=1)                  # < 7p, ≤ 3m
+    t = rsub(c, s2, s1, 4, guard=1)                  # < 7p, ≤ 3m
+    rr = rfix(c, radd(c, t, t))                      # < 14p, ≤ m
+    hh, r2_ = rmul_many(c, [(h, h), (rr, rr)])       # 9m², 196λ ✓ → ≤ m
+    i4 = radd(c, radd(c, hh, hh), radd(c, hh, hh))   # < 12p, ≤ 4m
+    zz2 = radd(c, z1z2, z1z2)                        # < 6p, ≤ 2m
+    j, v, z3 = rmul_many(
+        c, [(h, i4), (u1, i4), (zz2, h)])            # 12m², 84λ ✓ → ≤ m
+    v2 = radd(c, v, v)                               # < 6p, ≤ 2m
+    X3 = rfix(c, rsub(c, rsub(c, r2_, j, 4, guard=1), v2, 8,
+                      guard=2))                      # < 15p, ≤ m
+    vx = rsub(c, v, X3, 16, guard=1)                 # < 19p, ≤ 3m
+    t5, s1j = rmul_many(c, [(rr, vx), (s1, j)])      # 266λ ✓ → ≤ m
+    sj2 = radd(c, s1j, s1j)                          # < 6p, ≤ 2m
+    Y3 = rfix(c, rsub(c, t5, sj2, 8, guard=2))       # < 11p, ≤ m
+    Z3 = z3                                          # < 3p, ≤ m
+
+    both = ~inf1 & ~inf2
+    deg = both & congruent_zero(c, h, 8)             # same x (P = ±Q)
+    # infinity lanes: inf1 → P2, inf2 → P1
+    X3 = rsel(inf1, X2, rsel(inf2, X1, X3))
+    Y3 = rsel(inf1, Y2, rsel(inf2, Y1, Y3))
+    Z3 = rsel(inf1, Z2, rsel(inf2, Z1, Z3))
+    return X3, Y3, Z3, inf1 & inf2, deg
+
+
 # the A-domain representation of 1 (= A mod p) as residue columns
 def _one_dom(c: ECRNSContext):
     a_mod_p = c.A.prod % c.cp.p
@@ -258,18 +298,27 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         gy = jnp.take(tab_y, idx, axis=0).T
         return ((gx[:ia], gx[ia:]), (gy[:ia], gy[ia:]))
 
-    # 3. ladder with explicit infinity lane
+    # 3. TWO-ACCUMULATOR ladder: the per-window G-digit and Q-digit
+    # additions are independent chains, so both run as ONE mixed-add
+    # over a [I, 2N] concatenated state — the same 5 REDC layers per
+    # window serve both chains (half the dependency depth of
+    # interleaving them), and each layer's matmuls run at double batch
+    # width. The accumulators merge with a single full Jacobian add.
     n_tok = shape[1]
-    zA = jnp.zeros((c.A.count, n_tok), I32)
-    zB = jnp.zeros((c.B.count, n_tok), I32)
+    zA = jnp.zeros((c.A.count, 2 * n_tok), I32)
+    zB = jnp.zeros((c.B.count, 2 * n_tok), I32)
     X = (zA, zB)
     Y = (zA, zB)
     Z = (zA, zB)
-    inf = jnp.ones(n_tok, bool)
-    deg0 = jnp.zeros(n_tok, bool)
+    inf = jnp.ones(2 * n_tok, bool)
+    deg0 = jnp.zeros(2 * n_tok, bool)
     one_d = _one_dom(c)
 
-    def add_from_table(state, tab_x, tab_y, d, row0):
+    tab_x = jnp.concatenate([tgx, tqx], axis=0)
+    tab_y = jnp.concatenate([tgy, tqy], axis=0)
+    q_off = tgx.shape[0]
+
+    def add_from_table(state, d, row0):
         X, Y, Z, inf, deg = state
         has = d > 0
         idx = row0 + jnp.where(has, d - 1, 0)
@@ -293,12 +342,27 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     def ladder_body(i, state):
         d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
-        state = add_from_table(state, tgx, tgy, d1, i * per)
-        state = add_from_table(state, tqx, tqy, d2, key_base + i * per)
-        return state
+        d = jnp.concatenate([d1, d2])
+        row0 = jnp.concatenate(
+            [jnp.full((n_tok,), i * per, jnp.int32),
+             q_off + key_base + i * per])
+        return add_from_table(state, d, row0)
 
-    X, Y, Z, inf, deg = lax.fori_loop(
+    X2, Y2, Z2, inf2, deg2 = lax.fori_loop(
         0, n_windows, ladder_body, (X, Y, Z, inf, deg0))
+
+    def half(pair, lo):
+        return (lax.dynamic_slice_in_dim(pair[0], lo, n_tok, axis=1),
+                lax.dynamic_slice_in_dim(pair[1], lo, n_tok, axis=1))
+
+    Xg, Yg, Zg = half(X2, 0), half(Y2, 0), half(Z2, 0)
+    Xq, Yq, Zq = (half(X2, n_tok), half(Y2, n_tok), half(Z2, n_tok))
+    inf_g, inf_q = inf2[:n_tok], inf2[n_tok:]
+    deg = deg2[:n_tok] | deg2[n_tok:]
+
+    X, Y, Z, inf, deg_j = _jadd_rns(c, Xg, Yg, Zg, inf_g,
+                                    Xq, Yq, Zq, inf_q)
+    deg = deg | deg_j
 
     # 4. projective check in RNS: X ≡ r·Z² (or (r+n)·Z² when r+n < p)
     rA = _limb_pair_to_rns(c, r)

@@ -34,7 +34,7 @@ Everything is shape-static; one compilation per batch-size bucket.
 from __future__ import annotations
 
 import hashlib
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -428,3 +428,115 @@ def verify_ed25519_batch(table: Ed25519KeyTable, sigs: Sequence[bytes],
                          key_idx: np.ndarray) -> np.ndarray:
     """[N] bool verdicts for one EdDSA bucket (synchronous wrapper)."""
     return verify_ed25519_batch_pending(table, sigs, msgs, key_idx)()
+
+
+# ---------------------------------------------------------------------------
+# Packed single-transfer dispatch (see rsa.py's packed section)
+# ---------------------------------------------------------------------------
+
+ED_REC_EXTRA = 2          # trailing bytes per record: flags, key row
+
+
+def ed_packed_records(table: Ed25519KeyTable, sigs: Sequence[bytes],
+                      msgs: Sequence[bytes],
+                      key_idx: np.ndarray) -> np.ndarray:
+    """Host: packed [N, 64 + 32 + 2] u8 records for one EdDSA chunk.
+
+    Row layout: signature R‖S (64) ‖ k = SHA-512(R‖A‖M) mod L as 32
+    little-endian bytes ‖ validity flag u8 (length ok AND key decodes)
+    ‖ key row u8. The k hash is inherently host-side (variable-length
+    message); everything downstream of it runs on device.
+    """
+    n = len(sigs)
+    rec = np.zeros((n, 64 + 32 + ED_REC_EXTRA), np.uint8)
+    for j, sg in enumerate(sigs):
+        row = int(key_idx[j])
+        if len(sg) == 64:
+            rec[j, :64] = np.frombuffer(sg, np.uint8)
+            h = hashlib.sha512(
+                sg[:32] + table.key_bytes[row] + msgs[j]).digest()
+            kk = int.from_bytes(h, "little") % L_ORDER
+            rec[j, 64:96] = np.frombuffer(
+                kk.to_bytes(32, "little"), np.uint8)
+            rec[j, 96] = not table.invalid[row]
+        rec[j, 97] = row
+    return rec
+
+
+def _le_bytes_to_limbs_dev(mat):
+    """Device: [N, 2K] u8 little-endian → [K, N] u32 limbs."""
+    m = mat.astype(jnp.uint32)
+    return (m[:, 0::2] | (m[:, 1::2] << 8)).T
+
+
+def _ed_packed_unpack(packed):
+    sig = packed[:, :64]
+    flags = packed[:, 96] != 0
+    idx = packed[:, 97].astype(jnp.int32)
+    sign_r = (sig[:, 31] >> 7).astype(jnp.uint32)
+    r_clr = sig[:, :32].at[:, 31].set(sig[:, 31] & 0x7F)
+    yr = _le_bytes_to_limbs_dev(r_clr)
+    s = _le_bytes_to_limbs_dev(sig[:, 32:64])
+    kk = _le_bytes_to_limbs_dev(packed[:, 64:96])
+    bad = jnp.zeros(packed.shape[0], bool)   # folded into flags on host
+    return s, kk, yr, sign_r, bad, idx, flags
+
+
+def _ed_packed_rns_impl(packed, ta, tb, cdev):
+    from . import ed25519_rns
+
+    s, kk, yr, sign_r, bad, idx, flags = _ed_packed_unpack(packed)
+    p, pp, pr2, pone, pm2, l_ = cdev
+    ok = ed25519_rns._ed25519_rns_core(
+        s, kk, yr, sign_r, bad, idx, *ta, *tb, p, pp, pr2, pone, pm2, l_)
+    return ok & flags
+
+
+def _ed_packed_limb_impl(packed, ta, tb, cdev):
+    s, kk, yr, sign_r, bad, idx, flags = _ed_packed_unpack(packed)
+    p, pp, pr2, pone, pm2, l_ = cdev
+    ok = _ed25519_core(
+        s, kk, yr, sign_r, bad, idx, *ta, *tb, p, pp, pr2, pone, pm2, l_)
+    return ok & flags
+
+
+_ed_packed_jits: Dict[str, object] = {}
+
+
+def _ed_packed_jit(name: str, impl):
+    fn = _ed_packed_jits.get(name)
+    if fn is None:
+        fn = jax.jit(impl)
+        _ed_packed_jits[name] = fn
+    return fn
+
+
+def verify_ed_packed_pending(table: Ed25519KeyTable, rec: np.ndarray,
+                             mesh=None):
+    """Dispatch one packed EdDSA chunk; returns the device [N] bool.
+
+    With a mesh the record shards along the batch axis; tables
+    replicate (SURVEY.md §2.6).
+    """
+    from .rns import use_rns
+
+    if mesh is not None:
+        from ..parallel.place import replicated, shard_batch
+
+        dev = shard_batch(mesh, rec)
+        place = lambda a: replicated(mesh, a)  # noqa: E731
+    else:
+        dev = jax.device_put(rec)
+        place = lambda a: a  # noqa: E731
+    if use_rns():
+        from . import ed25519_rns
+
+        rtab = table.rns()
+        fn = _ed_packed_jit("rns", _ed_packed_rns_impl)
+        return fn(dev, tuple(place(a) for a in rtab.tna),
+                  tuple(place(a) for a in ed25519_rns.b_table_rns()),
+                  tuple(place(a) for a in consts().dev))
+    fn = _ed_packed_jit("limb", _ed_packed_limb_impl)
+    return fn(dev, tuple(place(a) for a in table.tna),
+              tuple(place(a) for a in b_table()),
+              tuple(place(a) for a in consts().dev))

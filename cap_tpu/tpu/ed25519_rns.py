@@ -86,6 +86,37 @@ def _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2):
     return tuple(rmul_many(c, [(e, f), (g, h), (f, g), (e, h)]))
 
 
+def _twod_dom(c: "Ed25519RNSContext"):
+    """A-domain residue columns of the curve constant 2d."""
+    from .ed25519 import D_CONST
+
+    v = 2 * D_CONST % P * c.a_mod_p % P
+    return (jnp.asarray([v % int(m) for m in c.A.m], I32)[:, None],
+            jnp.asarray([v % int(m) for m in c.B.m], I32)[:, None])
+
+
+def _edw_add_rns(c, P1, P2, twod):
+    """Complete full extended + extended addition (add-2008-hwcd-3,
+    a = -1), RNS pairs. Runs ONCE per batch to merge the two ladder
+    accumulators. Inputs < 3p with canonical digits; outputs likewise.
+    """
+    from .ec_rns import rmul_many
+
+    X1, Y1, Z1, T1 = P1
+    X2, Y2, Z2, T2 = P2
+    a, b, t12, z12 = rmul_many(
+        c, [(rsub(c, Y1, X1, 4, guard=1), rsub(c, Y2, X2, 4, guard=1)),
+            (radd(c, Y1, X1), radd(c, Y2, X2)),
+            (T1, T2), (Z1, Z2)])             # λ ≤ 49, ≤ 9m² → < 3p, ≤ m
+    cc = rmul(c, t12, twod)                  # < 3p, ≤ m
+    d = radd(c, z12, z12)                    # < 6p, ≤ 2m
+    e = rsub(c, b, a, 4, guard=1)            # < 7p, ≤ 3m
+    f = rsub(c, d, cc, 4, guard=1)           # < 10p, ≤ 4m
+    g = radd(c, d, cc)                       # < 9p, ≤ 3m
+    h = radd(c, b, a)                        # < 6p, ≤ 2m
+    return tuple(rmul_many(c, [(e, f), (g, h), (f, g), (e, h)]))
+
+
 def _window_triple_residue_rows(c: Ed25519RNSContext,
                                 pt: Tuple[int, int]) -> np.ndarray:
     """[3, NW·16, I_A+I_B] A-domain triples of d·2^{4i}·pt (d=0: id)."""
@@ -180,9 +211,14 @@ def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
         g = [jnp.take(t, idx, axis=0).T for t in (ta, tb, tc)]
         return [(v[:ia], v[ia:]) for v in g]
 
+    # TWO-ACCUMULATOR ladder (see ec_rns._ecdsa_rns_core): the B-chain
+    # ([S]B) and A-chain ([k](−A)) additions are independent, so both
+    # run as ONE complete mixed-add over [I, 2N] lanes — the same 2
+    # REDC layers per window serve both chains. One full Edwards add
+    # merges the accumulators (complete formulas: no flags needed).
     one_d = _one_dom(c)
-    zA = jnp.zeros((c.A.count, n_tok), I32)
-    zB = jnp.zeros((c.B.count, n_tok), I32)
+    zA = jnp.zeros((c.A.count, 2 * n_tok), I32)
+    zB = jnp.zeros((c.B.count, 2 * n_tok), I32)
     one_b = (jnp.broadcast_to(one_d[0], zA.shape),
              jnp.broadcast_to(one_d[1], zB.shape))
     X = (zA, zB)
@@ -190,18 +226,32 @@ def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
     Z = one_b
     T = (zA, zB)
 
+    cat_ym = jnp.concatenate([tb_ym, ta_ym], axis=0)
+    cat_yp = jnp.concatenate([tb_yp, ta_yp], axis=0)
+    cat_t2 = jnp.concatenate([tb_t2, ta_t2], axis=0)
+    q_off = tb_ym.shape[0]
+
     def ladder_body(i, state):
         X, Y, Z, T = state
         d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
-        ym, yp, t2 = gather3(tb_ym, tb_yp, tb_t2, i * PER + d1)
-        X, Y, Z, T = _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
-        ym, yp, t2 = gather3(ta_ym, ta_yp, ta_t2,
-                             key_base + i * PER + d2)
-        X, Y, Z, T = _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
-        return X, Y, Z, T
+        idx = jnp.concatenate(
+            [i * PER + d1, q_off + key_base + i * PER + d2])
+        ym, yp, t2 = gather3(cat_ym, cat_yp, cat_t2, idx)
+        return _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
 
     X, Y, Z, T = lax.fori_loop(0, NW8, ladder_body, (X, Y, Z, T))
+
+    def halves(pair):
+        return ((pair[0][:, :n_tok], pair[1][:, :n_tok]),
+                (pair[0][:, n_tok:], pair[1][:, n_tok:]))
+
+    Xb, Xa = halves(X)
+    Yb, Ya = halves(Y)
+    Zb, Za = halves(Z)
+    Tb, Ta = halves(T)
+    X, Y, Z, T = _edw_add_rns(c, (Xb, Yb, Zb, Tb), (Xa, Ya, Za, Ta),
+                              _twod_dom(c))
 
     # RNS → limbs, canonicalize mod p, then the limb-domain finish.
     def to_canonical(v_pair):

@@ -116,6 +116,9 @@ class RSAKeyTable:
         self.e_arr = np.asarray(self.e_ints, np.uint32)
         self.all_f4 = all(e == 65537 for e in self.e_ints)
         self.max_ebits = max(e.bit_length() for e in self.e_ints)
+        # Device-resident per-key scalars for the packed in-jit gathers.
+        self.sizes_dev = jnp.asarray(self.sizes_bytes, jnp.int32)
+        self.e_dev = jnp.asarray(self.e_arr)
         self._rns = None
 
     def rns(self):
@@ -466,3 +469,153 @@ def verify_pss_batch(table: RSAKeyTable, sigs: Sequence[bytes],
         em_bits = int(mod_bits[j]) - 1
         out[j] = pss_check_em(em_bytes[j], msg_hashes[j], em_bits, hash_name)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Packed single-transfer dispatch (the H2D-pipelined hot path)
+# ---------------------------------------------------------------------------
+#
+# The tunnel probe (tools/probe_tunnel.py, docs/PERF.md) shows the
+# host↔device link rewards FEW, LARGE transfers: bandwidth rises from
+# ~6 MB/s at 1 MB to ~24 MB/s at 64 MB, concurrent streams do NOT
+# aggregate, and transfers DO overlap device compute. So the hot path
+# ships ONE u8 record matrix per chunk — [sig ‖ digest ‖ flags ‖ kid]
+# rows — and runs unpack + limb building + expected-EM construction +
+# modexp + compare as ONE jitted program returning a [N] bool that is
+# only materialized in the batch-wide sync wave.
+
+RS_REC_EXTRA = 2          # trailing bytes per record: flags, key row
+
+
+def rs_packed_records(table: RSAKeyTable, sig_mat: np.ndarray,
+                      sig_lens: np.ndarray, hash_mat: np.ndarray,
+                      hash_name: str, key_idx: np.ndarray) -> np.ndarray:
+    """Host: build the packed [N, 2k + hlen + 2] u8 record matrix.
+
+    Row layout: right-aligned signature bytes (2k) ‖ digest (hlen) ‖
+    validity flag u8 ‖ key row u8. Invalid-length signatures are zeroed
+    with flag 0 (their verdict is decided host-side, matching the CPU
+    oracle's rejections).
+    """
+    sizes = np.asarray(table.sizes_bytes, np.int64)[key_idx]
+    len_ok = sig_lens == sizes
+    em_len_ok = sizes >= len(DIGEST_INFO_PREFIX[hash_name]) + \
+        HASH_LEN[hash_name] + 11
+    flags = (len_ok & em_len_ok).astype(np.uint8)
+    safe_lens = np.where(len_ok, sig_lens, 0)
+    width = 2 * table.k
+    aligned = L.right_align_bytes(
+        np.where(len_ok[:, None], sig_mat[:, :width], 0), safe_lens, width)
+    h_len = HASH_LEN[hash_name]
+    rec = np.empty((sig_mat.shape[0], width + h_len + RS_REC_EXTRA),
+                   np.uint8)
+    rec[:, :width] = aligned
+    rec[:, width:width + h_len] = hash_mat[:, :h_len]
+    rec[:, width + h_len] = flags
+    rec[:, width + h_len + 1] = key_idx.astype(np.uint8)
+    return rec
+
+
+def _rs_packed_unpack(packed, k: int, h_len: int):
+    """In-jit: record matrix → (s_limbs, dig, flags, idx)."""
+    import jax.numpy as jnp
+
+    width = 2 * k
+    s_limbs = bytes_to_limbs_device(packed[:, :width])
+    dig = packed[:, width:width + h_len]
+    flags = packed[:, width + h_len] != 0
+    idx = packed[:, width + h_len + 1].astype(jnp.int32)
+    return s_limbs, dig, flags, idx
+
+
+def _rs_packed_rns_impl(packed, sizes_tab, n_tab, sig_c_tab, n_B_tab,
+                        a2_A_tab, a2_B_tab, *, k: int, hash_name: str,
+                        ctx):
+    import jax.numpy as jnp
+
+    from . import bignum
+    from .rns import _rns_verify_core
+
+    s_limbs, dig, flags, idx = _rs_packed_unpack(packed, k,
+                                                 HASH_LEN[hash_name])
+    sizes = sizes_tab[idx]
+    expected = _expected_em_device(dig, sizes, k, hash_name)
+    in_range = ~bignum.compare_ge(s_limbs, n_tab[idx].T)
+    ok = _rns_verify_core(ctx, s_limbs, expected, sig_c_tab[idx].T,
+                          n_B_tab[idx].T, a2_A_tab[idx].T,
+                          a2_B_tab[idx].T)
+    return ok & in_range & flags
+
+
+def _rs_packed_limb_impl(packed, sizes_tab, n_tab, np_tab, r2_tab,
+                         one_tab, e_tab, *, k: int, hash_name: str,
+                         ebits: int, all_f4: bool):
+    import jax.numpy as jnp
+
+    from . import bignum
+
+    s_limbs, dig, flags, idx = _rs_packed_unpack(packed, k,
+                                                 HASH_LEN[hash_name])
+    sizes = sizes_tab[idx]
+    expected = _expected_em_device(dig, sizes, k, hash_name)
+    n = n_tab[idx].T
+    in_range = ~bignum.compare_ge(s_limbs, n)
+    nprime = np_tab[idx].T
+    r2 = r2_tab[idx].T
+    if all_f4:
+        em = bignum.modexp_65537(s_limbs, n, nprime, r2)
+    else:
+        em = bignum.modexp_vare(s_limbs, e_tab[idx], n, nprime, r2,
+                                one_tab[idx].T, ebits=ebits)
+    eq = jnp.all(em == expected, axis=0)
+    return eq & in_range & flags
+
+
+_rs_packed_jits: dict = {}
+
+
+def _rs_packed_jit(name: str, impl, static_names):
+    fn = _rs_packed_jits.get(name)
+    if fn is None:
+        import jax
+
+        fn = jax.jit(impl, static_argnames=static_names)
+        _rs_packed_jits[name] = fn
+    return fn
+
+
+def verify_rs_packed_pending(table: RSAKeyTable, rec: np.ndarray,
+                             hash_name: str, mesh=None):
+    """Dispatch one packed RS* chunk; returns the device [N] bool.
+
+    One H2D transfer (the record matrix), one compiled program, no
+    materialization — the caller syncs the whole batch at once. With a
+    mesh, the record shards along the batch axis and the tables
+    replicate (GSPMD partitions the program — SURVEY.md §2.6).
+    """
+    import jax
+
+    if mesh is not None:
+        from ..parallel.place import replicated, shard_batch
+
+        dev = shard_batch(mesh, rec)
+        place = lambda a: replicated(mesh, a)  # noqa: E731
+    else:
+        dev = jax.device_put(rec)
+        place = lambda a: a  # noqa: E731
+    if table.all_f4 and _use_rns():
+        ctx, rtab = table.rns()
+        if ctx is not None:
+            fn = _rs_packed_jit("rns", _rs_packed_rns_impl,
+                                ("k", "hash_name", "ctx"))
+            return fn(dev, place(table.sizes_dev), place(table.n_tab),
+                      place(rtab.sig_c), place(rtab.n_B),
+                      place(rtab.a2_A), place(rtab.a2_B), k=table.k,
+                      hash_name=hash_name, ctx=ctx)
+    fn = _rs_packed_jit("limb", _rs_packed_limb_impl,
+                        ("k", "hash_name", "ebits", "all_f4"))
+    return fn(dev, place(table.sizes_dev), place(table.n_tab),
+              place(table.np_tab), place(table.r2_tab),
+              place(table.one_tab), place(table.e_dev), k=table.k,
+              hash_name=hash_name, ebits=table.max_ebits,
+              all_f4=table.all_f4)

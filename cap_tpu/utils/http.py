@@ -1,19 +1,28 @@
-"""Small HTTP helpers with optional CA pinning.
+"""Pooled HTTP helpers with optional CA pinning.
 
-The reference builds pooled cleanhttp transports with custom RootCAs
-(jwt/keyset.go:204-225, oidc/provider.go:566-618); the Python analog is a
-shared ssl.SSLContext built from the provided CA PEM, used for every
-request a keyset/provider makes.
+The reference reuses pooled cleanhttp transports with custom RootCAs
+for discovery/token/JWKS/UserInfo traffic (jwt/keyset.go:204-225,
+oidc/provider.go:566-618). The Python analog here: a process-wide
+keep-alive connection pool keyed by (scheme, host, port, SSL context),
+so one TLS handshake serves a Provider's whole flow — discovery, token
+exchange, JWKS fetches, and UserInfo ride the same socket when the
+server allows keep-alive.
+
+Connection reuse is observable via telemetry counters
+(``http.conn_new`` / ``http.conn_reused``).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import ssl
-import urllib.error
-import urllib.request
+import threading
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import urlparse, urljoin
 
+from .. import telemetry
 from ..errors import InvalidCACertError
 
 
@@ -29,20 +38,175 @@ def ssl_context_for_ca(ca_pem: Optional[str]) -> Optional[ssl.SSLContext]:
     return ctx
 
 
+class ConnectionPool:
+    """Keep-alive HTTP(S) connection cache.
+
+    Mirrors the pooled-transport role of the reference's cleanhttp
+    clients: idle connections are parked per (scheme, host, port, SSL
+    context) and reused for subsequent requests. A request on a reused
+    connection that fails mid-flight (stale keep-alive the server
+    already closed) is retried ONCE on a fresh connection; failures on
+    fresh connections propagate as ConnectionError/OSError.
+    """
+
+    def __init__(self, max_idle_per_key: int = 4,
+                 idle_ttl: float = 60.0):
+        self._idle: Dict[tuple, list] = {}   # key -> [(conn, parked_at)]
+        self._lock = threading.Lock()
+        self._max_idle = max_idle_per_key
+        self._idle_ttl = idle_ttl
+
+    def _checkout(self, key):
+        import time
+
+        now = time.monotonic()
+        stale = []
+        try:
+            with self._lock:
+                conns = self._idle.get(key)
+                while conns:
+                    conn, parked = conns.pop()
+                    if now - parked <= self._idle_ttl:
+                        return conn, True
+                    stale.append(conn)
+            return None, False
+        finally:
+            for c in stale:
+                c.close()
+
+    def _checkin(self, key, conn) -> None:
+        import time
+
+        now = time.monotonic()
+        evict = []
+        with self._lock:
+            # lazy sweep: expire idle sockets everywhere so dead
+            # Providers' contexts don't pin fds for the process life
+            for k in list(self._idle):
+                kept = [(c, t) for (c, t) in self._idle[k]
+                        if now - t <= self._idle_ttl]
+                evict.extend(c for (c, t) in self._idle[k]
+                             if now - t > self._idle_ttl)
+                if kept:
+                    self._idle[k] = kept
+                else:
+                    del self._idle[k]
+            conns = self._idle.setdefault(key, [])
+            if len(conns) < self._max_idle:
+                conns.append((conn, now))
+                conn = None
+        for c in evict:
+            c.close()
+        if conn is not None:
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            for conns in self._idle.values():
+                for c, _ in conns:
+                    c.close()
+            self._idle.clear()
+
+    def request(self, method: str, url: str,
+                body: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ctx: Optional[ssl.SSLContext] = None,
+                timeout: float = 30.0,
+                max_redirects: int = 5) -> Tuple[int, bytes,
+                                                 Dict[str, str]]:
+        """One HTTP exchange → (status, body, lowercased headers).
+
+        4xx/5xx are returned, not raised (callers branch on status);
+        transport failures raise OSError subclasses. GET redirects are
+        followed up to ``max_redirects`` (the reference's http.Client
+        default behavior).
+        """
+        for _ in range(max_redirects + 1):
+            status, data, hdrs = self._one(method, url, body, headers,
+                                           ctx, timeout)
+            loc = hdrs.get("location")
+            if loc and status in (301, 302, 303, 307, 308):
+                url = urljoin(url, loc)
+                if status in (301, 302, 303) and method != "GET":
+                    # urllib/browser semantics: re-issue as GET
+                    method, body = "GET", None
+                continue  # 307/308 keep method + body
+            return status, data, hdrs
+        raise ConnectionError(f"{method} {url}: too many redirects")
+
+    def _one(self, method, url, body, headers, ctx, timeout):
+        u = urlparse(url)
+        if u.scheme not in ("http", "https"):
+            raise ConnectionError(f"unsupported URL scheme {u.scheme!r}")
+        port = u.port or (443 if u.scheme == "https" else 80)
+        key = (u.scheme, u.hostname, port, id(ctx) if ctx else None)
+        path = u.path or "/"
+        if u.query:
+            path += "?" + u.query
+
+        last_exc: Optional[Exception] = None
+        for attempt in (0, 1):
+            if attempt == 0:
+                conn, reused = self._checkout(key)
+            else:
+                conn, reused = None, False  # retry always on a fresh conn
+            if conn is None:
+                try:
+                    if u.scheme == "https":
+                        conn = http.client.HTTPSConnection(
+                            u.hostname, port, timeout=timeout,
+                            context=ctx)
+                    else:
+                        conn = http.client.HTTPConnection(
+                            u.hostname, port, timeout=timeout)
+                except Exception as e:  # noqa: BLE001
+                    raise ConnectionError(str(e)) from e
+                reused = False
+            else:
+                # reused sockets keep their creator's timeout: apply
+                # THIS caller's
+                conn.timeout = timeout
+                if getattr(conn, "sock", None) is not None:
+                    conn.sock.settimeout(timeout)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, ConnectionError,
+                    BrokenPipeError, socket.timeout, ssl.SSLError,
+                    OSError) as e:
+                conn.close()
+                last_exc = e
+                if reused:
+                    continue   # stale keep-alive → one fresh retry
+                if isinstance(e, OSError):
+                    raise
+                raise ConnectionError(str(e)) from e
+            telemetry.count("http.conn_reused" if reused
+                            else "http.conn_new")
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(key, conn)
+            return (resp.status, data,
+                    {k.lower(): v for k, v in resp.getheaders()})
+        raise ConnectionError(str(last_exc)) from last_exc
+
+
+_POOL = ConnectionPool()
+
+
+def default_pool() -> ConnectionPool:
+    return _POOL
+
+
 def get(url: str, ctx: Optional[ssl.SSLContext] = None,
         headers: Optional[Dict[str, str]] = None,
         timeout: float = 30.0) -> Tuple[int, bytes, Dict[str, str]]:
     """GET a URL; returns (status, body, lowercased headers)."""
-    req = urllib.request.Request(url, headers=headers or {})
-    try:
-        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
-            return (
-                resp.status,
-                resp.read(),
-                {k.lower(): v for k, v in resp.headers.items()},
-            )
-    except urllib.error.HTTPError as e:
-        return e.code, e.read(), {k.lower(): v for k, v in e.headers.items()}
+    return _POOL.request("GET", url, headers=headers, ctx=ctx,
+                         timeout=timeout)
 
 
 def get_json(url: str, ctx: Optional[ssl.SSLContext] = None,
@@ -94,13 +258,5 @@ def post_form(url: str, fields: Dict[str, str],
     data = urlencode(fields).encode("ascii")
     hdrs = {"Content-Type": "application/x-www-form-urlencoded"}
     hdrs.update(headers or {})
-    req = urllib.request.Request(url, data=data, headers=hdrs, method="POST")
-    try:
-        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
-            return (
-                resp.status,
-                resp.read(),
-                {k.lower(): v for k, v in resp.headers.items()},
-            )
-    except urllib.error.HTTPError as e:
-        return e.code, e.read(), {k.lower(): v for k, v in e.headers.items()}
+    return _POOL.request("POST", url, body=data, headers=hdrs, ctx=ctx,
+                         timeout=timeout)

@@ -1,0 +1,291 @@
+// Package captpu is the Go host-language client for the cap_tpu verify
+// worker: it exposes cap's KeySet seam (reference: jwt/keyset.go:27-32)
+// backed by the batched TPU verify service, so a Go application using
+// hashicorp/cap-style verification can route its hot path to the
+// accelerator with the pure-Go path staying the default.
+//
+// The wire protocol is CVB1 (cap_tpu/serve/protocol.py): length-prefixed
+// little-endian frames over TCP or a Unix socket. This package speaks it
+// natively — no cgo required; libcapclient.so (the C shim) remains
+// available for cgo-based hosts.
+//
+// Redaction stance (reference: oidc/access_token.go:6-19): error strings
+// never contain token material, and this package never logs.
+package captpu
+
+import (
+	"context"
+	"encoding/binary"
+	"encoding/json"
+	"errors"
+	"fmt"
+	"io"
+	"net"
+	"strings"
+	"sync"
+	"time"
+)
+
+const (
+	magic         = 0x31425643 // "CVB1"
+	typeVerifyReq = 1
+	typeVerifyRsp = 2
+	typePing      = 3
+	typePong      = 4
+
+	maxEntryBytes = 1 << 20
+	maxFrameBytes = 1 << 28
+)
+
+// KeySet mirrors cap's verification seam (jwt/keyset.go:27-32): it
+// verifies the signature of a compact JWS and returns its claims.
+type KeySet interface {
+	VerifySignature(ctx context.Context, token string) (map[string]interface{}, error)
+}
+
+// BatchKeySet is the batched extension the TPU backend serves.
+type BatchKeySet interface {
+	KeySet
+	// VerifyBatch verifies every token; result i corresponds to
+	// tokens[i]. A non-nil error means the whole batch failed
+	// (transport); per-token rejections land in Result.Err.
+	VerifyBatch(ctx context.Context, tokens []string) ([]Result, error)
+}
+
+// Result is one token's verdict.
+type Result struct {
+	Claims map[string]interface{} // nil when rejected
+	Err    error                  // nil when verified
+}
+
+// RemoteVerifyError is a per-token rejection from the worker. Its text
+// is the worker's error class + message (never the token itself).
+type RemoteVerifyError struct{ Msg string }
+
+func (e *RemoteVerifyError) Error() string { return e.Msg }
+
+// ErrClosed is returned after Close.
+var ErrClosed = errors.New("captpu: client closed")
+
+// TPUBatchKeySet is a KeySet backed by a cap_tpu verify worker.
+//
+// It holds one connection, redialing transparently after transport
+// errors (a failed exchange poisons the connection — response bytes
+// may be unread — mirroring the native client's handle poisoning).
+// Safe for concurrent use; calls serialize on the connection, and the
+// worker's AdaptiveBatcher coalesces concurrent callers into device
+// batches.
+type TPUBatchKeySet struct {
+	network string // "tcp" or "unix"
+	addr    string
+
+	mu     sync.Mutex
+	conn   net.Conn
+	closed bool
+
+	// DialTimeout bounds redials (default 10s).
+	DialTimeout time.Duration
+}
+
+// NewTPUBatchKeySet connects to a verify worker. addr is "host:port"
+// for TCP or "unix:///path/to.sock" for a Unix socket.
+func NewTPUBatchKeySet(addr string) (*TPUBatchKeySet, error) {
+	k := &TPUBatchKeySet{network: "tcp", addr: addr, DialTimeout: 10 * time.Second}
+	if strings.HasPrefix(addr, "unix://") {
+		k.network = "unix"
+		k.addr = strings.TrimPrefix(addr, "unix://")
+	}
+	if err := k.redial(); err != nil {
+		return nil, err
+	}
+	return k, nil
+}
+
+func (k *TPUBatchKeySet) redial() error {
+	if k.conn != nil {
+		k.conn.Close()
+		k.conn = nil
+	}
+	d := net.Dialer{Timeout: k.DialTimeout}
+	conn, err := d.Dial(k.network, k.addr)
+	if err != nil {
+		return fmt.Errorf("captpu: dial %s %s: %w", k.network, k.addr, err)
+	}
+	if tc, ok := conn.(*net.TCPConn); ok {
+		tc.SetNoDelay(true)
+	}
+	k.conn = conn
+	return nil
+}
+
+// Close releases the connection. Subsequent calls return ErrClosed.
+func (k *TPUBatchKeySet) Close() error {
+	k.mu.Lock()
+	defer k.mu.Unlock()
+	k.closed = true
+	if k.conn != nil {
+		err := k.conn.Close()
+		k.conn = nil
+		return err
+	}
+	return nil
+}
+
+// Ping reports worker liveness.
+func (k *TPUBatchKeySet) Ping() bool {
+	k.mu.Lock()
+	defer k.mu.Unlock()
+	if k.closed || k.ensureConn() != nil {
+		return false
+	}
+	hdr := make([]byte, 9)
+	binary.LittleEndian.PutUint32(hdr[0:4], magic)
+	hdr[4] = typePing
+	binary.LittleEndian.PutUint32(hdr[5:9], 0)
+	if _, err := k.conn.Write(hdr); err != nil {
+		k.poison()
+		return false
+	}
+	rsp := make([]byte, 9)
+	if _, err := io.ReadFull(k.conn, rsp); err != nil {
+		k.poison()
+		return false
+	}
+	if binary.LittleEndian.Uint32(rsp[0:4]) != magic || rsp[4] != typePong {
+		k.poison()
+		return false
+	}
+	return true
+}
+
+// VerifySignature implements KeySet for a single token.
+func (k *TPUBatchKeySet) VerifySignature(ctx context.Context, token string) (map[string]interface{}, error) {
+	res, err := k.VerifyBatch(ctx, []string{token})
+	if err != nil {
+		return nil, err
+	}
+	if res[0].Err != nil {
+		return nil, res[0].Err
+	}
+	return res[0].Claims, nil
+}
+
+// VerifyBatch sends one CVB1 verify frame and decodes the response.
+func (k *TPUBatchKeySet) VerifyBatch(ctx context.Context, tokens []string) ([]Result, error) {
+	k.mu.Lock()
+	defer k.mu.Unlock()
+	if k.closed {
+		return nil, ErrClosed
+	}
+	if err := k.ensureConn(); err != nil {
+		return nil, err
+	}
+	if dl, ok := ctx.Deadline(); ok {
+		k.conn.SetDeadline(dl)
+		defer k.conn.SetDeadline(time.Time{})
+	}
+
+	frame, err := encodeRequest(tokens)
+	if err != nil {
+		return nil, err
+	}
+	if _, err := k.conn.Write(frame); err != nil {
+		k.poison()
+		return nil, fmt.Errorf("captpu: send: %w", err)
+	}
+	res, err := decodeResponse(k.conn, len(tokens))
+	if err != nil {
+		k.poison()
+		return nil, err
+	}
+	return res, nil
+}
+
+func (k *TPUBatchKeySet) ensureConn() error {
+	if k.conn != nil {
+		return nil
+	}
+	return k.redial()
+}
+
+// poison drops the connection: after a failed exchange the stream may
+// hold unread response bytes, so reuse would misparse later frames.
+func (k *TPUBatchKeySet) poison() {
+	if k.conn != nil {
+		k.conn.Close()
+		k.conn = nil
+	}
+}
+
+// encodeRequest builds a CVB1 verify-request frame.
+func encodeRequest(tokens []string) ([]byte, error) {
+	size := 9
+	for _, t := range tokens {
+		if len(t) > maxEntryBytes {
+			return nil, fmt.Errorf("captpu: token exceeds %d bytes", maxEntryBytes)
+		}
+		size += 4 + len(t)
+	}
+	if size > maxFrameBytes {
+		return nil, fmt.Errorf("captpu: frame exceeds %d bytes", maxFrameBytes)
+	}
+	frame := make([]byte, 0, size)
+	var u32 [4]byte
+	binary.LittleEndian.PutUint32(u32[:], magic)
+	frame = append(frame, u32[:]...)
+	frame = append(frame, typeVerifyReq)
+	binary.LittleEndian.PutUint32(u32[:], uint32(len(tokens)))
+	frame = append(frame, u32[:]...)
+	for _, t := range tokens {
+		binary.LittleEndian.PutUint32(u32[:], uint32(len(t)))
+		frame = append(frame, u32[:]...)
+		frame = append(frame, t...)
+	}
+	return frame, nil
+}
+
+// decodeResponse reads one verify-response frame for count tokens.
+func decodeResponse(r io.Reader, count int) ([]Result, error) {
+	hdr := make([]byte, 9)
+	if _, err := io.ReadFull(r, hdr); err != nil {
+		return nil, fmt.Errorf("captpu: recv header: %w", err)
+	}
+	if binary.LittleEndian.Uint32(hdr[0:4]) != magic {
+		return nil, errors.New("captpu: bad magic in response")
+	}
+	if hdr[4] != typeVerifyRsp {
+		return nil, fmt.Errorf("captpu: unexpected frame type %d", hdr[4])
+	}
+	n := binary.LittleEndian.Uint32(hdr[5:9])
+	if int(n) != count {
+		return nil, fmt.Errorf("captpu: response count %d != request %d", n, count)
+	}
+	out := make([]Result, count)
+	entry := make([]byte, 5)
+	total := 0
+	for i := 0; i < count; i++ {
+		if _, err := io.ReadFull(r, entry); err != nil {
+			return nil, fmt.Errorf("captpu: recv entry: %w", err)
+		}
+		status := entry[0]
+		ln := binary.LittleEndian.Uint32(entry[1:5])
+		total += int(ln)
+		if ln > maxEntryBytes || total > maxFrameBytes {
+			return nil, errors.New("captpu: oversized response entry")
+		}
+		payload := make([]byte, ln)
+		if _, err := io.ReadFull(r, payload); err != nil {
+			return nil, fmt.Errorf("captpu: recv payload: %w", err)
+		}
+		if status == 0 {
+			var claims map[string]interface{}
+			if err := json.Unmarshal(payload, &claims); err != nil {
+				return nil, fmt.Errorf("captpu: claims decode: %w", err)
+			}
+			out[i] = Result{Claims: claims}
+		} else {
+			out[i] = Result{Err: &RemoteVerifyError{Msg: string(payload)}}
+		}
+	}
+	return out, nil
+}

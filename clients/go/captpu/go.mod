@@ -1,0 +1,3 @@
+module github.com/cap-tpu/clients/go/captpu
+
+go 1.15

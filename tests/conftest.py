@@ -20,3 +20,9 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+# Persistent compilation cache: the suite is compile-dominated on CPU
+# (engine programs per shape bucket); warm runs skip all of it.
+from cap_tpu import compile_cache
+
+compile_cache.enable()

@@ -348,3 +348,30 @@ def test_batch_id_token_verification(provider, idp):
     assert all(isinstance(r, dict) for r in res[:4])
     assert isinstance(res[4], InvalidNonceError)
     assert isinstance(res[5], InvalidSignatureError)
+
+
+def test_pooled_http_reuses_connections(idp):
+    """Discovery + token exchange + userinfo ride keep-alive sockets
+    from the shared pool (the reference's pooled cleanhttp transports,
+    oidc/provider.go:566-618): after the first request to the IdP the
+    rest reuse its connection instead of re-handshaking TLS."""
+    from cap_tpu import telemetry
+
+    with telemetry.recording() as rec:
+        cfg = Config(
+            issuer=idp.issuer(),
+            client_id=idp.client_id,
+            client_secret=idp.client_secret,
+            supported_signing_algs=["ES256"],
+            allowed_redirect_urls=[REDIRECT],
+            provider_ca=idp.ca_cert(),
+        )
+        p = Provider(cfg)
+        req = Request(60, REDIRECT)
+        idp.set_expected_auth_nonce(req.nonce())
+        token = p.exchange(req, req.state(), idp.expected_auth_code)
+        p.userinfo(token.static_token_source(), idp.replay_subject)
+    counters = rec.counters()
+    # discovery (1 fetch) + token POST + JWKS fetch + userinfo ≥ 4
+    # requests; after the first each should reuse the pooled socket.
+    assert counters.get("http.conn_reused", 0) >= 2, counters

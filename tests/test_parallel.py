@@ -127,3 +127,58 @@ def test_sharded_rns_verify_step():
     ok, total = step(*args)
     assert np.asarray(ok).all()
     assert int(total) == n_tok
+
+
+def _meshed_mixed_parity():
+    from cap_tpu import testing as captest
+    from cap_tpu.errors import InvalidSignatureError
+    from cap_tpu.jwt.jwk import JWK
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+    jwks, signers = [], []
+    for i, (alg, kw) in enumerate([
+            ("RS256", {"rsa_bits": 1024}), ("RS256", {"rsa_bits": 1024}),
+            ("ES256", {}), ("ES256", {}), ("EdDSA", {})]):
+        priv, pub = captest.generate_keys(alg, **kw)
+        jwks.append(JWK(pub, kid=f"m{i}"))
+        signers.append((priv, alg, f"m{i}"))
+    claims = captest.default_claims()
+    toks = []
+    for j in range(15):
+        priv, alg, kid = signers[j % len(signers)]
+        toks.append(captest.sign_jwt(priv, alg, claims, kid=kid))
+    tam = toks[0][:-8] + ("AAAAAAAA" if not toks[0].endswith("AAAAAAAA")
+                          else "BBBBBBBB")
+    batch = toks + [tam, "garbage"]
+
+    mesh = make_mesh(8)
+    meshed = TPUBatchKeySet(jwks, mesh=mesh)
+    plain = TPUBatchKeySet(jwks)
+    got = meshed.verify_batch(batch)
+    want = plain.verify_batch(batch)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert isinstance(g, Exception) == isinstance(w, Exception)
+        if not isinstance(g, Exception):
+            assert g == w
+    assert isinstance(got[-2], InvalidSignatureError)
+    assert isinstance(got[-1], Exception)
+
+
+def test_meshed_keyset_mixed_families():
+    """TPUBatchKeySet(mesh=...): the PRODUCT batch path sharded over
+    the 8-device mesh for all packed families (RS*, ES*, EdDSA) —
+    verdict parity with the un-meshed keyset, rejections included
+    (VERDICT r1 #3: multi-chip as a capability, not a demo). Runs the
+    limb engines (CPU default); the RNS variant is the `heavy` tier
+    below."""
+    _meshed_mixed_parity()
+
+
+@pytest.mark.heavy
+def test_meshed_keyset_mixed_families_rns(monkeypatch):
+    """Same parity with the RNS/MXU engines forced (accelerator path).
+    Compile-heavy on CPU — excluded from the default tier; run with
+    `pytest -m heavy` or `make test-all`."""
+    monkeypatch.setenv("CAP_TPU_RNS", "1")
+    _meshed_mixed_parity()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Generate CVB1 golden frames for the Go client's byte-parity tests.
+
+The Go toolchain is not available in this image, so the Go package's
+framing is pinned against the Python protocol implementation via these
+golden vectors: the Python side (the worker's source of truth) writes
+request/response frames to clients/go/captpu/testdata/, and
+captpu_test.go asserts byte equality / decode equality.
+
+Run after any protocol change:  python tools/gen_go_golden.py
+"""
+
+import io
+import json
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cap_tpu.errors import InvalidSignatureError
+from cap_tpu.serve import protocol
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "clients", "go", "captpu", "testdata")
+
+TOKENS = ["eyJhbGciOiJSUzI1NiJ9.e30.c2ln", "a.b.c", ""]
+RESULTS = [
+    {"iss": "https://example.com/", "aud": ["client-id"], "n": 3},
+    InvalidSignatureError(
+        "no known key successfully validated the token signature"),
+    {"sub": "alice", "unicode": "ü†✓"},
+]
+
+
+class _Sock:
+    """Duck-typed socket capturing sendall output."""
+
+    def __init__(self):
+        self.buf = io.BytesIO()
+
+    def sendall(self, b):
+        self.buf.write(b)
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    s = _Sock()
+    protocol.send_request(s, TOKENS)
+    with open(os.path.join(OUT, "request.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
+    s = _Sock()
+    protocol.send_response(s, RESULTS)
+    with open(os.path.join(OUT, "response.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
+    s = _Sock()
+    protocol.send_ping(s)
+    ping = s.buf.getvalue()
+    s = _Sock()
+    protocol.send_pong(s)
+    with open(os.path.join(OUT, "ping.bin"), "wb") as f:
+        f.write(ping)
+    with open(os.path.join(OUT, "pong.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
+    meta = {
+        "tokens": TOKENS,
+        "results": [
+            {"claims": r} if isinstance(r, dict) else
+            {"error": f"{type(r).__name__}: {r}"}
+            for r in RESULTS
+        ],
+    }
+    with open(os.path.join(OUT, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1, ensure_ascii=False)
+    print(f"golden vectors written to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Resident-compute timing of the ES256 RNS verify core.
+
+Methodology (docs/PERF.md): operands live on device; the core is
+dispatched K times back-to-back with a dependency chain (output feeds a
+dummy lane of the next call's inputs is unnecessary — calls on the same
+stream serialize); timing = slope between 1 rep and R reps, removing
+dispatch/sync constants. Only value materialization truly syncs.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(os.environ.get("N", 32768))
+REPS = int(os.environ.get("REPS", 4))
+
+from cap_tpu import testing as T
+from cap_tpu.tpu import ec as tpuec
+from cap_tpu.tpu import ec_rns
+
+import jax
+import jax.numpy as jnp
+
+os.environ.setdefault("CAP_TPU_RNS", "1")
+
+
+def main():
+    print(f"backend={jax.default_backend()} N={N}", flush=True)
+    keys = []
+    for i in range(8):
+        priv, pub = T.generate_keys("ES256")
+        keys.append(pub)
+    table = tpuec.ECKeyTable("P-256", keys)
+    cp = table.curve
+    rtab = table.rns()
+    consts = cp.device_consts()
+
+    rng = np.random.default_rng(0)
+    k = cp.k
+    # random-ish valid-range scalars as limbs
+    r_np = rng.integers(1, 1 << 16, (k, N), dtype=np.int64).astype(np.uint32)
+    s_np = rng.integers(1, 1 << 16, (k, N), dtype=np.int64).astype(np.uint32)
+    e_np = rng.integers(0, 1 << 16, (k, N), dtype=np.int64).astype(np.uint32)
+    idx_np = rng.integers(0, 8, N, dtype=np.int64).astype(np.int32)
+
+    r = jax.device_put(r_np)
+    s = jax.device_put(s_np)
+    e = jax.device_put(e_np)
+    idx = jax.device_put(idx_np)
+    g = ec_rns.g_residue_tables(cp.name)
+
+    def run():
+        return ec_rns._ecdsa_rns_core(
+            r, s, e, idx, rtab.tqx, rtab.tqy, *g, *consts[4:9],
+            crv=cp.name, nbits=cp.nbits)
+
+    # compile + settle
+    ok, deg = run()
+    float(jnp.sum(ok))
+    t0 = time.perf_counter()
+    ok, deg = run()
+    float(jnp.sum(ok))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = [run() for _ in range(1 + REPS)]
+    acc = outs[0][0]
+    for o, _ in outs[1:]:
+        acc = acc ^ o
+    float(jnp.sum(acc))
+    tR = time.perf_counter() - t0
+    per = (tR - t1) / REPS
+    print(f"1rep={t1:.3f}s  {1+REPS}rep={tR:.3f}s  -> core={per*1000:.1f} ms "
+          f"per {N} = {N/per:,.0f} verifies/s resident", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Breakdown of the ES256 RNS core: where do the milliseconds go?
+
+Times, with device-resident operands and slope methodology:
+  redc   — one rmul (REDC) chain, length matching the ladder's count
+  gather — the per-window table gathers alone
+  scalar — the limb-domain scalar work (range checks, inverse, u1/u2)
+  full   — the whole _ecdsa_rns_core
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(os.environ.get("N", 32768))
+REPS = int(os.environ.get("REPS", 3))
+
+os.environ.setdefault("CAP_TPU_RNS", "1")
+
+from cap_tpu import testing as T
+from cap_tpu.tpu import ec as tpuec
+from cap_tpu.tpu import ec_rns
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def slope(fn, sync):
+    """Seconds per rep via (R reps) - (1 rep).
+
+    Both rep-count variants are compiled AND run once before timing —
+    static rep counts are separate XLA programs, and a first execution
+    can include lazy work (constant hoisting) beyond compilation.
+    """
+    sync(fn(1))
+    sync(fn(1 + REPS))
+    t0 = time.perf_counter()
+    sync(fn(1))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sync(fn(1 + REPS))
+    tR = time.perf_counter() - t0
+    return (tR - t1) / REPS
+
+
+def main():
+    print(f"backend={jax.default_backend()} N={N}", flush=True)
+    c = ec_rns.ctx_for("P-256")
+    rng = np.random.default_rng(0)
+    ia, ib = c.A.count, c.B.count
+
+    xA = jax.device_put(rng.integers(0, 8000, (ia, 2 * N)).astype(np.int32))
+    xB = jax.device_put(rng.integers(0, 8000, (ib, 2 * N)).astype(np.int32))
+
+    n_chain = 32 * 5          # ladder REDC layers (2-acc: 5 per window)
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def redc_chain(a, b, reps: int):
+        def body(i, v):
+            return ec_rns.rmul(c, v, v)
+
+        v = lax.fori_loop(0, reps * n_chain, body, (a, b))
+        return v[0]
+
+    t = slope(lambda r: redc_chain(xA, xB, reps=r),
+              lambda o: float(jnp.sum(o)))
+    print(f"redc chain ({n_chain} rmuls @ [·,{2*N}]): {t*1000:7.1f} ms",
+          flush=True)
+
+    # gathers: one [2N] take per window from a Q-sized table, x and y
+    keys = [T.generate_keys("ES256")[1] for _ in range(8)]
+    table = tpuec.ECKeyTable("P-256", keys)
+    rtab = table.rns()
+    idx = jax.device_put(
+        rng.integers(0, rtab.tqx.shape[0], 2 * N).astype(np.int32))
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def gathers(idx, reps: int):
+        def body(i, acc):
+            gx = jnp.take(rtab.tqx, idx + i, axis=0)
+            gy = jnp.take(rtab.tqy, idx + i, axis=0)
+            return acc + gx[0] + gy[0]
+
+        return lax.fori_loop(0, reps * 32, body,
+                             jnp.zeros((ia + ib,), jnp.int32))
+
+    t = slope(lambda r: gathers(idx, reps=r), lambda o: float(jnp.sum(o)))
+    print(f"gathers (32 windows × 2 takes @ [{2*N}]):  {t*1000:7.1f} ms",
+          flush=True)
+
+    # scalar limb part: mimic steps 1-2 + final checks cost via bignum
+    from cap_tpu.tpu import bignum as B
+
+    cp = table.curve
+    consts = cp.device_consts()
+    n_, npp, nr2, none_, nm2 = consts[4:9]
+    k = cp.k
+    r = jax.device_put(
+        rng.integers(1, 1 << 16, (k, N), np.int64).astype(np.uint32))
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def scalar_part(r, reps: int):
+        sh = r.shape
+        nb = jnp.broadcast_to(n_, sh)
+        nppb = jnp.broadcast_to(npp, sh)
+        nr2b = jnp.broadcast_to(nr2, sh)
+
+        def body(i, acc):
+            s_m = B.mont_mul(acc, nr2b, nb, nppb)
+            w_m = B.batch_mont_inverse(s_m, n_, npp, nr2, none_, nm2,
+                                       nbits=cp.nbits)
+            return B.mont_mul(acc, w_m, nb, nppb)
+
+        return lax.fori_loop(0, reps, body, r)
+
+    t = slope(lambda r_: scalar_part(r, reps=r_),
+              lambda o: float(jnp.sum(o)))
+    print(f"scalar (inverse tree + mont_muls @ [{k},{N}]): {t*1000:7.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
