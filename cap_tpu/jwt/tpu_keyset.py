@@ -78,7 +78,15 @@ class TPUBatchKeySet(KeySet):
         self._mesh = mesh
 
         # Partition keys into family tables; remember each JWK's slot.
-        rsa_numbers, self._rsa_rows = [], {}
+        # RSA keys additionally split into SIZE CLASSES (one table per
+        # limb width): a mixed 2048/4096 JWKS must not pad every
+        # token's wire record to the widest key (the round-1 config-②
+        # cliff). Rows encode as class*256 + row.
+        from ..tpu.limbs import nlimbs_for_bits
+
+        rsa_classes: List[list] = []      # per class: [(n, e), ...]
+        rsa_class_need: List[int] = []    # per class: limb width
+        self._rsa_rows: Dict[int, int] = {}
         self._ec_keys: Dict[str, list] = {}
         self._ec_rows: Dict[str, Dict[int, int]] = {}
         self._ed_keys, self._ed_rows = [], {}
@@ -86,8 +94,15 @@ class TPUBatchKeySet(KeySet):
             key = jwk.key
             if isinstance(key, rsa.RSAPublicKey):
                 nums = key.public_numbers()
-                self._rsa_rows[i] = len(rsa_numbers)
-                rsa_numbers.append((nums.n, nums.e))
+                need = nlimbs_for_bits(nums.n.bit_length())
+                try:
+                    cls = rsa_class_need.index(need)
+                except ValueError:
+                    cls = len(rsa_classes)
+                    rsa_classes.append([])
+                    rsa_class_need.append(need)
+                self._rsa_rows[i] = cls * 256 + len(rsa_classes[cls])
+                rsa_classes[cls].append((nums.n, nums.e))
             elif isinstance(key, ec.EllipticCurvePublicKey):
                 crv = {"secp256r1": "P-256", "secp384r1": "P-384",
                        "secp521r1": "P-521"}[key.curve.name]
@@ -98,10 +113,11 @@ class TPUBatchKeySet(KeySet):
                 self._ed_rows[i] = len(self._ed_keys)
                 self._ed_keys.append(key)
 
-        self._rsa_table = None
-        if rsa_numbers:
+        self._rsa_tables: List[Any] = []
+        if rsa_classes:
             from ..tpu.rsa import RSAKeyTable
-            self._rsa_table = RSAKeyTable(rsa_numbers)
+            self._rsa_tables = [RSAKeyTable(nums) for nums in rsa_classes]
+        self._n_rsa_keys = sum(len(c) for c in rsa_classes)
         self._ec_tables: Dict[str, Any] = {}
         for crv, keys in self._ec_keys.items():
             try:
@@ -230,7 +246,7 @@ class TPUBatchKeySet(KeySet):
                 run_family(a, run_es)
         if self._ed_table is not None:
             run_family(algs.EdDSA, run_ed)
-        if self._rsa_table is not None:
+        if self._rsa_tables:
             for a in _RS:
                 run_family(a, run_rs)
             for a in _PS:
@@ -304,12 +320,8 @@ class TPUBatchKeySet(KeySet):
                         slow: List[int], results: List[Any]) -> None:
         from ..tpu import rsa as tpursa
 
-        table = self._rsa_table
-        if len(table.n_ints) > 255:        # kid row must fit a u8
-            return self._run_rsa_arrays("rs", hash_name, idx, pb,
-                                        pending, slow)
         rows = pb.kid_rows(idx, self._kid_rsa_row)
-        if len(table.n_ints) == 1:
+        if self._n_rsa_keys == 1:
             rows = np.where(rows == -1, 0, rows)
         fast = rows >= 0
         slow.extend(int(i) for i in idx[~fast])
@@ -317,35 +329,46 @@ class TPUBatchKeySet(KeySet):
         rows = rows[fast].astype(np.int32)
         if len(idx) == 0:
             return
-        width = 2 * table.k
         h_len = tpursa.HASH_LEN[hash_name]
-        chunk_n = self._chunk_tokens(width + h_len + tpursa.RS_REC_EXTRA)
-        for lo in range(0, len(idx), chunk_n):
-            chunk = idx[lo: lo + chunk_n]
-            crows = rows[lo: lo + chunk_n]
-            m = len(chunk)
-            pad = _pad_size(m, chunk_n)
-            sig_mat = np.zeros((pad, width), np.uint8)
-            sig_mat[:m] = pb.sig_matrix(chunk, width)
-            sig_lens = np.zeros(pad, np.int64)
-            sig_lens[:m] = pb.sig_len[chunk]
-            hash_mat = np.zeros((pad, 64), np.uint8)
-            hash_mat[:m] = pb.digest[chunk]
-            key_idx = np.zeros(pad, np.int32)
-            key_idx[:m] = crows
-            telemetry.count("device.rs.tokens", m)
-            with telemetry.span(f"dispatch.rs.{hash_name}"):
-                rec = tpursa.rs_packed_records(
-                    table, sig_mat, sig_lens, hash_mat, hash_name,
-                    key_idx)
-                ok_dev = tpursa.verify_rs_packed_pending(
-                    table, rec, hash_name, mesh=self._mesh)
-            packed_parts.append(ok_dev)
+        for cls, table in enumerate(self._rsa_tables):
+            sel = (rows // 256) == cls
+            if not sel.any():
+                continue
+            cls_idx = idx[sel]
+            cls_rows = rows[sel] % 256
+            if len(table.n_ints) > 255:    # kid row must fit a u8
+                self._run_rsa_arrays("rs", hash_name, cls_idx, pb,
+                                     pending, slow, cls=cls)
+                continue
+            width = 2 * table.k
+            chunk_n = self._chunk_tokens(width + h_len
+                                         + tpursa.RS_REC_EXTRA)
+            for lo in range(0, len(cls_idx), chunk_n):
+                chunk = cls_idx[lo: lo + chunk_n]
+                crows = cls_rows[lo: lo + chunk_n]
+                m = len(chunk)
+                pad = _pad_size(m, chunk_n)
+                sig_mat = np.zeros((pad, width), np.uint8)
+                sig_mat[:m] = pb.sig_matrix(chunk, width)
+                sig_lens = np.zeros(pad, np.int64)
+                sig_lens[:m] = pb.sig_len[chunk]
+                hash_mat = np.zeros((pad, 64), np.uint8)
+                hash_mat[:m] = pb.digest[chunk]
+                key_idx = np.zeros(pad, np.int32)
+                key_idx[:m] = crows
+                telemetry.count("device.rs.tokens", m)
+                with telemetry.span(f"dispatch.rs.{hash_name}"):
+                    rec = tpursa.rs_packed_records(
+                        table, sig_mat, sig_lens, hash_mat, hash_name,
+                        key_idx)
+                    ok_dev = tpursa.verify_rs_packed_pending(
+                        table, rec, hash_name, mesh=self._mesh)
+                packed_parts.append(ok_dev)
 
-            def consume(arrs, chunk=chunk, m=m):
-                self._finish_arrays(chunk, arrs[0][:m], pb, results)
+                def consume(arrs, chunk=chunk, m=m):
+                    self._finish_arrays(chunk, arrs[0][:m], pb, results)
 
-            packed_meta.append(([pad], consume))
+                packed_meta.append(([pad], consume))
 
     def _run_ec_packed(self, alg: str, idx: np.ndarray, pb,
                        packed_parts: List[Any],
@@ -410,12 +433,12 @@ class TPUBatchKeySet(KeySet):
 
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
                         pb, pending: List[tuple],
-                        slow: List[int]) -> None:
+                        slow: List[int],
+                        cls: Optional[int] = None) -> None:
         from ..tpu import rsa as tpursa
 
-        table = self._rsa_table
         rows = pb.kid_rows(idx, self._kid_rsa_row)
-        if len(table.n_ints) == 1:
+        if self._n_rsa_keys == 1:
             # single-key family: kid-less tokens have exactly one
             # candidate — dispatch them to the device (row 0), matching
             # the object path's single-candidate routing
@@ -426,31 +449,39 @@ class TPUBatchKeySet(KeySet):
         rows = rows[fast].astype(np.int32)
         if len(idx) == 0:
             return
-        width = 2 * table.k
-        for lo in range(0, len(idx), self._max_chunk):
-            chunk = idx[lo: lo + self._max_chunk]
-            crows = rows[lo: lo + self._max_chunk]
-            m = len(chunk)
-            pad = _pad_size(m, self._max_chunk)
-            sig_mat = np.zeros((pad, width), np.uint8)
-            sig_mat[:m] = pb.sig_matrix(chunk, width)
-            sig_lens = np.zeros(pad, np.int64)
-            sig_lens[:m] = pb.sig_len[chunk]
-            hash_mat = np.zeros((pad, 64), np.uint8)
-            hash_mat[:m] = pb.digest[chunk]
-            key_idx = np.zeros(pad, np.int32)
-            key_idx[:m] = crows
-            telemetry.count(f"device.{kind}.tokens", m)
-            with telemetry.span(f"dispatch.{kind}.{hash_name}"):
-                if kind == "rs":
-                    fin = tpursa.verify_pkcs1v15_arrays_pending(
-                        table, sig_mat, sig_lens, hash_mat, hash_name,
-                        key_idx)
-                else:
-                    fin = tpursa.verify_pss_arrays_pending(
-                        table, sig_mat, sig_lens, hash_mat, hash_name,
-                        key_idx)
-            pending.append((chunk, m, fin))
+        for c, table in enumerate(self._rsa_tables):
+            if cls is not None and c != cls:
+                continue
+            sel = (rows // 256) == c
+            if not sel.any():
+                continue
+            cls_idx = idx[sel]
+            cls_rows = rows[sel] % 256
+            width = 2 * table.k
+            for lo in range(0, len(cls_idx), self._max_chunk):
+                chunk = cls_idx[lo: lo + self._max_chunk]
+                crows = cls_rows[lo: lo + self._max_chunk]
+                m = len(chunk)
+                pad = _pad_size(m, self._max_chunk)
+                sig_mat = np.zeros((pad, width), np.uint8)
+                sig_mat[:m] = pb.sig_matrix(chunk, width)
+                sig_lens = np.zeros(pad, np.int64)
+                sig_lens[:m] = pb.sig_len[chunk]
+                hash_mat = np.zeros((pad, 64), np.uint8)
+                hash_mat[:m] = pb.digest[chunk]
+                key_idx = np.zeros(pad, np.int32)
+                key_idx[:m] = crows
+                telemetry.count(f"device.{kind}.tokens", m)
+                with telemetry.span(f"dispatch.{kind}.{hash_name}"):
+                    if kind == "rs":
+                        fin = tpursa.verify_pkcs1v15_arrays_pending(
+                            table, sig_mat, sig_lens, hash_mat,
+                            hash_name, key_idx)
+                    else:
+                        fin = tpursa.verify_pss_arrays_pending(
+                            table, sig_mat, sig_lens, hash_mat,
+                            hash_name, key_idx)
+                pending.append((chunk, m, fin))
 
     def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb,
                        pending: List[tuple], slow: List[int]) -> None:
@@ -617,9 +648,9 @@ class TPUBatchKeySet(KeySet):
                 continue
             if key_for[j] is None:
                 buckets.setdefault(("cpu",), []).append(j)
-            elif p.alg in _RS and self._rsa_table is not None:
+            elif p.alg in _RS and self._rsa_tables:
                 buckets.setdefault(("rs", _RS[p.alg]), []).append(j)
-            elif p.alg in _PS and self._rsa_table is not None:
+            elif p.alg in _PS and self._rsa_tables:
                 buckets.setdefault(("ps", _PS[p.alg]), []).append(j)
             elif p.alg in _ES and _ES[p.alg] in self._ec_tables:
                 buckets.setdefault(("es", p.alg), []).append(j)
@@ -698,24 +729,30 @@ class TPUBatchKeySet(KeySet):
     def _run_rsa(self, kind, hash_name, idxs, parsed_list, key_for, results):
         from ..tpu import rsa as tpursa
 
-        table = self._rsa_table
-        for lo in range(0, len(idxs), self._max_chunk):
-            chunk = idxs[lo: lo + self._max_chunk]
-            pad = _pad_size(len(chunk), self._max_chunk)
-            sigs = [parsed_list[j].signature for j in chunk]
-            hashes_ = self._hashes(chunk, parsed_list, hash_name)
-            rows = [self._rsa_rows[key_for[j]] for j in chunk]
-            fill = pad - len(chunk)
-            sigs += [b""] * fill
-            hashes_ += [b"\x00" * tpursa.HASH_LEN[hash_name]] * fill
-            key_idx = np.asarray(rows + [0] * fill, np.int32)
-            if kind == "rs":
-                ok = tpursa.verify_pkcs1v15_batch(
-                    table, sigs, hashes_, hash_name, key_idx)
-            else:
-                ok = tpursa.verify_pss_batch(
-                    table, sigs, hashes_, hash_name, key_idx)
-            self._finish(chunk, parsed_list, ok[: len(chunk)], results)
+        by_cls: Dict[int, List[int]] = {}
+        for j in idxs:
+            by_cls.setdefault(
+                self._rsa_rows[key_for[j]] // 256, []).append(j)
+        for cls, cidxs in sorted(by_cls.items()):
+            table = self._rsa_tables[cls]
+            for lo in range(0, len(cidxs), self._max_chunk):
+                chunk = cidxs[lo: lo + self._max_chunk]
+                pad = _pad_size(len(chunk), self._max_chunk)
+                sigs = [parsed_list[j].signature for j in chunk]
+                hashes_ = self._hashes(chunk, parsed_list, hash_name)
+                rows = [self._rsa_rows[key_for[j]] % 256 for j in chunk]
+                fill = pad - len(chunk)
+                sigs += [b""] * fill
+                hashes_ += [b"\x00" * tpursa.HASH_LEN[hash_name]] * fill
+                key_idx = np.asarray(rows + [0] * fill, np.int32)
+                if kind == "rs":
+                    ok = tpursa.verify_pkcs1v15_batch(
+                        table, sigs, hashes_, hash_name, key_idx)
+                else:
+                    ok = tpursa.verify_pss_batch(
+                        table, sigs, hashes_, hash_name, key_idx)
+                self._finish(chunk, parsed_list, ok[: len(chunk)],
+                             results)
 
     def _run_ec(self, alg, idxs, parsed_list, key_for, results):
         from ..tpu import ec as tpuec

@@ -687,4 +687,88 @@ void cap_sha_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
   for (auto& th : threads) th.join();
 }
 
+
+// EMSA-PSS-VERIFY (RFC 8017 §9.1.2) for a batch of device-computed EMs,
+// salt auto-recovered (parity with cap_tpu.tpu.rsa.pss_check_em and the
+// CPU oracle's PSS.AUTO). em: [n, em_stride] right-aligned big-endian.
+void cap_pss_check_batch(const uint8_t* em, int64_t n, int64_t em_stride,
+                         const uint8_t* mhash, int64_t mhash_stride,
+                         const int64_t* em_bits, int32_t bits,
+                         const uint8_t* valid, uint8_t* out_ok,
+                         int32_t n_threads) {
+  const int64_t h_len = bits / 8;
+  void (*hash_fn)(const uint8_t*, size_t, uint8_t*) =
+      bits == 256 ? sha2::sha256 : bits == 384 ? sha2::sha384 : sha2::sha512;
+  if (n_threads <= 0) {
+    n_threads = int32_t(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 4;
+  }
+  if (n_threads > n) n_threads = int32_t(n > 0 ? n : 1);
+  auto worker = [&](int64_t lo, int64_t hi) {
+    std::vector<uint8_t> db((size_t)em_stride);
+    std::vector<uint8_t> mgf_in((size_t)h_len + 4);
+    std::vector<uint8_t> mgf_out(64);
+    std::vector<uint8_t> mprime(8 + 64 + (size_t)em_stride);
+    std::vector<uint8_t> hprime(64);
+    for (int64_t i = lo; i < hi; i++) {
+      out_ok[i] = 0;
+      if (!valid[i]) continue;
+      const uint8_t* row = em + i * em_stride;
+      int64_t elen = (em_bits[i] + 7) / 8;
+      if (elen > em_stride) continue;
+      // dropped high bytes must be zero (EM < 2^emBits)
+      bool lead_zero = true;
+      for (int64_t j = 0; j < em_stride - elen; j++)
+        if (row[j]) { lead_zero = false; break; }
+      if (!lead_zero) continue;
+      const uint8_t* e = row + (em_stride - elen);
+      if (elen < h_len + 2) continue;
+      if (e[elen - 1] != 0xBC) continue;
+      int64_t db_len = elen - h_len - 1;
+      const uint8_t* masked_db = e;
+      const uint8_t* h = e + db_len;
+      int unused = int(8 * elen - em_bits[i]);
+      if (unused && (masked_db[0] >> (8 - unused))) continue;
+      // DB = maskedDB XOR MGF1(H, db_len)
+      std::memcpy(mgf_in.data(), h, size_t(h_len));
+      for (int64_t off = 0, c = 0; off < db_len; off += h_len, c++) {
+        mgf_in[size_t(h_len) + 0] = uint8_t(c >> 24);
+        mgf_in[size_t(h_len) + 1] = uint8_t(c >> 16);
+        mgf_in[size_t(h_len) + 2] = uint8_t(c >> 8);
+        mgf_in[size_t(h_len) + 3] = uint8_t(c);
+        hash_fn(mgf_in.data(), size_t(h_len) + 4, mgf_out.data());
+        int64_t take = db_len - off < h_len ? db_len - off : h_len;
+        for (int64_t j = 0; j < take; j++)
+          db[size_t(off + j)] = masked_db[off + j] ^ mgf_out[size_t(j)];
+      }
+      if (unused) db[0] &= uint8_t(0xFF >> unused);
+      // DB = 0x00.. ‖ 0x01 ‖ salt
+      int64_t sep = -1;
+      for (int64_t j = 0; j < db_len; j++) {
+        if (db[size_t(j)] == 0x01) { sep = j; break; }
+        if (db[size_t(j)] != 0x00) { sep = -2; break; }
+      }
+      if (sep < 0) continue;
+      const uint8_t* salt = db.data() + sep + 1;
+      int64_t salt_len = db_len - sep - 1;
+      // H' = Hash(0x00*8 ‖ mHash ‖ salt)
+      std::memset(mprime.data(), 0, 8);
+      std::memcpy(mprime.data() + 8, mhash + i * mhash_stride,
+                  size_t(h_len));
+      std::memcpy(mprime.data() + 8 + h_len, salt, size_t(salt_len));
+      hash_fn(mprime.data(), size_t(8 + h_len + salt_len), hprime.data());
+      out_ok[i] = std::memcmp(hprime.data(), h, size_t(h_len)) == 0;
+    }
+  };
+  if (n_threads <= 1) { worker(0, n); return; }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    int64_t lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back(worker, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
 }  // extern "C"

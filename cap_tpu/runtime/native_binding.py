@@ -68,6 +68,17 @@ _lib.cap_sha_batch.argtypes = [
     ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
     ctypes.c_int32,
 ]
+try:
+    _lib.cap_pss_check_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int32,
+    ]
+    _HAS_PSS_CHECK = True
+except AttributeError:           # stale .so from before the PSS check
+    _HAS_PSS_CHECK = False
 
 
 class NativeParsed:
@@ -464,3 +475,36 @@ def sha_batch(chunks: Sequence[bytes], bits: int,
     )
     raw = out.tobytes()
     return [raw[i * out_len:(i + 1) * out_len] for i in range(n)]
+
+
+def pss_check_batch(em_mat: np.ndarray, mhash_mat: np.ndarray,
+                    em_bits: np.ndarray, bits: int, valid: np.ndarray,
+                    n_threads: int = 0) -> Optional[np.ndarray]:
+    """Batched EMSA-PSS-VERIFY (salt auto-recovered) in native C++.
+
+    em_mat: [n, stride] right-aligned big-endian EM bytes;
+    mhash_mat: [n, ≥bits/8] digests; em_bits: [n] modBits-1;
+    valid: [n] precondition mask. Returns [n] bool, or None when the
+    loaded library predates cap_pss_check_batch (caller falls back to
+    the Python check).
+    """
+    if not _HAS_PSS_CHECK:
+        return None
+    em_mat = np.ascontiguousarray(em_mat, np.uint8)
+    mhash_mat = np.ascontiguousarray(mhash_mat, np.uint8)
+    em_bits = np.ascontiguousarray(em_bits, np.int64)
+    valid_u8 = np.ascontiguousarray(valid, np.uint8)
+    n = em_mat.shape[0]
+    out = np.zeros(n, np.uint8)
+    _lib.cap_pss_check_batch(
+        em_mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, em_mat.shape[1],
+        mhash_mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mhash_mat.shape[1],
+        em_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        bits,
+        valid_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_threads,
+    )
+    return out.astype(bool)

@@ -1,11 +1,14 @@
 """TPU verify engine.
 
 The performance layer of cap_tpu: batched big-number and elliptic-curve
-arithmetic as JAX programs (XLA-compiled for TPU, with Pallas kernels
-for the hottest loops), plus the batching/bucketing runtime that feeds
-it. The reference has no native/accelerated components (SURVEY.md §2) —
-this subsystem is the new framework's replacement for the Go stdlib
-crypto inner loops (crypto/rsa, crypto/ecdsa, crypto/ed25519).
+arithmetic as JAX programs XLA-compiled for TPU, plus the
+batching/bucketing runtime that feeds it. A hand-written fused Pallas
+REDC kernel exists (pallas_redc.py, CAP_TPU_PALLAS=1) but the measured
+A/B (docs/PERF.md) has XLA's fusion ahead, so the XLA path is the
+default. The reference has no native/accelerated components
+(SURVEY.md §2) — this subsystem is the new framework's replacement for
+the Go stdlib crypto inner loops (crypto/rsa, crypto/ecdsa,
+crypto/ed25519).
 
 Layout convention: big integers are little-endian base-2^16 limb vectors
 stored **limb-first**: an array of shape [K, N] holds N numbers of K

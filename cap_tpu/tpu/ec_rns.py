@@ -83,11 +83,20 @@ def _fixB(c, x):
                     c.dB["inv_f"][:, None])
 
 
+def _redc_dispatch(c: ECRNSContext, pA, pB):
+    """REDC via the fused Pallas kernel on accelerators, XLA otherwise."""
+    from . import pallas_redc
+
+    if pallas_redc.enabled():
+        return pallas_redc.redc_fused(c, pA, pB)
+    return _redc(pA, pB, c.sig_c, c.p_B, c.consts)
+
+
 def rmul(c: ECRNSContext, a, b):
     """(a·b)·A⁻¹ mod p — output value < 3p for λ₁λ₂ ≤ 2^14."""
     pA = _fixA(c, a[0] * b[0])
     pB = _fixB(c, a[1] * b[1])
-    return _redc(pA, pB, c.sig_c, c.p_B, c.consts)
+    return _redc_dispatch(c, pA, pB)
 
 
 def rmul_many(c: ECRNSContext, pairs):
@@ -102,7 +111,7 @@ def rmul_many(c: ECRNSContext, pairs):
                                   axis=1))
     pB = _fixB(c, jnp.concatenate([a[1] * b[1] for a, b in pairs],
                                   axis=1))
-    tA, tB = _redc(pA, pB, c.sig_c, c.p_B, c.consts)
+    tA, tB = _redc_dispatch(c, pA, pB)
     return [(tA[:, i * n:(i + 1) * n], tB[:, i * n:(i + 1) * n])
             for i in range(len(pairs))]
 

@@ -449,18 +449,34 @@ def ed_packed_records(table: Ed25519KeyTable, sigs: Sequence[bytes],
     """
     n = len(sigs)
     rec = np.zeros((n, 64 + 32 + ED_REC_EXTRA), np.uint8)
+    chunks: List[bytes] = []
+    live: List[int] = []
     for j, sg in enumerate(sigs):
         row = int(key_idx[j])
+        rec[j, 97] = row
         if len(sg) == 64:
             rec[j, :64] = np.frombuffer(sg, np.uint8)
-            h = hashlib.sha512(
-                sg[:32] + table.key_bytes[row] + msgs[j]).digest()
-            kk = int.from_bytes(h, "little") % L_ORDER
-            rec[j, 64:96] = np.frombuffer(
-                kk.to_bytes(32, "little"), np.uint8)
             rec[j, 96] = not table.invalid[row]
-        rec[j, 97] = row
+            chunks.append(sg[:32] + table.key_bytes[row] + msgs[j])
+            live.append(j)
+    if not live:
+        return rec
+    # k = SHA-512(R ‖ A ‖ M): multithreaded C++ when built
+    digests = _sha512_batch(chunks)
+    for j, h in zip(live, digests):
+        kk = int.from_bytes(h, "little") % L_ORDER
+        rec[j, 64:96] = np.frombuffer(kk.to_bytes(32, "little"),
+                                      np.uint8)
     return rec
+
+
+def _sha512_batch(chunks: Sequence[bytes]) -> List[bytes]:
+    from ..runtime import prep
+
+    native = prep._load_native()
+    if native is not None:
+        return native.sha_batch(chunks, 512)
+    return [hashlib.sha512(c).digest() for c in chunks]
 
 
 def _le_bytes_to_limbs_dev(mat):

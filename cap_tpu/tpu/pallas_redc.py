@@ -1,0 +1,178 @@
+"""Fused RNS Montgomery reduction (REDC) as a Pallas TPU kernel.
+
+The EC/Ed ladders spend most of their device time in ``rns._redc``:
+two base extensions (packed bf16 matmuls) glued by ~10 elementwise
+Barrett-fix passes over [I, N] i32 residue planes. Under plain XLA each
+matmul boundary materializes its neighborhood to HBM, so the chain is
+HBM-traffic-bound (docs/PERF.md: the 160-layer ladder chain measures
+~0.4 ms/layer at N=65536 while its FLOPs are microseconds).
+
+This kernel runs the whole REDC — channel products, σ, A→B extension,
+the B-side multiplies, and the B→A extension — on VMEM-resident tiles,
+touching HBM once for inputs and once for outputs. Enabled for
+per-channel (EC/Ed) contexts via CAP_TPU_PALLAS=1; A/B numbers in
+docs/PERF.md. The RSA REDC (per-token key constants) stays on the XLA
+path.
+
+Numerical contract: identical to rns._redc. The Barrett fixes tolerate
+±2 quotient error, so deriving 1/m in f32 in-kernel (vs the host's
+f64→f32 constant) stays exact.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+F32 = jnp.float32
+BF16 = jnp.bfloat16
+
+_TILE = 2048        # lanes per grid step (multiple of 128)
+
+
+def enabled() -> bool:
+    """Fused Pallas REDC: opt-in via CAP_TPU_PALLAS=1 (A/B gate)."""
+    v = os.environ.get("CAP_TPU_PALLAS")
+    return v is not None and v not in ("0", "false", "no")
+
+
+def _fix(v, m, inv_f):
+    """Exact v mod m for 0 <= v < 2^31 (rns._mod_fix)."""
+    q = jnp.floor(v.astype(F32) * inv_f).astype(I32)
+    r = v - q * m
+    r = jnp.where(r < 0, r + m, r)
+    r = jnp.where(r < 0, r + m, r)
+    r = jnp.where(r >= m, r - m, r)
+    r = jnp.where(r >= m, r - m, r)
+    return r
+
+
+def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
+                      src_prod_mod_dst, offset):
+    """rns._extend on VMEM tiles: [I_src, T] -> [I_dst, T]."""
+    j = wh.shape[0]
+    t = sig.shape[1]
+    w_cat = jnp.concatenate([wh, wl], axis=0)              # [2J, I]
+    x_cat = jnp.concatenate(
+        [(sig >> 7).astype(BF16), (sig & 127).astype(BF16)], axis=1)
+    c = jnp.dot(w_cat, x_cat, preferred_element_type=F32).astype(I32)
+    hh = c[:j, :t]
+    mid = c[:j, t:] + c[j:, :t]
+    ll = c[j:, t:]
+    alpha = jnp.floor(
+        jnp.sum(sig.astype(F32) * inv_src_f, axis=0, keepdims=True)
+        + offset).astype(I32)                              # [1, T]
+    c14 = jnp.mod(jnp.full_like(m_dst, 1 << 14), m_dst)
+    c7 = jnp.mod(jnp.full_like(m_dst, 1 << 7), m_dst)
+    comb = _fix(_fix(hh, m_dst, inv_dst_f) * c14
+                + _fix(mid, m_dst, inv_dst_f) * c7
+                + _fix(ll, m_dst, inv_dst_f), m_dst, inv_dst_f)
+    corr = _fix(jnp.mod(alpha, m_dst)
+                * jnp.mod(src_prod_mod_dst, m_dst), m_dst, inv_dst_f)
+    return _fix(comb - corr + m_dst, m_dst, inv_dst_f)
+
+
+def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
+                 wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                 amodb_ref, bmoda_ref, invab_ref, invmib_ref,
+                 tA_ref, tB_ref):
+    xA = xA_ref[:]
+    xB = xB_ref[:]
+    mA = mA_ref[:]                       # [IA, 1] i32
+    mB = mB_ref[:]                       # [IB, 1] i32
+    invA_f = 1.0 / mA.astype(F32)
+    invB_f = 1.0 / mB.astype(F32)
+
+    sig = _fix(xA * sigc_ref[:], mA, invA_f)
+    q_B = _extend_in_kernel(sig, invA_f, wabh_ref[:], wabl_ref[:],
+                            mB, invB_f, amodb_ref[:], -1e-4)
+    qn = _fix(q_B * nB_ref[:], mB, invB_f)
+    t_B = _fix(xB + qn, mB, invB_f)
+    t_B = _fix(t_B * invab_ref[:], mB, invB_f)
+    sig2 = _fix(t_B * invmib_ref[:], mB, invB_f)
+    t_A = _extend_in_kernel(sig2, invB_f, wbah_ref[:], wbal_ref[:],
+                            mA, invA_f, bmoda_ref[:], 0.5 - 1e-4)
+    tA_ref[:] = t_A
+    tB_ref[:] = t_B
+
+
+_CONST_CACHE: Dict[int, tuple] = {}
+
+
+def _ctx_consts(c) -> tuple:
+    """Per-context 2-D constant arrays for the kernel (cached)."""
+    key = id(c)
+    out = _CONST_CACHE.get(key)
+    if out is None:
+        (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
+
+        def col(v):
+            # numpy on host: redc_fused runs inside jit traces, and
+            # tracer-created arrays must never be cached (they leak);
+            # numpy constants embed safely into every trace.
+            return np.asarray(v, np.int32).reshape(-1, 1)
+
+        out = (
+            col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
+            w_ab[0], w_ab[1], w_ba[0], w_ba[1],
+            col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
+        )
+        _CONST_CACHE[key] = out
+    return out
+
+
+@partial(jax.jit, static_argnames=("ia", "ib"))
+def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+               amodb, bmoda, invab, invmib, ia: int, ib: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = xA.shape[1]
+    grid = n // _TILE
+
+    def col_spec(rows):
+        return pl.BlockSpec((rows, _TILE), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+              invab, invmib)
+    return pl.pallas_call(
+        _redc_kernel,
+        out_shape=(jax.ShapeDtypeStruct((ia, n), I32),
+                   jax.ShapeDtypeStruct((ib, n), I32)),
+        grid=(grid,),
+        in_specs=[col_spec(ia), col_spec(ib)]
+        + [const_spec(a.shape) for a in consts],
+        out_specs=(col_spec(ia), col_spec(ib)),
+    )(xA, xB, *consts)
+
+
+def redc_fused(c, x_A, x_B):
+    """Drop-in for rns._redc on per-channel (EC/Ed) contexts.
+
+    Pads the lane axis to the tile size; padding lanes hold zeros,
+    which every fix maps to a valid residue and the caller's slices
+    drop.
+    """
+    ia, ib = x_A.shape[0], x_B.shape[0]
+    n = x_A.shape[1]
+    pad = (-n) % _TILE
+    if pad:
+        x_A = jnp.pad(x_A, ((0, 0), (0, pad)))
+        x_B = jnp.pad(x_B, ((0, 0), (0, pad)))
+    tA, tB = _redc_call(x_A, x_B, *_ctx_consts(c), ia=ia, ib=ib)
+    if pad:
+        tA = tA[:, :n]
+        tB = tB[:, :n]
+    return tA, tB

@@ -21,6 +21,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from cap_tpu import compile_cache
+
+compile_cache.enable()
+
 from cap_tpu import testing as T
 from cap_tpu.jwt import StaticKeySet
 from cap_tpu.jwt.jwk import JWK
