@@ -28,7 +28,12 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, REPO)
+import importlib.util
+_spec = importlib.util.find_spec("cap_tpu")
+if _spec is None or not (_spec.origin or "").startswith(REPO + os.sep):
+    # Not installed, or an installed copy would shadow THIS checkout:
+    # the bench must always measure the code it sits next to.
+    sys.path.insert(0, REPO)
 
 BASELINE_TARGET = 500_000.0  # verifies/sec, BASELINE.json north_star
 

@@ -43,6 +43,12 @@ _ES = {algs.ES256: "P-256", algs.ES384: "P-384", algs.ES512: "P-521"}
 
 _MIN_BUCKET = 128
 
+# RSA key-table rows encode as class * _RSA_CLS_STRIDE + row. The
+# stride must exceed any realistic per-class key count: with a 256
+# stride, key 256 of class 0 would alias to class 1 row 0 and dispatch
+# against the wrong modulus table (a persistent false rejection).
+_RSA_CLS_STRIDE = 1 << 16
+
 
 def _pad_size(n: int, max_chunk: int) -> int:
     """Next power of two ≥ n (≥ _MIN_BUCKET), capped at max_chunk."""
@@ -81,7 +87,7 @@ class TPUBatchKeySet(KeySet):
         # RSA keys additionally split into SIZE CLASSES (one table per
         # limb width): a mixed 2048/4096 JWKS must not pad every
         # token's wire record to the widest key (the round-1 config-②
-        # cliff). Rows encode as class*256 + row.
+        # cliff). Rows encode as class*_RSA_CLS_STRIDE + row.
         from ..tpu.limbs import nlimbs_for_bits
 
         rsa_classes: List[list] = []      # per class: [(n, e), ...]
@@ -101,7 +107,8 @@ class TPUBatchKeySet(KeySet):
                     cls = len(rsa_classes)
                     rsa_classes.append([])
                     rsa_class_need.append(need)
-                self._rsa_rows[i] = cls * 256 + len(rsa_classes[cls])
+                self._rsa_rows[i] = (cls * _RSA_CLS_STRIDE
+                                     + len(rsa_classes[cls]))
                 rsa_classes[cls].append((nums.n, nums.e))
             elif isinstance(key, ec.EllipticCurvePublicKey):
                 crv = {"secp256r1": "P-256", "secp384r1": "P-384",
@@ -331,11 +338,11 @@ class TPUBatchKeySet(KeySet):
             return
         h_len = tpursa.HASH_LEN[hash_name]
         for cls, table in enumerate(self._rsa_tables):
-            sel = (rows // 256) == cls
+            sel = (rows // _RSA_CLS_STRIDE) == cls
             if not sel.any():
                 continue
             cls_idx = idx[sel]
-            cls_rows = rows[sel] % 256
+            cls_rows = rows[sel] % _RSA_CLS_STRIDE
             if len(table.n_ints) > 255:    # kid row must fit a u8
                 self._run_rsa_arrays("rs", hash_name, cls_idx, pb,
                                      pending, slow, cls=cls)
@@ -452,11 +459,11 @@ class TPUBatchKeySet(KeySet):
         for c, table in enumerate(self._rsa_tables):
             if cls is not None and c != cls:
                 continue
-            sel = (rows // 256) == c
+            sel = (rows // _RSA_CLS_STRIDE) == c
             if not sel.any():
                 continue
             cls_idx = idx[sel]
-            cls_rows = rows[sel] % 256
+            cls_rows = rows[sel] % _RSA_CLS_STRIDE
             width = 2 * table.k
             for lo in range(0, len(cls_idx), self._max_chunk):
                 chunk = cls_idx[lo: lo + self._max_chunk]
@@ -732,7 +739,7 @@ class TPUBatchKeySet(KeySet):
         by_cls: Dict[int, List[int]] = {}
         for j in idxs:
             by_cls.setdefault(
-                self._rsa_rows[key_for[j]] // 256, []).append(j)
+                self._rsa_rows[key_for[j]] // _RSA_CLS_STRIDE, []).append(j)
         for cls, cidxs in sorted(by_cls.items()):
             table = self._rsa_tables[cls]
             for lo in range(0, len(cidxs), self._max_chunk):
@@ -740,7 +747,8 @@ class TPUBatchKeySet(KeySet):
                 pad = _pad_size(len(chunk), self._max_chunk)
                 sigs = [parsed_list[j].signature for j in chunk]
                 hashes_ = self._hashes(chunk, parsed_list, hash_name)
-                rows = [self._rsa_rows[key_for[j]] % 256 for j in chunk]
+                rows = [self._rsa_rows[key_for[j]] % _RSA_CLS_STRIDE
+                        for j in chunk]
                 fill = pad - len(chunk)
                 sigs += [b""] * fill
                 hashes_ += [b"\x00" * tpursa.HASH_LEN[hash_name]] * fill

@@ -17,9 +17,20 @@ tests/test_parallel.py and the driver's dryrun_multichip.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from collections import OrderedDict
+from typing import Any, Tuple
 
-_replicated_cache: Dict[Tuple[int, int], Any] = {}
+# (id(mesh), id(arr)) → (mesh, arr, replicated). The STRONG refs to the
+# keying objects make id-aliasing impossible while an entry lives (a
+# rebuilt key table can never be served another table's replicated
+# copy), and the LRU bound keeps dropped keysets from pinning device
+# buffers forever.
+_replicated_cache: "OrderedDict[Tuple[int, int], Any]" = OrderedDict()
+# Sized for several live keysets: one meshed TPUBatchKeySet places
+# ~6 arrays per RSA size class + 4-5 per EC curve + Ed tables; the
+# bound must comfortably exceed the combined working set or every
+# batch silently re-broadcasts its tables across the mesh.
+_REPLICATED_CACHE_MAX = 512
 
 
 def batch_axis(mesh) -> str:
@@ -39,16 +50,20 @@ def shard_batch(mesh, arr):
 def replicated(mesh, arr):
     """Mesh-replicated copy of a device array, cached per (mesh, array).
 
-    Cache keys are object ids; both the mesh (owned by the KeySet) and
-    the table arrays (owned by the key tables) outlive the cache entry's
-    usefulness, so ids are stable.
+    The cache holds strong references to the mesh and source array, so
+    entries can never be aliased by id reuse after garbage collection;
+    a small LRU bound evicts replicated buffers of dropped keysets.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
     key = (id(mesh), id(arr))
-    out = _replicated_cache.get(key)
-    if out is None:
-        out = jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))
-        _replicated_cache[key] = out
+    hit = _replicated_cache.get(key)
+    if hit is not None:
+        _replicated_cache.move_to_end(key)
+        return hit[2]
+    out = jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))
+    _replicated_cache[key] = (mesh, arr, out)
+    while len(_replicated_cache) > _REPLICATED_CACHE_MAX:
+        _replicated_cache.popitem(last=False)
     return out

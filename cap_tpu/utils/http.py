@@ -45,8 +45,9 @@ class ConnectionPool:
     clients: idle connections are parked per (scheme, host, port, SSL
     context) and reused for subsequent requests. A request on a reused
     connection that fails mid-flight (stale keep-alive the server
-    already closed) is retried ONCE on a fresh connection; failures on
-    fresh connections propagate as ConnectionError/OSError.
+    already closed) is retried ONCE on a fresh connection — idempotent
+    methods only; failures on fresh connections propagate as
+    ConnectionError/OSError.
     """
 
     def __init__(self, max_idle_per_key: int = 4,
@@ -121,12 +122,21 @@ class ConnectionPool:
         followed up to ``max_redirects`` (the reference's http.Client
         default behavior).
         """
+        origin_host = urlparse(url).hostname
         for _ in range(max_redirects + 1):
             status, data, hdrs = self._one(method, url, body, headers,
                                            ctx, timeout)
             loc = hdrs.get("location")
             if loc and status in (301, 302, 303, 307, 308):
                 url = urljoin(url, loc)
+                if urlparse(url).hostname != origin_host and headers:
+                    # Credentials must not follow a redirect off the
+                    # original host (Go's http.Client strips them the
+                    # same way): a compromised IdP response would
+                    # otherwise exfiltrate Bearer/Basic credentials.
+                    headers = {k: v for k, v in headers.items()
+                               if k.lower() not in ("authorization",
+                                                    "cookie")}
                 if status in (301, 302, 303) and method != "GET":
                     # urllib/browser semantics: re-issue as GET
                     method, body = "GET", None
@@ -139,7 +149,11 @@ class ConnectionPool:
         if u.scheme not in ("http", "https"):
             raise ConnectionError(f"unsupported URL scheme {u.scheme!r}")
         port = u.port or (443 if u.scheme == "https" else 80)
-        key = (u.scheme, u.hostname, port, id(ctx) if ctx else None)
+        # Key on the SSLContext OBJECT (hashable; the pool entry keeps
+        # it alive): an id()-based key could alias a dead Provider's
+        # context with a newly-allocated one at the same address and
+        # hand out a socket validated under the wrong CA.
+        key = (u.scheme, u.hostname, port, ctx)
         path = u.path or "/"
         if u.query:
             path += "?" + u.query
@@ -168,9 +182,11 @@ class ConnectionPool:
                 conn.timeout = timeout
                 if getattr(conn, "sock", None) is not None:
                     conn.sock.settimeout(timeout)
+            sent = False
             try:
                 conn.request(method, path, body=body,
                              headers=headers or {})
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
             except (http.client.HTTPException, ConnectionError,
@@ -178,8 +194,15 @@ class ConnectionPool:
                     OSError) as e:
                 conn.close()
                 last_exc = e
-                if reused:
-                    continue   # stale keep-alive → one fresh retry
+                if reused and (not sent or method in ("GET", "HEAD")):
+                    # Stale keep-alive → one fresh retry. Send-phase
+                    # failures retry for ANY method (the server closed
+                    # the parked socket before reading, so nothing was
+                    # processed); after the request went out, only
+                    # idempotent methods retry — replaying a completed
+                    # POST (token exchange) could consume the one-shot
+                    # auth code twice.
+                    continue
                 if isinstance(e, OSError):
                     raise
                 raise ConnectionError(str(e)) from e

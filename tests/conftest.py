@@ -11,10 +11,16 @@ startup and pins the platform; ``jax.config.update`` still wins when
 called before first device use.
 """
 
+import importlib.util
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.find_spec("cap_tpu")
+if _spec is None or not (_spec.origin or "").startswith(_REPO + os.sep):
+    # Not installed, or an installed copy would shadow this checkout:
+    # the suite must always test the code it sits next to.
+    sys.path.insert(0, _REPO)
 
 import jax
 

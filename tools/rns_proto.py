@@ -9,12 +9,13 @@ matmuls, Barrett guess-then-fix channel reduction, and a shifted
 comparison window at the end instead of any RNS->binary conversion.
 """
 
+import os
 import random
 import sys
 
 import numpy as np
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def sieve_primes(lo, hi):
