@@ -3,17 +3,23 @@ CXXFLAGS ?= -O3 -march=native -fPIC -shared -pthread -std=c++17 -Wall
 
 NATIVE_DIR := cap_tpu/runtime/native
 NATIVE_SO := $(NATIVE_DIR)/libcapruntime.so
+CLAIMS_SO := $(NATIVE_DIR)/_capclaims.so
 CLIENT_DIR := cap_tpu/serve/native
 CLIENT_SO := $(CLIENT_DIR)/libcapclient.so
+PYTHON ?= python3
+PY_INCLUDE := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_paths()['include'])")
 
 .PHONY: all native test bench clean
 
 all: native
 
-native: $(NATIVE_SO) $(CLIENT_SO)
+native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 
 $(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
+
+$(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
+	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
@@ -25,7 +31,7 @@ bench: native
 	python bench.py
 
 clean:
-	rm -f $(NATIVE_SO) $(CLIENT_SO)
+	rm -f $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 
 test-all: native
 	python -m pytest tests/ -q -m ""

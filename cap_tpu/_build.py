@@ -1,33 +1,72 @@
 """Build the native runtime libraries on demand.
 
 The compiled ``.so`` artifacts are not committed (they are unreviewable
-and go stale silently); ``make native`` produces them, and the ctypes
-bindings call :func:`build_native` on first use when the library is
-missing. Failures are non-fatal — every native component has a pure
-Python fallback.
+and go stale silently). The bindings call :func:`build_native` on first
+use; it compiles the ``.cpp`` sources that ship INSIDE the package with
+g++ directly — no Makefile needed, so non-editable pip installs build
+too — using the RUNNING interpreter's headers for the extension module
+(a PATH ``python3`` of a different version must not pick the headers).
+Failures are non-fatal: every native component has a pure Python
+fallback. ``make native`` remains the developer-facing entry point.
 """
 
 from __future__ import annotations
 
 import os
 import subprocess
+import sysconfig
 import threading
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.dirname(os.path.abspath(__file__))
 _lock = threading.Lock()
 _done = False
 
+_FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
+
+# (source, output, needs_python_headers) — paths relative to cap_tpu/.
+_TARGETS = [
+    (os.path.join("runtime", "native", "jose_native.cpp"),
+     os.path.join("runtime", "native", "libcapruntime.so"), False),
+    (os.path.join("serve", "native", "client_native.cpp"),
+     os.path.join("serve", "native", "libcapclient.so"), False),
+    (os.path.join("runtime", "native", "claims_ext.cpp"),
+     os.path.join("runtime", "native", "_capclaims.so"), True),
+]
+
+
+def _build_one(src: str, out: str, py_headers: bool,
+               timeout: float) -> None:
+    src = os.path.join(_PKG, src)
+    out = os.path.join(_PKG, out)
+    if not os.path.exists(src):
+        return
+    if os.path.exists(out) and \
+            os.path.getmtime(out) >= os.path.getmtime(src):
+        return
+    cmd = ["g++", *_FLAGS]
+    # -march=native when the compiler supports it (portable fallback
+    # without), matching the Makefile's default flags.
+    cmd.append("-march=native")
+    if py_headers:
+        cmd.append("-I" + sysconfig.get_paths()["include"])
+    cmd += ["-o", out, src]
+    res = subprocess.run(cmd, capture_output=True, timeout=timeout,
+                         check=False)
+    if res.returncode != 0 and "-march=native" in cmd:
+        cmd.remove("-march=native")
+        subprocess.run(cmd, capture_output=True, timeout=timeout,
+                       check=False)
+
 
 def build_native(timeout: float = 180.0) -> None:
-    """Run ``make -C <repo> native`` once, quietly, best-effort."""
+    """Compile any missing/stale native library once, best-effort."""
     global _done
     with _lock:
         if _done:
             return
         _done = True
-        try:
-            subprocess.run(["make", "-C", _REPO, "native"],
-                           capture_output=True, timeout=timeout,
-                           check=False)
-        except Exception:  # noqa: BLE001 - fallbacks handle absence
-            pass
+        for src, out, py_headers in _TARGETS:
+            try:
+                _build_one(src, out, py_headers, timeout)
+            except Exception:  # noqa: BLE001 - fallbacks handle absence
+                pass

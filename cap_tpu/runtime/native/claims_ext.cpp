@@ -1,0 +1,716 @@
+// _capclaims — native batch claims-JSON parsing for cap_tpu.
+//
+// The reference parses claims with encoding/json per token inside its
+// verify path (jwt/validator.go UnmarshalClaims → map[string]interface{});
+// the Python analog (json.loads per payload) costs 5-25 µs/token on the
+// host and sits on the GIL, capping honest unique-token batch
+// throughput. This extension splits the work:
+//
+//   phase 1 (GIL RELEASED, multithreaded): every payload is scanned by
+//     a strict JSON parser into a flat numeric "tape" — string/number
+//     spans, structural ops — with all validation done here;
+//   phase 2 (GIL held, single pass): the tapes replay into Python
+//     objects. Claim KEYS repeat massively across tokens, so a small
+//     byte-exact intern table reuses one PyUnicode per distinct key.
+//
+// Fidelity contract: for any payload this parser accepts, the result
+// is indistinguishable from json.loads(payload); anything outside the
+// supported envelope (depth > 64, NaN/Infinity literals, lone
+// surrogates, ints > 4300 digits, ...) is flagged FALLBACK and the
+// Python side re-parses that token with json.loads — never a silent
+// behavioural difference. Malformed JSON is flagged with a parse error
+// the Python side maps to MalformedTokenError (same taxonomy as the
+// jose path).
+//
+// Build: make native (g++ -O3 -shared -fPIC -pthread, linked against
+// the CPython headers; the module ships as source and is compiled on
+// first use like the rest of the native runtime).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Tape representation (phase-1 output, phase-2 input)
+// ---------------------------------------------------------------------------
+
+enum Op : uint32_t {
+  OP_OBJ_START = 1,
+  OP_OBJ_END = 2,
+  OP_ARR_START = 3,
+  OP_ARR_END = 4,
+  OP_KEY = 5,      // off, len, esc  (string span; esc => needs unescape)
+  OP_STR = 6,      // off, len, esc
+  OP_INT = 7,      // lo, hi         (int64 in two u32 slots)
+  OP_BIGINT = 8,   // off, len       (digits span; PyLong_FromString)
+  OP_FLOAT = 9,    // lo, hi         (double bits in two u32 slots)
+  OP_TRUE = 10,
+  OP_FALSE = 11,
+  OP_NULL = 12,
+};
+
+enum Status : int32_t {
+  ST_OK = 0,
+  ST_MALFORMED = 1,   // invalid JSON → MalformedTokenError
+  ST_NOT_OBJECT = 2,  // valid JSON, but not an object → MalformedTokenError
+  ST_FALLBACK = 3,    // valid-looking but outside the envelope → json.loads
+};
+
+constexpr int kMaxDepth = 64;
+// CPython refuses int() conversion beyond sys.int_info.default_max_str_digits
+// (4300) — route anything close to that through json.loads.
+constexpr int kMaxIntDigits = 2000;
+
+struct TokenTape {
+  std::vector<uint32_t> ops;  // triplets: op, a, b
+  int32_t status = ST_MALFORMED;
+};
+
+struct Parser {
+  const uint8_t* s;
+  size_t n;
+  size_t i = 0;
+  TokenTape* out;
+
+  explicit Parser(const uint8_t* data, size_t len, TokenTape* tape)
+      : s(data), n(len), out(tape) {}
+
+  void emit(uint32_t op, uint32_t a = 0, uint32_t b = 0) {
+    out->ops.push_back(op);
+    out->ops.push_back(a);
+    out->ops.push_back(b);
+  }
+
+  void ws() {
+    while (i < n && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' ||
+                     s[i] == '\r'))
+      ++i;
+  }
+
+  // Scan a JSON string starting AFTER the opening quote; returns false on
+  // malformed. Sets *esc when escapes are present, validates UTF-8 and
+  // escape syntax (so phase 2 can decode without error paths).
+  bool scan_string(uint32_t* off, uint32_t* len, uint32_t* esc, bool* fb) {
+    size_t start = i;
+    *esc = 0;
+    while (i < n) {
+      uint8_t c = s[i];
+      if (c == '"') {
+        *off = static_cast<uint32_t>(start);
+        *len = static_cast<uint32_t>(i - start);
+        ++i;
+        return true;
+      }
+      if (c == '\\') {
+        *esc = 1;
+        if (i + 1 >= n) return false;
+        uint8_t e = s[i + 1];
+        if (e == 'u') {
+          if (i + 5 >= n) return false;
+          for (int k = 2; k <= 5; ++k) {
+            uint8_t h = s[i + k];
+            if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                  (h >= 'A' && h <= 'F')))
+              return false;
+          }
+          // Lone/paired surrogates: json.loads has precise pass-through
+          // semantics for lone surrogates — route any surrogate escape
+          // to the fallback rather than replicate them bug-for-bug.
+          uint32_t v = 0;
+          for (int k = 2; k <= 5; ++k) {
+            uint8_t h = s[i + k];
+            v = v * 16 + (h <= '9' ? h - '0' : (h | 32) - 'a' + 10);
+          }
+          if (v >= 0xD800 && v <= 0xDFFF) *fb = true;
+          i += 6;
+          continue;
+        }
+        if (e != '"' && e != '\\' && e != '/' && e != 'b' && e != 'f' &&
+            e != 'n' && e != 'r' && e != 't')
+          return false;
+        i += 2;
+        continue;
+      }
+      if (c < 0x20) return false;  // unescaped control char
+      if (c < 0x80) {
+        ++i;
+        continue;
+      }
+      // UTF-8 validation (strict, no overlongs/surrogates) so phase 2's
+      // PyUnicode_DecodeUTF8 cannot fail.
+      int need;
+      uint32_t cp;
+      if ((c & 0xE0) == 0xC0) {
+        need = 1;
+        cp = c & 0x1F;
+        if (cp < 2) return false;  // overlong
+      } else if ((c & 0xF0) == 0xE0) {
+        need = 2;
+        cp = c & 0x0F;
+      } else if ((c & 0xF8) == 0xF0) {
+        need = 3;
+        cp = c & 0x07;
+      } else {
+        return false;
+      }
+      if (i + need >= n) return false;
+      for (int k = 1; k <= need; ++k) {
+        uint8_t cc = s[i + k];
+        if ((cc & 0xC0) != 0x80) return false;
+        cp = (cp << 6) | (cc & 0x3F);
+      }
+      if (need == 2 && (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)))
+        return false;
+      if (need == 3 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
+      i += need + 1;
+    }
+    return false;  // unterminated
+  }
+
+  bool parse_number(bool* fb) {
+    size_t start = i;
+    bool is_float = false;
+    if (i < n && s[i] == '-') ++i;
+    if (i >= n) return false;
+    if (s[i] == '0') {
+      ++i;
+    } else if (s[i] >= '1' && s[i] <= '9') {
+      while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+    } else {
+      return false;
+    }
+    if (i < n && s[i] == '.') {
+      is_float = true;
+      ++i;
+      if (i >= n || s[i] < '0' || s[i] > '9') return false;
+      while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+    }
+    if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+      is_float = true;
+      ++i;
+      if (i < n && (s[i] == '+' || s[i] == '-')) ++i;
+      if (i >= n || s[i] < '0' || s[i] > '9') return false;
+      while (i < n && s[i] >= '0' && s[i] <= '9') ++i;
+    }
+    size_t len = i - start;
+    if (is_float) {
+      // strtod matches json.loads (float(repr) semantics): both parse
+      // the shortest round-trip; overflow → ±inf, same as json.loads.
+      char buf[340];
+      if (len >= sizeof(buf)) {
+        *fb = true;
+        return true;
+      }
+      std::memcpy(buf, s + start, len);
+      buf[len] = 0;
+      char* end = nullptr;
+      double v = std::strtod(buf, &end);
+      if (end != buf + len) return false;
+      uint64_t bits;
+      std::memcpy(&bits, &v, 8);
+      emit(OP_FLOAT, static_cast<uint32_t>(bits),
+           static_cast<uint32_t>(bits >> 32));
+      return true;
+    }
+    // Integer: int64 fast path, digit-span for big ones.
+    size_t digs = len - (s[start] == '-' ? 1 : 0);
+    if (digs <= 18) {
+      int64_t v = 0;
+      size_t k = start + (s[start] == '-' ? 1 : 0);
+      for (; k < i; ++k) v = v * 10 + (s[k] - '0');
+      if (s[start] == '-') v = -v;
+      uint64_t u = static_cast<uint64_t>(v);
+      emit(OP_INT, static_cast<uint32_t>(u), static_cast<uint32_t>(u >> 32));
+      return true;
+    }
+    if (digs > kMaxIntDigits) {
+      *fb = true;
+      return true;
+    }
+    emit(OP_BIGINT, static_cast<uint32_t>(start), static_cast<uint32_t>(len));
+    return true;
+  }
+
+  // Full value parser. Returns false on malformed; sets *fb to route the
+  // token to json.loads (valid JSON we choose not to replicate).
+  bool parse_value(int depth, bool* fb) {
+    if (depth > kMaxDepth) {
+      *fb = true;
+      return true;
+    }
+    ws();
+    if (i >= n) return false;
+    uint8_t c = s[i];
+    switch (c) {
+      case '{': {
+        ++i;
+        emit(OP_OBJ_START);
+        ws();
+        if (i < n && s[i] == '}') {
+          ++i;
+          emit(OP_OBJ_END);
+          return true;
+        }
+        while (true) {
+          ws();
+          if (i >= n || s[i] != '"') return false;
+          ++i;
+          uint32_t off, len, esc;
+          if (!scan_string(&off, &len, &esc, fb)) return false;
+          emit(OP_KEY, off, (len << 1) | esc);
+          ws();
+          if (i >= n || s[i] != ':') return false;
+          ++i;
+          if (!parse_value(depth + 1, fb)) return false;
+          if (*fb) return true;  // unwind: token goes to json.loads
+          ws();
+          if (i >= n) return false;
+          if (s[i] == ',') {
+            ++i;
+            continue;
+          }
+          if (s[i] == '}') {
+            ++i;
+            emit(OP_OBJ_END);
+            return true;
+          }
+          return false;
+        }
+      }
+      case '[': {
+        ++i;
+        emit(OP_ARR_START);
+        ws();
+        if (i < n && s[i] == ']') {
+          ++i;
+          emit(OP_ARR_END);
+          return true;
+        }
+        while (true) {
+          if (!parse_value(depth + 1, fb)) return false;
+          if (*fb) return true;  // unwind: token goes to json.loads
+          ws();
+          if (i >= n) return false;
+          if (s[i] == ',') {
+            ++i;
+            continue;
+          }
+          if (s[i] == ']') {
+            ++i;
+            emit(OP_ARR_END);
+            return true;
+          }
+          return false;
+        }
+      }
+      case '"': {
+        ++i;
+        uint32_t off, len, esc;
+        if (!scan_string(&off, &len, &esc, fb)) return false;
+        emit(OP_STR, off, (len << 1) | esc);
+        return true;
+      }
+      case 't':
+        if (i + 4 <= n && std::memcmp(s + i, "true", 4) == 0) {
+          i += 4;
+          emit(OP_TRUE);
+          return true;
+        }
+        return false;
+      case 'f':
+        if (i + 5 <= n && std::memcmp(s + i, "false", 5) == 0) {
+          i += 5;
+          emit(OP_FALSE);
+          return true;
+        }
+        return false;
+      case 'n':
+        if (i + 4 <= n && std::memcmp(s + i, "null", 4) == 0) {
+          i += 4;
+          emit(OP_NULL);
+          return true;
+        }
+        return false;
+      case 'N':
+      case 'I':
+        // NaN / Infinity: json.loads accepts these by default. Rare in
+        // real claims — fall back rather than replicate.
+        *fb = true;
+        return true;
+      default:
+        if (c == '-' && i + 1 < n && s[i + 1] == 'I') {
+          *fb = true;  // -Infinity
+          return true;
+        }
+        return parse_number(fb);
+    }
+  }
+
+  void run() {
+    bool fb = false;
+    ws();
+    bool is_obj = i < n && s[i] == '{';
+    if (!parse_value(0, &fb)) {
+      out->status = ST_MALFORMED;
+      return;
+    }
+    if (fb) {
+      out->status = ST_FALLBACK;
+      return;
+    }
+    ws();
+    if (i != n) {
+      out->status = ST_MALFORMED;  // trailing garbage
+      return;
+    }
+    out->status = is_obj ? ST_OK : ST_NOT_OBJECT;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Phase 2: tape → Python objects
+// ---------------------------------------------------------------------------
+
+// Byte-exact key intern table: claims keys ("iss", "sub", "exp", ...)
+// repeat across every token in a batch; one PyUnicode per distinct key
+// makes dict fills cheap (cached hash, pointer-equal keys).
+struct KeyCache {
+  struct Entry {
+    std::string bytes;
+    PyObject* obj;  // owned
+  };
+  std::vector<Entry> entries;
+
+  ~KeyCache() {
+    for (auto& e : entries) Py_XDECREF(e.obj);
+  }
+
+  PyObject* get(const char* data, size_t len) {  // borrowed return
+    for (auto& e : entries) {
+      if (e.bytes.size() == len &&
+          std::memcmp(e.bytes.data(), data, len) == 0)
+        return e.obj;
+    }
+    if (entries.size() >= 256) return nullptr;  // degenerate batch: skip cache
+    PyObject* o = PyUnicode_DecodeUTF8(data, static_cast<Py_ssize_t>(len),
+                                       nullptr);
+    if (o == nullptr) return nullptr;
+    PyUnicode_InternInPlace(&o);
+    entries.push_back({std::string(data, len), o});
+    return o;
+  }
+};
+
+PyObject* decode_escaped(const uint8_t* data, size_t len) {
+  // Unescape into a scratch, then UTF-8 decode. Validation already
+  // happened in phase 1, so escapes are well-formed and non-surrogate.
+  std::string buf;
+  buf.reserve(len);
+  size_t i = 0;
+  while (i < len) {
+    uint8_t c = data[i];
+    if (c != '\\') {
+      buf.push_back(static_cast<char>(c));
+      ++i;
+      continue;
+    }
+    uint8_t e = data[i + 1];
+    switch (e) {
+      case '"': buf.push_back('"'); i += 2; break;
+      case '\\': buf.push_back('\\'); i += 2; break;
+      case '/': buf.push_back('/'); i += 2; break;
+      case 'b': buf.push_back('\b'); i += 2; break;
+      case 'f': buf.push_back('\f'); i += 2; break;
+      case 'n': buf.push_back('\n'); i += 2; break;
+      case 'r': buf.push_back('\r'); i += 2; break;
+      case 't': buf.push_back('\t'); i += 2; break;
+      default: {  // \uXXXX (non-surrogate — surrogates went to fallback)
+        uint32_t v = 0;
+        for (int k = 2; k <= 5; ++k) {
+          uint8_t h = data[i + k];
+          v = v * 16 + (h <= '9' ? h - '0' : (h | 32) - 'a' + 10);
+        }
+        if (v < 0x80) {
+          buf.push_back(static_cast<char>(v));
+        } else if (v < 0x800) {
+          buf.push_back(static_cast<char>(0xC0 | (v >> 6)));
+          buf.push_back(static_cast<char>(0x80 | (v & 0x3F)));
+        } else {
+          buf.push_back(static_cast<char>(0xE0 | (v >> 12)));
+          buf.push_back(static_cast<char>(0x80 | ((v >> 6) & 0x3F)));
+          buf.push_back(static_cast<char>(0x80 | (v & 0x3F)));
+        }
+        i += 6;
+      }
+    }
+  }
+  return PyUnicode_DecodeUTF8(buf.data(),
+                              static_cast<Py_ssize_t>(buf.size()), nullptr);
+}
+
+// Replay one token's tape. Returns a new reference, or nullptr with a
+// Python exception set.
+PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
+                          KeyCache* keys) {
+  // Explicit container stack; values attach to the top container (dict
+  // via pending key, list via append).
+  struct Frame {
+    PyObject* container;  // owned here until popped
+    PyObject* key;        // owned; pending dict key
+  };
+  std::vector<Frame> stack;
+  PyObject* root = nullptr;
+
+  auto attach = [&](PyObject* v) -> bool {  // steals v
+    if (stack.empty()) {
+      root = v;
+      return true;
+    }
+    Frame& f = stack.back();
+    if (PyDict_CheckExact(f.container)) {
+      int rc = PyDict_SetItem(f.container, f.key, v);
+      Py_DECREF(v);
+      Py_CLEAR(f.key);
+      return rc == 0;
+    }
+    int rc = PyList_Append(f.container, v);
+    Py_DECREF(v);
+    return rc == 0;
+  };
+  auto fail = [&]() -> PyObject* {
+    for (auto& f : stack) {
+      Py_XDECREF(f.container);
+      Py_XDECREF(f.key);
+    }
+    Py_XDECREF(root);
+    return nullptr;
+  };
+
+  const uint32_t* ops = tape.ops.data();
+  size_t nops = tape.ops.size();
+  for (size_t t = 0; t < nops; t += 3) {
+    uint32_t op = ops[t], a = ops[t + 1], b = ops[t + 2];
+    switch (op) {
+      case OP_OBJ_START: {
+        PyObject* d = PyDict_New();
+        if (d == nullptr) return fail();
+        stack.push_back({d, nullptr});
+        break;
+      }
+      case OP_ARR_START: {
+        PyObject* l = PyList_New(0);
+        if (l == nullptr) return fail();
+        stack.push_back({l, nullptr});
+        break;
+      }
+      case OP_OBJ_END:
+      case OP_ARR_END: {
+        PyObject* done = stack.back().container;
+        Py_XDECREF(stack.back().key);
+        stack.pop_back();
+        if (!attach(done)) return fail();
+        break;
+      }
+      case OP_KEY: {
+        uint32_t len = b >> 1, esc = b & 1;
+        const char* data = reinterpret_cast<const char*>(payload + a);
+        PyObject* k;
+        if (esc) {
+          k = decode_escaped(payload + a, len);
+        } else {
+          PyObject* cached = keys->get(data, len);
+          if (cached != nullptr) {
+            Py_INCREF(cached);
+            k = cached;
+          } else {
+            k = PyUnicode_DecodeUTF8(data, static_cast<Py_ssize_t>(len),
+                                     nullptr);
+          }
+        }
+        if (k == nullptr) return fail();
+        Py_XDECREF(stack.back().key);
+        stack.back().key = k;
+        break;
+      }
+      case OP_STR: {
+        uint32_t len = b >> 1, esc = b & 1;
+        PyObject* v =
+            esc ? decode_escaped(payload + a, len)
+                : PyUnicode_DecodeUTF8(
+                      reinterpret_cast<const char*>(payload + a),
+                      static_cast<Py_ssize_t>(len), nullptr);
+        if (v == nullptr || !attach(v)) return fail();
+        break;
+      }
+      case OP_INT: {
+        int64_t iv = static_cast<int64_t>(
+            (static_cast<uint64_t>(b) << 32) | a);
+        PyObject* v = PyLong_FromLongLong(iv);
+        if (v == nullptr || !attach(v)) return fail();
+        break;
+      }
+      case OP_BIGINT: {
+        char buf[kMaxIntDigits + 2];
+        std::memcpy(buf, payload + a, b);
+        buf[b] = 0;
+        PyObject* v = PyLong_FromString(buf, nullptr, 10);
+        if (v == nullptr || !attach(v)) return fail();
+        break;
+      }
+      case OP_FLOAT: {
+        uint64_t bits = (static_cast<uint64_t>(b) << 32) | a;
+        double d;
+        std::memcpy(&d, &bits, 8);
+        PyObject* v = PyFloat_FromDouble(d);
+        if (v == nullptr || !attach(v)) return fail();
+        break;
+      }
+      case OP_TRUE:
+      case OP_FALSE: {
+        PyObject* v = op == OP_TRUE ? Py_True : Py_False;
+        Py_INCREF(v);
+        if (!attach(v)) return fail();
+        break;
+      }
+      case OP_NULL: {
+        Py_INCREF(Py_None);
+        if (!attach(Py_None)) return fail();
+        break;
+      }
+      default:
+        PyErr_SetString(PyExc_SystemError, "corrupt claims tape");
+        return fail();
+    }
+  }
+  return root;
+}
+
+// ---------------------------------------------------------------------------
+// Module entry: parse_batch(scratch, offsets, lengths) → list
+// ---------------------------------------------------------------------------
+
+// Returns a list with one entry per token:
+//   dict  — parsed claims
+//   1     — malformed JSON        (int sentinel)
+//   2     — valid JSON, not an object
+//   3     — fallback: caller must json.loads this payload
+PyObject* parse_batch(PyObject*, PyObject* args) {
+  Py_buffer scratch, offv, lenv;
+  int n_threads = 0;
+  if (!PyArg_ParseTuple(args, "y*y*y*|i", &scratch, &offv, &lenv,
+                        &n_threads))
+    return nullptr;
+  const uint8_t* base = static_cast<const uint8_t*>(scratch.buf);
+  const int64_t* offs = static_cast<const int64_t*>(offv.buf);
+  const int64_t* lens = static_cast<const int64_t*>(lenv.buf);
+  Py_ssize_t n = offv.len / static_cast<Py_ssize_t>(sizeof(int64_t));
+
+  bool bounds_ok = lenv.len == offv.len;
+  for (Py_ssize_t i = 0; bounds_ok && i < n; ++i) {
+    if (offs[i] < 0 || lens[i] < 0 ||
+        offs[i] + lens[i] > scratch.len)
+      bounds_ok = false;
+  }
+  if (!bounds_ok) {
+    PyBuffer_Release(&scratch);
+    PyBuffer_Release(&offv);
+    PyBuffer_Release(&lenv);
+    PyErr_SetString(PyExc_ValueError, "offsets/lengths out of bounds");
+    return nullptr;
+  }
+
+  std::vector<TokenTape> tapes(static_cast<size_t>(n));
+
+  Py_BEGIN_ALLOW_THREADS
+  unsigned hw = std::thread::hardware_concurrency();
+  size_t workers = n_threads > 0 ? static_cast<size_t>(n_threads)
+                                 : (hw ? hw : 4);
+  if (workers > static_cast<size_t>(n) && n > 0)
+    workers = static_cast<size_t>(n);
+  if (workers <= 1 || n < 256) {
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      Parser p(base + offs[i], static_cast<size_t>(lens[i]), &tapes[i]);
+      p.run();
+    }
+  } else {
+    std::vector<std::thread> pool;
+    std::atomic<size_t> next{0};
+    for (size_t w = 0; w < workers; ++w) {
+      pool.emplace_back([&]() {
+        constexpr size_t kGrain = 256;
+        while (true) {
+          size_t lo = next.fetch_add(kGrain);
+          if (lo >= static_cast<size_t>(n)) return;
+          size_t hi = lo + kGrain;
+          if (hi > static_cast<size_t>(n)) hi = static_cast<size_t>(n);
+          for (size_t i = lo; i < hi; ++i) {
+            Parser p(base + offs[i], static_cast<size_t>(lens[i]),
+                     &tapes[i]);
+            p.run();
+          }
+        }
+      });
+    }
+    for (auto& t : pool) t.join();
+  }
+  Py_END_ALLOW_THREADS
+
+  KeyCache keys;
+  PyObject* out = PyList_New(n);
+  if (out == nullptr) {
+    PyBuffer_Release(&scratch);
+    PyBuffer_Release(&offv);
+    PyBuffer_Release(&lenv);
+    return nullptr;
+  }
+  bool err = false;
+  for (Py_ssize_t i = 0; i < n && !err; ++i) {
+    PyObject* item;
+    if (tapes[i].status == ST_OK) {
+      item = build_from_tape(tapes[static_cast<size_t>(i)], base + offs[i],
+                             &keys);
+      if (item == nullptr) err = true;
+    } else {
+      item = PyLong_FromLong(tapes[i].status);
+      if (item == nullptr) err = true;
+    }
+    if (!err) PyList_SET_ITEM(out, i, item);
+  }
+  PyBuffer_Release(&scratch);
+  PyBuffer_Release(&offv);
+  PyBuffer_Release(&lenv);
+  if (err) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"parse_batch", parse_batch, METH_VARARGS,
+     "parse_batch(scratch, offsets_i64, lengths_i64, n_threads=0) -> "
+     "list[dict | int-status]"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_capclaims",
+    "Batch claims-JSON parsing (native runtime)", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+extern "C" PyMODINIT_FUNC PyInit__capclaims(void) {
+  return PyModule_Create(&moduledef);
+}

@@ -68,6 +68,26 @@ _lib.cap_sha_batch.argtypes = [
     ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
     ctypes.c_int32,
 ]
+def _load_claims_ext():
+    """Import the _capclaims extension module (None when unbuilt)."""
+    import importlib.machinery
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(_LIB_PATH), "_capclaims.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        loader = importlib.machinery.ExtensionFileLoader("_capclaims", path)
+        spec = importlib.util.spec_from_loader("_capclaims", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        return mod
+    except Exception:  # noqa: BLE001 - stale/foreign .so → Python parse
+        return None
+
+
+_claims_ext = _load_claims_ext()
+
 try:
     _lib.cap_pss_check_batch.argtypes = [
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
@@ -310,36 +330,59 @@ class PreparedBatch:
 
         Called between device dispatch and the materializing sync so
         the host-side JSON parsing overlaps the device wait instead of
-        serializing after it. Identical payload bytes (replayed tokens
-        are common in verify workloads) parse ONCE; each index still
-        receives its own independent container copy, so callers can
-        mutate results safely.
+        serializing after it. The _capclaims extension does the heavy
+        scan GIL-free across threads (~2 µs/token); payloads outside
+        its envelope re-parse with json.loads — byte-for-byte identical
+        results either way (tests/test_native_runtime.py fuzz parity).
+        Without the extension, identical payload bytes (replay-heavy
+        workloads) parse once and fan out as independent copies.
         """
         try:
             cache = self._claims_cache
         except AttributeError:
             cache = {}
             self._claims_cache = cache
-        protos: Dict[bytes, Any] = {}
         scratch = self.scratch
         off, ln = self.payload_off, self.payload_len
-        for i in indices:
+        idx = np.asarray([i for i in indices
+                          if int(i) not in cache], np.int64)
+        if len(idx) == 0:
+            return
+        if _claims_ext is not None:
+            offs = np.ascontiguousarray(off[idx], np.int64)
+            lens = np.ascontiguousarray(ln[idx], np.int64)
+            parsed = _claims_ext.parse_batch(scratch, offs, lens)
+            for j, v in zip(idx, parsed):
+                j = int(j)
+                if type(v) is dict:
+                    cache[j] = v
+                else:
+                    # malformed / not-an-object / outside-envelope:
+                    # re-parse with json.loads so messages and edge
+                    # semantics are byte-identical to the Python path
+                    # (the int status is only a fast-path filter).
+                    cache[j] = self._parse_one(int(off[j]), int(ln[j]))
+            return
+        protos: Dict[bytes, Any] = {}
+        for i in idx:
             i = int(i)
-            if i in cache:
-                continue
             raw = scratch[off[i]: off[i] + ln[i]].tobytes()
             proto = protos.get(raw)
             if proto is None:
-                try:
-                    c = json.loads(raw)
-                    proto = c if isinstance(c, dict) else \
-                        MalformedTokenError("payload is not a JSON object")
-                except (ValueError, UnicodeDecodeError) as e:
-                    proto = MalformedTokenError(
-                        f"payload is not valid JSON: {e}")
+                proto = self._parse_one(int(off[i]), int(ln[i]))
                 protos[raw] = proto
             cache[i] = _copy_claims(proto) \
                 if isinstance(proto, dict) else proto
+
+    def _parse_one(self, off: int, ln: int) -> Any:
+        """json.loads one payload → dict or MalformedTokenError."""
+        raw = self.scratch[off: off + ln].tobytes()
+        try:
+            c = json.loads(raw)
+            return c if isinstance(c, dict) else \
+                MalformedTokenError("payload is not a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            return MalformedTokenError(f"payload is not valid JSON: {e}")
 
     def signature(self, i: int) -> bytes:
         o, l = int(self.sig_off[i]), int(self.sig_len[i])

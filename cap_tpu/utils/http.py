@@ -122,18 +122,23 @@ class ConnectionPool:
         followed up to ``max_redirects`` (the reference's http.Client
         default behavior).
         """
-        origin_host = urlparse(url).hostname
+        origin = urlparse(url)
         for _ in range(max_redirects + 1):
             status, data, hdrs = self._one(method, url, body, headers,
                                            ctx, timeout)
             loc = hdrs.get("location")
             if loc and status in (301, 302, 303, 307, 308):
                 url = urljoin(url, loc)
-                if urlparse(url).hostname != origin_host and headers:
+                target = urlparse(url)
+                downgrade = origin.scheme == "https" and \
+                    target.scheme != "https"
+                if headers and (target.hostname != origin.hostname
+                                or downgrade):
                     # Credentials must not follow a redirect off the
-                    # original host (Go's http.Client strips them the
-                    # same way): a compromised IdP response would
-                    # otherwise exfiltrate Bearer/Basic credentials.
+                    # original host OR onto cleartext http (Go's
+                    # http.Client strips them the same way): a
+                    # compromised IdP response would otherwise
+                    # exfiltrate Bearer/Basic credentials.
                     headers = {k: v for k, v in headers.items()
                                if k.lower() not in ("authorization",
                                                     "cookie")}
