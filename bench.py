@@ -2,22 +2,25 @@
 """Headline benchmark: mixed RS256/ES256 JWT verifies/sec on one chip.
 
 Mirrors the north-star config (BASELINE.json): a 16-key JWKS (8 RSA-2048
-+ 8 P-256), a large batch of mixed RS256/ES256 tokens, verified through
-``TPUBatchKeySet.verify_batch`` — JOSE prep on host (C++ runtime when
-built), signature math on the device engine.
++ 8 P-256), large batches of mixed RS256/ES256 tokens, verified through
+``TPUBatchKeySet`` — JOSE prep on host (C++ runtime), signature math on
+the device engine.
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "verifies/sec", "vs_baseline": N}
-vs_baseline is measured throughput / the 500k verifies/sec target
-(BASELINE.md — the reference publishes no numbers of its own).
+Honesty rules (VERDICT r2):
+- every token in a batch is UNIQUE (distinct sub/jti → distinct payload
+  bytes and signatures): no claims-parse amortization, full wire cost;
+- the headline ``value`` is the MEDIAN steady-state rate over a
+  pipelined window of back-to-back batches (≥8 measured intervals),
+  not the peak rep — the peak is demoted to a side field;
+- wire accounting: ``wire_effective_mbps`` is the H2D record traffic
+  actually moved during the window; ``wire_probe_mbps`` is a raw
+  device_put probe run right after; their ratio says how much of the
+  link the pipeline extracts.
 
-Environment knobs: CAP_BENCH_BATCH (default 65536), CAP_BENCH_REPS
-(default 4), CAP_BENCH_UNIQUE (default 1024).
+Prints exactly ONE JSON line on stdout.
 
-The reported value is the PEAK rep: the host↔device link on tunneled
-setups has multi-second congestion transients (see docs/PERF.md), and
-the peak reflects machine capability; per-rep rates and latency
-quantiles go to stderr for the full picture.
+Environment knobs: CAP_BENCH_BATCH (default 65536), CAP_BENCH_WINDOW
+(default 8 measured batches), CAP_BENCH_UNIQUE (default = batch).
 """
 
 import json
@@ -39,17 +42,20 @@ BASELINE_TARGET = 500_000.0  # verifies/sec, BASELINE.json north_star
 
 
 def _ensure_native() -> None:
-    """Build the C++ JOSE-prep runtime if it isn't built yet."""
-    so = os.path.join(REPO, "cap_tpu", "runtime", "native",
-                      "libcapruntime.so")
-    if os.path.exists(so):
-        return
+    """Build the native runtime pieces if they aren't built yet."""
     from cap_tpu._build import build_native
     build_native()
 
 
 def _make_fixtures(n_unique: int):
-    """16-key JWKS (8×RSA-2048, 8×P-256) + n_unique mixed signed JWTs."""
+    """16-key JWKS (8×RSA-2048, 8×P-256) + n_unique UNIQUE mixed JWTs.
+
+    Uniqueness is per token (sub + jti differ), so payload bytes and
+    signatures are all distinct — the workload a real verifier sees.
+    Signing happens across threads (OpenSSL releases the GIL).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
     from cap_tpu import testing as T
     from cap_tpu.jwt import algs
     from cap_tpu.jwt.jwk import JWK
@@ -64,71 +70,113 @@ def _make_fixtures(n_unique: int):
         jwks.append(JWK(pub, kid=f"es-{i}"))
         signers.append((priv, algs.ES256, f"es-{i}"))
 
-    claims = T.default_claims(ttl=86400.0)
-    tokens = []
-    for j in range(n_unique):
+    base = T.default_claims(ttl=86400.0)
+
+    def sign(j: int) -> str:
         priv, alg, kid = signers[j % len(signers)]
-        tokens.append(T.sign_jwt(priv, alg, claims, kid=kid))
+        claims = dict(base, sub=f"user-{j:08d}", jti=f"tok-{j:012d}")
+        return T.sign_jwt(priv, alg, claims, kid=kid)
+
+    workers = min(16, os.cpu_count() or 4)
+    with ThreadPoolExecutor(workers) as ex:
+        tokens = list(ex.map(sign, range(n_unique), chunksize=256))
     return jwks, tokens
+
+
+def _probe_wire_mbps() -> float:
+    """Raw sustained H2D bandwidth right now (16 MB u8, best of 2)."""
+    import jax
+    import numpy as np
+
+    buf = np.random.default_rng(0).integers(
+        0, 256, size=16 << 20, dtype=np.uint8)
+    best = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        arr = jax.device_put(buf)
+        arr.block_until_ready()
+        # block_until_ready can return early on tunneled backends —
+        # only a materializing read truly fences the transfer.
+        float(arr[-1])
+        dt = time.perf_counter() - t0
+        best = max(best, (buf.nbytes / dt) / (1 << 20))
+        del arr
+    return best
 
 
 def main() -> None:
     _ensure_native()
-    from cap_tpu import compile_cache
+    from cap_tpu import compile_cache, telemetry
 
     compile_cache.enable()
 
     batch = int(os.environ.get("CAP_BENCH_BATCH", 1 << 16))
-    reps = int(os.environ.get("CAP_BENCH_REPS", 4))
-    n_unique = min(int(os.environ.get("CAP_BENCH_UNIQUE", 1024)), batch)
+    window = int(os.environ.get("CAP_BENCH_WINDOW", 8))
+    n_unique = min(int(os.environ.get("CAP_BENCH_UNIQUE", batch)), batch)
 
     from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
 
+    t0 = time.perf_counter()
     jwks, unique = _make_fixtures(n_unique)
     tokens = (unique * (batch // len(unique) + 1))[:batch]
+    sign_s = time.perf_counter() - t0
     ks = TPUBatchKeySet(jwks)
 
     # Warmup: triggers XLA compilation for every bucket shape.
     out = ks.verify_batch(tokens)
     bad = sum(1 for r in out if isinstance(r, Exception))
     if bad:
-        print(json.dumps({"metric": "error",
-                          "value": bad,
-                          "unit": "failed_verifies",
-                          "vs_baseline": 0.0}))
+        print(json.dumps({"metric": "error", "value": bad,
+                          "unit": "failed_verifies", "vs_baseline": 0.0}))
         return
 
-    rates, lats = [], []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        ks.verify_batch(tokens)
-        dt = time.perf_counter() - t0
-        rates.append(batch / dt)
-        lats.append(dt)
-    value = max(rates)                       # peak rep (tunnel variance)
-    median = statistics.median(rates)
+    # Steady-state pipelined window: window+1 back-to-back batches,
+    # 2-deep in flight; the first completion (pipeline fill) is
+    # dropped, leaving `window` measured completion intervals.
+    rec = telemetry.enable()
+    done_t = []
+    t_start = time.perf_counter()
+    for _ in ks.verify_stream(tokens for _ in range(window + 1)):
+        done_t.append(time.perf_counter())
+    telemetry.disable()
+    h2d_bytes = rec.counters().get("h2d.bytes", 0)
 
-    # Per-rep rates + batch latency quantiles (BASELINE.md tracked
-    # metric) → stderr so stdout stays the single driver JSON line.
-    slats = sorted(lats)
+    intervals = [b - a for a, b in zip(done_t, done_t[1:])]
+    rates = [batch / dt for dt in intervals]
+    value = statistics.median(rates)
+    peak = max(rates)
+    # Steady state starts at the first completion (pipeline fill and
+    # any tunnel stall during it excluded, matching the median).
+    agg = (batch * window) / (done_t[-1] - done_t[0])
+    slats = sorted(intervals)
     p99 = slats[max(0, math.ceil(0.99 * len(slats)) - 1)]  # nearest rank
-    print(f"reps={[round(r, 0) for r in rates]} "
-          f"batch_latency_s p50={slats[len(slats) // 2]:.3f} "
-          f"p99={p99:.3f} max={slats[-1]:.3f} batch={batch}",
+
+    bytes_per_batch = h2d_bytes / (window + 1)
+    med_interval = statistics.median(intervals)
+    eff_mbps = (bytes_per_batch / med_interval) / (1 << 20)
+    probe_mbps = _probe_wire_mbps()
+
+    print(f"sign={sign_s:.1f}s window={window} "
+          f"rates={[round(r) for r in rates]} "
+          f"interval_s p50={slats[len(slats) // 2]:.3f} p99={p99:.3f} "
+          f"h2d={h2d_bytes / (1 << 20):.1f}MB "
+          f"eff={eff_mbps:.1f}MB/s probe={probe_mbps:.1f}MB/s",
           file=sys.stderr)
 
-    # value = peak rep; value_median alongside so downstream consumers
-    # see typical throughput, not just the best tunnel window
-    # (ADVICE r1); p99 batch latency is the BASELINE.json tracked
-    # latency metric.
     print(json.dumps({
         "metric": "jwt_verifies_per_sec_rs256_es256_16key_jwks",
-        "value": round(value, 1),
+        "value": round(value, 1),                 # MEDIAN steady-state
         "unit": "verifies/sec",
         "vs_baseline": round(value / BASELINE_TARGET, 4),
-        "value_median": round(median, 1),
+        "value_peak": round(peak, 1),
+        "value_window_mean": round(agg, 1),
         "p99_batch_latency_s": round(p99, 3),
         "batch": batch,
+        "unique_tokens": n_unique,
+        "wire_effective_mbps": round(eff_mbps, 2),
+        "wire_probe_mbps": round(probe_mbps, 2),
+        "wire_efficiency": round(eff_mbps / probe_mbps, 3)
+        if probe_mbps else None,
     }))
 
 

@@ -195,12 +195,49 @@ class TPUBatchKeySet(KeySet):
         telemetry.count("verify_batch.tokens", len(tokens))
         with telemetry.span("verify_batch.total"):
             if prep._load_native() is not None:
-                return self._verify_batch_fast(tokens)
+                return self._collect_batch(self._dispatch_batch(tokens))
             return self._verify_batch_objects(tokens)
 
-    def _verify_batch_fast(self, tokens: Sequence[str]) -> List[Any]:
-        """Array-native batch path: C++ prep → numpy bucketing/kid gather
-        → device dispatch, with per-token Python only for results."""
+    def verify_batch_async(self, tokens: Sequence[str]):
+        """Dispatch a batch; returns collect() → per-token results.
+
+        All device work (transfers + programs) is queued before this
+        returns; the returned thunk blocks on the one materializing
+        sync. Dispatching the NEXT batch before collecting the previous
+        one keeps the host↔device wire busy during host-side prep —
+        the 2-deep pipelining the serve layer and bench use.
+        """
+        from ..runtime import prep
+
+        telemetry.count("verify_batch.calls")
+        telemetry.count("verify_batch.tokens", len(tokens))
+        if prep._load_native() is None:
+            results = self._verify_batch_objects(tokens)
+            return lambda: results
+        state = self._dispatch_batch(tokens)
+        return lambda: self._collect_batch(state)
+
+    def verify_stream(self, batches, depth: int = 2):
+        """Pipelined verification of an iterable of token batches.
+
+        Yields each batch's results in order while keeping up to
+        ``depth`` batches in flight: batch k+1's host prep + packing +
+        H2D overlap batch k's device drain. The throughput shape the
+        reference's sequential loop (jwt/keyset.go:126-139 per token)
+        cannot express.
+        """
+        from collections import deque
+
+        inflight: deque = deque()
+        for tokens in batches:
+            inflight.append(self.verify_batch_async(tokens))
+            if len(inflight) >= depth:
+                yield inflight.popleft()()
+        while inflight:
+            yield inflight.popleft()()
+
+    def _dispatch_batch(self, tokens: Sequence[str]) -> dict:
+        """Phase 1: prep, bucket, pack, and queue ALL device work."""
         from ..runtime.native_binding import ALG_NAMES, prepare_batch_arrays
 
         with telemetry.span("prep.native"):
@@ -213,14 +250,14 @@ class TPUBatchKeySet(KeySet):
 
         slow: List[int] = []
         # Two-phase device interaction: every bucket's device work is
-        # DISPATCHED first, then one materializing sync wave collects
-        # verdicts. Hot families (RS*, ES*) go through the PACKED path:
-        # one u8 record transfer + one compiled program per chunk, and
-        # every chunk's [pad] bool verdict is concatenated device-side
-        # so the whole batch costs ONE host↔device materialization.
-        # Compute-heavy families dispatch first so their device time
-        # overlaps the later families' H2D transfers (the wire is the
-        # binding resource — docs/PERF.md).
+        # DISPATCHED here (transfers are asynchronous on the JAX
+        # runtime — they queue on the wire and overlap the packing of
+        # later chunks and the next batch's prep), then _collect_batch
+        # materializes ONE concatenated verdict array. Hot families
+        # (RS*, ES*) go through the PACKED path: one u8 record transfer
+        # + one compiled program per chunk. Compute-heavy families
+        # dispatch first so their device time overlaps the later
+        # families' H2D transfers (docs/PERF.md).
         pending: List[tuple] = []
         packed_parts: List[Any] = []      # device [pad] bool arrays
         packed_meta: List[tuple] = []     # (n_slots, consume(arrs))
@@ -259,6 +296,18 @@ class TPUBatchKeySet(KeySet):
             for a in _PS:
                 run_family(a, run_ps)
 
+        return dict(pb=pb, n=n, ok=ok, results=results, slow=slow,
+                    pending=pending, packed_parts=packed_parts,
+                    packed_meta=packed_meta)
+
+    def _collect_batch(self, state: dict) -> List[Any]:
+        """Phase 2: claims prefetch, materializing sync, verdicts."""
+        pb, n, ok = state["pb"], state["n"], state["ok"]
+        results, slow = state["results"], state["slow"]
+        pending = state["pending"]
+        packed_parts = state["packed_parts"]
+        packed_meta = state["packed_meta"]
+
         with telemetry.span("device.sync"):
             if packed_parts:
                 import jax.numpy as jnp
@@ -267,11 +316,12 @@ class TPUBatchKeySet(KeySet):
                             if len(packed_parts) > 1 else packed_parts[0])
                 # Overlap the host-side claims JSON parsing with the
                 # device drain (transfers + compute are still in
-                # flight; only np.asarray below truly blocks).
+                # flight; only np.asarray below truly blocks). Every
+                # ok-status token still has results[i] None here (only
+                # prep errors are filled), so the index set is just the
+                # ok mask — no per-token filtering.
                 with telemetry.span("claims.prefetch"):
-                    pb.prefetch_claims(
-                        i for i in np.nonzero(ok)[0]
-                        if results[int(i)] is None)
+                    pb.prefetch_claims(np.nonzero(ok)[0])
                 flat = np.asarray(flat_dev)
                 off = 0
                 for n_slots, consume in packed_meta:
@@ -299,17 +349,24 @@ class TPUBatchKeySet(KeySet):
     @staticmethod
     def _finish_arrays(chunk, okv, pb, results: List[Any]) -> None:
         """Write per-token verdicts for one array-path device chunk."""
-        for j, good in zip(chunk, okv):
-            j = int(j)
+        cache = getattr(pb, "_claims_cache", None)
+        if cache is None:
+            cache = {}
+        claims = pb.claims
+        msg = ("no known key successfully validated the token "
+               "signature")
+        for j, good in zip(np.asarray(chunk).tolist(),
+                           np.asarray(okv).tolist()):
             if good:
-                try:
-                    results[j] = pb.claims(j)
-                except MalformedTokenError as e:
-                    results[j] = e
+                hit = cache.get(j)
+                if hit is None:
+                    try:
+                        hit = claims(j)
+                    except MalformedTokenError as e:
+                        hit = e
+                results[j] = hit
             else:
-                results[j] = InvalidSignatureError(
-                    "no known key successfully validated the token "
-                    "signature")
+                results[j] = InvalidSignatureError(msg)
 
     def _chunk_tokens(self, rec_width: int) -> int:
         """Tokens per packed chunk: target ~5 MB transfers (the tunnel's
@@ -348,6 +405,8 @@ class TPUBatchKeySet(KeySet):
                                      pending, slow, cls=cls)
                 continue
             width = 2 * table.k
+            sizes_all = np.asarray(table.sizes_bytes, np.int64)
+            t_len = len(tpursa.DIGEST_INFO_PREFIX[hash_name]) + h_len
             chunk_n = self._chunk_tokens(width + h_len
                                          + tpursa.RS_REC_EXTRA)
             for lo in range(0, len(cls_idx), chunk_n):
@@ -355,19 +414,25 @@ class TPUBatchKeySet(KeySet):
                 crows = cls_rows[lo: lo + chunk_n]
                 m = len(chunk)
                 pad = _pad_size(m, chunk_n)
-                sig_mat = np.zeros((pad, width), np.uint8)
-                sig_mat[:m] = pb.sig_matrix(chunk, width)
-                sig_lens = np.zeros(pad, np.int64)
-                sig_lens[:m] = pb.sig_len[chunk]
-                hash_mat = np.zeros((pad, 64), np.uint8)
-                hash_mat[:m] = pb.digest[chunk]
-                key_idx = np.zeros(pad, np.int32)
-                key_idx[:m] = crows
                 telemetry.count("device.rs.tokens", m)
                 with telemetry.span(f"dispatch.rs.{hash_name}"):
-                    rec = tpursa.rs_packed_records(
-                        table, sig_mat, sig_lens, hash_mat, hash_name,
-                        key_idx)
+                    sizes = sizes_all[crows]
+                    em_ok = (sizes >= t_len + 11).astype(np.uint8)
+                    rec = pb.pack_sig_records(chunk, sizes, em_ok,
+                                              crows, width, h_len, pad)
+                    if rec is None:       # pre-packer .so: numpy path
+                        sig_mat = np.zeros((pad, width), np.uint8)
+                        sig_mat[:m] = pb.sig_matrix(chunk, width)
+                        sig_lens = np.zeros(pad, np.int64)
+                        sig_lens[:m] = pb.sig_len[chunk]
+                        hash_mat = np.zeros((pad, 64), np.uint8)
+                        hash_mat[:m] = pb.digest[chunk]
+                        key_idx = np.zeros(pad, np.int32)
+                        key_idx[:m] = crows
+                        rec = tpursa.rs_packed_records(
+                            table, sig_mat, sig_lens, hash_mat,
+                            hash_name, key_idx)
+                    telemetry.count("h2d.bytes", rec.nbytes)
                     ok_dev = tpursa.verify_rs_packed_pending(
                         table, rec, hash_name, mesh=self._mesh)
                 packed_parts.append(ok_dev)
@@ -407,19 +472,24 @@ class TPUBatchKeySet(KeySet):
             crows = rows[lo: lo + chunk_n]
             m = len(chunk)
             pad = _pad_size(m, chunk_n)
-            sig_mat = np.zeros((pad, width), np.uint8)
-            sig_mat[:m] = pb.sig_matrix(chunk, width)
-            sig_lens = np.zeros(pad, np.int64)
-            sig_lens[:m] = pb.sig_len[chunk]
-            hash_mat = np.zeros((pad, 64), np.uint8)
-            hash_mat[:m] = pb.digest[chunk]
-            key_idx = np.zeros(pad, np.int32)
-            key_idx[:m] = crows
             telemetry.count("device.es.tokens", m)
             with telemetry.span(f"dispatch.es.{crv}"):
-                rec = tpuec.es_packed_records(
-                    table, sig_mat, sig_lens, hash_mat, hash_len,
-                    key_idx)
+                rec = pb.pack_sig_records(
+                    chunk, np.full(m, width, np.int64),
+                    np.ones(m, np.uint8), crows, width, hash_len, pad)
+                if rec is None:           # pre-packer .so: numpy path
+                    sig_mat = np.zeros((pad, width), np.uint8)
+                    sig_mat[:m] = pb.sig_matrix(chunk, width)
+                    sig_lens = np.zeros(pad, np.int64)
+                    sig_lens[:m] = pb.sig_len[chunk]
+                    hash_mat = np.zeros((pad, 64), np.uint8)
+                    hash_mat[:m] = pb.digest[chunk]
+                    key_idx = np.zeros(pad, np.int32)
+                    key_idx[:m] = crows
+                    rec = tpuec.es_packed_records(
+                        table, sig_mat, sig_lens, hash_mat, hash_len,
+                        key_idx)
+                telemetry.count("h2d.bytes", rec.nbytes)
                 ok_dev, deg_dev = tpuec.verify_es_packed_pending(
                     table, rec, hash_len, mesh=self._mesh)
             packed_parts.append(ok_dev)
@@ -562,6 +632,7 @@ class TPUBatchKeySet(KeySet):
             telemetry.count("device.ed.tokens", m)
             with telemetry.span("dispatch.ed25519"):
                 rec = tpued.ed_packed_records(table, sigs, msgs, key_idx)
+                telemetry.count("h2d.bytes", rec.nbytes)
                 ok_dev = tpued.verify_ed_packed_pending(
                     table, rec, mesh=self._mesh)
             packed_parts.append(ok_dev)

@@ -771,4 +771,63 @@ void cap_pss_check_batch(const uint8_t* em, int64_t n, int64_t em_stride,
   for (auto& th : threads) th.join();
 }
 
+
+// Pack one verify chunk's device records in a single multithreaded
+// pass: out row r = [right-aligned sig bytes (width) ‖ digest (h_len)
+// ‖ valid flag ‖ key row]. Replaces the numpy gather → align → where →
+// assemble chain (several full-matrix passes, GIL-held) on the batch
+// hot path. Rows whose signature length differs from their key's
+// expected size, or whose `extra_valid` is 0, pack as zeros with
+// flag 0 (the verdict is decided host-side, matching the CPU oracle).
+// Rows in [m, pad) are padding: all-zero. idx selects tokens from the
+// batch-wide arrays; sig_off is absolute into scratch.
+void cap_pack_sig_records(
+    const uint8_t* scratch, int64_t scratch_len,
+    const int64_t* sig_off, const int64_t* sig_len,
+    const uint8_t* digest, int64_t digest_stride,
+    const int64_t* idx, const int64_t* expect_size,
+    const uint8_t* extra_valid, const uint8_t* key_rows,
+    int64_t m, int64_t pad, int64_t width, int64_t h_len,
+    uint8_t* out, int32_t n_threads) {
+  const int64_t rec_w = width + h_len + 2;
+  auto worker = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++) {
+      uint8_t* row = out + r * rec_w;
+      if (r >= m) {
+        std::memset(row, 0, size_t(rec_w));
+        continue;
+      }
+      int64_t i = idx[r];
+      int64_t len = sig_len[i];
+      bool valid = extra_valid[r] != 0 && len == expect_size[r] &&
+                   len <= width && sig_off[i] >= 0 &&
+                   sig_off[i] + len <= scratch_len;
+      if (valid) {
+        std::memset(row, 0, size_t(width - len));
+        std::memcpy(row + width - len, scratch + sig_off[i],
+                    size_t(len));
+      } else {
+        std::memset(row, 0, size_t(width));
+      }
+      std::memcpy(row + width, digest + i * digest_stride,
+                  size_t(h_len));
+      row[width + h_len] = valid ? 1 : 0;
+      row[width + h_len + 1] = key_rows[r];
+    }
+  };
+  if (n_threads <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n_threads = int32_t(hw ? hw : 4);
+  }
+  if (n_threads <= 1 || pad < 2048) { worker(0, pad); return; }
+  std::vector<std::thread> threads;
+  int64_t chunk = (pad + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    int64_t lo = t * chunk, hi = lo + chunk < pad ? lo + chunk : pad;
+    if (lo >= hi) break;
+    threads.emplace_back(worker, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
 }  // extern "C"

@@ -23,10 +23,14 @@ from ..errors import MalformedTokenError, TokenNotSignedError
 
 _LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "native", "libcapruntime.so")
-if not os.path.exists(_LIB_PATH):
-    # Build artifacts are not committed (ADVICE r1): build on first use.
-    from .._build import build_native
-    build_native()
+# Build artifacts are not committed (ADVICE r1): build on first use.
+# Unconditional — build_native() is a cheap no-op when everything is
+# fresh (per-target mtime checks) and rebuilds STALE libraries too; a
+# missing-only gate would leave an old libcapruntime.so without the
+# record packer and never compile _capclaims.so at all.
+from .._build import build_native
+
+build_native()
 _lib = ctypes.CDLL(_LIB_PATH)
 
 ALG_NAMES = ["RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
@@ -87,6 +91,20 @@ def _load_claims_ext():
 
 
 _claims_ext = _load_claims_ext()
+
+try:
+    _lib.cap_pack_sig_records.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+    ]
+    _HAS_PACK_RECORDS = True
+except AttributeError:       # stale .so from before the packer
+    _HAS_PACK_RECORDS = False
 
 try:
     _lib.cap_pss_check_batch.argtypes = [
@@ -303,6 +321,39 @@ class PreparedBatch:
         rows[present] = resolved[present]
         return rows
 
+    def pack_sig_records(self, idx: np.ndarray, expect_size: np.ndarray,
+                         extra_valid: np.ndarray, key_rows: np.ndarray,
+                         width: int, h_len: int,
+                         pad: int) -> Optional[np.ndarray]:
+        """One-pass native build of a packed [pad, width+h_len+2] u8
+        record chunk: right-aligned signature ‖ digest ‖ flag ‖ key row.
+
+        Row flags are 1 iff extra_valid[r] and sig_len == expect_size
+        (the CPU oracle's length rejections). Returns None when the
+        loaded library predates the packer (caller uses the numpy
+        path). GIL-free and multithreaded — this replaces several
+        full-matrix numpy passes on the batch hot path.
+        """
+        if not _HAS_PACK_RECORDS:
+            return None
+        m = len(idx)
+        idx = np.ascontiguousarray(idx, np.int64)
+        expect = np.ascontiguousarray(expect_size, np.int64)
+        valid = np.ascontiguousarray(extra_valid, np.uint8)
+        rows = np.ascontiguousarray(key_rows, np.uint8)
+        out = np.empty((pad, width + h_len + 2), np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        _lib.cap_pack_sig_records(
+            self.scratch.ctypes.data_as(u8p), len(self.scratch),
+            self.sig_off.ctypes.data_as(i64p),
+            self.sig_len.ctypes.data_as(i64p),
+            self.digest.ctypes.data_as(u8p), self.digest.shape[1],
+            idx.ctypes.data_as(i64p), expect.ctypes.data_as(i64p),
+            valid.ctypes.data_as(u8p), rows.ctypes.data_as(u8p),
+            m, pad, width, h_len, out.ctypes.data_as(u8p), 0)
+        return out
+
     # -- lazy per-token materialization -----------------------------------
 
     def payload_bytes(self, i: int) -> bytes:
@@ -344,8 +395,11 @@ class PreparedBatch:
             self._claims_cache = cache
         scratch = self.scratch
         off, ln = self.payload_off, self.payload_len
-        idx = np.asarray([i for i in indices
-                          if int(i) not in cache], np.int64)
+        if not cache and isinstance(indices, np.ndarray):
+            idx = indices.astype(np.int64, copy=False)
+        else:
+            idx = np.asarray([i for i in indices
+                              if int(i) not in cache], np.int64)
         if len(idx) == 0:
             return
         if _claims_ext is not None:
@@ -483,11 +537,14 @@ def prepare_batch_arrays(tokens: Sequence[str],
         kid_mat=outs["kid"],
         kid_len=outs["kid_len"],
         sig_off=base + outs["sig_off"],
-        sig_len=outs["sig_len"],
+        # contiguous copies: the native record packer reads these
+        # through raw pointers (structured-array field views stride by
+        # the full record and would be misread)
+        sig_len=np.ascontiguousarray(outs["sig_len"]),
         payload_off=base + outs["payload_off"],
         payload_len=outs["payload_len"],
         si_len=outs["signing_input_len"],
-        digest=outs["digest"],
+        digest=np.ascontiguousarray(outs["digest"]),
         digest_len=outs["digest_len"],
         scratch=scratch,
         blob=blob,

@@ -172,6 +172,92 @@ class CurveParams:
             self._g_tables = (jnp.asarray(gx_rows), jnp.asarray(gy_rows))
         return self._g_tables
 
+    # -- fast window-table precompute (Jacobian + one batched inverse) ----
+
+    def window_multiples(self, point: Tuple[int, int], w_bits: int,
+                         n_windows: int) -> Tuple[list, list]:
+        """All d·2^{w·i}·point (d ∈ [1, 2^w−1], i ∈ [0, n_windows)) as
+        affine int lists, row order i·(2^w−1) + (d−1).
+
+        The naive per-row affine chain costs one modular inversion per
+        point; here the chain runs in Jacobian coordinates (no
+        inversions) and ONE batched Montgomery-trick inversion converts
+        every row to affine — the difference between seconds and
+        minutes for the 12-bit tables (2^12−1 rows × 22 windows/key).
+        Never hits infinity: d·2^{w·i} < 2^{w·n_windows + w} is never
+        ≡ 0 mod n for the prime-order base points used here.
+        """
+        p = self.p
+        per = (1 << w_bits) - 1
+        rows = n_windows * per
+        JX = [0] * rows
+        JY = [0] * rows
+        JZ = [0] * rows
+        bx, by = point
+
+        def jdouble(X1, Y1, Z1):
+            # dbl-2001-b (a = -3)
+            delta = Z1 * Z1 % p
+            gamma = Y1 * Y1 % p
+            beta = X1 * gamma % p
+            alpha = 3 * (X1 - delta) * (X1 + delta) % p
+            X3 = (alpha * alpha - 8 * beta) % p
+            Z3 = ((Y1 + Z1) ** 2 - gamma - delta) % p
+            Y3 = (alpha * (4 * beta - X3) - 8 * gamma * gamma) % p
+            return X3, Y3, Z3
+
+        def jmadd(X1, Y1, Z1, x2, y2):
+            # madd-2004-hmv (Z2 = 1); caller guarantees the points are
+            # distinct and nonzero, so h ≠ 0.
+            z1z1 = Z1 * Z1 % p
+            u2 = x2 * z1z1 % p
+            s2 = y2 * Z1 % p * z1z1 % p
+            h = (u2 - X1) % p
+            hh = h * h % p
+            i4 = 4 * hh % p
+            j = h * i4 % p
+            r = 2 * (s2 - Y1) % p
+            v = X1 * i4 % p
+            X3 = (r * r - j - 2 * v) % p
+            Y3 = (r * (v - X3) - 2 * Y1 * j) % p
+            Z3 = ((Z1 + h) ** 2 - z1z1 - hh) % p
+            return X3, Y3, Z3
+
+        for i in range(n_windows):
+            base_row = i * per
+            # d = 1: the (affine) base itself
+            JX[base_row], JY[base_row], JZ[base_row] = bx, by, 1
+            if per > 1:
+                X, Y, Z = jdouble(bx, by, 1)         # d = 2
+                JX[base_row + 1], JY[base_row + 1], JZ[base_row + 1] = \
+                    X, Y, Z
+                for d in range(3, per + 1):
+                    X, Y, Z = jmadd(X, Y, Z, bx, by)
+                    r = base_row + d - 1
+                    JX[r], JY[r], JZ[r] = X, Y, Z
+            # advance base by 2^w for the next window
+            BX, BY, BZ = bx, by, 1
+            for _ in range(w_bits):
+                BX, BY, BZ = jdouble(BX, BY, BZ)
+            zi = pow(BZ, -1, p)
+            zi2 = zi * zi % p
+            bx, by = BX * zi2 % p, BY * zi2 % p * zi % p
+
+        # One batched inversion of all Z (Montgomery's trick).
+        pref = [1] * (rows + 1)
+        for r in range(rows):
+            pref[r + 1] = pref[r] * JZ[r] % p
+        inv = pow(pref[rows], -1, p)
+        X_out = [0] * rows
+        Y_out = [0] * rows
+        for r in range(rows - 1, -1, -1):
+            zi = pref[r] * inv % p       # = JZ[r]^-1
+            inv = inv * JZ[r] % p
+            zi2 = zi * zi % p
+            X_out[r] = JX[r] * zi2 % p
+            Y_out[r] = JY[r] * zi2 % p * zi % p
+        return X_out, Y_out
+
 
 _CURVES_CACHE: Dict[str, CurveParams] = {}
 
@@ -459,9 +545,9 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
             r_limbs, s_limbs, e_limbs,
             jnp.asarray(key_idx, jnp.int32),
             rtab.tqx, rtab.tqy,
-            *ec_rns.g_residue_tables(cp.name),
+            *ec_rns.g_residue_tables(cp.name, rtab.ctx.w_bits),
             *consts[4:9],
-            crv=cp.name, nbits=cp.nbits,
+            crv=cp.name, nbits=cp.nbits, wbits=rtab.ctx.w_bits,
         )
     else:
         ok_dev, deg_dev = _ecdsa_core(
@@ -574,7 +660,8 @@ def es_packed_records(table: ECKeyTable, sig_mat: np.ndarray,
 
 
 def _es_packed_rns_impl(packed, tqx, tqy, g_tabs, consts, *, crv: str,
-                        nbits: int, k: int, cb: int, hlen: int):
+                        nbits: int, wbits: int, k: int, cb: int,
+                        hlen: int):
     from . import ec_rns
 
     sig = packed[:, :2 * cb]
@@ -583,7 +670,8 @@ def _es_packed_rns_impl(packed, tqx, tqy, g_tabs, consts, *, crv: str,
     idx = packed[:, 2 * cb + hlen + 1].astype(jnp.int32)
     r, s, e = _ec_prep(sig, dig, k=k)
     ok, deg = ec_rns._ecdsa_rns_core(r, s, e, idx, tqx, tqy, *g_tabs,
-                                     *consts, crv=crv, nbits=nbits)
+                                     *consts, crv=crv, nbits=nbits,
+                                     wbits=wbits)
     return ok & flags, deg & flags
 
 
@@ -637,12 +725,14 @@ def verify_es_packed_pending(table: ECKeyTable, rec: np.ndarray,
         rtab = table.rns()
         consts = cp.device_consts()
         fn = _es_packed_jit("rns", _es_packed_rns_impl,
-                            ("crv", "nbits", "k", "cb", "hlen"))
+                            ("crv", "nbits", "wbits", "k", "cb",
+                             "hlen"))
         return fn(dev, place(rtab.tqx), place(rtab.tqy),
                   tuple(place(a) for a in
-                        ec_rns.g_residue_tables(cp.name)),
+                        ec_rns.g_residue_tables(cp.name,
+                                                rtab.ctx.w_bits)),
                   tuple(place(a) for a in consts[4:9]),
-                  crv=cp.name, nbits=cp.nbits,
+                  crv=cp.name, nbits=cp.nbits, wbits=rtab.ctx.w_bits,
                   k=cp.k, cb=cp.coord_bytes, hlen=hash_len)
     fn = _es_packed_jit("limb", _es_packed_limb_impl,
                         ("nbits", "n_windows", "k", "cb", "hlen"))

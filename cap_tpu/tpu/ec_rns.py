@@ -30,7 +30,7 @@ backends; bit-exact parity enforced by the shared conformance tests.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -48,25 +48,44 @@ from .rns import (
 )
 
 
-W_BITS = 8          # window width: byte-aligned digits, 255-entry rows
+def default_w_bits() -> int:
+    """Interleaved-window width for the RNS EC ladder.
+
+    8-bit everywhere: measured on the attached chip, 12-bit windows
+    (22 ladder steps instead of 32) are 2.3× SLOWER — the 2^12-entry
+    tables (~130 MB at 8 keys) push the per-window gathers into
+    scattered HBM reads, which dominates the saved REDC depth. The
+    machinery supports any width (CAP_TPU_EC_WBITS to re-measure on
+    other parts); docs/PERF.md records the A/B.
+    """
+    import os
+
+    v = os.environ.get("CAP_TPU_EC_WBITS")
+    if v:
+        return int(v)
+    return 8
 
 
 class ECRNSContext(FieldRNSContext):
     """Per-curve field context (shared construction in FieldRNSContext)."""
 
-    def __init__(self, cp: CurveParams):
+    def __init__(self, cp: CurveParams, w_bits: int):
         super().__init__(cp.p, cp.k)
         self.cp = cp
-        self.n_windows = (cp.nbits + W_BITS - 1) // W_BITS
+        self.w_bits = w_bits
+        self.n_windows = (cp.nbits + w_bits - 1) // w_bits
 
 
-_CTX: Dict[str, ECRNSContext] = {}
+_CTX: Dict[tuple, ECRNSContext] = {}
 
 
-def ctx_for(crv: str) -> ECRNSContext:
-    if crv not in _CTX:
-        _CTX[crv] = ECRNSContext(curve(crv))
-    return _CTX[crv]
+def ctx_for(crv: str, w_bits: Optional[int] = None) -> ECRNSContext:
+    if w_bits is None:
+        w_bits = default_w_bits()
+    key = (crv, w_bits)
+    if key not in _CTX:
+        _CTX[key] = ECRNSContext(curve(crv), w_bits)
+    return _CTX[key]
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +179,27 @@ def congruent_zero(c: ECRNSContext, x, max_c: int):
     return ok
 
 
+def congruent_zero_probe(c: ECRNSContext, x, max_c: int, nch: int = 2):
+    """[N] bool: SUFFICIENT test for value(x) ≡ 0 (mod p) on ``nch``
+    probe channels only — every true congruence is caught (residues of
+    a multiple of p match c·p on all channels, hence on the probe
+    subset), with ~max_c/(m₀·m₁) ≈ 3e-7 false positives.
+
+    Used for the per-window degeneracy flags, where a false positive
+    just sends one token to the CPU oracle re-verify (same contract,
+    ~23× less elementwise work per window than the full-base compare);
+    the final acceptance check keeps the exact ``congruent_zero``.
+    """
+    mch = c.dA["m"][:nch, None]
+    mfch = c.dA["m_f"][:nch, None]
+    ifch = c.dA["inv_f"][:nch, None]
+    xa = _mod_fix(x[0][:nch], mch, mfch, ifch)
+    ok = jnp.zeros(xa.shape[1], bool)
+    for cc in range(max_c):
+        ok = ok | jnp.all(xa == c.cp_A[cc][:nch, None], axis=0)
+    return ok
+
+
 def req(c: ECRNSContext, x, y, slack: int):
     """[N] bool: value(x) ≡ value(y) (mod p); x < slack·p bound."""
     d = rsub(c, x, y, slack)
@@ -203,7 +243,7 @@ def _madd_rns(c: ECRNSContext, X1, Y1, Z1, inf1, x2, y2):
     Z3 = rfix(c, rsub(c, rsub(c, zh2, z1z1, 4, guard=1), hh, 4,
                       guard=1))                  # < 11p, ≤ m (fixed)
 
-    deg = ~inf1 & congruent_zero(c, h, 20)       # same-x (incl. inverse)
+    deg = ~inf1 & congruent_zero_probe(c, h, 20)  # same-x (incl. inverse)
     return X3, Y3, Z3, deg
 
 
@@ -239,7 +279,7 @@ def _jadd_rns(c: ECRNSContext, X1, Y1, Z1, inf1, X2, Y2, Z2, inf2):
     Z3 = z3                                          # < 3p, ≤ m
 
     both = ~inf1 & ~inf2
-    deg = both & congruent_zero(c, h, 8)             # same x (P = ±Q)
+    deg = both & congruent_zero_probe(c, h, 8)       # same x (P = ±Q)
     # infinity lanes: inf1 → P2, inf2 → P1
     X3 = rsel(inf1, X2, rsel(inf2, X1, X3))
     Y3 = rsel(inf1, Y2, rsel(inf2, Y1, Y3))
@@ -258,19 +298,39 @@ def _one_dom(c: ECRNSContext):
 # The batched verify core
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("crv", "nbits"))
+def _digits_of(u, w_bits: int, n_windows: int):
+    """[K, N] u32 16-bit limbs → [n_windows, N] i32 w-bit digits.
+
+    Digits may straddle limb boundaries for w ∤ 16 (the 12-bit path);
+    an appended zero limb covers the top window's spill.
+    """
+    up = jnp.concatenate(
+        [u, jnp.zeros((1, u.shape[1]), u.dtype)], axis=0)
+    mask = (1 << w_bits) - 1
+    outs = []
+    for j in range(n_windows):
+        b = w_bits * j
+        l, o = b >> 4, b & 15
+        d = up[l] >> o
+        if o + w_bits > 16:
+            d = d | (up[l + 1] << (16 - o))
+        outs.append(d & mask)
+    return jnp.stack(outs).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("crv", "nbits", "wbits"))
 def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
                     n, npp, nr2, none_, nm2,
-                    crv: str, nbits: int):
+                    crv: str, nbits: int, wbits: int = 8):
     """ECDSA verify: scalar math in limbs, point math in RNS.
 
     r, s, e: [K, N] limb values; key_idx [N]; tq*/tg*: window tables
-    as RNS residue rows [rows, I_A + I_B] (A-domain). n..nm2: [K, 1]
-    scalar-field constants. Returns (ok, deg) [N] bools.
+    as RNS residue rows [rows, I_A + I_B] (A-domain, width ``wbits``).
+    n..nm2: [K, 1] scalar-field constants. Returns (ok, deg) [N] bools.
     """
     from . import bignum as B
 
-    c = ctx_for(crv)
+    c = ctx_for(crv, wbits)
     k = r.shape[0]
     shape = r.shape
     nb = jnp.broadcast_to(n, shape)
@@ -287,32 +347,28 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     u1 = B.mont_mul(e, w_m, nb, nppb)
     u2 = B.mont_mul(r, w_m, nb, nppb)
 
-    # 2. window digits (byte-aligned: 2 digits per 16-bit limb)
+    # 2. window digits (w-bit, limb-boundary-straddling for w ∤ 16)
     n_windows = c.n_windows
-    per = (1 << W_BITS) - 1
+    per = (1 << wbits) - 1
 
-    def bytes_of(u):
-        return jnp.stack(
-            [(u >> (8 * j)) & 255 for j in range(2)], axis=1
-        ).reshape(2 * k, shape[1]).astype(jnp.int32)
-
-    dig1 = bytes_of(u1)
-    dig2 = bytes_of(u2)
+    dig1 = _digits_of(u1, wbits, n_windows)
+    dig2 = _digits_of(u2, wbits, n_windows)
     key_base = key_idx.astype(jnp.int32) * (n_windows * per)
 
     ia = c.A.count
-
-    def gather_pt(tab_x, tab_y, idx):
-        gx = jnp.take(tab_x, idx, axis=0).T       # [I_A+I_B, N]
-        gy = jnp.take(tab_y, idx, axis=0).T
-        return ((gx[:ia], gx[ia:]), (gy[:ia], gy[ia:]))
+    iab = ia + c.B.count
 
     # 3. TWO-ACCUMULATOR ladder: the per-window G-digit and Q-digit
     # additions are independent chains, so both run as ONE mixed-add
     # over a [I, 2N] concatenated state — the same 5 REDC layers per
     # window serve both chains (half the dependency depth of
-    # interleaving them), and each layer's matmuls run at double batch
-    # width. The accumulators merge with a single full Jacobian add.
+    # interleaving them). The x and y window tables fuse into one
+    # [rows, 2I] table so each step costs ONE gather (same bytes, half
+    # the gather dispatches). A 4-chain even/odd split (16 steps at 4N
+    # lanes) measured SLOWER on the chip — per-layer cost here scales
+    # with lane width (bandwidth-bound), so halving depth while
+    # doubling width nets negative with the extra merge adds
+    # (docs/PERF.md A/B). The accumulators merge with one Jacobian add.
     n_tok = shape[1]
     zA = jnp.zeros((c.A.count, 2 * n_tok), I32)
     zB = jnp.zeros((c.B.count, 2 * n_tok), I32)
@@ -323,15 +379,21 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     deg0 = jnp.zeros(2 * n_tok, bool)
     one_d = _one_dom(c)
 
-    tab_x = jnp.concatenate([tgx, tqx], axis=0)
-    tab_y = jnp.concatenate([tgy, tqy], axis=0)
+    tab = jnp.concatenate(
+        [jnp.concatenate([tgx, tqx], axis=0),
+         jnp.concatenate([tgy, tqy], axis=0)], axis=1)  # [rows, 2I]
     q_off = tgx.shape[0]
+
+    def gather_pt(idx):
+        g = jnp.take(tab, idx, axis=0).T          # [2I, M]
+        return ((g[:ia], g[ia:iab]),
+                (g[iab:iab + ia], g[iab + ia:]))
 
     def add_from_table(state, d, row0):
         X, Y, Z, inf, deg = state
         has = d > 0
         idx = row0 + jnp.where(has, d - 1, 0)
-        x2, y2 = gather_pt(tab_x, tab_y, idx)
+        x2, y2 = gather_pt(idx)
         X3, Y3, Z3, dd = _madd_rns(c, X, Y, Z, inf, x2, y2)
         # infinity accumulator: result is the (lifted) affine addend
         lift = inf & has
@@ -353,7 +415,7 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
         d = jnp.concatenate([d1, d2])
         row0 = jnp.concatenate(
-            [jnp.full((n_tok,), i * per, jnp.int32),
+            [jnp.full((n_tok,), 1, jnp.int32) * (i * per),
              q_off + key_base + i * per])
         return add_from_table(state, d, row0)
 
@@ -410,12 +472,13 @@ def _limb_pair_to_rns(c: ECRNSContext, limbs):
 class ECRNSKeyTable:
     """Window tables as A-domain residue rows [rows, I_A + I_B]."""
 
-    def __init__(self, crv: str, keys: Sequence):
-        self.ctx = ctx_for(crv)
+    def __init__(self, crv: str, keys: Sequence,
+                 w_bits: Optional[int] = None):
+        self.ctx = ctx_for(crv, w_bits)
         self.cp = self.ctx.cp
         c = self.ctx
         nk = len(keys)
-        rows = self.ctx.n_windows * ((1 << W_BITS) - 1)
+        rows = c.n_windows * ((1 << c.w_bits) - 1)
         ia, ib = c.A.count, c.B.count
         tqx = np.empty((nk * rows, ia + ib), np.int32)
         tqy = np.empty((nk * rows, ia + ib), np.int32)
@@ -428,42 +491,54 @@ class ECRNSKeyTable:
         self.tqy = jnp.asarray(tqy)
 
 
+def _residue_matrix(c: ECRNSContext, vals: List[int]) -> np.ndarray:
+    """[len(vals), I_A + I_B] i32 residues of host ints < p, vectorized.
+
+    Bytes-of-value × (256^j mod mᵢ) as one f64 BLAS matmul — exact,
+    since every term is < 255·2^13 and ≤ 67 terms sum < 2^53 — then a
+    single i64 %. Replaces the per-row residues_of() python loop (the
+    12-bit tables have 90k rows/key; per-row conversion was seconds).
+    """
+    cp = c.cp
+    nb = (cp.p.bit_length() + 7) // 8 + 1
+    blob = b"".join(v.to_bytes(nb, "little") for v in vals)
+    mat = np.frombuffer(blob, np.uint8).reshape(len(vals), nb)
+    ms = np.concatenate([np.asarray(c.A.m, np.int64),
+                         np.asarray(c.B.m, np.int64)])
+    powm = np.empty((nb, len(ms)), np.int64)
+    for i, m in enumerate(ms):
+        mi = int(m)
+        powm[:, i] = [pow(256, j, mi) for j in range(nb)]
+    acc = mat.astype(np.float64) @ powm.astype(np.float64)
+    return (acc.astype(np.int64) % ms[None, :]).astype(np.int32)
+
+
 def _window_residue_rows(c: ECRNSContext, point) -> Tuple[np.ndarray,
                                                           np.ndarray]:
-    """Host: 8-bit window table of d·2^{8i}·point as A-domain residues.
+    """Host: w-bit window table of d·2^{w·i}·point as A-domain residues.
 
-    Row i·255 + (d−1) holds d·2^{8i}·point; byte-aligned digits halve
-    the ladder length vs 4-bit windows at the cost of bigger (still
-    small) tables and a ~30ms/key host precompute.
+    Row i·(2^w−1) + (d−1) holds d·2^{w·i}·point. The affine multiples
+    come from the Jacobian chain + one batched inversion
+    (CurveParams.window_multiples), residues from the vectorized
+    converter — together ~0.5 s/key for the 12-bit P-256 tables.
     """
     cp = c.cp
     p = cp.p
     a_mod = c.A.prod % p
-    nw = c.n_windows
-    ia, ib = c.A.count, c.B.count
-    per = (1 << W_BITS) - 1
-    rx = np.empty((nw * per, ia + ib), np.int32)
-    ry = np.empty((nw * per, ia + ib), np.int32)
-    base = point
-    for i in range(nw):
-        acc = None
-        for d in range(1, per + 1):
-            acc = cp.affine_add(acc, base)
-            x, y = acc
-            rx[i * per + d - 1] = c.residues_of(x * a_mod % p)
-            ry[i * per + d - 1] = c.residues_of(y * a_mod % p)
-        for _ in range(W_BITS):
-            base = cp.affine_add(base, base)
+    X, Y = cp.window_multiples(point, c.w_bits, c.n_windows)
+    rx = _residue_matrix(c, [x * a_mod % p for x in X])
+    ry = _residue_matrix(c, [y * a_mod % p for y in Y])
     return rx, ry
 
 
-_G_TABLES: Dict[str, tuple] = {}
+_G_TABLES: Dict[tuple, tuple] = {}
 
 
-def g_residue_tables(crv: str):
-    if crv not in _G_TABLES:
-        c = ctx_for(crv)
+def g_residue_tables(crv: str, w_bits: Optional[int] = None):
+    c = ctx_for(crv, w_bits)
+    key = (crv, c.w_bits)
+    if key not in _G_TABLES:
         cp = c.cp
         rx, ry = _window_residue_rows(c, (cp.gx, cp.gy))
-        _G_TABLES[crv] = (jnp.asarray(rx), jnp.asarray(ry))
-    return _G_TABLES[crv]
+        _G_TABLES[key] = (jnp.asarray(rx), jnp.asarray(ry))
+    return _G_TABLES[key]

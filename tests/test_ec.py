@@ -196,3 +196,68 @@ def test_es_no_kid_single_key_routes_to_device():
     tok = captest.sign_jwt(priv, "ES256", captest.default_claims())
     res = ks.verify_batch([tok] * 3)
     assert all(isinstance(r, dict) for r in res)
+
+
+@pytest.mark.heavy
+def test_rns_w12_parity(monkeypatch):
+    """12-bit window RNS path against the CPU oracle.
+
+    The default is w=8 everywhere (w=12 measured slower on the chip —
+    see ec_rns.default_w_bits), but the machinery stays width-generic
+    for re-measurement on other parts; this pins the cross-limb digit
+    extraction, the Jacobian+batched-inverse table build, and the probe
+    degeneracy flags at w=12 — successes AND rejections.
+    """
+    from cap_tpu.tpu import ec_rns
+
+    monkeypatch.setenv("CAP_TPU_RNS", "1")
+    curve_cls, hash_cls, cb = _CFG["P-256"]
+    privs = [cec.generate_private_key(curve_cls()) for _ in range(3)]
+    pubs = [p.public_key() for p in privs]
+    table = ECKeyTable("P-256", pubs)
+    table._rns = ec_rns.ECRNSKeyTable("P-256", pubs, w_bits=12)
+    assert table.rns().ctx.w_bits == 12
+
+    msg = b"w12 parity"
+    digest = hashlib.new(hash_cls.name, msg).digest()
+    sigs, rows, want = [], [], []
+    for i, p in enumerate(privs):
+        sigs.append(_raw_sign(p, msg, hash_cls, cb))
+        rows.append(i)
+        want.append(True)
+    good = bytearray(sigs[0])
+    for flip in (0, cb - 1, cb, 2 * cb - 1):    # r/s head+tail tampering
+        bad = bytearray(good)
+        bad[flip] ^= 1
+        sigs.append(bytes(bad)); rows.append(0); want.append(False)
+    sigs.append(b"\x00" * (2 * cb)); rows.append(0); want.append(False)
+    n_int = curve("P-256").n
+    sigs.append(n_int.to_bytes(cb, "big") + good[cb:])   # r = n
+    rows.append(0); want.append(False)
+    # wrong-key dispatch must reject
+    sigs.append(bytes(good)); rows.append(1); want.append(False)
+
+    ok = verify_ecdsa_batch(table, sigs, [digest] * len(sigs),
+                            np.asarray(rows, np.int32))
+    assert list(ok) == want
+
+
+@pytest.mark.heavy
+def test_window_multiples_matches_affine_chain():
+    """Jacobian fast path == the naive affine chain, several widths."""
+    cp = curve("P-256")
+    priv = cec.generate_private_key(cec.SECP256R1())
+    nums = priv.public_key().public_numbers()
+    point = (nums.x, nums.y)
+    for w_bits, n_windows in ((4, 3), (8, 2), (12, 2)):
+        X, Y = cp.window_multiples(point, w_bits, n_windows)
+        per = (1 << w_bits) - 1
+        base = point
+        for i in range(n_windows):
+            acc = None
+            for d in range(1, per + 1):
+                acc = cp.affine_add(acc, base)
+                r = i * per + d - 1
+                assert (X[r], Y[r]) == acc, (w_bits, i, d)
+            for _ in range(w_bits):
+                base = cp.affine_add(base, base)

@@ -279,8 +279,9 @@ def test_prefetch_claims_uses_ext_with_identical_results():
               for i in range(50)]
     # one weird-but-valid payload and one non-object payload via raw JWS
     h = b64url_encode(json.dumps({"alg": "ES256"}).encode())
+    inf_payload = b'{"inf": Infinity}'
     tokens.append(f"{h}.{b64url_encode(b'[1,2,3]')}.c2ln")
-    tokens.append(f"{h}.{b64url_encode(b'{\"inf\": Infinity}')}.c2ln")
+    tokens.append(f"{h}.{b64url_encode(inf_payload)}.c2ln")
 
     pb1 = native.prepare_batch_arrays(tokens)
     pb1.prefetch_claims(range(pb1.n))
