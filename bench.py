@@ -54,8 +54,6 @@ def _make_fixtures(n_unique: int):
     signatures are all distinct — the workload a real verifier sees.
     Signing happens across threads (OpenSSL releases the GIL).
     """
-    from concurrent.futures import ThreadPoolExecutor
-
     from cap_tpu import testing as T
     from cap_tpu.jwt import algs
     from cap_tpu.jwt.jwk import JWK
@@ -69,18 +67,7 @@ def _make_fixtures(n_unique: int):
         priv, pub = T.generate_keys(algs.ES256)
         jwks.append(JWK(pub, kid=f"es-{i}"))
         signers.append((priv, algs.ES256, f"es-{i}"))
-
-    base = T.default_claims(ttl=86400.0)
-
-    def sign(j: int) -> str:
-        priv, alg, kid = signers[j % len(signers)]
-        claims = dict(base, sub=f"user-{j:08d}", jti=f"tok-{j:012d}")
-        return T.sign_jwt(priv, alg, claims, kid=kid)
-
-    workers = min(16, os.cpu_count() or 4)
-    with ThreadPoolExecutor(workers) as ex:
-        tokens = list(ex.map(sign, range(n_unique), chunksize=256))
-    return jwks, tokens
+    return jwks, T.sign_unique_jwts(signers, n_unique)
 
 
 def _probe_wire_mbps() -> float:
@@ -136,8 +123,16 @@ def main() -> None:
     rec = telemetry.enable()
     done_t = []
     t_start = time.perf_counter()
-    for _ in ks.verify_stream(tokens for _ in range(window + 1)):
+    for out in ks.verify_stream(tokens for _ in range(window + 1)):
         done_t.append(time.perf_counter())
+        # The timed path must verify correctly too — a pipelining
+        # regression returning errors must not produce a clean rate.
+        bad = sum(1 for r in out if isinstance(r, Exception))
+        if bad:
+            print(json.dumps({"metric": "error", "value": bad,
+                              "unit": "failed_verifies",
+                              "vs_baseline": 0.0}))
+            return
     telemetry.disable()
     h2d_bytes = rec.counters().get("h2d.bytes", 0)
 

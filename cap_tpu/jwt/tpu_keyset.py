@@ -270,12 +270,22 @@ class TPUBatchKeySet(KeySet):
             runner(alg_name, idx)
 
         def run_rs(alg_name: str, idx: np.ndarray) -> None:
-            self._run_rsa_packed(_RS[alg_name], idx, pb, packed_parts,
-                                 packed_meta, pending, slow, results)
+            self._run_rsa_packed("rs", _RS[alg_name], idx, pb,
+                                 packed_parts, packed_meta, pending,
+                                 slow, results)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
-            self._run_rsa_arrays("ps", _PS[alg_name], idx, pb, pending,
-                                 slow)
+            # PS256 rides the packed single-transfer path with the
+            # device-side EMSA-PSS check; PS384/512 keep the arrays
+            # path (device modexp + native host MGF1 tail) until the
+            # device SHA-2 grows 384/512 variants.
+            if _PS[alg_name] == "sha256":
+                self._run_rsa_packed("ps", "sha256", idx, pb,
+                                     packed_parts, packed_meta,
+                                     pending, slow, results)
+            else:
+                self._run_rsa_arrays("ps", _PS[alg_name], idx, pb,
+                                     pending, slow)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
             self._run_ec_packed(alg_name, idx, pb, packed_parts,
@@ -377,7 +387,8 @@ class TPUBatchKeySet(KeySet):
             c *= 2
         return min(self._max_chunk, max(1024, c))
 
-    def _run_rsa_packed(self, hash_name: str, idx: np.ndarray, pb,
+    def _run_rsa_packed(self, kind: str, hash_name: str,
+                        idx: np.ndarray, pb,
                         packed_parts: List[Any],
                         packed_meta: List[tuple],
                         pending: List[tuple],
@@ -401,7 +412,7 @@ class TPUBatchKeySet(KeySet):
             cls_idx = idx[sel]
             cls_rows = rows[sel] % _RSA_CLS_STRIDE
             if len(table.n_ints) > 255:    # kid row must fit a u8
-                self._run_rsa_arrays("rs", hash_name, cls_idx, pb,
+                self._run_rsa_arrays(kind, hash_name, cls_idx, pb,
                                      pending, slow, cls=cls)
                 continue
             width = 2 * table.k
@@ -414,11 +425,16 @@ class TPUBatchKeySet(KeySet):
                 crows = cls_rows[lo: lo + chunk_n]
                 m = len(chunk)
                 pad = _pad_size(m, chunk_n)
-                telemetry.count("device.rs.tokens", m)
-                with telemetry.span(f"dispatch.rs.{hash_name}"):
+                telemetry.count(f"device.{kind}.tokens", m)
+                with telemetry.span(f"dispatch.{kind}.{hash_name}"):
                     sizes = sizes_all[crows]
-                    em_ok = (sizes >= t_len + 11).astype(np.uint8)
-                    rec = pb.pack_sig_records(chunk, sizes, em_ok,
+                    if kind == "rs":
+                        # PKCS#1 v1.5 needs emLen ≥ tLen + 11; the
+                        # PSS equivalent checks run on device.
+                        extra = (sizes >= t_len + 11).astype(np.uint8)
+                    else:
+                        extra = np.ones(m, np.uint8)
+                    rec = pb.pack_sig_records(chunk, sizes, extra,
                                               crows, width, h_len, pad)
                     if rec is None:       # pre-packer .so: numpy path
                         sig_mat = np.zeros((pad, width), np.uint8)
@@ -432,9 +448,22 @@ class TPUBatchKeySet(KeySet):
                         rec = tpursa.rs_packed_records(
                             table, sig_mat, sig_lens, hash_mat,
                             hash_name, key_idx)
+                        if kind == "ps":
+                            # rs_packed_records applies the v1.5 emLen
+                            # flag; PSS keeps plain length validity.
+                            len_ok = (sig_lens == sizes_all[
+                                np.concatenate([crows, np.zeros(
+                                    pad - m, np.int32)])])
+                            rec[:, width + h_len] = \
+                                len_ok.astype(np.uint8)
+                            rec[m:, width + h_len] = 0
                     telemetry.count("h2d.bytes", rec.nbytes)
-                    ok_dev = tpursa.verify_rs_packed_pending(
-                        table, rec, hash_name, mesh=self._mesh)
+                    if kind == "rs":
+                        ok_dev = tpursa.verify_rs_packed_pending(
+                            table, rec, hash_name, mesh=self._mesh)
+                    else:
+                        ok_dev = tpursa.verify_ps_packed_pending(
+                            table, rec, hash_name, mesh=self._mesh)
                 packed_parts.append(ok_dev)
 
                 def consume(arrs, chunk=chunk, m=m):
@@ -887,12 +916,13 @@ class TPURemoteKeySet(KeySet):
 
     def __init__(self, jwks_url: str, jwks_ca_pem: Optional[str] = None,
                  max_chunk: int = 32768,
-                 min_refresh_interval: float = 10.0):
+                 min_refresh_interval: float = 10.0, mesh=None):
         from .keyset import JSONWebKeySet
 
         self._remote = JSONWebKeySet(jwks_url, jwks_ca_pem)
         self._max_chunk = max_chunk
         self._min_refresh = min_refresh_interval
+        self._mesh = mesh          # propagated to every table rebuild
         self._ks: Optional[TPUBatchKeySet] = None
         self._kids: set = set()
         self._last_refresh = 0.0
@@ -923,7 +953,8 @@ class TPURemoteKeySet(KeySet):
             jwks = self._remote.keys(refresh=refresh)
             kids = {j.kid for j in jwks if j.kid}
             if self._ks is None or kids != self._kids:
-                self._ks = TPUBatchKeySet(jwks, max_chunk=self._max_chunk)
+                self._ks = TPUBatchKeySet(jwks, max_chunk=self._max_chunk,
+                                          mesh=self._mesh)
                 self._kids = kids
             return self._ks
 

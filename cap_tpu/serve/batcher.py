@@ -8,10 +8,17 @@ connections accumulate in one queue; a dispatcher flushes to
 reached OR the oldest queued token has waited ``max_wait_ms``. Under
 load, flushes are back-to-back full batches (max throughput); when
 idle, a lone token waits at most one wait window (bounded p99).
+
+When the keyset exposes ``verify_batch_async`` (TPUBatchKeySet), the
+dispatcher runs TWO-DEEP: flush k+1's host prep and H2D overlap flush
+k's device drain (a collector thread owns the materializing syncs), so
+sustained load keeps the wire busy — the same pipelining bench.py
+measures, available to every serve client.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Any, List, Optional, Sequence
@@ -49,6 +56,14 @@ class AdaptiveBatcher:
         self._queue: List[_Pending] = []
         self._queued_tokens = 0
         self._closed = False
+        # 2-deep pipeline: one batch draining in the collector while
+        # the dispatcher preps/dispatches the next (maxsize=1 bounds
+        # the in-flight depth and applies backpressure).
+        self._inflight: "queue.Queue" = queue.Queue(maxsize=1)
+        self._collector = threading.Thread(
+            target=self._collect_loop, daemon=True,
+            name="cap-tpu-collector")
+        self._collector.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="cap-tpu-batcher")
         self._thread.start()
@@ -74,7 +89,15 @@ class AdaptiveBatcher:
         with self._cv:
             self._closed = True
             self._cv.notify()
-        self._thread.join(timeout=5.0)
+        # The dispatcher may be blocked handing its LAST batch to the
+        # collector (bounded queue) while the collector sits in a
+        # multi-second device sync — wait it out, or a sentinel racing
+        # that put could shut the collector down ahead of the batch and
+        # strand its submitters in event.wait() forever.
+        while self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self._inflight.put(None)          # collector shutdown sentinel
+        self._collector.join(timeout=60.0)
 
     # -- dispatcher -------------------------------------------------------
 
@@ -113,11 +136,38 @@ class AdaptiveBatcher:
             tokens.extend(p.tokens)
         telemetry.count("batcher.flushes")
         telemetry.observe("batcher.batch_size", float(n))
+        dispatch = getattr(self._keyset, "verify_batch_async", None)
+        if dispatch is not None:
+            try:
+                with telemetry.span("batcher.dispatch"):
+                    collect = dispatch(tokens)
+            except Exception as e:  # noqa: BLE001 - fan the failure out
+                self._distribute(batch, [e] * len(tokens))
+                return
+            self._inflight.put((batch, len(tokens), collect))
+            return
         try:
             with telemetry.span("batcher.flush"):
                 results = self._keyset.verify_batch(tokens)
         except Exception as e:  # noqa: BLE001 - fan the failure out
             results = [e] * len(tokens)
+        self._distribute(batch, results)
+
+    def _collect_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            batch, n_tokens, collect = item
+            try:
+                with telemetry.span("batcher.collect"):
+                    results = collect()
+            except Exception as e:  # noqa: BLE001 - fan the failure out
+                results = [e] * n_tokens
+            self._distribute(batch, results)
+
+    @staticmethod
+    def _distribute(batch: List[_Pending], results: List[Any]) -> None:
         off = 0
         for p in batch:
             p.results = list(results[off: off + len(p.tokens)])

@@ -103,6 +103,27 @@ def default_claims(issuer: str = "https://example.com/", sub: str = "alice",
     return claims
 
 
+def sign_unique_jwts(signers, n: int, ttl: float = 86400.0):
+    """n UNIQUE test JWTs: distinct sub/jti per token → distinct payload
+    bytes AND signatures (the honest-bench workload; VERDICT r2 #3).
+
+    signers: [(private_key, alg, kid), ...] cycled round-robin; signing
+    runs across threads (OpenSSL releases the GIL).
+    """
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    base = default_claims(ttl=ttl)
+
+    def sign(j: int) -> str:
+        priv, alg, kid = signers[j % len(signers)]
+        claims = dict(base, sub=f"user-{j:08d}", jti=f"tok-{j:012d}")
+        return sign_jwt(priv, alg, claims, kid=kid)
+
+    with ThreadPoolExecutor(min(16, os.cpu_count() or 4)) as ex:
+        return list(ex.map(sign, range(n), chunksize=256))
+
+
 def generate_ca(common_name: str = "cap-tpu-test-ca") -> Tuple[str, Any, str]:
     """Generate a self-signed CA; returns (cert_pem, private_key, key_pem)."""
     key = ec.generate_private_key(ec.SECP256R1())

@@ -415,7 +415,7 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
         d = jnp.concatenate([d1, d2])
         row0 = jnp.concatenate(
-            [jnp.full((n_tok,), 1, jnp.int32) * (i * per),
+            [jnp.full((n_tok,), i * per, jnp.int32),
              q_off + key_base + i * per])
         return add_from_table(state, d, row0)
 

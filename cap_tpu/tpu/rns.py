@@ -445,6 +445,16 @@ class RNSToLimbs:
     """
 
     def __init__(self, base: _Base, k_out: int):
+        # Instances are cached (_TO_LIMBS_CACHE) and may be built
+        # lazily during a jit trace; without the compile-time-eval
+        # guard the jnp constants below would be TRACERS of that trace
+        # and poison every later call (UnexpectedTracerError).
+        import jax
+
+        with jax.ensure_compile_time_eval():
+            self._init(base, k_out)
+
+    def _init(self, base: "_Base", k_out: int):
         self.base = base
         self.k_out = k_out
         bits = int(np.ceil(np.log2(float(base.count)))) + \

@@ -119,6 +119,8 @@ class RSAKeyTable:
         # Device-resident per-key scalars for the packed in-jit gathers.
         self.sizes_dev = jnp.asarray(self.sizes_bytes, jnp.int32)
         self.e_dev = jnp.asarray(self.e_arr)
+        self.mod_bits_dev = jnp.asarray(
+            [n.bit_length() for n in self.n_ints], jnp.int32)
         self._rns = None
 
     def rns(self):
@@ -509,6 +511,96 @@ def verify_pss_batch(table: RSAKeyTable, sigs: Sequence[bytes],
 
 
 # ---------------------------------------------------------------------------
+# Device-side EMSA-PSS-VERIFY (SHA-256 family)
+# ---------------------------------------------------------------------------
+
+def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
+                       h_len: int):
+    """RFC 8017 §9.1.2 on device, salt auto-recovered: [N] bool.
+
+    em_bytes: [N, width] big-endian EM integer bytes (width = 2k);
+    mhash: [N, h_len] u8; mod_bits: [N] i32 per-token modulus bits.
+    SHA-256 only (PS256) — the MGF1 digests and H' run as batched
+    device hashing (tpu/sha256.py), so NO EM bytes ever leave the
+    device; the reference computes all of this per token on CPU
+    (jwt/keyset.go:126-139 → crypto/rsa.VerifyPSS).
+
+    Bit-exact with pss_check_em/cap_pss_check_batch: every structural
+    rejection (short emLen, missing 0xBC, nonzero leading bits/bytes,
+    bad PS/0x01 separator, H' mismatch) reproduces the host verdicts.
+    """
+    import jax.numpy as jnp
+
+    from . import sha256 as S
+
+    n = em_bytes.shape[0]
+    em_bits = mod_bits.astype(jnp.int32) - 1
+    em_len = (em_bits + 7) // 8                     # [N]
+    start = width - em_len                          # first EM byte
+    cols = jnp.arange(width, dtype=jnp.int32)[None, :]
+
+    # EM < 2^emBits: bytes before `start` must be zero.
+    lead_ok = jnp.all(jnp.where(cols < start[:, None], em_bytes, 0) == 0,
+                      axis=1)
+    db_len = em_len - h_len - 1                     # [N]
+    len_ok = em_len >= h_len + 2
+    trailer_ok = em_bytes[:, width - 1] == 0xBC
+
+    # H and maskedDB, gathered at per-token offsets.
+    h_mat = em_bytes[:, width - 1 - h_len: width - 1]       # [N, h_len]
+    db_max = width - h_len - 1
+    dbj = jnp.arange(db_max, dtype=jnp.int32)[None, :]
+    db_idx = jnp.clip(start[:, None] + dbj, 0, width - 1)
+    masked_db = jnp.take_along_axis(em_bytes, db_idx, axis=1)
+    in_db = dbj < db_len[:, None]
+    masked_db = jnp.where(in_db, masked_db, 0)
+
+    unused = 8 * em_len - em_bits                   # [N] ∈ [0, 7]
+    top_mask = (0xFF >> unused).astype(jnp.uint8)   # [N]
+    top_ok = (unused == 0) | \
+        ((masked_db[:, 0] >> (8 - unused).astype(jnp.uint8)) == 0)
+
+    # MGF1(H, dbLen): ceil(db_max/h_len) fixed-size single-block
+    # hashes; mask byte j = SHA256(H ‖ be32(j // h_len))[j % h_len].
+    n_ctr = (db_max + h_len - 1) // h_len
+    seeds = jnp.zeros((n, h_len + 4), jnp.uint8)
+    seeds = seeds.at[:, :h_len].set(h_mat)
+    mask_parts = []
+    for ctr in range(n_ctr):
+        s = seeds.at[:, h_len + 3].set(jnp.uint8(ctr & 0xFF))
+        s = s.at[:, h_len + 2].set(jnp.uint8((ctr >> 8) & 0xFF))
+        mask_parts.append(S.sha256_fixed(s))
+    mask = jnp.concatenate(mask_parts, axis=1)[:, :db_max]
+    db = masked_db ^ jnp.where(in_db, mask, 0)
+    db = db.at[:, 0].set(db[:, 0] & top_mask)
+
+    # DB = 0x00.. ‖ 0x01 ‖ salt: first nonzero byte must be 0x01.
+    nz = (db != 0) & in_db
+    sep = jnp.argmax(nz, axis=1).astype(jnp.int32)  # 0 when none
+    any_nz = jnp.any(nz, axis=1)
+    sep_ok = any_nz & \
+        (jnp.take_along_axis(db, sep[:, None], axis=1)[:, 0] == 1)
+    salt_len = db_len - sep - 1                     # [N]
+
+    # M' = 0^8 ‖ mHash ‖ salt; salt gathered from db[sep+1 ...].
+    salt_max = db_max - 1
+    mp_len = 8 + h_len + salt_len
+    mp_max = 8 + h_len + salt_max
+    sj = jnp.arange(salt_max, dtype=jnp.int32)[None, :]
+    salt_idx = jnp.clip(sep[:, None] + 1 + sj, 0, db_max - 1)
+    salt = jnp.take_along_axis(db, salt_idx, axis=1)
+    salt = jnp.where(sj < salt_len[:, None], salt, 0)
+    mprime = jnp.zeros((n, mp_max), jnp.uint8)
+    mprime = mprime.at[:, 8:8 + h_len].set(mhash[:, :h_len])
+    mprime = mprime.at[:, 8 + h_len:].set(salt)
+    hprime = S.sha256_var(mprime, mp_len, mp_max)
+
+    h_ok = jnp.all(hprime[:, :h_len] == h_mat, axis=1)
+    return (lead_ok & len_ok & trailer_ok & top_ok & sep_ok & h_ok &
+            (db_len > 0))
+
+
+# ---------------------------------------------------------------------------
 # Packed single-transfer dispatch (the H2D-pipelined hot path)
 # ---------------------------------------------------------------------------
 #
@@ -608,6 +700,47 @@ def _rs_packed_limb_impl(packed, sizes_tab, n_tab, np_tab, r2_tab,
     return eq & in_range & flags
 
 
+def _ps_packed_rns_impl(packed, mod_bits_tab, n_tab, sig_c_tab, n_B_tab,
+                        a2_A_tab, a2_B_tab, *, k: int, hash_name: str,
+                        ctx):
+    from . import bignum
+    from .rns import _rns_modexp_em_core
+
+    s_limbs, dig, flags, idx = _rs_packed_unpack(packed, k,
+                                                 HASH_LEN[hash_name])
+    n_g = n_tab[idx].T
+    in_range = ~bignum.compare_ge(s_limbs, n_g)
+    em = _rns_modexp_em_core(ctx, k + 1, s_limbs, sig_c_tab[idx].T,
+                             n_B_tab[idx].T, a2_A_tab[idx].T,
+                             a2_B_tab[idx].T, n_g)
+    em_bytes = _limbs_to_bytes_impl(em[:k])   # canonical < n < 2^16k
+    ok = _pss_verify_device(em_bytes, dig, mod_bits_tab[idx],
+                            width=2 * k, h_len=HASH_LEN[hash_name])
+    return ok & in_range & flags
+
+
+def _ps_packed_limb_impl(packed, mod_bits_tab, n_tab, np_tab, r2_tab,
+                         one_tab, e_tab, *, k: int, hash_name: str,
+                         ebits: int, all_f4: bool):
+    from . import bignum
+
+    s_limbs, dig, flags, idx = _rs_packed_unpack(packed, k,
+                                                 HASH_LEN[hash_name])
+    n = n_tab[idx].T
+    in_range = ~bignum.compare_ge(s_limbs, n)
+    nprime = np_tab[idx].T
+    r2 = r2_tab[idx].T
+    if all_f4:
+        em = bignum.modexp_65537(s_limbs, n, nprime, r2)
+    else:
+        em = bignum.modexp_vare(s_limbs, e_tab[idx], n, nprime, r2,
+                                one_tab[idx].T, ebits=ebits)
+    em_bytes = _limbs_to_bytes_impl(em)
+    ok = _pss_verify_device(em_bytes, dig, mod_bits_tab[idx],
+                            width=2 * k, h_len=HASH_LEN[hash_name])
+    return ok & in_range & flags
+
+
 _rs_packed_jits: dict = {}
 
 
@@ -652,6 +785,47 @@ def verify_rs_packed_pending(table: RSAKeyTable, rec: np.ndarray,
     fn = _rs_packed_jit("limb", _rs_packed_limb_impl,
                         ("k", "hash_name", "ebits", "all_f4"))
     return fn(dev, place(table.sizes_dev), place(table.n_tab),
+              place(table.np_tab), place(table.r2_tab),
+              place(table.one_tab), place(table.e_dev), k=table.k,
+              hash_name=hash_name, ebits=table.max_ebits,
+              all_f4=table.all_f4)
+
+
+def verify_ps_packed_pending(table: RSAKeyTable, rec: np.ndarray,
+                             hash_name: str, mesh=None):
+    """Dispatch one packed PS* chunk; returns the device [N] bool.
+
+    Like verify_rs_packed_pending, but the expected-EM compare is
+    replaced by the FULL device-side EMSA-PSS-VERIFY — modexp, MGF1,
+    separator scan, and H' hashing all stay on device, so the EM bytes
+    (as large as the signature upload) never cross back to the host.
+    SHA-256 only (PS256); callers route other hashes through the
+    arrays path with the native host tail.
+    """
+    import jax
+
+    assert hash_name == "sha256", "device PSS path is SHA-256 only"
+    if mesh is not None:
+        from ..parallel.place import replicated, shard_batch
+
+        dev = shard_batch(mesh, rec)
+        place = lambda a: replicated(mesh, a)  # noqa: E731
+    else:
+        dev = jax.device_put(rec)
+        place = lambda a: a  # noqa: E731
+    if table.all_f4 and _use_rns():
+        ctx, rtab = table.rns()
+        if ctx is not None:
+            fn = _rs_packed_jit("ps_rns", _ps_packed_rns_impl,
+                                ("k", "hash_name", "ctx"))
+            return fn(dev, place(table.mod_bits_dev),
+                      place(table.n_tab), place(rtab.sig_c),
+                      place(rtab.n_B), place(rtab.a2_A),
+                      place(rtab.a2_B), k=table.k,
+                      hash_name=hash_name, ctx=ctx)
+    fn = _rs_packed_jit("ps_limb", _ps_packed_limb_impl,
+                        ("k", "hash_name", "ebits", "all_f4"))
+    return fn(dev, place(table.mod_bits_dev), place(table.n_tab),
               place(table.np_tab), place(table.r2_tab),
               place(table.one_tab), place(table.e_dev), k=table.k,
               hash_name=hash_name, ebits=table.max_ebits,

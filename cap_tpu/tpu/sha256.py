@@ -1,0 +1,161 @@
+"""Batched SHA-256 as a JAX program (FIPS 180-4, from the spec).
+
+Device-side hashing for the PSS verify tail: EMSA-PSS-VERIFY needs
+MGF1(H, dbLen) (fixed-short seeds) and H' = SHA-256(0^8 ‖ mHash ‖ salt)
+(variable-length messages). Doing both ON DEVICE removes the PS* paths'
+EM download entirely — only a [N] bool crosses back (the reference
+computes this on the CPU per token via crypto/rsa.VerifyPSS,
+/root/reference/jwt/keyset.go:126-139).
+
+Everything is uint32 elementwise over the batch lane axis — long chains
+of adds/rotates that XLA fuses into a handful of kernels; per-token
+message lengths are handled by running the maximum block count and
+snapshotting each token's state after ITS final block.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], np.uint32)
+
+_H0 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
+               np.uint32)
+
+
+def _ror(x, r: int):
+    return (x >> r) | (x << (32 - r))
+
+
+def compress(state, words):
+    """One SHA-256 compression over the batch.
+
+    state: tuple of 8 [N] uint32; words: [16, N] uint32 message words.
+    Returns the new 8-tuple. uint32 adds wrap, matching the spec.
+
+    The 64 rounds run as a lax.scan with a rolling 16-word schedule
+    window (W[t+16] = W[t] + σ0(W[t+1]) + W[t+9] + σ1(W[t+14])): a
+    fully unrolled compression is ~3.5k XLA ops and takes minutes to
+    compile per call site on CPU; the scan body is ~60 ops.
+    """
+    from jax import lax
+
+    k_arr = jnp.asarray(_K)
+
+    def round_body(carry, kt):
+        (a, b, c, d, e, f, g, h), w_win = carry
+        w_t = w_win[0]
+        s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kt + w_t
+        s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        nxt = (t1 + t2, a, b, c, d + t1, e, f, g)
+        # schedule: W[t+16] from the current window (extra entries past
+        # round 48 are computed and discarded — cheaper than a branch)
+        ws0 = _ror(w_win[1], 7) ^ _ror(w_win[1], 18) ^ (w_win[1] >> 3)
+        ws1 = _ror(w_win[14], 17) ^ _ror(w_win[14], 19) ^ \
+            (w_win[14] >> 10)
+        w_new = w_win[0] + ws0 + w_win[9] + ws1
+        w_win = jnp.concatenate([w_win[1:], w_new[None]], axis=0)
+        return (nxt, w_win), None
+
+    (out, _), _ = lax.scan(round_body, (tuple(state), words), k_arr)
+    return tuple(s + v for s, v in zip(state, out))
+
+
+def _bytes_to_words(block):
+    """[N, 64] uint8 → [16, N] uint32 big-endian words."""
+    b = block.astype(jnp.uint32).reshape(block.shape[0], 16, 4)
+    w = (b[:, :, 0] << 24) | (b[:, :, 1] << 16) | \
+        (b[:, :, 2] << 8) | b[:, :, 3]
+    return w.T
+
+
+def _init_state(n):
+    return tuple(jnp.full((n,), int(v), jnp.uint32) for v in _H0)
+
+
+def sha256_fixed(msgs):
+    """SHA-256 of [N, L] uint8 messages, fixed L ≤ 55 (single block).
+
+    Returns [N, 32] uint8 digests. The MGF1 seeds (h_len + 4 bytes) and
+    other short fixed-size inputs take this path.
+    """
+    n, length = msgs.shape
+    assert length <= 55, "single-block limit"
+    block = jnp.zeros((n, 64), jnp.uint8)
+    block = block.at[:, :length].set(msgs)
+    block = block.at[:, length].set(jnp.uint8(0x80))
+    bits = length * 8
+    block = block.at[:, 62].set(jnp.uint8(bits >> 8))
+    block = block.at[:, 63].set(jnp.uint8(bits & 0xFF))
+    state = compress(_init_state(n), _bytes_to_words(block))
+    return _digest_bytes(state)
+
+
+def sha256_var(msgs, lens, max_len: int):
+    """SHA-256 of [N, max_len] uint8 buffers with per-token ``lens``.
+
+    Bytes at and beyond each token's length MUST already be zero (the
+    padding 0x80 and the 64-bit bit-length are placed per token here).
+    Runs ceil((max_len + 9) / 64) compressions and snapshots each
+    token's state after its own final block. Returns [N, 32] uint8.
+    """
+    n = msgs.shape[0]
+    n_blocks = (max_len + 9 + 63) // 64
+    buf = jnp.zeros((n, n_blocks * 64), jnp.uint8)
+    buf = buf.at[:, :msgs.shape[1]].set(msgs)
+    pos = jnp.arange(n_blocks * 64, dtype=jnp.int32)[None, :]
+    lens32 = lens.astype(jnp.int32)[:, None]
+    buf = jnp.where(pos == lens32, jnp.uint8(0x80), buf)
+    # 64-bit big-endian bit length in the last 8 bytes of each token's
+    # final block (lens < 2^28 here, so 4 low bytes suffice; the rest
+    # stay zero).
+    final_block = (lens32 + 8) // 64      # block index holding length
+    msg_bits = (lens.astype(jnp.uint32) * 8)[:, None]
+    len_base = final_block * 64 + 56
+    for j in range(4):                    # bytes 60..63 of that block
+        shift = jnp.uint32(8 * (3 - j))
+        byte = ((msg_bits >> shift) & 0xFF).astype(jnp.uint8)
+        buf = jnp.where(pos == len_base + 60 - 56 + j, byte, buf)
+
+    state = _init_state(n)
+    out = state
+    for i in range(n_blocks):
+        state = compress(state,
+                         _bytes_to_words(buf[:, i * 64:(i + 1) * 64]))
+        is_final = (final_block[:, 0] == i)
+        out = tuple(jnp.where(is_final, s, o)
+                    for s, o in zip(state, out))
+    return _digest_bytes(out)
+
+
+def _digest_bytes(state):
+    """8×[N] uint32 state → [N, 32] uint8 big-endian digest."""
+    cols = []
+    for s in state:
+        cols.append((s >> 24).astype(jnp.uint8))
+        cols.append(((s >> 16) & 0xFF).astype(jnp.uint8))
+        cols.append(((s >> 8) & 0xFF).astype(jnp.uint8))
+        cols.append((s & 0xFF).astype(jnp.uint8))
+    return jnp.stack(cols, axis=1)

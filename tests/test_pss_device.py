@@ -1,0 +1,169 @@
+"""Device-side EMSA-PSS-VERIFY + batched SHA-256 conformance.
+
+The PS* packed path replaces the host MGF1/H' tail with on-device
+hashing (cap_tpu/tpu/sha256.py + rsa._pss_verify_device): these tests
+pin bit-exactness against hashlib and against the host PSS oracle
+(pss_check_em), then the full PS256 keyset path against the CPU
+verify oracle — rejections included.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cap_tpu import testing as captest
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.jwk import JWK
+from cap_tpu.jwt.keyset import StaticKeySet
+from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+from cap_tpu.tpu import rsa as R
+from cap_tpu.tpu import sha256 as S
+
+
+def test_sha256_fixed_matches_hashlib():
+    rng = np.random.default_rng(5)
+    for length in (4, 36, 55):
+        msgs = rng.integers(0, 256, (32, length), dtype=np.uint8)
+        got = np.asarray(jax.jit(S.sha256_fixed)(jnp.asarray(msgs)))
+        for i in range(len(msgs)):
+            assert got[i].tobytes() == \
+                hashlib.sha256(msgs[i].tobytes()).digest()
+
+
+def test_sha256_var_matches_hashlib():
+    rng = np.random.default_rng(6)
+    max_len = 262
+    lens = np.concatenate([
+        rng.integers(0, max_len + 1, 24),
+        [0, 1, 55, 56, 63, 64, 119, 120, 127, 128, max_len],
+    ]).astype(np.int64)
+    msgs = np.zeros((len(lens), max_len), np.uint8)
+    for i, ln in enumerate(lens):
+        msgs[i, :ln] = rng.integers(0, 256, ln, dtype=np.uint8)
+    got = np.asarray(jax.jit(
+        lambda m, ln: S.sha256_var(m, ln, max_len))(
+            jnp.asarray(msgs), jnp.asarray(lens)))
+    for i, ln in enumerate(lens):
+        assert got[i].tobytes() == \
+            hashlib.sha256(msgs[i, :ln].tobytes()).digest(), int(ln)
+
+
+def _mk_valid_em(rng, width, h_len, mhash, mod_bits, salt_len):
+    em_bits = mod_bits - 1
+    em_len = (em_bits + 7) // 8
+    db_len = em_len - h_len - 1
+    if salt_len > db_len - 1 or salt_len < 0:
+        return None
+    salt = bytes(rng.integers(0, 256, salt_len, dtype=np.uint8)) \
+        if salt_len else b""
+    h = hashlib.sha256(b"\x00" * 8 + mhash + salt).digest()
+    db = b"\x00" * (db_len - salt_len - 1) + b"\x01" + salt
+    mask = R._mgf1(h, db_len, "sha256")
+    masked = bytes(a ^ b for a, b in zip(db, mask))
+    unused = 8 * em_len - em_bits
+    if unused:
+        masked = bytes([masked[0] & (0xFF >> unused)]) + masked[1:]
+    return (b"\x00" * (width - em_len)) + masked + h + b"\xbc"
+
+
+def test_pss_device_matches_host_oracle():
+    """Structural fuzz: every verdict equals pss_check_em's."""
+    rng = np.random.default_rng(9)
+    k, h_len = 17, 32
+    width = 2 * k
+    mhash = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    ems, mbs = [], []
+
+    def add(em, mb):
+        ems.append(np.frombuffer(em[:width].ljust(width, b"\x00"),
+                                 np.uint8))
+        mbs.append(mb)
+
+    for mb in (width * 8 - 7, width * 8 - 4, width * 8, 270):
+        em_len = (mb - 1 + 7) // 8
+        db_len = em_len - h_len - 1
+        for sl in {0, 1, min(32, db_len - 1), db_len - 1}:
+            em = _mk_valid_em(rng, width, h_len, mhash, mb, sl)
+            if em is None:
+                continue
+            add(em, mb)
+            for mut in (lambda b: b.__setitem__(-1, 0xBB),       # trailer
+                        lambda b: b.__setitem__(-2, b[-2] ^ 1),  # H bit
+                        lambda b: b.__setitem__(
+                            width - em_len, b[width - em_len] ^ 0x80)):
+                t = bytearray(em)
+                mut(t)
+                add(bytes(t), mb)
+        add(b"\x00" * width, mb)                 # no separator
+    for _ in range(40):
+        add(bytes(rng.integers(0, 256, width, dtype=np.uint8)), 270)
+
+    em_mat = np.stack(ems)
+    mb_arr = np.asarray(mbs, np.int32)
+    mh_mat = np.tile(np.frombuffer(mhash, np.uint8), (len(ems), 1))
+    fn = jax.jit(lambda e, m, b: R._pss_verify_device(
+        e, m, b, width=width, h_len=h_len))
+    got = np.asarray(fn(jnp.asarray(em_mat), jnp.asarray(mh_mat),
+                        jnp.asarray(mb_arr)))
+    for i in range(len(ems)):
+        want = R.pss_check_em(em_mat[i].tobytes(), mhash,
+                              int(mb_arr[i]) - 1, "sha256")
+        assert bool(got[i]) == want, (i, int(mb_arr[i]))
+
+
+def test_ps256_keyset_parity():
+    """PS256 through the packed device path vs the CPU oracle."""
+    jwks, privs, pubs = [], [], []
+    for i in range(2):
+        priv, pub = captest.generate_keys(algs.PS256, rsa_bits=1024)
+        jwks.append(JWK(pub, kid=f"p{i}"))
+        privs.append(priv)
+        pubs.append(pub)
+    toks = [captest.sign_jwt(privs[j % 2], algs.PS256,
+                             captest.default_claims(sub=f"u{j}"),
+                             kid=f"p{j % 2}")
+            for j in range(40)]
+    toks.append(toks[0][:-8] + "AAAAAAAA")        # tampered signature
+    toks.append(toks[1].replace(".", ".x", 1))    # malformed
+    ks = TPUBatchKeySet(jwks)
+    oracle = StaticKeySet(pubs)
+    out = ks.verify_batch(toks)
+    for i, tk in enumerate(toks):
+        try:
+            oracle.verify_signature(tk)
+            want = True
+        except Exception:  # noqa: BLE001
+            want = False
+        assert (not isinstance(out[i], Exception)) == want, (i, out[i])
+
+
+@pytest.mark.heavy
+def test_ps256_keyset_parity_rns(monkeypatch):
+    """Same contract on the RNS/MXU engine, mixed 2048/2040 moduli
+    (same limb class, different emLen — the per-token offset math)."""
+    monkeypatch.setenv("CAP_TPU_RNS", "1")
+    jwks, privs, pubs = [], [], []
+    for i, bits in enumerate([2048, 2040]):
+        priv, pub = captest.generate_keys(algs.PS256, rsa_bits=bits)
+        jwks.append(JWK(pub, kid=f"p{i}"))
+        privs.append(priv)
+        pubs.append(pub)
+    toks = [captest.sign_jwt(privs[j % 2], algs.PS256,
+                             captest.default_claims(sub=f"u{j}"),
+                             kid=f"p{j % 2}")
+            for j in range(24)]
+    toks.append(toks[0][:-8] + "AAAAAAAA")
+    ks = TPUBatchKeySet(jwks)
+    oracle = StaticKeySet(pubs)
+    out = ks.verify_batch(toks)
+    for i, tk in enumerate(toks):
+        try:
+            oracle.verify_signature(tk)
+            want = True
+        except Exception:  # noqa: BLE001
+            want = False
+        assert (not isinstance(out[i], Exception)) == want, (i, out[i])

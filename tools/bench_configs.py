@@ -38,6 +38,11 @@ def tile(unique, n):
     return (unique * (n // len(unique) + 1))[:n]
 
 
+def sign_unique(signers, n):
+    """n UNIQUE tokens (distinct sub/jti), signed across threads."""
+    return T.sign_unique_jwts(signers, n)
+
+
 def rate(fn, n):
     fn()
     vals = []
@@ -48,9 +53,34 @@ def rate(fn, n):
     return statistics.median(vals)
 
 
-def emit(name, value, n):
-    print(json.dumps({"metric": name, "value": round(value, 1),
-                      "unit": "verifies/sec", "batch": n}), flush=True)
+def rate_stream(ks, toks, window: int = 4):
+    """Steady-state pipelined rate: median completion interval over
+    ``window`` back-to-back batches (2-deep), pipeline fill dropped —
+    the same methodology as bench.py's headline. Returns
+    (rate, effective_h2d_mbps): the device configs here are WIRE-bound
+    on the tunnel-attached dev chip, so each number carries the link
+    throughput it was measured at (docs/PERF.md)."""
+    from cap_tpu import telemetry
+
+    ks.verify_batch(toks)                      # warm compile
+    rec = telemetry.enable()
+    done = []
+    for out in ks.verify_stream(toks for _ in range(window + 1)):
+        done.append(time.perf_counter())
+        assert not any(isinstance(r, Exception) for r in out)
+    telemetry.disable()
+    h2d = rec.counters().get("h2d.bytes", 0) / (window + 1)
+    intervals = [b - a for a, b in zip(done, done[1:])]
+    med = statistics.median(intervals)
+    return len(toks) / med, (h2d / med) / (1 << 20)
+
+
+def emit(name, value, n, eff_mbps=None):
+    rec = {"metric": name, "value": round(value, 1),
+           "unit": "verifies/sec", "batch": n}
+    if eff_mbps is not None:
+        rec["wire_effective_mbps"] = round(eff_mbps, 2)
+    print(json.dumps(rec), flush=True)
 
 
 def config1():
@@ -76,16 +106,12 @@ def config2():
         priv, pub = T.generate_keys(alg, rsa_bits=bits)
         jwks.append(JWK(pub, kid=f"k{i}"))
         signers.append((priv, alg, f"k{i}"))
-    uniq = [T.sign_jwt(p, a, T.default_claims(ttl=86400), kid=k)
-            for j in range(256) for p, a, k in [signers[j % 8]]]
-    toks = tile(uniq, n)
+    toks = sign_unique(signers, n)
     ks = TPUBatchKeySet(jwks)
-
-    def run():
-        out = ks.verify_batch(toks)
-        assert not any(isinstance(r, Exception) for r in out)
-
-    emit("cfg2_rs_mix_8key_jwks", rate(run, n), n)
+    out = ks.verify_batch(toks)
+    assert not any(isinstance(r, Exception) for r in out)
+    r, eff = rate_stream(ks, toks)
+    emit("cfg2_rs_mix_8key_jwks", r, n, eff)
 
 
 def config3():
@@ -99,16 +125,12 @@ def config3():
         priv, pub = T.generate_keys("ES384")
         jwks.append(JWK(pub, kid=f"p384-{i}"))
         signers.append((priv, "ES384", f"p384-{i}"))
-    uniq = [T.sign_jwt(p, a, T.default_claims(ttl=86400), kid=k)
-            for j in range(256) for p, a, k in [signers[j % 8]]]
-    toks = tile(uniq, n)
+    toks = sign_unique(signers, n)
     ks = TPUBatchKeySet(jwks)
-
-    def run():
-        out = ks.verify_batch(toks)
-        assert not any(isinstance(r, Exception) for r in out)
-
-    emit("cfg3_es256_es384", rate(run, n), n)
+    out = ks.verify_batch(toks)
+    assert not any(isinstance(r, Exception) for r in out)
+    r, eff = rate_stream(ks, toks)
+    emit("cfg3_es256_es384", r, n, eff)
 
 
 def config4():
@@ -122,16 +144,12 @@ def config4():
         priv, pub = T.generate_keys("EdDSA")
         jwks.append(JWK(pub, kid=f"ed-{i}"))
         signers.append((priv, "EdDSA", f"ed-{i}"))
-    uniq = [T.sign_jwt(p, a, T.default_claims(ttl=86400), kid=k)
-            for j in range(256) for p, a, k in [signers[j % 8]]]
-    toks = tile(uniq, n)
+    toks = sign_unique(signers, n)
     ks = TPUBatchKeySet(jwks)
-
-    def run():
-        out = ks.verify_batch(toks)
-        assert not any(isinstance(r, Exception) for r in out)
-
-    emit("cfg4_ps256_eddsa", rate(run, n), n)
+    out = ks.verify_batch(toks)
+    assert not any(isinstance(r, Exception) for r in out)
+    r, eff = rate_stream(ks, toks)
+    emit("cfg4_ps256_eddsa", r, n, eff)
 
 
 def config5():

@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Serving-path benchmark: throughput vs per-REQUEST p99 latency.
+
+What a user of the framework actually experiences (VERDICT r2 #5): N
+concurrent CVB1 clients stream small verify requests at a VerifyWorker
+whose AdaptiveBatcher owns the latency/throughput tradeoff; this sweeps
+``max_wait_ms`` operating points and reports, per point, sustained
+verifies/sec and request-latency quantiles.
+
+Env knobs: CAP_SERVE_CLIENTS (32), CAP_SERVE_REQ_TOKENS (64),
+CAP_SERVE_SECONDS (12 per point), CAP_SERVE_WAITS ("1,5,20"),
+CAP_SERVE_TARGET_BATCH (8192).
+
+Prints one JSON line on stdout: per-point results + the best-throughput
+point's p99 as the headline fields.
+"""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fixtures(n_unique: int = 16384):
+    from cap_tpu import testing as T
+    from cap_tpu.jwt import algs
+    from cap_tpu.jwt.jwk import JWK
+
+    jwks, signers = [], []
+    for i in range(8):
+        priv, pub = T.generate_keys(algs.RS256, rsa_bits=2048)
+        jwks.append(JWK(pub, kid=f"rs-{i}"))
+        signers.append((priv, algs.RS256, f"rs-{i}"))
+    for i in range(8):
+        priv, pub = T.generate_keys(algs.ES256)
+        jwks.append(JWK(pub, kid=f"es-{i}"))
+        signers.append((priv, algs.ES256, f"es-{i}"))
+    return jwks, T.sign_unique_jwts(signers, n_unique)
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[max(0, math.ceil(q * len(sorted_vals)) - 1)]
+
+
+def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
+              req_tokens: int, seconds: float,
+              target_batch: int) -> dict:
+    from cap_tpu.serve.client import VerifyClient
+    from cap_tpu.serve.worker import VerifyWorker
+
+    worker = VerifyWorker(keyset, target_batch=target_batch,
+                          max_wait_ms=max_wait_ms)
+    host, port = worker.address
+    lat_per_thread = [[] for _ in range(n_clients)]
+    done = [0] * n_clients
+    stop = threading.Event()
+
+    def client_loop(ti: int) -> None:
+        # generous timeout: first flushes of a fresh shape bucket can
+        # hit an XLA compile (~40s over the tunnel) before the cache
+        # warms
+        cl = VerifyClient(host, port, timeout=180.0)
+        rng = ti * 7919
+        try:
+            while not stop.is_set():
+                rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
+                lo = rng % max(1, len(tokens) - req_tokens)
+                req = tokens[lo: lo + req_tokens]
+                t0 = time.perf_counter()
+                out = cl.verify_batch(req)
+                lat_per_thread[ti].append(time.perf_counter() - t0)
+                bad = sum(1 for r in out if isinstance(r, Exception))
+                assert bad == 0, f"unexpected failures: {bad}"
+                done[ti] += len(req)
+        finally:
+            cl.close()
+
+    threads = [threading.Thread(target=client_loop, args=(i,),
+                                daemon=True) for i in range(n_clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.perf_counter() - t_start
+    worker.close()
+
+    lats = sorted(x for sub in lat_per_thread for x in sub)
+    total = sum(done)
+    return {
+        "max_wait_ms": max_wait_ms,
+        "clients": n_clients,
+        "req_tokens": req_tokens,
+        "throughput": round(total / elapsed, 1),
+        "requests": len(lats),
+        "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
+        "p95_ms": round(_quantile(lats, 0.95) * 1e3, 1),
+        "p99_ms": round(_quantile(lats, 0.99) * 1e3, 1),
+    }
+
+
+def main() -> None:
+    from cap_tpu import compile_cache
+    from cap_tpu._build import build_native
+
+    build_native()
+    compile_cache.enable()
+
+    n_clients = int(os.environ.get("CAP_SERVE_CLIENTS", 32))
+    req_tokens = int(os.environ.get("CAP_SERVE_REQ_TOKENS", 64))
+    seconds = float(os.environ.get("CAP_SERVE_SECONDS", 12))
+    waits = [float(w) for w in
+             os.environ.get("CAP_SERVE_WAITS", "1,5,20").split(",")]
+    target_batch = int(os.environ.get("CAP_SERVE_TARGET_BATCH", 8192))
+
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+    jwks, tokens = _fixtures()
+    ks = TPUBatchKeySet(jwks)
+    # Warm every (family, pad) bucket shape the batcher can flush:
+    # coalesced batches pad to powers of two below target_batch.
+    sz = 128
+    while sz <= 16384:
+        ks.verify_batch(tokens[:sz])
+        sz *= 2
+
+    points = []
+    for w in waits:
+        pt = run_point(ks, tokens, w, n_clients, req_tokens, seconds,
+                       target_batch)
+        points.append(pt)
+        print(f"max_wait={w:5.1f}ms  thr={pt['throughput']:>9.0f}/s  "
+              f"p50={pt['p50_ms']:6.1f}ms p95={pt['p95_ms']:7.1f}ms "
+              f"p99={pt['p99_ms']:7.1f}ms  reqs={pt['requests']}",
+              file=sys.stderr)
+
+    best = max(points, key=lambda p: p["throughput"])
+    print(json.dumps({
+        "metric": "serve_verifies_per_sec",
+        "value": best["throughput"],
+        "unit": "verifies/sec",
+        "p99_request_latency_ms": best["p99_ms"],
+        "points": points,
+    }))
+
+
+if __name__ == "__main__":
+    main()
