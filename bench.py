@@ -48,26 +48,11 @@ def _ensure_native() -> None:
 
 
 def _make_fixtures(n_unique: int):
-    """16-key JWKS (8×RSA-2048, 8×P-256) + n_unique UNIQUE mixed JWTs.
-
-    Uniqueness is per token (sub + jti differ), so payload bytes and
-    signatures are all distinct — the workload a real verifier sees.
-    Signing happens across threads (OpenSSL releases the GIL).
-    """
+    """North-star workload (cap_tpu.testing.headline_fixtures):
+    16-key JWKS + n_unique UNIQUE mixed RS256/ES256 tokens."""
     from cap_tpu import testing as T
-    from cap_tpu.jwt import algs
-    from cap_tpu.jwt.jwk import JWK
 
-    jwks, signers = [], []
-    for i in range(8):
-        priv, pub = T.generate_keys(algs.RS256, rsa_bits=2048)
-        jwks.append(JWK(pub, kid=f"rs-{i}"))
-        signers.append((priv, algs.RS256, f"rs-{i}"))
-    for i in range(8):
-        priv, pub = T.generate_keys(algs.ES256)
-        jwks.append(JWK(pub, kid=f"es-{i}"))
-        signers.append((priv, algs.ES256, f"es-{i}"))
-    return jwks, T.sign_unique_jwts(signers, n_unique)
+    return T.headline_fixtures(n_unique)
 
 
 def _probe_wire_mbps() -> float:

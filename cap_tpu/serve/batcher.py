@@ -57,9 +57,13 @@ class AdaptiveBatcher:
         self._queued_tokens = 0
         self._closed = False
         # 2-deep pipeline: one batch draining in the collector while
-        # the dispatcher preps/dispatches the next (maxsize=1 bounds
-        # the in-flight depth and applies backpressure).
-        self._inflight: "queue.Queue" = queue.Queue(maxsize=1)
+        # the dispatcher preps/dispatches the next. The SLOT semaphore
+        # is acquired BEFORE dispatching, so at most one un-collected
+        # dispatch exists besides the one the collector is draining —
+        # a bounded queue alone would admit a third batch's device
+        # work before blocking.
+        self._inflight: "queue.Queue" = queue.Queue()
+        self._slot = threading.Semaphore(1)
         self._collector = threading.Thread(
             target=self._collect_loop, daemon=True,
             name="cap-tpu-collector")
@@ -138,10 +142,12 @@ class AdaptiveBatcher:
         telemetry.observe("batcher.batch_size", float(n))
         dispatch = getattr(self._keyset, "verify_batch_async", None)
         if dispatch is not None:
+            self._slot.acquire()          # backpressure BEFORE dispatch
             try:
                 with telemetry.span("batcher.dispatch"):
                     collect = dispatch(tokens)
             except Exception as e:  # noqa: BLE001 - fan the failure out
+                self._slot.release()
                 self._distribute(batch, [e] * len(tokens))
                 return
             self._inflight.put((batch, len(tokens), collect))
@@ -164,6 +170,8 @@ class AdaptiveBatcher:
                     results = collect()
             except Exception as e:  # noqa: BLE001 - fan the failure out
                 results = [e] * n_tokens
+            finally:
+                self._slot.release()
             self._distribute(batch, results)
 
     @staticmethod

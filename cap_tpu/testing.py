@@ -124,6 +124,28 @@ def sign_unique_jwts(signers, n: int, ttl: float = 86400.0):
         return list(ex.map(sign, range(n), chunksize=256))
 
 
+def headline_fixtures(n_unique: int):
+    """The BASELINE.json north-star workload: a 16-key JWKS (8×RSA-2048
+    + 8×P-256) and n_unique UNIQUE mixed RS256/ES256 tokens.
+
+    Shared by bench.py and tools/bench_serve.py so the offline and
+    serving benchmarks can never desynchronize their key mix.
+    """
+    from .jwt import algs
+    from .jwt.jwk import JWK
+
+    jwks, signers = [], []
+    for i in range(8):
+        priv, pub = generate_keys(algs.RS256, rsa_bits=2048)
+        jwks.append(JWK(pub, kid=f"rs-{i}"))
+        signers.append((priv, algs.RS256, f"rs-{i}"))
+    for i in range(8):
+        priv, pub = generate_keys(algs.ES256)
+        jwks.append(JWK(pub, kid=f"es-{i}"))
+        signers.append((priv, algs.ES256, f"es-{i}"))
+    return jwks, sign_unique_jwts(signers, n_unique)
+
+
 def generate_ca(common_name: str = "cap-tpu-test-ca") -> Tuple[str, Any, str]:
     """Generate a self-signed CA; returns (cert_pem, private_key, key_pem)."""
     key = ec.generate_private_key(ec.SECP256R1())

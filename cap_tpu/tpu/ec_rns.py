@@ -389,11 +389,22 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         return ((g[:ia], g[ia:iab]),
                 (g[iab:iab + ia], g[iab + ia:]))
 
+    from . import pallas_madd
+
+    use_fused = pallas_madd.enabled()
+    interp = jax.default_backend() == "cpu"   # interpret mode on CPU
+
     def add_from_table(state, d, row0):
         X, Y, Z, inf, deg = state
         has = d > 0
         idx = row0 + jnp.where(has, d - 1, 0)
         x2, y2 = gather_pt(idx)
+        if use_fused:
+            # One VMEM-resident kernel for the whole mixed-add incl.
+            # the lift/select bookkeeping (pallas_madd).
+            Xn, Yn, Zn, dd = pallas_madd.madd_fused(
+                c, X, Y, Z, inf, has, x2, y2, interpret=interp)
+            return Xn, Yn, Zn, inf & ~has, deg | dd
         X3, Y3, Z3, dd = _madd_rns(c, X, Y, Z, inf, x2, y2)
         # infinity accumulator: result is the (lifted) affine addend
         lift = inf & has

@@ -1,0 +1,273 @@
+"""Fused RNS mixed-add (Jacobian + affine) as one Pallas TPU kernel.
+
+The ES*/Ed ladders are HBM-bandwidth-bound under XLA: each of the ~5
+REDC layers per window materializes its [I, 2N] residue planes to HBM
+between kernels, and the measured per-layer cost is ~6× the pure
+traffic of one read+write pass (docs/PERF.md round-3 A/Bs — wider
+windows and more chains both lost because they scale traffic, not
+depth). This kernel runs ec_rns._madd_rns END-TO-END on VMEM tiles —
+11 rmuls (each a full Bajard/Kawamura REDC with both base extensions),
+the lazy adds/subs, the degeneracy probe, and the infinity/digit-0
+selection — touching HBM once for inputs and once for outputs.
+
+Numerical contract: bit-identical to the XLA path (same fixed-point
+ops, same lazily-tracked bounds — every product stays < 2^31); parity
+pinned by tests/test_pallas_madd.py in interpret mode on CPU and by
+the RNS suite on device. Enabled via CAP_TPU_PALLAS_MADD (default ON
+for TPU backends once measured faster; A/B in docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_redc import _extend_in_kernel, _fix
+
+I32 = jnp.int32
+F32 = jnp.float32
+
+_TILE = int(os.environ.get("CAP_TPU_MADD_TILE", 512))  # lanes/step
+_DEG_MAXC = 20      # same-x probe candidates (h < 20p)
+_DEG_CH = 2         # probe channels (false-positive ~maxc/m0/m1)
+
+
+def enabled() -> bool:
+    """Fused Pallas mixed-add: CAP_TPU_PALLAS_MADD=1/0 overrides.
+
+    Default ON for accelerator backends: measured 157 -> 140 ms per
+    32k-token ES256 core (+11%) at tile 512 (tiles 256/512 tie, 1024
+    slightly worse, 2048 catastrophically spills — docs/PERF.md).
+    CPU stays on the XLA path (interpret mode is far slower and the
+    XLA path is the reference for parity tests).
+    """
+    v = os.environ.get("CAP_TPU_PALLAS_MADD")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    # Mosaic/TPU kernel only: a GPU backend must keep the XLA path.
+    return jax.default_backend() == "tpu"
+
+
+def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
+                 pxa_ref, pxb_ref, pya_ref, pyb_ref,
+                 has_ref, inf_ref,
+                 mA_ref, mB_ref, sigc_ref, nB_ref,
+                 wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                 amodb_ref, bmoda_ref, invab_ref, invmib_ref,
+                 cpA_ref, cpB_ref, oneA_ref, oneB_ref,
+                 oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
+                 deg_ref):
+    mA = mA_ref[:]                       # [IA, 1]
+    mB = mB_ref[:]
+    invA_f = 1.0 / mA.astype(F32)
+    invB_f = 1.0 / mB.astype(F32)
+    sigc = sigc_ref[:]
+    nB = nB_ref[:]
+    invab = invab_ref[:]
+    invmib = invmib_ref[:]
+    cpA = cpA_ref[:]                     # [IA, maxc] (pre-transposed:
+    cpB = cpB_ref[:]                     # static 2-D slices only —
+                                         # int indexing lowers to a
+                                         # gather Mosaic rejects)
+
+    def fixA(v):
+        return _fix(v, mA, invA_f)
+
+    def fixB(v):
+        return _fix(v, mB, invB_f)
+
+    def redc(pA, pB):
+        sig = fixA(pA * sigc)
+        q_B = _extend_in_kernel(sig, invA_f, wabh_ref[:], wabl_ref[:],
+                                mB, invB_f, amodb_ref[:], -1e-4)
+        qn = fixB(q_B * nB)
+        t_B = fixB(pB + qn)
+        t_B = fixB(t_B * invab)
+        sig2 = fixB(t_B * invmib)
+        t_A = _extend_in_kernel(sig2, invB_f, wbah_ref[:], wbal_ref[:],
+                                mA, invA_f, bmoda_ref[:], 0.5 - 1e-4)
+        return t_A, t_B
+
+    def rmul(a, b):
+        return redc(fixA(a[0] * b[0]), fixB(a[1] * b[1]))
+
+    def radd(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def rsub(a, b, cmul: int, guard: int):
+        # a + cmul·p − b + guard·m: mirrors ec_rns.rsub's value/digit
+        # bound discipline exactly (bounds documented there).
+        ga = guard * mA
+        gb = guard * mB
+        return (a[0] + cpA[:, cmul:cmul + 1] - b[0] + ga,
+                a[1] + cpB[:, cmul:cmul + 1] - b[1] + gb)
+
+    def rfix(a):
+        return (fixA(a[0]), fixB(a[1]))
+
+    X = (xa_ref[:], xb_ref[:])
+    Y = (ya_ref[:], yb_ref[:])
+    Z = (za_ref[:], zb_ref[:])
+    x2 = (pxa_ref[:], pxb_ref[:])
+    y2 = (pya_ref[:], pyb_ref[:])
+    has = has_ref[:]                     # [1, T] i32 0/1
+    inf = inf_ref[:]
+
+    # _madd_rns, layer for layer (bounds comments live there).
+    z1z1 = rmul(Z, Z)
+    u2 = rmul(x2, z1z1)
+    z1_3 = rmul(Z, z1z1)
+    h = rsub(u2, X, 16, 1)
+    zh = radd(Z, h)
+    s2 = rmul(y2, z1_3)
+    hh = rmul(h, h)
+    zh2 = rmul(zh, zh)
+    i4 = radd(radd(hh, hh), radd(hh, hh))
+    s2y1 = rsub(s2, Y, 16, 1)
+    rr = rfix(radd(s2y1, s2y1))
+    j = rmul(h, i4)
+    v = rmul(X, i4)
+    r2_ = rmul(rr, rr)
+    vv = radd(v, v)
+    X3 = rfix(rsub(rsub(r2_, j, 4, 1), vv, 8, 2))
+    y1j = rmul(Y, j)
+    t5 = rmul(rr, rsub(v, X3, 16, 1))
+    Y3 = rfix(rsub(t5, radd(y1j, y1j), 8, 2))
+    Z3 = rfix(rsub(rsub(zh2, z1z1, 4, 1), hh, 4, 1))
+
+    # same-x degeneracy probe on _DEG_CH channels (ec_rns
+    # congruent_zero_probe): sufficient, false positives → CPU oracle.
+    h_probe = _fix(h[0][:_DEG_CH], mA[:_DEG_CH], invA_f[:_DEG_CH])
+    deg = jnp.zeros((1, h_probe.shape[1]), I32)
+    for cc in range(_DEG_MAXC):
+        cand = cpA[:_DEG_CH, cc:cc + 1]
+        hit = jnp.min(
+            jnp.where(h_probe == cand, 1, 0), axis=0, keepdims=True)
+        deg = deg | hit
+    not_inf = 1 - inf
+    deg = deg & not_inf & has
+
+    # infinity lift + digit-0 select (ec_rns.add_from_table semantics)
+    lift = inf & has
+    oneA = oneA_ref[:]
+    oneB = oneB_ref[:]
+
+    def pick(res, addend, one_col, orig):
+        sel_l = lift != 0
+        r = jnp.where(sel_l, addend, res) if one_col is None else \
+            jnp.where(sel_l, jnp.broadcast_to(one_col, res.shape), res)
+        return jnp.where(has != 0, r, orig)
+
+    oxa_ref[:] = pick(X3[0], x2[0], None, X[0])
+    oxb_ref[:] = pick(X3[1], x2[1], None, X[1])
+    oya_ref[:] = pick(Y3[0], y2[0], None, Y[0])
+    oyb_ref[:] = pick(Y3[1], y2[1], None, Y[1])
+    oza_ref[:] = pick(Z3[0], None, oneA, Z[0])
+    ozb_ref[:] = pick(Z3[1], None, oneB, Z[1])
+    deg_ref[:] = deg
+
+
+_CONSTS: Dict[int, tuple] = {}
+
+
+def _ctx_consts(c) -> tuple:
+    key = id(c)
+    out = _CONSTS.get(key)
+    if out is None:
+        (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
+
+        def col(v):
+            # host numpy only: this cache must never hold tracers
+            return np.asarray(v, np.int32).reshape(-1, 1)
+
+        a_mod_p = c.A.prod % c.cp.p
+        one_a = col([a_mod_p % int(m) for m in c.A.m])
+        one_b = col([a_mod_p % int(m) for m in c.B.m])
+        out = (
+            col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
+            np.asarray(w_ab[0]), np.asarray(w_ab[1]),
+            np.asarray(w_ba[0]), np.asarray(w_ba[1]),
+            col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
+            np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
+            np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
+            one_a, one_b,
+        )
+        _CONSTS[key] = out
+    return out
+
+
+@partial(jax.jit, static_argnames=("ia", "ib", "interpret"))
+def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
+               mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+               amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
+               ia: int, ib: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = xa.shape[1]
+    grid = n // _TILE
+
+    def col_spec(rows):
+        return pl.BlockSpec((rows, _TILE), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+              invab, invmib, cpA, cpB, oneA, oneB)
+    outs = (jax.ShapeDtypeStruct((ia, n), I32),
+            jax.ShapeDtypeStruct((ib, n), I32)) * 3 + \
+        (jax.ShapeDtypeStruct((1, n), I32),)
+    return pl.pallas_call(
+        _madd_kernel,
+        out_shape=outs,
+        grid=(grid,),
+        in_specs=[col_spec(ia), col_spec(ib)] * 3
+        + [col_spec(ia), col_spec(ib)] * 2
+        + [col_spec(1), col_spec(1)]
+        + [const_spec(a.shape) for a in consts],
+        out_specs=tuple([col_spec(ia), col_spec(ib)] * 3
+                        + [col_spec(1)]),
+        interpret=interpret,
+    )(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf, *consts)
+
+
+def madd_fused(c, X, Y, Z, inf, has, x2, y2, interpret: bool = False):
+    """Fused add_from_table step: returns (X', Y', Z', deg_bool).
+
+    X/Y/Z/x2/y2: (A, B) residue-plane pairs [I, N]; inf/has: [N] bool.
+    The caller keeps the cheap [N]-wide bookkeeping (inf' = inf & ~has,
+    deg accumulation) in XLA.
+    """
+    ia = X[0].shape[0]
+    ib = X[1].shape[0]
+    n = X[0].shape[1]
+    pad = (-n) % _TILE
+
+    def p2(pair):
+        if not pad:
+            return pair
+        return (jnp.pad(pair[0], ((0, 0), (0, pad))),
+                jnp.pad(pair[1], ((0, 0), (0, pad))))
+
+    Xp, Yp, Zp, x2p, y2p = p2(X), p2(Y), p2(Z), p2(x2), p2(y2)
+    has_i = jnp.pad(has.astype(I32)[None, :], ((0, 0), (0, pad)))
+    # padding lanes: inf=1, has=0 → pass-through of zero planes
+    inf_i = jnp.pad(inf.astype(I32)[None, :], ((0, 0), (0, pad)),
+                    constant_values=1)
+    out = _madd_call(Xp[0], Xp[1], Yp[0], Yp[1], Zp[0], Zp[1],
+                     x2p[0], x2p[1], y2p[0], y2p[1], has_i, inf_i,
+                     *_ctx_consts(c), ia=ia, ib=ib,
+                     interpret=interpret)
+    oxa, oxb, oya, oyb, oza, ozb, deg = out
+    sl = slice(0, n)
+    return ((oxa[:, sl], oxb[:, sl]), (oya[:, sl], oyb[:, sl]),
+            (oza[:, sl], ozb[:, sl]), deg[0, sl] != 0)

@@ -754,6 +754,18 @@ def _rs_packed_jit(name: str, impl, static_names):
     return fn
 
 
+def _place_packed(rec: np.ndarray, mesh):
+    """Shared mesh/single-device placement for packed dispatches:
+    returns (device record, place(table) fn)."""
+    import jax
+
+    if mesh is not None:
+        from ..parallel.place import replicated, shard_batch
+
+        return shard_batch(mesh, rec), (lambda a: replicated(mesh, a))
+    return jax.device_put(rec), (lambda a: a)
+
+
 def verify_rs_packed_pending(table: RSAKeyTable, rec: np.ndarray,
                              hash_name: str, mesh=None):
     """Dispatch one packed RS* chunk; returns the device [N] bool.
@@ -763,16 +775,7 @@ def verify_rs_packed_pending(table: RSAKeyTable, rec: np.ndarray,
     mesh, the record shards along the batch axis and the tables
     replicate (GSPMD partitions the program — SURVEY.md §2.6).
     """
-    import jax
-
-    if mesh is not None:
-        from ..parallel.place import replicated, shard_batch
-
-        dev = shard_batch(mesh, rec)
-        place = lambda a: replicated(mesh, a)  # noqa: E731
-    else:
-        dev = jax.device_put(rec)
-        place = lambda a: a  # noqa: E731
+    dev, place = _place_packed(rec, mesh)
     if table.all_f4 and _use_rns():
         ctx, rtab = table.rns()
         if ctx is not None:
@@ -802,17 +805,8 @@ def verify_ps_packed_pending(table: RSAKeyTable, rec: np.ndarray,
     SHA-256 only (PS256); callers route other hashes through the
     arrays path with the native host tail.
     """
-    import jax
-
     assert hash_name == "sha256", "device PSS path is SHA-256 only"
-    if mesh is not None:
-        from ..parallel.place import replicated, shard_batch
-
-        dev = shard_batch(mesh, rec)
-        place = lambda a: replicated(mesh, a)  # noqa: E731
-    else:
-        dev = jax.device_put(rec)
-        place = lambda a: a  # noqa: E731
+    dev, place = _place_packed(rec, mesh)
     if table.all_f4 and _use_rns():
         ctx, rtab = table.rns()
         if ctx is not None:

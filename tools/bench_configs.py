@@ -108,8 +108,7 @@ def config2():
         signers.append((priv, alg, f"k{i}"))
     toks = sign_unique(signers, n)
     ks = TPUBatchKeySet(jwks)
-    out = ks.verify_batch(toks)
-    assert not any(isinstance(r, Exception) for r in out)
+    # rate_stream warms compile and asserts every batch verifies
     r, eff = rate_stream(ks, toks)
     emit("cfg2_rs_mix_8key_jwks", r, n, eff)
 
@@ -127,8 +126,7 @@ def config3():
         signers.append((priv, "ES384", f"p384-{i}"))
     toks = sign_unique(signers, n)
     ks = TPUBatchKeySet(jwks)
-    out = ks.verify_batch(toks)
-    assert not any(isinstance(r, Exception) for r in out)
+    # rate_stream warms compile and asserts every batch verifies
     r, eff = rate_stream(ks, toks)
     emit("cfg3_es256_es384", r, n, eff)
 
@@ -146,8 +144,7 @@ def config4():
         signers.append((priv, "EdDSA", f"ed-{i}"))
     toks = sign_unique(signers, n)
     ks = TPUBatchKeySet(jwks)
-    out = ks.verify_batch(toks)
-    assert not any(isinstance(r, Exception) for r in out)
+    # rate_stream warms compile and asserts every batch verifies
     r, eff = rate_stream(ks, toks)
     emit("cfg4_ps256_eddsa", r, n, eff)
 

@@ -28,19 +28,8 @@ sys.path.insert(0, REPO)
 
 def _fixtures(n_unique: int = 16384):
     from cap_tpu import testing as T
-    from cap_tpu.jwt import algs
-    from cap_tpu.jwt.jwk import JWK
 
-    jwks, signers = [], []
-    for i in range(8):
-        priv, pub = T.generate_keys(algs.RS256, rsa_bits=2048)
-        jwks.append(JWK(pub, kid=f"rs-{i}"))
-        signers.append((priv, algs.RS256, f"rs-{i}"))
-    for i in range(8):
-        priv, pub = T.generate_keys(algs.ES256)
-        jwks.append(JWK(pub, kid=f"es-{i}"))
-        signers.append((priv, algs.ES256, f"es-{i}"))
-    return jwks, T.sign_unique_jwts(signers, n_unique)
+    return T.headline_fixtures(n_unique)
 
 
 def _quantile(sorted_vals, q):
