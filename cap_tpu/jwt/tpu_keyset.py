@@ -275,17 +275,13 @@ class TPUBatchKeySet(KeySet):
                                  slow, results)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
-            # PS256 rides the packed single-transfer path with the
-            # device-side EMSA-PSS check; PS384/512 keep the arrays
-            # path (device modexp + native host MGF1 tail) until the
-            # device SHA-2 grows 384/512 variants.
-            if _PS[alg_name] == "sha256":
-                self._run_rsa_packed("ps", "sha256", idx, pb,
-                                     packed_parts, packed_meta,
-                                     pending, slow, results)
-            else:
-                self._run_rsa_arrays("ps", _PS[alg_name], idx, pb,
-                                     pending, slow)
+            # Every PS* family rides the packed single-transfer path
+            # with the device-side EMSA-PSS check (SHA-256 via
+            # tpu/sha256.py, SHA-384/512 via the u32-pair engine in
+            # tpu/sha512.py) — no EM bytes return to the host.
+            self._run_rsa_packed("ps", _PS[alg_name], idx, pb,
+                                 packed_parts, packed_meta,
+                                 pending, slow, results)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
             self._run_ec_packed(alg_name, idx, pb, packed_parts,

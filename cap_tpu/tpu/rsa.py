@@ -511,19 +511,34 @@ def verify_pss_batch(table: RSAKeyTable, sigs: Sequence[bytes],
 
 
 # ---------------------------------------------------------------------------
-# Device-side EMSA-PSS-VERIFY (SHA-256 family)
+# Device-side EMSA-PSS-VERIFY (SHA-256/384/512)
 # ---------------------------------------------------------------------------
 
+def _pss_hash_fns(hash_name: str):
+    """(fixed_fn, var_fn, h_len) for the device PSS hashing."""
+    if hash_name == "sha256":
+        from . import sha256 as S
+
+        return S.sha256_fixed, S.sha256_var, 32
+    from . import sha512 as S
+
+    if hash_name == "sha384":
+        return S.sha384_fixed, S.sha384_var, 48
+    if hash_name == "sha512":
+        return S.sha512_fixed, S.sha512_var, 64
+    raise ValueError(f"unsupported PSS hash {hash_name!r}")
+
+
 def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
-                       h_len: int):
+                       hash_name: str):
     """RFC 8017 §9.1.2 on device, salt auto-recovered: [N] bool.
 
     em_bytes: [N, width] big-endian EM integer bytes (width = 2k);
     mhash: [N, h_len] u8; mod_bits: [N] i32 per-token modulus bits.
-    SHA-256 only (PS256) — the MGF1 digests and H' run as batched
-    device hashing (tpu/sha256.py), so NO EM bytes ever leave the
-    device; the reference computes all of this per token on CPU
-    (jwt/keyset.go:126-139 → crypto/rsa.VerifyPSS).
+    The MGF1 digests and H' run as batched device hashing
+    (tpu/sha256.py, tpu/sha512.py — all three PS* families), so NO EM
+    bytes ever leave the device; the reference computes all of this
+    per token on CPU (jwt/keyset.go:126-139 → crypto/rsa.VerifyPSS).
 
     Bit-exact with pss_check_em/cap_pss_check_batch: every structural
     rejection (short emLen, missing 0xBC, nonzero leading bits/bytes,
@@ -531,7 +546,7 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
     """
     import jax.numpy as jnp
 
-    from . import sha256 as S
+    sha_fixed, sha_var, h_len = _pss_hash_fns(hash_name)
 
     n = em_bytes.shape[0]
     em_bits = mod_bits.astype(jnp.int32) - 1
@@ -561,7 +576,7 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
         ((masked_db[:, 0] >> (8 - unused).astype(jnp.uint8)) == 0)
 
     # MGF1(H, dbLen): ceil(db_max/h_len) fixed-size single-block
-    # hashes; mask byte j = SHA256(H ‖ be32(j // h_len))[j % h_len].
+    # hashes; mask byte j = Hash(H ‖ be32(j // h_len))[j % h_len].
     n_ctr = (db_max + h_len - 1) // h_len
     seeds = jnp.zeros((n, h_len + 4), jnp.uint8)
     seeds = seeds.at[:, :h_len].set(h_mat)
@@ -569,7 +584,7 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
     for ctr in range(n_ctr):
         s = seeds.at[:, h_len + 3].set(jnp.uint8(ctr & 0xFF))
         s = s.at[:, h_len + 2].set(jnp.uint8((ctr >> 8) & 0xFF))
-        mask_parts.append(S.sha256_fixed(s))
+        mask_parts.append(sha_fixed(s))
     mask = jnp.concatenate(mask_parts, axis=1)[:, :db_max]
     db = masked_db ^ jnp.where(in_db, mask, 0)
     db = db.at[:, 0].set(db[:, 0] & top_mask)
@@ -593,7 +608,7 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
     mprime = jnp.zeros((n, mp_max), jnp.uint8)
     mprime = mprime.at[:, 8:8 + h_len].set(mhash[:, :h_len])
     mprime = mprime.at[:, 8 + h_len:].set(salt)
-    hprime = S.sha256_var(mprime, mp_len, mp_max)
+    hprime = sha_var(mprime, mp_len, mp_max)
 
     h_ok = jnp.all(hprime[:, :h_len] == h_mat, axis=1)
     return (lead_ok & len_ok & trailer_ok & top_ok & sep_ok & h_ok &
@@ -715,7 +730,7 @@ def _ps_packed_rns_impl(packed, mod_bits_tab, n_tab, sig_c_tab, n_B_tab,
                              a2_B_tab[idx].T, n_g)
     em_bytes = _limbs_to_bytes_impl(em[:k])   # canonical < n < 2^16k
     ok = _pss_verify_device(em_bytes, dig, mod_bits_tab[idx],
-                            width=2 * k, h_len=HASH_LEN[hash_name])
+                            width=2 * k, hash_name=hash_name)
     return ok & in_range & flags
 
 
@@ -737,7 +752,7 @@ def _ps_packed_limb_impl(packed, mod_bits_tab, n_tab, np_tab, r2_tab,
                                 one_tab[idx].T, ebits=ebits)
     em_bytes = _limbs_to_bytes_impl(em)
     ok = _pss_verify_device(em_bytes, dig, mod_bits_tab[idx],
-                            width=2 * k, h_len=HASH_LEN[hash_name])
+                            width=2 * k, hash_name=hash_name)
     return ok & in_range & flags
 
 
@@ -802,10 +817,8 @@ def verify_ps_packed_pending(table: RSAKeyTable, rec: np.ndarray,
     replaced by the FULL device-side EMSA-PSS-VERIFY — modexp, MGF1,
     separator scan, and H' hashing all stay on device, so the EM bytes
     (as large as the signature upload) never cross back to the host.
-    SHA-256 only (PS256); callers route other hashes through the
-    arrays path with the native host tail.
+    All three hash families (tpu/sha256.py, tpu/sha512.py).
     """
-    assert hash_name == "sha256", "device PSS path is SHA-256 only"
     dev, place = _place_packed(rec, mesh)
     if table.all_f4 and _use_rns():
         ctx, rtab = table.rns()

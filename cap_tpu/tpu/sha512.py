@@ -1,0 +1,222 @@
+"""Batched SHA-512/384 as JAX programs (FIPS 180-4, from the spec).
+
+Companion to tpu/sha256.py for the PS384/PS512 device PSS tails (and
+the Ed25519 k-hash later): 64-bit words emulated as (hi, lo) uint32
+pairs — TPUs have no native u64 — with explicit carry propagation on
+adds and pairwise rotates. Same lax.scan structure as SHA-256 (an
+unrolled 80-round compression would be ~10k XLA ops per call site).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+_K512 = [
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f,
+    0xe9b5dba58189dbbc, 0x3956c25bf348b538, 0x59f111f1b605d019,
+    0x923f82a4af194f9b, 0xab1c5ed5da6d8118, 0xd807aa98a3030242,
+    0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235,
+    0xc19bf174cf692694, 0xe49b69c19ef14ad2, 0xefbe4786384f25e3,
+    0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65, 0x2de92c6f592b0275,
+    0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f,
+    0xbf597fc7beef0ee4, 0xc6e00bf33da88fc2, 0xd5a79147930aa725,
+    0x06ca6351e003826f, 0x142929670a0e6e70, 0x27b70a8546d22ffc,
+    0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6,
+    0x92722c851482353b, 0xa2bfe8a14cf10364, 0xa81a664bbc423001,
+    0xc24b8b70d0f89791, 0xc76c51a30654be30, 0xd192e819d6ef5218,
+    0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99,
+    0x34b0bcb5e19b48a8, 0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb,
+    0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3, 0x748f82ee5defb2fc,
+    0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915,
+    0xc67178f2e372532b, 0xca273eceea26619c, 0xd186b8c721c0c207,
+    0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178, 0x06f067aa72176fba,
+    0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc,
+    0x431d67c49c100d4c, 0x4cc5d4becb3e42b6, 0x597f299cfc657e2a,
+    0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+]
+
+_H512 = [0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b,
+         0xa54ff53a5f1d36f1, 0x510e527fade682d1, 0x9b05688c2b3e6c1f,
+         0x1f83d9abfb41bd6b, 0x5be0cd19137e2179]
+
+_H384 = [0xcbbb9d5dc1059ed8, 0x629a292a367cd507, 0x9159015a3070dd17,
+         0x152fecd8f70e5939, 0x67332667ffc00b31, 0x8eb44a8768581511,
+         0xdb0c2e0d64f98fa7, 0x47b5481dbefa4fa4]
+
+U32 = jnp.uint32
+
+
+def _add2(a, b):
+    """(hi, lo) + (hi, lo) with carry (mod 2^64)."""
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(U32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _ror2(x, r: int):
+    """64-bit rotate right of a (hi, lo) pair by r ∈ (0, 64)."""
+    hi, lo = x
+    if r == 32:
+        return (lo, hi)
+    if r > 32:
+        hi, lo = lo, hi
+        r -= 32
+    # rotate the 64-bit value right by r < 32
+    return ((hi >> r) | (lo << (32 - r)),
+            (lo >> r) | (hi << (32 - r)))
+
+
+def _shr2(x, r: int):
+    """64-bit logical shift right by r < 32."""
+    hi, lo = x
+    return (hi >> r, (lo >> r) | (hi << (32 - r)))
+
+
+def _xor2(*xs):
+    hi = xs[0][0]
+    lo = xs[0][1]
+    for x in xs[1:]:
+        hi = hi ^ x[0]
+        lo = lo ^ x[1]
+    return (hi, lo)
+
+
+def compress512(state, words):
+    """One SHA-512 compression over the batch.
+
+    state: tuple of 8 (hi, lo) pairs of [N] uint32; words: [32, N]
+    uint32 — the 16 message words as interleaved (hi, lo) rows
+    (row 2t = hi of word t, row 2t+1 = lo).
+    """
+    k_hi = jnp.asarray([k >> 32 for k in _K512], np.uint32)
+    k_lo = jnp.asarray([k & 0xFFFFFFFF for k in _K512], np.uint32)
+    k_arr = jnp.stack([k_hi, k_lo], axis=1)       # [80, 2]
+
+    def round_body(carry, kt):
+        st, w_win = carry                          # w_win [32, N]
+        a, b, c, d, e, f, g, h = st
+        w_t = (w_win[0], w_win[1])
+        s1 = _xor2(_ror2(e, 14), _ror2(e, 18), _ror2(e, 41))
+        ch = (( e[0] & f[0]) ^ (~e[0] & g[0]),
+              ( e[1] & f[1]) ^ (~e[1] & g[1]))
+        kt64 = (kt[0], kt[1])
+        t1 = _add2(_add2(_add2(h, s1), _add2(ch, kt64)), w_t)
+        s0 = _xor2(_ror2(a, 28), _ror2(a, 34), _ror2(a, 39))
+        maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+               (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+        t2 = _add2(s0, maj)
+        new_st = (_add2(t1, t2), a, b, c, _add2(d, t1), e, f, g)
+        # schedule: W[t+16] = W[t] + σ0(W[t+1]) + W[t+9] + σ1(W[t+14])
+        w1 = (w_win[2], w_win[3])
+        w9 = (w_win[18], w_win[19])
+        w14 = (w_win[28], w_win[29])
+        sg0 = _xor2(_ror2(w1, 1), _ror2(w1, 8), _shr2(w1, 7))
+        sg1 = _xor2(_ror2(w14, 19), _ror2(w14, 61), _shr2(w14, 6))
+        w_new = _add2(_add2(w_t, sg0), _add2(w9, sg1))
+        w_win = jnp.concatenate(
+            [w_win[2:], w_new[0][None], w_new[1][None]], axis=0)
+        return (new_st, w_win), None
+
+    (out, _), _ = lax.scan(round_body, (tuple(state), words),
+                           k_arr)
+    return tuple(_add2(s, v) for s, v in zip(state, out))
+
+
+def _bytes_to_words512(block):
+    """[N, 128] uint8 → [32, N] uint32 interleaved (hi, lo) pairs."""
+    b = block.astype(U32).reshape(block.shape[0], 16, 8)
+    hi = (b[:, :, 0] << 24) | (b[:, :, 1] << 16) | \
+        (b[:, :, 2] << 8) | b[:, :, 3]
+    lo = (b[:, :, 4] << 24) | (b[:, :, 5] << 16) | \
+        (b[:, :, 6] << 8) | b[:, :, 7]
+    return jnp.stack([hi, lo], axis=2).reshape(
+        block.shape[0], 32).T
+
+
+def _init_state512(n, h0):
+    return tuple(
+        (jnp.full((n,), int(v >> 32), U32),
+         jnp.full((n,), int(v & 0xFFFFFFFF), U32)) for v in h0)
+
+
+def _digest_bytes512(state, out_words: int):
+    cols = []
+    for hi, lo in state[:out_words]:
+        for word in (hi, lo):
+            cols.append((word >> 24).astype(jnp.uint8))
+            cols.append(((word >> 16) & 0xFF).astype(jnp.uint8))
+            cols.append(((word >> 8) & 0xFF).astype(jnp.uint8))
+            cols.append((word & 0xFF).astype(jnp.uint8))
+    return jnp.stack(cols, axis=1)
+
+
+def _hash_fixed(msgs, h0, out_words: int):
+    n, length = msgs.shape
+    assert length <= 111, "single-block limit (SHA-512 family)"
+    block = jnp.zeros((n, 128), jnp.uint8)
+    block = block.at[:, :length].set(msgs)
+    block = block.at[:, length].set(jnp.uint8(0x80))
+    bits = length * 8
+    block = block.at[:, 126].set(jnp.uint8(bits >> 8))
+    block = block.at[:, 127].set(jnp.uint8(bits & 0xFF))
+    state = compress512(_init_state512(n, h0), _bytes_to_words512(block))
+    return _digest_bytes512(state, out_words)
+
+
+def _hash_var(msgs, lens, max_len: int, h0, out_words: int):
+    n = msgs.shape[0]
+    n_blocks = (max_len + 17 + 127) // 128
+    buf = jnp.zeros((n, n_blocks * 128), jnp.uint8)
+    buf = buf.at[:, :msgs.shape[1]].set(msgs)
+    pos = jnp.arange(n_blocks * 128, dtype=jnp.int32)[None, :]
+    lens32 = lens.astype(jnp.int32)[:, None]
+    buf = jnp.where(pos == lens32, jnp.uint8(0x80), buf)
+    # 128-bit big-endian length: lens < 2^28 → 4 low bytes suffice.
+    final_block = (lens32 + 16) // 128
+    msg_bits = (lens.astype(U32) * 8)[:, None]
+    len_pos = final_block * 128 + 124
+    for j in range(4):
+        shift = U32(8 * (3 - j))
+        byte = ((msg_bits >> shift) & 0xFF).astype(jnp.uint8)
+        buf = jnp.where(pos == len_pos + j, byte, buf)
+
+    state = _init_state512(n, h0)
+    out = state
+    for i in range(n_blocks):
+        state = compress512(
+            state, _bytes_to_words512(buf[:, i * 128:(i + 1) * 128]))
+        is_final = (final_block[:, 0] == i)
+        out = tuple(
+            (jnp.where(is_final, s[0], o[0]),
+             jnp.where(is_final, s[1], o[1]))
+            for s, o in zip(state, out))
+    return _digest_bytes512(out, out_words)
+
+
+def sha512_fixed(msgs):
+    """SHA-512 of [N, L] uint8, fixed L ≤ 111 → [N, 64] uint8."""
+    return _hash_fixed(msgs, _H512, 8)
+
+
+def sha384_fixed(msgs):
+    """SHA-384 of [N, L] uint8, fixed L ≤ 111 → [N, 48] uint8."""
+    return _hash_fixed(msgs, _H384, 6)
+
+
+def sha512_var(msgs, lens, max_len: int):
+    """SHA-512 of [N, max_len] buffers with per-token lens → [N, 64]."""
+    return _hash_var(msgs, lens, max_len, _H512, 8)
+
+
+def sha384_var(msgs, lens, max_len: int):
+    """SHA-384 of [N, max_len] buffers with per-token lens → [N, 48]."""
+    return _hash_var(msgs, lens, max_len, _H384, 6)

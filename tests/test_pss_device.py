@@ -106,7 +106,7 @@ def test_pss_device_matches_host_oracle():
     mb_arr = np.asarray(mbs, np.int32)
     mh_mat = np.tile(np.frombuffer(mhash, np.uint8), (len(ems), 1))
     fn = jax.jit(lambda e, m, b: R._pss_verify_device(
-        e, m, b, width=width, h_len=h_len))
+        e, m, b, width=width, hash_name="sha256"))
     got = np.asarray(fn(jnp.asarray(em_mat), jnp.asarray(mh_mat),
                         jnp.asarray(mb_arr)))
     for i in range(len(ems)):
@@ -159,6 +159,77 @@ def test_ps256_keyset_parity_rns(monkeypatch):
     toks.append(toks[0][:-8] + "AAAAAAAA")
     ks = TPUBatchKeySet(jwks)
     oracle = StaticKeySet(pubs)
+    out = ks.verify_batch(toks)
+    for i, tk in enumerate(toks):
+        try:
+            oracle.verify_signature(tk)
+            want = True
+        except Exception:  # noqa: BLE001
+            want = False
+        assert (not isinstance(out[i], Exception)) == want, (i, out[i])
+
+
+def test_sha512_family_matches_hashlib():
+    from cap_tpu.tpu import sha512 as S5
+
+    rng = np.random.default_rng(7)
+    for name, fixed, var in (("sha512", S5.sha512_fixed, S5.sha512_var),
+                             ("sha384", S5.sha384_fixed, S5.sha384_var)):
+        for length in (4, 68, 111):
+            msgs = rng.integers(0, 256, (16, length), dtype=np.uint8)
+            got = np.asarray(jax.jit(fixed)(jnp.asarray(msgs)))
+            for i in range(len(msgs)):
+                assert got[i].tobytes() == \
+                    hashlib.new(name, msgs[i].tobytes()).digest(), \
+                    (name, length, i)
+        max_len = 300
+        lens = np.concatenate([
+            rng.integers(0, max_len + 1, 12),
+            [0, 111, 112, 127, 128, 239, 240, max_len],
+        ]).astype(np.int64)
+        msgs = np.zeros((len(lens), max_len), np.uint8)
+        for i, ln in enumerate(lens):
+            msgs[i, :ln] = rng.integers(0, 256, ln, dtype=np.uint8)
+        got = np.asarray(jax.jit(
+            lambda m, ln: var(m, ln, max_len))(
+                jnp.asarray(msgs), jnp.asarray(lens)))
+        for i, ln in enumerate(lens):
+            assert got[i].tobytes() == \
+                hashlib.new(name, msgs[i, :ln].tobytes()).digest(), \
+                (name, int(ln))
+
+
+def test_ps384_keyset_parity():
+    """PS384 through the packed device path (SHA-384 u32-pair engine)."""
+    priv, pub = captest.generate_keys(algs.PS384, rsa_bits=1024)
+    toks = [captest.sign_jwt(priv, algs.PS384,
+                             captest.default_claims(sub=f"u{j}"),
+                             kid="p0")
+            for j in range(16)]
+    toks.append(toks[0][:-8] + "AAAAAAAA")
+    ks = TPUBatchKeySet([JWK(pub, kid="p0")])
+    oracle = StaticKeySet([pub])
+    out = ks.verify_batch(toks)
+    for i, tk in enumerate(toks):
+        try:
+            oracle.verify_signature(tk)
+            want = True
+        except Exception:  # noqa: BLE001
+            want = False
+        assert (not isinstance(out[i], Exception)) == want, (i, out[i])
+
+
+@pytest.mark.heavy
+def test_ps512_keyset_parity():
+    """PS512 (needs emLen ≥ 2·64 + 2 → ≥1536-bit keys)."""
+    priv, pub = captest.generate_keys(algs.PS512, rsa_bits=1536)
+    toks = [captest.sign_jwt(priv, algs.PS512,
+                             captest.default_claims(sub=f"u{j}"),
+                             kid="p0")
+            for j in range(8)]
+    toks.append(toks[0][:-8] + "AAAAAAAA")
+    ks = TPUBatchKeySet([JWK(pub, kid="p0")])
+    oracle = StaticKeySet([pub])
     out = ks.verify_batch(toks)
     for i, tk in enumerate(toks):
         try:
