@@ -138,18 +138,24 @@ def _meshed_mixed_parity():
     jwks, signers = [], []
     for i, (alg, kw) in enumerate([
             ("RS256", {"rsa_bits": 1024}), ("RS256", {"rsa_bits": 1024}),
-            ("ES256", {}), ("ES256", {}), ("EdDSA", {})]):
+            ("ES256", {}), ("ES256", {}), ("EdDSA", {}),
+            ("PS256", {"rsa_bits": 1024})]):
         priv, pub = captest.generate_keys(alg, **kw)
         jwks.append(JWK(pub, kid=f"m{i}"))
         signers.append((priv, alg, f"m{i}"))
     claims = captest.default_claims()
     toks = []
-    for j in range(15):
+    for j in range(18):
         priv, alg, kid = signers[j % len(signers)]
         toks.append(captest.sign_jwt(priv, alg, claims, kid=kid))
     tam = toks[0][:-8] + ("AAAAAAAA" if not toks[0].endswith("AAAAAAAA")
                           else "BBBBBBBB")
-    batch = toks + [tam, "garbage"]
+    # toks[5] is PS256 (signer 5): tampering it exercises the meshed
+    # device EMSA-PSS REJECTION path, not just its accept path
+    tam_ps = toks[5][:-8] + ("AAAAAAAA"
+                             if not toks[5].endswith("AAAAAAAA")
+                             else "BBBBBBBB")
+    batch = toks + [tam, tam_ps, "garbage"]
 
     mesh = make_mesh(8)
     meshed = TPUBatchKeySet(jwks, mesh=mesh)
@@ -161,13 +167,15 @@ def _meshed_mixed_parity():
         assert isinstance(g, Exception) == isinstance(w, Exception)
         if not isinstance(g, Exception):
             assert g == w
-    assert isinstance(got[-2], InvalidSignatureError)
+    assert isinstance(got[-3], InvalidSignatureError)
+    assert isinstance(got[-2], InvalidSignatureError)   # tampered PS256
     assert isinstance(got[-1], Exception)
 
 
 def test_meshed_keyset_mixed_families():
     """TPUBatchKeySet(mesh=...): the PRODUCT batch path sharded over
-    the 8-device mesh for all packed families (RS*, ES*, EdDSA) —
+    the 8-device mesh for all packed families (RS*, ES*, EdDSA, PS*
+    with the device EMSA-PSS check) —
     verdict parity with the un-meshed keyset, rejections included
     (VERDICT r1 #3: multi-chip as a capability, not a demo). Runs the
     limb engines (CPU default); the RNS variant is the `heavy` tier
