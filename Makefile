@@ -3,10 +3,13 @@ CXXFLAGS ?= -O3 -march=native -fPIC -shared -pthread -std=c++17 -Wall
 
 NATIVE_DIR := cap_tpu/runtime/native
 NATIVE_SO := $(NATIVE_DIR)/libcapruntime.so
-CLAIMS_SO := $(NATIVE_DIR)/_capclaims.so
 CLIENT_DIR := cap_tpu/serve/native
 CLIENT_SO := $(CLIENT_DIR)/libcapclient.so
 PYTHON ?= python3
+# ABI-tagged: must match what cap_tpu._build.EXT_NAME expects to load.
+# A silent fallback name would build an artifact the loader never looks
+# for, so a failed probe fails the claims target instead.
+CLAIMS_EXT_NAME := $(shell $(PYTHON) -c "from cap_tpu._build import EXT_NAME; print(EXT_NAME)" 2>/dev/null)
 PY_INCLUDE := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_paths()['include'])")
 
 .PHONY: all native test bench clean
@@ -18,8 +21,16 @@ native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 $(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
+ifeq ($(CLAIMS_EXT_NAME),)
+CLAIMS_SO := claims-probe-failed
+.PHONY: claims-probe-failed
+claims-probe-failed:
+	@echo "error: could not import cap_tpu._build with PYTHON=$(PYTHON); claims extension name unknown" >&2; exit 1
+else
+CLAIMS_SO := $(NATIVE_DIR)/$(CLAIMS_EXT_NAME)
 $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
+endif
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
@@ -31,7 +42,7 @@ bench: native
 	python bench.py
 
 clean:
-	rm -f $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
+	rm -f $(NATIVE_SO) $(CLIENT_SO) $(NATIVE_DIR)/_capclaims*.so
 
 test-all: native
 	python -m pytest tests/ -q -m ""

@@ -23,6 +23,12 @@ _done = False
 
 _FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
 
+# The extension module's filename carries the running interpreter's ABI
+# tag (e.g. _capclaims.cpython-311-x86_64-linux-gnu.so): it is built
+# against THIS interpreter's headers, and an untagged name would let a
+# checkout shared across CPython minor versions load a mismatched ABI.
+EXT_NAME = "_capclaims" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
+
 # (source, output, needs_python_headers) — paths relative to cap_tpu/.
 _TARGETS = [
     (os.path.join("runtime", "native", "jose_native.cpp"),
@@ -30,7 +36,7 @@ _TARGETS = [
     (os.path.join("serve", "native", "client_native.cpp"),
      os.path.join("serve", "native", "libcapclient.so"), False),
     (os.path.join("runtime", "native", "claims_ext.cpp"),
-     os.path.join("runtime", "native", "_capclaims.so"), True),
+     os.path.join("runtime", "native", EXT_NAME), True),
 ]
 
 

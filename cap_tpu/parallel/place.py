@@ -17,8 +17,12 @@ tests/test_parallel.py and the driver's dryrun_multichip.
 
 from __future__ import annotations
 
+import os
+import threading
 from collections import OrderedDict
 from typing import Any, Tuple
+
+from .. import telemetry
 
 # (id(mesh), id(arr)) → (mesh, arr, replicated). The STRONG refs to the
 # keying objects make id-aliasing impossible while an entry lives (a
@@ -26,11 +30,27 @@ from typing import Any, Tuple
 # copy), and the LRU bound keeps dropped keysets from pinning device
 # buffers forever.
 _replicated_cache: "OrderedDict[Tuple[int, int], Any]" = OrderedDict()
-# Sized for several live keysets: one meshed TPUBatchKeySet places
-# ~6 arrays per RSA size class + 4-5 per EC curve + Ed tables; the
-# bound must comfortably exceed the combined working set or every
-# batch silently re-broadcasts its tables across the mesh.
-_REPLICATED_CACHE_MAX = 512
+# Bounded by approximate BYTES, not entry count: individual tables
+# range from a few KB to ~130 MB (12-bit EC windows), so a count bound
+# either evicts a live working set or pins GBs of dropped keysets'
+# buffers. The bound must comfortably exceed the combined working set
+# of the live keysets or every batch silently re-broadcasts its tables
+# across the mesh; 1 GiB covers dozens of keysets at default window
+# sizes while capping the HBM a rotation churn can pin. Raise via
+# CAP_TPU_REPLICATED_CACHE_MB for many live keysets with large (12-bit)
+# windows; the `parallel.replicated_evictions` telemetry counter ticking
+# steadily under load is the thrash signal to watch.
+_REPLICATED_CACHE_MAX_BYTES = int(os.environ.get(
+    "CAP_TPU_REPLICATED_CACHE_MB", str(1 << 10))) << 20
+_replicated_cache_bytes = 0
+# replicated() is called concurrently (serve dispatcher + user threads
+# on the same mesh); the byte counter is read-modify-write state, so
+# all cache mutations happen under this lock.
+_cache_lock = threading.Lock()
+
+
+def _entry_nbytes(arr) -> int:
+    return int(getattr(arr, "nbytes", 0) or 0)
 
 
 def batch_axis(mesh) -> str:
@@ -52,18 +72,33 @@ def replicated(mesh, arr):
 
     The cache holds strong references to the mesh and source array, so
     entries can never be aliased by id reuse after garbage collection;
-    a small LRU bound evicts replicated buffers of dropped keysets.
+    an LRU bounded by approximate bytes evicts replicated buffers of
+    dropped keysets without pinning GBs of HBM under keyset rotation.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    global _replicated_cache_bytes
     key = (id(mesh), id(arr))
-    hit = _replicated_cache.get(key)
-    if hit is not None:
-        _replicated_cache.move_to_end(key)
-        return hit[2]
+    with _cache_lock:
+        hit = _replicated_cache.get(key)
+        if hit is not None:
+            _replicated_cache.move_to_end(key)
+            return hit[2]
     out = jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))
-    _replicated_cache[key] = (mesh, arr, out)
-    while len(_replicated_cache) > _REPLICATED_CACHE_MAX:
-        _replicated_cache.popitem(last=False)
+    with _cache_lock:
+        # A concurrent caller may have inserted the same key while we
+        # were broadcasting — keep (and return) the first copy so every
+        # shard keeps gathering from one buffer.
+        hit = _replicated_cache.get(key)
+        if hit is not None:
+            _replicated_cache.move_to_end(key)
+            return hit[2]
+        _replicated_cache[key] = (mesh, arr, out)
+        _replicated_cache_bytes += _entry_nbytes(arr)
+        while (_replicated_cache_bytes > _REPLICATED_CACHE_MAX_BYTES
+               and len(_replicated_cache) > 1):
+            _, (_, old_arr, _) = _replicated_cache.popitem(last=False)
+            _replicated_cache_bytes -= _entry_nbytes(old_arr)
+            telemetry.count("parallel.replicated_evictions")
     return out

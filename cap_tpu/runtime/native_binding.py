@@ -77,7 +77,9 @@ def _load_claims_ext():
     import importlib.machinery
     import importlib.util
 
-    path = os.path.join(os.path.dirname(_LIB_PATH), "_capclaims.so")
+    from .._build import EXT_NAME
+
+    path = os.path.join(os.path.dirname(_LIB_PATH), EXT_NAME)
     if not os.path.exists(path):
         return None
     try:

@@ -25,6 +25,11 @@ from typing import Any, List, Optional, Sequence
 
 from .. import telemetry
 
+# Dispatcher-side shutdown sentinel: put into the inflight queue by the
+# dispatcher thread itself just before it exits, so by FIFO order it
+# arrives AFTER every batch the dispatcher ever handed over.
+_DISPATCHER_DONE = object()
+
 
 class _Pending:
     __slots__ = ("tokens", "results", "event", "ts")
@@ -57,13 +62,14 @@ class AdaptiveBatcher:
         self._queued_tokens = 0
         self._closed = False
         # 2-deep pipeline: one batch draining in the collector while
-        # the dispatcher preps/dispatches the next. The SLOT semaphore
-        # is acquired BEFORE dispatching, so at most one un-collected
-        # dispatch exists besides the one the collector is draining —
-        # a bounded queue alone would admit a third batch's device
-        # work before blocking.
+        # the dispatcher preps/dispatches the next. TWO slots, each
+        # acquired BEFORE dispatching and released when the collector
+        # finishes draining that batch: batch k+1's host prep/H2D runs
+        # while batch k drains (the point of the pipeline), and batch
+        # k+2's dispatch blocks until k is collected — a bounded queue
+        # alone would admit a third batch's device work first.
         self._inflight: "queue.Queue" = queue.Queue()
-        self._slot = threading.Semaphore(1)
+        self._slot = threading.Semaphore(2)
         self._collector = threading.Thread(
             target=self._collect_loop, daemon=True,
             name="cap-tpu-collector")
@@ -89,23 +95,30 @@ class AdaptiveBatcher:
         assert p.results is not None
         return p.results
 
-    def close(self) -> None:
+    def close(self, deadline_s: float = 120.0) -> None:
         with self._cv:
             self._closed = True
             self._cv.notify()
-        # The dispatcher may be blocked handing its LAST batch to the
-        # collector (bounded queue) while the collector sits in a
-        # multi-second device sync — wait it out, or a sentinel racing
-        # that put could shut the collector down ahead of the batch and
-        # strand its submitters in event.wait() forever.
-        while self._thread.is_alive():
+        # The dispatcher may be blocked in _slot.acquire() for its LAST
+        # batch while the collector sits in a multi-second device sync —
+        # wait it out, but bound the whole shutdown: if a device sync
+        # wedges past the deadline, give up and return; both threads
+        # are daemons, and the collector keeps draining whatever the
+        # dispatcher hands it until the dispatcher-side DONE sentinel.
+        limit = time.monotonic() + deadline_s
+        while self._thread.is_alive() and time.monotonic() < limit:
             self._thread.join(timeout=2.0)
-        self._inflight.put(None)          # collector shutdown sentinel
-        self._collector.join(timeout=60.0)
+        self._collector.join(timeout=max(1.0, limit - time.monotonic()))
 
     # -- dispatcher -------------------------------------------------------
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            self._inflight.put(_DISPATCHER_DONE)
+
+    def _run_loop(self) -> None:
         while True:
             with self._cv:
                 while not self._queue and not self._closed:
@@ -160,9 +173,16 @@ class AdaptiveBatcher:
         self._distribute(batch, results)
 
     def _collect_loop(self) -> None:
+        # The dispatcher enqueues _DISPATCHER_DONE on exit, so by FIFO
+        # order every batch it ever dispatched is collected before this
+        # loop returns — even when close()'s deadline expired while
+        # batches were still dispatching, submitters are never stranded
+        # in event.wait(). (A dispatcher that dies without the sentinel
+        # is impossible short of interpreter teardown; both threads are
+        # daemons regardless.)
         while True:
             item = self._inflight.get()
-            if item is None:
+            if item is _DISPATCHER_DONE:
                 return
             batch, n_tokens, collect = item
             try:
