@@ -12,15 +12,9 @@ PYTHON ?= python3
 CLAIMS_EXT_NAME := $(shell $(PYTHON) -c "from cap_tpu._build import EXT_NAME; print(EXT_NAME)" 2>/dev/null)
 PY_INCLUDE := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_paths()['include'])")
 
-.PHONY: all native test bench clean
-
-all: native
-
-native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
-
-$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
-	$(CXX) $(CXXFLAGS) -o $@ $<
-
+# CLAIMS_SO must be assigned BEFORE the `native:` rule below — make
+# expands prerequisite lists at parse time, so a later assignment would
+# leave the dependency empty and silently skip the claims build.
 ifeq ($(CLAIMS_EXT_NAME),)
 CLAIMS_SO := claims-probe-failed
 .PHONY: claims-probe-failed
@@ -31,6 +25,15 @@ CLAIMS_SO := $(NATIVE_DIR)/$(CLAIMS_EXT_NAME)
 $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
+
+.PHONY: all native test bench clean
+
+all: native
+
+native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
+
+$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $<
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<

@@ -58,6 +58,225 @@ def _pad_size(n: int, max_chunk: int) -> int:
     return min(size, max_chunk)
 
 
+def _pack_rsa_record(pb, table, kind: str, hash_name: str,
+                     chunk: np.ndarray, crows: np.ndarray,
+                     pad: int) -> np.ndarray:
+    """One packed RS*/PS* record matrix for ``chunk`` (native packer
+    when built, numpy fallback otherwise). Shared by the dispatch path
+    and the resident engine benchmark so both measure the same bytes."""
+    from ..tpu import rsa as tpursa
+
+    h_len = tpursa.HASH_LEN[hash_name]
+    width = 2 * table.k
+    m = len(chunk)
+    sizes_all = np.asarray(table.sizes_bytes, np.int64)
+    sizes = sizes_all[crows]
+    if kind == "rs":
+        # PKCS#1 v1.5 needs emLen ≥ tLen + 11; the PSS equivalent
+        # checks run on device.
+        t_len = len(tpursa.DIGEST_INFO_PREFIX[hash_name]) + h_len
+        extra = (sizes >= t_len + 11).astype(np.uint8)
+    else:
+        extra = np.ones(m, np.uint8)
+    rec = pb.pack_sig_records(chunk, sizes, extra, crows, width,
+                              h_len, pad)
+    if rec is None:               # pre-packer .so: numpy path
+        sig_mat = np.zeros((pad, width), np.uint8)
+        sig_mat[:m] = pb.sig_matrix(chunk, width)
+        sig_lens = np.zeros(pad, np.int64)
+        sig_lens[:m] = pb.sig_len[chunk]
+        hash_mat = np.zeros((pad, 64), np.uint8)
+        hash_mat[:m] = pb.digest[chunk]
+        key_idx = np.zeros(pad, np.int32)
+        key_idx[:m] = crows
+        rec = tpursa.rs_packed_records(table, sig_mat, sig_lens,
+                                       hash_mat, hash_name, key_idx)
+        if kind == "ps":
+            # rs_packed_records applies the v1.5 emLen flag; PSS
+            # keeps plain length validity.
+            len_ok = (sig_lens == sizes_all[
+                np.concatenate([crows, np.zeros(pad - m, np.int32)])])
+            rec[:, width + h_len] = len_ok.astype(np.uint8)
+            rec[m:, width + h_len] = 0
+    return rec
+
+
+def _pack_es_record(pb, table, chunk: np.ndarray, crows: np.ndarray,
+                    hash_len: int, pad: int) -> np.ndarray:
+    """One packed ES* record matrix for ``chunk`` (native packer when
+    built, numpy fallback otherwise)."""
+    from ..tpu import ec as tpuec
+
+    cb = table.curve.coord_bytes
+    width = 2 * cb
+    m = len(chunk)
+    rec = pb.pack_sig_records(chunk, np.full(m, width, np.int64),
+                              np.ones(m, np.uint8), crows, width,
+                              hash_len, pad)
+    if rec is None:               # pre-packer .so: numpy path
+        sig_mat = np.zeros((pad, width), np.uint8)
+        sig_mat[:m] = pb.sig_matrix(chunk, width)
+        sig_lens = np.zeros(pad, np.int64)
+        sig_lens[:m] = pb.sig_len[chunk]
+        hash_mat = np.zeros((pad, 64), np.uint8)
+        hash_mat[:m] = pb.digest[chunk]
+        key_idx = np.zeros(pad, np.int32)
+        key_idx[:m] = crows
+        rec = tpuec.es_packed_records(table, sig_mat, sig_lens,
+                                      hash_mat, hash_len, key_idx)
+    return rec
+
+
+def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str]):
+    """Device-RESIDENT dispatch closures for the engine benchmark.
+
+    Preps + packs ``tokens`` ONCE, places every packed family record on
+    the device, and returns ``(n_tokens, [fn, ...])`` where each ``fn()``
+    re-dispatches the full packed verify program (record unpack, limb
+    build, modexp / EC ladder, verdict reduce) on the already-resident
+    record and returns a device array of per-slot accept bits summed to
+    a scalar. Nothing host-side — prep, packing, H2D — happens on the
+    timed path, so slope-timing these closures measures ENGINE speed
+    independent of link bandwidth (bench.py ``resident_mixed_vps``;
+    the reference's whole verify hot path is keyset.go:126-139).
+
+    Every token must route to a packed family (RS*/PS*/ES*/EdDSA with
+    device tables and known kids) — anything that would fall back to
+    the CPU oracle raises, so the resident number can never silently
+    measure a subset.
+    """
+    import jax.numpy as jnp
+
+    from ..runtime.native_binding import ALG_NAMES, prepare_batch_arrays
+    from ..tpu import ec as tpuec
+    from ..tpu import ed25519 as tpued
+    from ..tpu import rsa as tpursa
+
+    pb = prepare_batch_arrays(list(tokens))
+    if not (pb.status == 0).all():
+        raise InvalidParameterError(
+            "resident bench tokens must all prep cleanly")
+    alg_ids = {name: i for i, name in enumerate(ALG_NAMES)}
+    covered = np.zeros(pb.n, bool)
+    fns = []
+
+    def dev_put(rec):
+        import jax
+
+        return jax.device_put(rec)
+
+    for alg_name, hash_name in list(_RS.items()) + list(_PS.items()):
+        kind = "rs" if alg_name in _RS else "ps"
+        idx = np.nonzero(pb.alg_id == alg_ids[alg_name])[0]
+        if len(idx) == 0:
+            continue
+        rows = pb.kid_rows(idx, ks._kid_rsa_row)
+        if ks._n_rsa_keys == 1:
+            rows = np.where(rows == -1, 0, rows)
+        if (rows < 0).any():
+            raise InvalidParameterError(
+                f"{alg_name}: tokens with unknown kid")
+        covered[idx] = True
+        for cls, table in enumerate(ks._rsa_tables):
+            sel = (rows // _RSA_CLS_STRIDE) == cls
+            if not sel.any():
+                continue
+            if len(table.n_ints) > 255:   # u8 kid row, arrays path
+                raise InvalidParameterError(
+                    f"{alg_name}: >255 keys in one size class is "
+                    "outside the packed path")
+            chunk = idx[sel]
+            crows = (rows[sel] % _RSA_CLS_STRIDE).astype(np.int32)
+            pad = _pad_size(len(chunk), ks._max_chunk)
+            if len(chunk) > pad:
+                raise InvalidParameterError("bucket exceeds max_chunk")
+            rec = dev_put(_pack_rsa_record(pb, table, kind, hash_name,
+                                           chunk, crows, pad))
+            verify = (tpursa.verify_rs_packed_pending if kind == "rs"
+                      else tpursa.verify_ps_packed_pending)
+
+            def fn(rec=rec, table=table, hash_name=hash_name,
+                   verify=verify):
+                # device_put inside is a no-op: rec is already resident
+                return jnp.sum(verify(table, rec, hash_name)
+                               .astype(jnp.int32))
+
+            fns.append((len(chunk), fn))
+
+    for alg_name, crv in _ES.items():
+        idx = np.nonzero(pb.alg_id == alg_ids[alg_name])[0]
+        if len(idx) == 0:
+            continue
+        if crv not in ks._ec_tables:
+            raise InvalidParameterError(f"no {crv} device table")
+        table = ks._ec_tables[crv]
+        if len(table.keys) > 255:         # u8 kid row, arrays path
+            raise InvalidParameterError(
+                f"{alg_name}: >255 keys is outside the packed path")
+        rows = pb.kid_rows(idx, ks._kid_ec_row[crv])
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        if (rows < 0).any():
+            raise InvalidParameterError(
+                f"{alg_name}: tokens with unknown kid")
+        covered[idx] = True
+        hash_len = tpursa.HASH_LEN[algs.HASH_FOR_ALG[alg_name]]
+        pad = _pad_size(len(idx), ks._max_chunk)
+        if len(idx) > pad:
+            raise InvalidParameterError("bucket exceeds max_chunk")
+        rec = dev_put(_pack_es_record(pb, table, idx,
+                                      rows.astype(np.int32),
+                                      hash_len, pad))
+
+        def fn(rec=rec, table=table, hash_len=hash_len):
+            # deg slots are CPU-re-verified on the real path, so they
+            # count as accepts here (deg is flags-masked: padded slots
+            # contribute nothing). The OR also keeps the deg output
+            # live so XLA cannot dead-code any of the ladder.
+            ok_dev, deg_dev = tpuec.verify_es_packed_pending(
+                table, rec, hash_len)
+            return jnp.sum((ok_dev | deg_dev).astype(jnp.int32))
+
+        fns.append((len(idx), fn))
+
+    idx = np.nonzero(pb.alg_id == alg_ids[algs.EdDSA])[0]
+    if len(idx) > 0:
+        table = ks._ed_table
+        if table is None:
+            raise InvalidParameterError("no EdDSA device table")
+        if len(table.keys) > 255:         # u8 kid row, arrays path
+            raise InvalidParameterError(
+                "EdDSA: >255 keys is outside the packed path")
+        rows = pb.kid_rows(idx, ks._kid_ed_row)
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        if (rows < 0).any():
+            raise InvalidParameterError("EdDSA: tokens with unknown kid")
+        covered[idx] = True
+        pad = _pad_size(len(idx), ks._max_chunk)
+        if len(idx) > pad:
+            raise InvalidParameterError("bucket exceeds max_chunk")
+        sigs = [pb.signature(int(j)) for j in idx]
+        msgs = [pb.signing_input(int(j)) for j in idx]
+        fill = pad - len(idx)
+        key_idx = np.concatenate([rows.astype(np.int32),
+                                  np.zeros(fill, np.int32)])
+        rec = dev_put(tpued.ed_packed_records(
+            table, sigs + [b""] * fill, msgs + [b""] * fill, key_idx))
+
+        def fn(rec=rec, table=table):
+            return jnp.sum(tpued.verify_ed_packed_pending(table, rec)
+                           .astype(jnp.int32))
+
+        fns.append((len(idx), fn))
+
+    if not covered.all():
+        raise InvalidParameterError(
+            "tokens outside the packed families: "
+            f"{np.nonzero(~covered)[0][:5].tolist()}...")
+    return int(covered.sum()), fns
+
+
 class TPUBatchKeySet(KeySet):
     """KeySet whose batch path runs on the TPU verify engine.
 
@@ -412,8 +631,6 @@ class TPUBatchKeySet(KeySet):
                                      pending, slow, cls=cls)
                 continue
             width = 2 * table.k
-            sizes_all = np.asarray(table.sizes_bytes, np.int64)
-            t_len = len(tpursa.DIGEST_INFO_PREFIX[hash_name]) + h_len
             chunk_n = self._chunk_tokens(width + h_len
                                          + tpursa.RS_REC_EXTRA)
             for lo in range(0, len(cls_idx), chunk_n):
@@ -423,36 +640,8 @@ class TPUBatchKeySet(KeySet):
                 pad = _pad_size(m, chunk_n)
                 telemetry.count(f"device.{kind}.tokens", m)
                 with telemetry.span(f"dispatch.{kind}.{hash_name}"):
-                    sizes = sizes_all[crows]
-                    if kind == "rs":
-                        # PKCS#1 v1.5 needs emLen ≥ tLen + 11; the
-                        # PSS equivalent checks run on device.
-                        extra = (sizes >= t_len + 11).astype(np.uint8)
-                    else:
-                        extra = np.ones(m, np.uint8)
-                    rec = pb.pack_sig_records(chunk, sizes, extra,
-                                              crows, width, h_len, pad)
-                    if rec is None:       # pre-packer .so: numpy path
-                        sig_mat = np.zeros((pad, width), np.uint8)
-                        sig_mat[:m] = pb.sig_matrix(chunk, width)
-                        sig_lens = np.zeros(pad, np.int64)
-                        sig_lens[:m] = pb.sig_len[chunk]
-                        hash_mat = np.zeros((pad, 64), np.uint8)
-                        hash_mat[:m] = pb.digest[chunk]
-                        key_idx = np.zeros(pad, np.int32)
-                        key_idx[:m] = crows
-                        rec = tpursa.rs_packed_records(
-                            table, sig_mat, sig_lens, hash_mat,
-                            hash_name, key_idx)
-                        if kind == "ps":
-                            # rs_packed_records applies the v1.5 emLen
-                            # flag; PSS keeps plain length validity.
-                            len_ok = (sig_lens == sizes_all[
-                                np.concatenate([crows, np.zeros(
-                                    pad - m, np.int32)])])
-                            rec[:, width + h_len] = \
-                                len_ok.astype(np.uint8)
-                            rec[m:, width + h_len] = 0
+                    rec = _pack_rsa_record(pb, table, kind, hash_name,
+                                           chunk, crows, pad)
                     telemetry.count("h2d.bytes", rec.nbytes)
                     if kind == "rs":
                         ok_dev = tpursa.verify_rs_packed_pending(
@@ -499,21 +688,8 @@ class TPUBatchKeySet(KeySet):
             pad = _pad_size(m, chunk_n)
             telemetry.count("device.es.tokens", m)
             with telemetry.span(f"dispatch.es.{crv}"):
-                rec = pb.pack_sig_records(
-                    chunk, np.full(m, width, np.int64),
-                    np.ones(m, np.uint8), crows, width, hash_len, pad)
-                if rec is None:           # pre-packer .so: numpy path
-                    sig_mat = np.zeros((pad, width), np.uint8)
-                    sig_mat[:m] = pb.sig_matrix(chunk, width)
-                    sig_lens = np.zeros(pad, np.int64)
-                    sig_lens[:m] = pb.sig_len[chunk]
-                    hash_mat = np.zeros((pad, 64), np.uint8)
-                    hash_mat[:m] = pb.digest[chunk]
-                    key_idx = np.zeros(pad, np.int32)
-                    key_idx[:m] = crows
-                    rec = tpuec.es_packed_records(
-                        table, sig_mat, sig_lens, hash_mat, hash_len,
-                        key_idx)
+                rec = _pack_es_record(pb, table, chunk, crows,
+                                      hash_len, pad)
                 telemetry.count("h2d.bytes", rec.nbytes)
                 ok_dev, deg_dev = tpuec.verify_es_packed_pending(
                     table, rec, hash_len, mesh=self._mesh)

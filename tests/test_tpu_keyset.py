@@ -279,3 +279,37 @@ def test_remote_keyset_refetch_failure_keeps_verdicts():
     assert isinstance(out[0], dict)
     assert isinstance(out[1], InvalidSignatureError)
     assert isinstance(out[2], dict)
+
+
+def test_resident_dispatchers_headline_mix():
+    """The resident engine benchmark (bench.py resident_mixed_vps)
+    dispatches the REAL packed programs on device-resident records:
+    accept-bit sums must equal the token count per family bucket, and
+    repeated dispatches must keep returning it (the slope-timing loop
+    relies on that)."""
+    from cap_tpu.jwt.tpu_keyset import resident_dispatchers
+
+    jwks, toks = captest.headline_fixtures(256)
+    ks = TPUBatchKeySet(jwks)
+    n, fns = resident_dispatchers(ks, toks)
+    assert n == len(toks)
+    assert len(fns) == 2              # one RS256 bucket + one ES256
+    per_fn = {int(fn()) for _, fn in fns}
+    assert per_fn == {sum(m for m, _ in fns) // 2}
+    total = sum(int(fn()) for _, fn in fns)
+    assert total == n
+
+
+def test_resident_dispatchers_rejects_unroutable():
+    """A token that would fall back to the CPU oracle must raise — the
+    resident number can never silently measure a subset."""
+    from cap_tpu.errors import InvalidParameterError
+    from cap_tpu.jwt.tpu_keyset import resident_dispatchers
+
+    jwks, toks = captest.headline_fixtures(16)
+    ks = TPUBatchKeySet(jwks)
+    priv, _ = captest.generate_keys("ES256")
+    stranger = captest.sign_jwt(priv, "ES256", captest.default_claims(),
+                                kid="not-in-jwks")
+    with pytest.raises(InvalidParameterError):
+        resident_dispatchers(ks, toks + [stranger])
