@@ -20,6 +20,8 @@ Pipeline per batch:
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -301,6 +303,16 @@ class TPUBatchKeySet(KeySet):
         self._max_chunk = max_chunk
         self._cpu_fallback = cpu_fallback
         self._mesh = mesh
+        # Wire-adaptive chunk sizing (VERDICT r3 #3): EWMA of the
+        # OBSERVED effective H2D byte rate, updated after every batch
+        # collect; _chunk_tokens sizes chunks to a time budget against
+        # it so a slow link gets smaller chunks (bounded p99) and a
+        # fast link keeps big ones (throughput). None until the first
+        # batch completes (the static 5 MB default applies).
+        self._wire_bps: Optional[float] = None
+        self._last_collect_t: Optional[float] = None
+        self._chunk_budget_s = float(os.environ.get(
+            "CAP_TPU_CHUNK_BUDGET_MS", "250")) / 1e3
 
         # Partition keys into family tables; remember each JWK's slot.
         # RSA keys additionally split into SIZE CLASSES (one table per
@@ -459,6 +471,11 @@ class TPUBatchKeySet(KeySet):
         """Phase 1: prep, bucket, pack, and queue ALL device work."""
         from ..runtime.native_binding import ALG_NAMES, prepare_batch_arrays
 
+        # Wire-estimate span starts HERE: transfers drain while later
+        # chunks are still being packed, so measuring from dispatch END
+        # would overestimate the link (the sync would block briefly on
+        # an already-drained wire).
+        t_dispatch = time.perf_counter()
         with telemetry.span("prep.native"):
             pb = prepare_batch_arrays(tokens)
         n = pb.n
@@ -480,6 +497,7 @@ class TPUBatchKeySet(KeySet):
         pending: List[tuple] = []
         packed_parts: List[Any] = []      # device [pad] bool arrays
         packed_meta: List[tuple] = []     # (n_slots, consume(arrs))
+        stats = {"h2d": 0}                # record bytes this batch
         alg_ids = {name: i for i, name in enumerate(ALG_NAMES)}
 
         def run_family(alg_name: str, runner) -> None:
@@ -491,7 +509,7 @@ class TPUBatchKeySet(KeySet):
         def run_rs(alg_name: str, idx: np.ndarray) -> None:
             self._run_rsa_packed("rs", _RS[alg_name], idx, pb,
                                  packed_parts, packed_meta, pending,
-                                 slow, results)
+                                 slow, results, stats)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
             # Every PS* family rides the packed single-transfer path
@@ -500,15 +518,16 @@ class TPUBatchKeySet(KeySet):
             # tpu/sha512.py) — no EM bytes return to the host.
             self._run_rsa_packed("ps", _PS[alg_name], idx, pb,
                                  packed_parts, packed_meta,
-                                 pending, slow, results)
+                                 pending, slow, results, stats)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
             self._run_ec_packed(alg_name, idx, pb, packed_parts,
-                                packed_meta, pending, slow, results)
+                                packed_meta, pending, slow, results,
+                                stats)
 
         def run_ed(alg_name: str, idx: np.ndarray) -> None:
             self._run_ed_packed(idx, pb, packed_parts, packed_meta,
-                                pending, slow, results)
+                                pending, slow, results, stats)
 
         for a, crv in _ES.items():
             if crv in self._ec_tables:
@@ -523,7 +542,8 @@ class TPUBatchKeySet(KeySet):
 
         return dict(pb=pb, n=n, ok=ok, results=results, slow=slow,
                     pending=pending, packed_parts=packed_parts,
-                    packed_meta=packed_meta)
+                    packed_meta=packed_meta, stats=stats,
+                    t_dispatch=t_dispatch)
 
     def _collect_batch(self, state: dict) -> List[Any]:
         """Phase 2: claims prefetch, materializing sync, verdicts."""
@@ -569,7 +589,38 @@ class TPUBatchKeySet(KeySet):
             with telemetry.span("cpu_fallback"):
                 for j in sorted(slow_set):
                     results[j] = self._verify_one_parsed(pb.parsed(j))
+        self._observe_wire(state)
         return results
+
+    def _observe_wire(self, state: dict) -> None:
+        """Update the observed effective H2D rate after one batch.
+
+        Two candidate estimates, take the MAX:
+        - bytes / (now - previous collect end): the bench's
+          steady-state definition — right under pipelined load but
+          poisoned by idle gaps between batches;
+        - bytes / (now - this batch's dispatch start): spans up to
+          ``depth`` intervals under pipelining (≈2× low) but contains
+          no idle time.
+        Under load the interval estimate wins; when idle the span
+        estimate wins — so the EWMA never collapses from a quiet
+        period and chunks don't shrink to the floor for no reason.
+        """
+        now = time.perf_counter()
+        h2d = state.get("stats", {}).get("h2d", 0)
+        t_dispatch = state.get("t_dispatch")
+        last, self._last_collect_t = self._last_collect_t, now
+        if not h2d or t_dispatch is None:
+            return
+        span = now - t_dispatch
+        est = h2d / span if span > 0 else 0.0
+        if last is not None and now > last:
+            est = max(est, h2d / (now - last))
+        if est <= 0:
+            return
+        prev = self._wire_bps
+        self._wire_bps = est if prev is None else 0.5 * prev + 0.5 * est
+        telemetry.observe("wire.est_mbps", self._wire_bps / (1 << 20))
 
     @staticmethod
     def _finish_arrays(chunk, okv, pb, results: List[Any]) -> None:
@@ -594,11 +645,22 @@ class TPUBatchKeySet(KeySet):
                 results[j] = InvalidSignatureError(msg)
 
     def _chunk_tokens(self, rec_width: int) -> int:
-        """Tokens per packed chunk: target ~5 MB transfers (the tunnel's
-        bandwidth sweet spot, tools/probe_tunnel.py), pow-2 for shape
-        reuse, capped at max_chunk."""
+        """Tokens per packed chunk, pow-2 for shape reuse.
+
+        Until the first batch completes: target ~5 MB transfers (the
+        tunnel's bandwidth sweet spot, tools/probe_tunnel.py). After:
+        target the TIME budget (CAP_TPU_CHUNK_BUDGET_MS, default 250)
+        against the observed effective H2D rate, clamped to [1, 8] MB —
+        a 6 MB/s trough then gets ~1.5 MB chunks (bounded per-chunk
+        latency, finer pipeline overlap) while a fast link keeps large
+        ones (VERDICT r3 #3)."""
+        budget_bytes = 5 << 20
+        bps = self._wire_bps
+        if bps:
+            budget_bytes = min(max(int(bps * self._chunk_budget_s),
+                                   1 << 20), 8 << 20)
         c = 1024
-        while c * 2 * rec_width <= (5 << 20):
+        while c * 2 * rec_width <= budget_bytes:
             c *= 2
         return min(self._max_chunk, max(1024, c))
 
@@ -607,7 +669,8 @@ class TPUBatchKeySet(KeySet):
                         packed_parts: List[Any],
                         packed_meta: List[tuple],
                         pending: List[tuple],
-                        slow: List[int], results: List[Any]) -> None:
+                        slow: List[int], results: List[Any],
+                        stats: dict) -> None:
         from ..tpu import rsa as tpursa
 
         rows = pb.kid_rows(idx, self._kid_rsa_row)
@@ -628,7 +691,7 @@ class TPUBatchKeySet(KeySet):
             cls_rows = rows[sel] % _RSA_CLS_STRIDE
             if len(table.n_ints) > 255:    # kid row must fit a u8
                 self._run_rsa_arrays(kind, hash_name, cls_idx, pb,
-                                     pending, slow, cls=cls)
+                                     pending, slow, stats, cls=cls)
                 continue
             width = 2 * table.k
             chunk_n = self._chunk_tokens(width + h_len
@@ -643,6 +706,7 @@ class TPUBatchKeySet(KeySet):
                     rec = _pack_rsa_record(pb, table, kind, hash_name,
                                            chunk, crows, pad)
                     telemetry.count("h2d.bytes", rec.nbytes)
+                    stats["h2d"] += rec.nbytes
                     if kind == "rs":
                         ok_dev = tpursa.verify_rs_packed_pending(
                             table, rec, hash_name, mesh=self._mesh)
@@ -660,14 +724,16 @@ class TPUBatchKeySet(KeySet):
                        packed_parts: List[Any],
                        packed_meta: List[tuple],
                        pending: List[tuple],
-                       slow: List[int], results: List[Any]) -> None:
+                       slow: List[int], results: List[Any],
+                       stats: dict) -> None:
         from ..tpu import ec as tpuec
         from ..tpu.rsa import HASH_LEN
 
         crv = _ES[alg]
         table = self._ec_tables[crv]
         if len(table.keys) > 255:
-            return self._run_ec_arrays(alg, idx, pb, pending, slow)
+            return self._run_ec_arrays(alg, idx, pb, pending, slow,
+                                       stats)
         hash_len = HASH_LEN[algs.HASH_FOR_ALG[alg]]
         rows = pb.kid_rows(idx, self._kid_ec_row[crv])
         if len(table.keys) == 1:
@@ -691,6 +757,7 @@ class TPUBatchKeySet(KeySet):
                 rec = _pack_es_record(pb, table, chunk, crows,
                                       hash_len, pad)
                 telemetry.count("h2d.bytes", rec.nbytes)
+                stats["h2d"] += rec.nbytes
                 ok_dev, deg_dev = tpuec.verify_es_packed_pending(
                     table, rec, hash_len, mesh=self._mesh)
             packed_parts.append(ok_dev)
@@ -711,7 +778,7 @@ class TPUBatchKeySet(KeySet):
 
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
                         pb, pending: List[tuple],
-                        slow: List[int],
+                        slow: List[int], stats: dict,
                         cls: Optional[int] = None) -> None:
         from ..tpu import rsa as tpursa
 
@@ -750,6 +817,10 @@ class TPUBatchKeySet(KeySet):
                 key_idx = np.zeros(pad, np.int32)
                 key_idx[:m] = crows
                 telemetry.count(f"device.{kind}.tokens", m)
+                h2d = (sig_mat.nbytes + sig_lens.nbytes
+                       + hash_mat.nbytes + key_idx.nbytes)
+                telemetry.count("h2d.bytes", h2d)
+                stats["h2d"] += h2d
                 with telemetry.span(f"dispatch.{kind}.{hash_name}"):
                     if kind == "rs":
                         fin = tpursa.verify_pkcs1v15_arrays_pending(
@@ -762,7 +833,8 @@ class TPUBatchKeySet(KeySet):
                 pending.append((chunk, m, fin))
 
     def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb,
-                       pending: List[tuple], slow: List[int]) -> None:
+                       pending: List[tuple], slow: List[int],
+                       stats: dict) -> None:
         from ..tpu import ec as tpuec
         from ..tpu.rsa import HASH_LEN
 
@@ -794,6 +866,10 @@ class TPUBatchKeySet(KeySet):
             key_idx = np.zeros(pad, np.int32)
             key_idx[:m] = crows
             telemetry.count("device.es.tokens", m)
+            h2d = (sig_mat.nbytes + sig_lens.nbytes + hash_mat.nbytes
+                   + key_idx.nbytes)
+            telemetry.count("h2d.bytes", h2d)
+            stats["h2d"] += h2d
             with telemetry.span(f"dispatch.es.{crv}"):
                 fin = tpuec.verify_ecdsa_arrays_pending(
                     table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
@@ -803,12 +879,13 @@ class TPUBatchKeySet(KeySet):
                        packed_parts: List[Any],
                        packed_meta: List[tuple],
                        pending: List[tuple],
-                       slow: List[int], results: List[Any]) -> None:
+                       slow: List[int], results: List[Any],
+                       stats: dict) -> None:
         from ..tpu import ed25519 as tpued
 
         table = self._ed_table
         if len(table.keys) > 255:
-            return self._run_ed_arrays(idx, pb, pending, slow)
+            return self._run_ed_arrays(idx, pb, pending, slow, stats)
         rows = pb.kid_rows(idx, self._kid_ed_row)
         if len(table.keys) == 1:
             rows = np.where(rows == -1, 0, rows)
@@ -834,6 +911,7 @@ class TPUBatchKeySet(KeySet):
             with telemetry.span("dispatch.ed25519"):
                 rec = tpued.ed_packed_records(table, sigs, msgs, key_idx)
                 telemetry.count("h2d.bytes", rec.nbytes)
+                stats["h2d"] += rec.nbytes
                 ok_dev = tpued.verify_ed_packed_pending(
                     table, rec, mesh=self._mesh)
             packed_parts.append(ok_dev)
@@ -844,7 +922,8 @@ class TPUBatchKeySet(KeySet):
             packed_meta.append(([pad], consume))
 
     def _run_ed_arrays(self, idx: np.ndarray, pb,
-                       pending: List[tuple], slow: List[int]) -> None:
+                       pending: List[tuple], slow: List[int],
+                       stats: dict) -> None:
         from ..tpu import ed25519 as tpued
 
         table = self._ed_table
@@ -870,6 +949,10 @@ class TPUBatchKeySet(KeySet):
             msgs += [b""] * fill
             key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
             telemetry.count("device.ed.tokens", m)
+            h2d = (sum(len(x) for x in sigs)
+                   + sum(len(x) for x in msgs) + key_idx.nbytes)
+            telemetry.count("h2d.bytes", h2d)
+            stats["h2d"] += h2d
             with telemetry.span("dispatch.ed25519"):
                 fin = tpued.verify_ed25519_batch_pending(
                     table, sigs, msgs, key_idx)

@@ -313,3 +313,32 @@ def test_resident_dispatchers_rejects_unroutable():
                                 kid="not-in-jwks")
     with pytest.raises(InvalidParameterError):
         resident_dispatchers(ks, toks + [stranger])
+
+
+def test_wire_adaptive_chunk_sizing():
+    """_chunk_tokens targets a TIME budget against the observed wire
+    rate: slow link -> smaller chunks (bounded per-chunk latency), fast
+    link -> the 8 MB clamp; a real batch updates the estimate."""
+    jwks, toks = captest.headline_fixtures(64)
+    ks = TPUBatchKeySet(jwks)
+    rec_width = 292                   # RS-2048 record bytes
+
+    default = ks._chunk_tokens(rec_width)      # no estimate: ~5 MB
+    ks._wire_bps = 6 * (1 << 20)               # 6 MB/s trough
+    slow = ks._chunk_tokens(rec_width)
+    # 6 MB/s * 250 ms = 1.5 MB -> ~4k tokens of 292 B (pow-2)
+    assert slow * rec_width <= int(1.5 * (1 << 20))
+    assert slow < default
+    ks._wire_bps = 100 * (1 << 20)             # fat co-located link
+    fast = ks._chunk_tokens(rec_width)
+    assert fast * rec_width <= (8 << 20)       # clamp
+    assert fast >= default
+
+    ks._wire_bps = None
+    out = ks.verify_batch(toks)
+    assert all(isinstance(r, dict) for r in out)
+    from cap_tpu.runtime import prep
+    if prep._load_native() is not None:
+        # the object fallback never dispatches device work, so the
+        # estimate only updates on the native batch path
+        assert ks._wire_bps is not None and ks._wire_bps > 0
