@@ -61,6 +61,7 @@ def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
                  wabh_ref, wabl_ref, wbah_ref, wbal_ref,
                  amodb_ref, bmoda_ref, invab_ref, invmib_ref,
                  cpA_ref, cpB_ref, oneA_ref, oneB_ref,
+                 c14a_ref, c14b_ref,
                  oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
                  deg_ref):
     mA = mA_ref[:]                       # [IA, 1]
@@ -85,13 +86,15 @@ def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
     def redc(pA, pB):
         sig = fixA(pA * sigc)
         q_B = _extend_in_kernel(sig, invA_f, wabh_ref[:], wabl_ref[:],
-                                mB, invB_f, amodb_ref[:], -1e-4)
-        qn = fixB(q_B * nB)
-        t_B = fixB(pB + qn)
+                                mB, invB_f, amodb_ref[:], -1e-4,
+                                c14b_ref[:])
+        # q·p + x < 2^28 — one fix covers the merged product-and-add
+        t_B = fixB(pB + q_B * nB)
         t_B = fixB(t_B * invab)
         sig2 = fixB(t_B * invmib)
         t_A = _extend_in_kernel(sig2, invB_f, wbah_ref[:], wbal_ref[:],
-                                mA, invA_f, bmoda_ref[:], 0.5 - 1e-4)
+                                mA, invA_f, bmoda_ref[:], 0.5 - 1e-4,
+                                c14a_ref[:])
         return t_A, t_B
 
     def rmul(a, b):
@@ -197,6 +200,8 @@ def _ctx_consts(c) -> tuple:
             np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
             np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
             one_a, one_b,
+            col((1 << 14) % np.asarray(c.A.m, np.int64)),
+            col((1 << 14) % np.asarray(c.B.m, np.int64)),
         )
         _CONSTS[key] = out
     return out
@@ -206,6 +211,7 @@ def _ctx_consts(c) -> tuple:
 def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
                mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
                amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
+               c14a, c14b,
                ia: int, ib: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -222,7 +228,7 @@ def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
                             memory_space=pltpu.VMEM)
 
     consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
-              invab, invmib, cpA, cpB, oneA, oneB)
+              invab, invmib, cpA, cpB, oneA, oneB, c14a, c14b)
     outs = (jax.ShapeDtypeStruct((ia, n), I32),
             jax.ShapeDtypeStruct((ib, n), I32)) * 3 + \
         (jax.ShapeDtypeStruct((1, n), I32),)

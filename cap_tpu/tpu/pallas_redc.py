@@ -14,9 +14,12 @@ per-channel (EC/Ed) contexts via CAP_TPU_PALLAS=1; A/B numbers in
 docs/PERF.md. The RSA REDC (per-token key constants) stays on the XLA
 path.
 
-Numerical contract: identical to rns._redc. The Barrett fixes tolerate
-±2 quotient error, so deriving 1/m in f32 in-kernel (vs the host's
-f64→f32 constant) stays exact.
+Numerical contract: identical to rns._redc. The Barrett quotient
+guess is within ±1 of floor(v/m) for v < 2^31 (see _fix), and the two
+conditional corrections consume exactly that margin — deriving 1/m in
+f32 in-kernel (vs the host's f64→f32 constant) adds ≤ 2^-24 relative
+error, already inside the ±1 analysis. There is NO spare quotient
+slack: any new operation that widens v past 2^31 needs its own bound.
 """
 
 from __future__ import annotations
@@ -44,19 +47,37 @@ def enabled() -> bool:
 
 
 def _fix(v, m, inv_f):
-    """Exact v mod m for 0 <= v < 2^31 (rns._mod_fix)."""
+    """Exact v mod m for 0 <= v < 2^31 (rns._mod_fix).
+
+    ONE correction each way suffices: the f32 quotient guess is within
+    ±1 of floor(v/m) — |f32(v) − v| ≤ ulp(2^31)/2 = 128 contributes
+    ≤ 128/m ≤ 2^-5 after ×(1/m) (m ≥ 2^12), the 1/m rounding
+    ≤ (v/m)·2^-24 ≤ 2^-5, and the product rounding ≤ ulp(2^19)/2
+    = 2^-5 — total ≤ 0.094 < 1, so r = v − q·m lands in (−m, 2m).
+    """
     q = jnp.floor(v.astype(F32) * inv_f).astype(I32)
     r = v - q * m
     r = jnp.where(r < 0, r + m, r)
-    r = jnp.where(r < 0, r + m, r)
-    r = jnp.where(r >= m, r - m, r)
     r = jnp.where(r >= m, r - m, r)
     return r
 
 
 def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
-                      src_prod_mod_dst, offset):
-    """rns._extend on VMEM tiles: [I_src, T] -> [I_dst, T]."""
+                      src_prod_mod_dst, offset, c14):
+    """rns._extend on VMEM tiles: [I_src, T] -> [I_dst, T].
+
+    Recombination bounds (EC/Ed contexts: I ≤ ~25 channels of 13-bit
+    primes): hh/mid/ll ≤ 2I·127² < 2^20; 2^7 mod m = 128 EXACTLY
+    (m ≥ 2^12), so mid·128 + ll < 2^28 needs no per-term fixes; only
+    hh (weight 2^14 > m) reduces first. α ∈ [-1, I_src], so its mod-m
+    adjust is one select, not an integer division; c14 = 2^14 mod m
+    arrives as a host constant.
+    """
+    # Structural overflow guard (shapes are static at trace time):
+    # fix(hh)·c14 + mid·128 + ll < 2^28 + I·16129·257 stays below 2^31
+    # only for I ≤ 448 — ample for per-channel contexts (P-521 ≈ 43),
+    # but any future reuse beyond that must restore per-term fixes.
+    assert sig.shape[0] <= 448, "extension recombination would overflow"
     j = wh.shape[0]
     t = sig.shape[1]
     w_cat = jnp.concatenate([wh, wl], axis=0)              # [2J, I]
@@ -69,19 +90,17 @@ def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
     alpha = jnp.floor(
         jnp.sum(sig.astype(F32) * inv_src_f, axis=0, keepdims=True)
         + offset).astype(I32)                              # [1, T]
-    c14 = jnp.mod(jnp.full_like(m_dst, 1 << 14), m_dst)
-    c7 = jnp.mod(jnp.full_like(m_dst, 1 << 7), m_dst)
     comb = _fix(_fix(hh, m_dst, inv_dst_f) * c14
-                + _fix(mid, m_dst, inv_dst_f) * c7
-                + _fix(ll, m_dst, inv_dst_f), m_dst, inv_dst_f)
-    corr = _fix(jnp.mod(alpha, m_dst)
-                * jnp.mod(src_prod_mod_dst, m_dst), m_dst, inv_dst_f)
+                + mid * 128 + ll, m_dst, inv_dst_f)
+    alpha_adj = jnp.where(alpha < 0, alpha + m_dst, alpha)
+    corr = _fix(alpha_adj * src_prod_mod_dst, m_dst, inv_dst_f)
     return _fix(comb - corr + m_dst, m_dst, inv_dst_f)
 
 
 def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
                  wabh_ref, wabl_ref, wbah_ref, wbal_ref,
                  amodb_ref, bmoda_ref, invab_ref, invmib_ref,
+                 c14a_ref, c14b_ref,
                  tA_ref, tB_ref):
     xA = xA_ref[:]
     xB = xB_ref[:]
@@ -92,13 +111,15 @@ def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
 
     sig = _fix(xA * sigc_ref[:], mA, invA_f)
     q_B = _extend_in_kernel(sig, invA_f, wabh_ref[:], wabl_ref[:],
-                            mB, invB_f, amodb_ref[:], -1e-4)
-    qn = _fix(q_B * nB_ref[:], mB, invB_f)
-    t_B = _fix(xB + qn, mB, invB_f)
+                            mB, invB_f, amodb_ref[:], -1e-4,
+                            c14b_ref[:])
+    # q·n + x < 2^28 — one fix covers the merged product-and-add
+    t_B = _fix(xB + q_B * nB_ref[:], mB, invB_f)
     t_B = _fix(t_B * invab_ref[:], mB, invB_f)
     sig2 = _fix(t_B * invmib_ref[:], mB, invB_f)
     t_A = _extend_in_kernel(sig2, invB_f, wbah_ref[:], wbal_ref[:],
-                            mA, invA_f, bmoda_ref[:], 0.5 - 1e-4)
+                            mA, invA_f, bmoda_ref[:], 0.5 - 1e-4,
+                            c14a_ref[:])
     tA_ref[:] = t_A
     tB_ref[:] = t_B
 
@@ -123,6 +144,8 @@ def _ctx_consts(c) -> tuple:
             col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
             w_ab[0], w_ab[1], w_ba[0], w_ba[1],
             col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
+            col((1 << 14) % np.asarray(c.A.m, np.int64)),
+            col((1 << 14) % np.asarray(c.B.m, np.int64)),
         )
         _CONST_CACHE[key] = out
     return out
@@ -130,7 +153,8 @@ def _ctx_consts(c) -> tuple:
 
 @partial(jax.jit, static_argnames=("ia", "ib"))
 def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
-               amodb, bmoda, invab, invmib, ia: int, ib: int):
+               amodb, bmoda, invab, invmib, c14a, c14b,
+               ia: int, ib: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -146,7 +170,7 @@ def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
                             memory_space=pltpu.VMEM)
 
     consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
-              invab, invmib)
+              invab, invmib, c14a, c14b)
     return pl.pallas_call(
         _redc_kernel,
         out_shape=(jax.ShapeDtypeStruct((ia, n), I32),

@@ -14,8 +14,8 @@ This module restructures modexp so the heavy lifting IS a matmul:
 - exactness on a bf16/f32 MXU: every 13-bit operand is split into
   7-bit halves, giving four bf16 matmuls whose f32 accumulations stay
   below 2^24 (integer-exact); channel reductions use Barrett
-  guess-then-fix (f32 picks the quotient, i32 computes the exact
-  remainder, two conditional corrections);
+  guess-then-fix (f32 picks the quotient to within ±1, i32 computes
+  the exact remainder, one conditional correction each way);
 - the A→B extension runs with floor-approximated α (error ∈ {-1, 0} —
   a bounded extra multiple of A that the value bound absorbs); the
   B→A extension adds the Kawamura 0.5 offset, which is EXACT here
@@ -231,12 +231,17 @@ class RNSKeyTable:
 
 def _mod_fix(x: jnp.ndarray, m: jnp.ndarray, m_f: jnp.ndarray,
              inv_f: jnp.ndarray) -> jnp.ndarray:
-    """Exact x mod m for 0 ≤ x < 2^31: f32 Barrett guess, i32 fix."""
+    """Exact x mod m for 0 ≤ x < 2^31: f32 Barrett guess, i32 fix.
+
+    One correction each way: the f32 quotient guess is within ±1 of
+    floor(x/m) — |f32(x) − x| ≤ ulp(2^31)/2 = 128 contributes
+    ≤ 128/m ≤ 2^-5 (m ≥ 2^12), the 1/m constant's rounding
+    ≤ (x/m)·2^-24 ≤ 2^-5, the product's rounding ≤ ulp(2^19)/2
+    = 2^-5 — total ≤ 0.094 < 1, so r = x − q·m ∈ (−m, 2m).
+    """
     q = jnp.floor(x.astype(F32) * inv_f).astype(I32)
     r = x - q * m
     r = jnp.where(r < 0, r + m, r)
-    r = jnp.where(r < 0, r + m, r)
-    r = jnp.where(r >= m, r - m, r)
     r = jnp.where(r >= m, r - m, r)
     return r
 
@@ -281,11 +286,20 @@ def _extend(sig: jnp.ndarray, src_dev, dst_dev, w_pair,
         return _mod_fix(v, m, m_f, inv_f)
 
     c14 = (1 << 14) % m
-    c7 = (1 << 7) % m
-    comb = fix(fix(hh) * c14 + fix(mid) * c7 + fix(ll))
-    # α ∈ [-1, I_src]: the -1 case (floor undershoot at q ≈ 0) must wrap
-    # modularly — jnp.mod gives the non-negative residue.
-    corr = fix(jnp.mod(alpha[None, :], m) * (src_prod_mod_dst[:, None] % m))
+    i_src = sig.shape[0]
+    if i_src <= 448:
+        # 2^7 mod m = 128 EXACTLY (m ≥ 2^12), so mid/ll skip their
+        # per-term fixes: fix(hh)·c14 < 2^28, mid·128 ≤ 2I·127²·128,
+        # ll ≤ I·127² — the sum stays < 2^31 for I ≤ 448 (covers
+        # every context through 4096-bit moduli).
+        comb = fix(fix(hh) * c14 + mid * 128 + ll)
+    else:
+        comb = fix(fix(hh) * c14 + fix(mid) * 128 + fix(ll))
+    # α ∈ [-1, I_src]: only the -1 case (floor undershoot at q ≈ 0)
+    # needs the modular wrap — a select, not an integer division.
+    alpha_adj = jnp.where(alpha < 0, alpha[None, :] + m,
+                          alpha[None, :])
+    corr = fix(alpha_adj * src_prod_mod_dst[:, None])
     return fix(comb - corr + m)
 
 
@@ -299,8 +313,8 @@ def _redc(x_A, x_B, sig_c, n_B, ctx_consts):
 
     sig = _mod_fix(x_A * sig_c, mA, mA_f, invA_f)
     q_B = _extend(sig, dA, dB, W_AB, Amod_B, offset=-1e-4)
-    qn = _mod_fix(q_B * n_B, mB, mB_f, invB_f)
-    t_B = _mod_fix(x_B + qn, mB, mB_f, invB_f)
+    # q·n + x < 2^28: one fix covers the merged product-and-add
+    t_B = _mod_fix(x_B + q_B * n_B, mB, mB_f, invB_f)
     t_B = _mod_fix(t_B * invA_B[:, None], mB, mB_f, invB_f)
     sig2 = _mod_fix(t_B * dB["inv_Mi"][:, None], mB, mB_f, invB_f)
     t_A = _extend(sig2, dB, dA, W_BA, Bmod_A, offset=0.5 - 1e-4)
