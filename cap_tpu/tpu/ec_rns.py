@@ -93,13 +93,11 @@ def ctx_for(crv: str, w_bits: Optional[int] = None) -> ECRNSContext:
 # ---------------------------------------------------------------------------
 
 def _fixA(c, x):
-    return _mod_fix(x, c.dA["m"][:, None], c.dA["m_f"][:, None],
-                    c.dA["inv_f"][:, None])
+    return _mod_fix(x, c.dA["m"][:, None], c.dA["inv_f"][:, None])
 
 
 def _fixB(c, x):
-    return _mod_fix(x, c.dB["m"][:, None], c.dB["m_f"][:, None],
-                    c.dB["inv_f"][:, None])
+    return _mod_fix(x, c.dB["m"][:, None], c.dB["inv_f"][:, None])
 
 
 def _redc_dispatch(c: ECRNSContext, pA, pB):
@@ -191,9 +189,8 @@ def congruent_zero_probe(c: ECRNSContext, x, max_c: int, nch: int = 2):
     the final acceptance check keeps the exact ``congruent_zero``.
     """
     mch = c.dA["m"][:nch, None]
-    mfch = c.dA["m_f"][:nch, None]
     ifch = c.dA["inv_f"][:nch, None]
-    xa = _mod_fix(x[0][:nch], mch, mfch, ifch)
+    xa = _mod_fix(x[0][:nch], mch, ifch)
     ok = jnp.zeros(xa.shape[1], bool)
     for cc in range(max_c):
         ok = ok | jnp.all(xa == c.cp_A[cc][:nch, None], axis=0)

@@ -229,7 +229,7 @@ class RNSKeyTable:
 # Device kernels
 # ---------------------------------------------------------------------------
 
-def _mod_fix(x: jnp.ndarray, m: jnp.ndarray, m_f: jnp.ndarray,
+def _mod_fix(x: jnp.ndarray, m: jnp.ndarray,
              inv_f: jnp.ndarray) -> jnp.ndarray:
     """Exact x mod m for 0 ≤ x < 2^31: f32 Barrett guess, i32 fix.
 
@@ -279,11 +279,10 @@ def _extend(sig: jnp.ndarray, src_dev, dst_dev, w_pair,
         jnp.sum(sig.astype(F32) * src_dev["inv_f"][:, None], axis=0)
         + offset).astype(I32)                       # [N]
     m = dst_dev["m"][:, None]
-    m_f = dst_dev["m_f"][:, None]
     inv_f = dst_dev["inv_f"][:, None]
 
     def fix(v):
-        return _mod_fix(v, m, m_f, inv_f)
+        return _mod_fix(v, m, inv_f)
 
     c14 = (1 << 14) % m
     i_src = sig.shape[0]
@@ -306,26 +305,22 @@ def _extend(sig: jnp.ndarray, src_dev, dst_dev, w_pair,
 def _redc(x_A, x_B, sig_c, n_B, ctx_consts):
     """One RNS Montgomery reduction: x → x·A⁻¹ mod n (value < 3n)."""
     (dA, dB, W_AB, W_BA, Amod_B, Bmod_A, invA_B) = ctx_consts
-    mA, mA_f, invA_f = dA["m"][:, None], dA["m_f"][:, None], \
-        dA["inv_f"][:, None]
-    mB, mB_f, invB_f = dB["m"][:, None], dB["m_f"][:, None], \
-        dB["inv_f"][:, None]
+    mA, invA_f = dA["m"][:, None], dA["inv_f"][:, None]
+    mB, invB_f = dB["m"][:, None], dB["inv_f"][:, None]
 
-    sig = _mod_fix(x_A * sig_c, mA, mA_f, invA_f)
+    sig = _mod_fix(x_A * sig_c, mA, invA_f)
     q_B = _extend(sig, dA, dB, W_AB, Amod_B, offset=-1e-4)
     # q·n + x < 2^28: one fix covers the merged product-and-add
-    t_B = _mod_fix(x_B + q_B * n_B, mB, mB_f, invB_f)
-    t_B = _mod_fix(t_B * invA_B[:, None], mB, mB_f, invB_f)
-    sig2 = _mod_fix(t_B * dB["inv_Mi"][:, None], mB, mB_f, invB_f)
+    t_B = _mod_fix(x_B + q_B * n_B, mB, invB_f)
+    t_B = _mod_fix(t_B * invA_B[:, None], mB, invB_f)
+    sig2 = _mod_fix(t_B * dB["inv_Mi"][:, None], mB, invB_f)
     t_A = _extend(sig2, dB, dA, W_BA, Bmod_A, offset=0.5 - 1e-4)
     return t_A, t_B
 
 
 def _mul_redc(aA, aB, bA, bB, sig_c, n_B, ctx_consts, dA, dB):
-    pA = _mod_fix(aA * bA, dA["m"][:, None], dA["m_f"][:, None],
-                  dA["inv_f"][:, None])
-    pB = _mod_fix(aB * bB, dB["m"][:, None], dB["m_f"][:, None],
-                  dB["inv_f"][:, None])
+    pA = _mod_fix(aA * bA, dA["m"][:, None], dA["inv_f"][:, None])
+    pB = _mod_fix(aB * bB, dB["m"][:, None], dB["inv_f"][:, None])
     return _redc(pA, pB, sig_c, n_B, ctx_consts)
 
 
@@ -347,11 +342,10 @@ def _limbs_to_rns(limbs: jnp.ndarray, t_pair, dev) -> jnp.ndarray:
     lh2 = mm(tl, lh)     # weight 2^8
     ll2 = mm(tl, ll)     # weight 2^0
     m = dev["m"][:, None]
-    m_f = dev["m_f"][:, None]
     inv_f = dev["inv_f"][:, None]
 
     def fix(v):
-        return _mod_fix(v, m, m_f, inv_f)
+        return _mod_fix(v, m, inv_f)
 
     c15 = (1 << 15) % m
     c8 = (1 << 8) % m
@@ -500,7 +494,7 @@ class RNSToLimbs:
         from . import bignum as B
 
         sig = _mod_fix(x_a * self.inv_Mi[:, None], self.m[:, None],
-                       self.m_f[:, None], self.minv_f[:, None])
+                       self.minv_f[:, None])
         alpha = jnp.floor(
             jnp.sum(sig.astype(F32) * self.inv_f[:, None], axis=0)
             + 0.5).astype(I32)                        # exact: value ≪ A
@@ -559,13 +553,12 @@ def _rns_verify_core(ctx: RNSContext, s_limbs, expected_limbs,
 
     eB = _limbs_to_rns(expected_limbs, ctx.T_B, dB)
     mB = dB["m"][:, None]
-    mB_f = dB["m_f"][:, None]
     invB_f = dB["inv_f"][:, None]
     ok = jnp.zeros(s_limbs.shape[1], bool)
     shifted = eB
     for _ in range(3):                      # c = 0, 1, 2 (result < 3n)
         ok = ok | jnp.all(xB == shifted, axis=0)
-        shifted = _mod_fix(shifted + n_B, mB, mB_f, invB_f)
+        shifted = _mod_fix(shifted + n_B, mB, invB_f)
     return ok
 
 

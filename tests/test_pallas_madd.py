@@ -60,3 +60,80 @@ def test_fused_madd_matches_xla_path(monkeypatch):
 
     assert list(ok_xla) == list(ok_fused)
     assert list(ok_xla) == [True, True, False, False, False, False]
+
+
+@pytest.mark.heavy
+def test_compiled_mosaic_parity_on_chip():
+    """The COMPILED Mosaic kernel vs the XLA path on the real chip.
+
+    The interpret-mode test above pins the kernel's arithmetic; a
+    Mosaic miscompile would only surface as a mysterious bench error
+    (VERDICT r3 #5). This runs the same accept/tamper/range-reject
+    vectors through both paths on the attached TPU in a subprocess
+    (the suite's conftest pins this process to the CPU mesh), and
+    diffs the verdict vectors bitwise. Auto-skips without a TPU.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = r"""
+import json, sys, hashlib
+sys.path.insert(0, %r)
+import jax
+if jax.default_backend() in ("cpu",):
+    print(json.dumps({"skip": "no TPU backend"})); sys.exit(0)
+import numpy as np
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec as cec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature)
+from cap_tpu.tpu.ec import ECKeyTable, curve, verify_ecdsa_batch
+from cap_tpu.tpu import ec_rns
+import os
+
+privs = [cec.generate_private_key(cec.SECP256R1()) for _ in range(2)]
+msg = b"mosaic parity"
+digest = hashlib.sha256(msg).digest()
+sigs, rows = [], []
+for i, p in enumerate(privs):
+    r, s = decode_dss_signature(p.sign(msg, cec.ECDSA(hashes.SHA256())))
+    sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    rows.append(i)
+bad = bytearray(sigs[0]); bad[-1] ^= 1
+sigs.append(bytes(bad)); rows.append(0)
+bad = bytearray(sigs[0]); bad[0] ^= 0x80
+sigs.append(bytes(bad)); rows.append(0)
+sigs.append(b"\x00" * 64); rows.append(0)
+n_int = curve("P-256").n
+sigs.append(sigs[0][:32] + (n_int - 1).to_bytes(32, "big")); rows.append(0)
+digests = [digest] * len(sigs)
+rows = np.asarray(rows, np.int32)
+
+os.environ["CAP_TPU_RNS"] = "1"
+# the baseline must be the true XLA path: a fused-REDC env flag would
+# route BOTH runs through Mosaic and make the diff vacuous
+os.environ["CAP_TPU_PALLAS"] = "0"
+os.environ["CAP_TPU_PALLAS_MADD"] = "0"
+table = ECKeyTable("P-256", [p.public_key() for p in privs])
+ok_xla = [bool(v) for v in verify_ecdsa_batch(table, sigs, digests, rows)]
+
+os.environ["CAP_TPU_PALLAS_MADD"] = "1"
+ec_rns._ecdsa_rns_core.clear_cache()
+table2 = ECKeyTable("P-256", [p.public_key() for p in privs])
+ok_mosaic = [bool(v)
+             for v in verify_ecdsa_batch(table2, sigs, digests, rows)]
+print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic}))
+""" % (repo,)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "CAP_TPU_"))}
+    res = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert res.returncode == 0, res.stderr[-2000:]
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    if "skip" in out:
+        pytest.skip(out["skip"])
+    assert out["xla"] == out["mosaic"], out
+    assert out["xla"] == [True, True, False, False, False, False], out
