@@ -45,12 +45,78 @@ class VerifyClient:
         if not tokens:
             return []
         protocol.send_request(self._sock, tokens)
+        return self._read_response(len(tokens))
+
+    def verify_stream(self, batches, depth: int = 4):
+        """Pipelined requests: up to ``depth`` frames in flight.
+
+        Yields each batch's results in request order (CVB1 correlates
+        by order). The worker reads eagerly, so while batch k verifies
+        on the device, batches k+1.. are already crossing the wire and
+        queueing in its batcher — the serve-path analog of
+        ``TPUBatchKeySet.verify_stream`` (VERDICT r3 #7). A sender
+        thread writes frames so a full send buffer can never deadlock
+        against the unread responses.
+
+        Leaving the stream early (break / exception) POISONS the
+        client: in-flight responses would otherwise be misattributed
+        to later requests (order is the only correlation), so the
+        socket is closed and any further call raises.
+        """
+        import queue
+        import threading
+
+        sent: "queue.Queue" = queue.Queue()
+        slots = threading.Semaphore(depth)
+        stop = threading.Event()
+        send_err: List[BaseException] = []
+
+        def sender() -> None:
+            try:
+                for toks in batches:
+                    toks = list(toks)
+                    while not slots.acquire(timeout=0.25):
+                        if stop.is_set():
+                            return
+                    if stop.is_set():
+                        return
+                    if toks:
+                        protocol.send_request(self._sock, toks)
+                    sent.put(len(toks))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                send_err.append(e)
+            finally:
+                sent.put(None)
+
+        t = threading.Thread(target=sender, daemon=True,
+                             name="cap-tpu-client-send")
+        t.start()
+        clean = False
+        try:
+            while True:
+                n = sent.get()
+                if n is None:
+                    if send_err:
+                        raise send_err[0]
+                    clean = True
+                    return
+                out = self._read_response(n) if n else []
+                slots.release()
+                yield out
+        finally:
+            stop.set()
+            if not clean:
+                # abandoned or failed mid-stream: unread responses are
+                # on the wire — the connection cannot be reused
+                self.close()
+
+    def _read_response(self, n_tokens: int) -> List[Any]:
         ftype, entries = protocol.recv_frame(self._sock)
         if ftype != protocol.T_VERIFY_RESP:
             raise protocol.ProtocolError(f"expected response, got {ftype}")
-        if len(entries) != len(tokens):
+        if len(entries) != n_tokens:
             raise protocol.ProtocolError(
-                f"response count {len(entries)} != request {len(tokens)}")
+                f"response count {len(entries)} != request {n_tokens}")
         out: List[Any] = []
         for status, payload in entries:
             if status == 0:

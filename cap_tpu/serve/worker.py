@@ -84,11 +84,28 @@ class VerifyWorker:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="cap-tpu-conn").start()
 
+    # Outstanding frames per connection before the reader stops reading
+    # (backpressure then propagates to the client through TCP). Bounds
+    # the memory a frame-spamming client can pin.
+    _MAX_INFLIGHT = 64
+
     def _serve_conn(self, conn: socket.socket) -> None:
+        import queue as q
+
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass  # UDS
+        # Reader/responder split: this thread KEEPS READING frames while
+        # earlier submissions verify, so a client may pipeline several
+        # requests on one connection; responses return strictly in
+        # request order (CVB1 has no request ids — order IS the
+        # correlation).
+        respq: "q.Queue" = q.Queue(maxsize=self._MAX_INFLIGHT)
+        responder = threading.Thread(
+            target=self._respond_loop, args=(conn, respq),
+            daemon=True, name="cap-tpu-respond")
+        responder.start()
         try:
             while True:
                 try:
@@ -102,16 +119,43 @@ class VerifyWorker:
                     telemetry.count("worker.protocol_errors")
                     return
                 if ftype == protocol.T_PING:
-                    protocol.send_pong(conn)
+                    respq.put(("pong", None))
                     continue
                 if ftype != protocol.T_VERIFY_REQ:
                     return  # protocol violation → drop the connection
                 telemetry.count("worker.requests")
                 telemetry.count("worker.tokens", len(entries))
-                results = self._batcher.submit(entries)
-                protocol.send_response(conn, results)
+                respq.put(("batch", self._batcher.submit_nowait(entries)))
         finally:
+            respq.put(None)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    @staticmethod
+    def _respond_loop(conn: socket.socket, respq) -> None:
+        broken = False
+        while True:
+            item = respq.get()
+            if item is None:
+                return
+            if broken:
+                continue              # discard; reader is winding down
+            kind, pending = item
+            try:
+                if kind == "pong":
+                    protocol.send_pong(conn)
+                else:
+                    pending.event.wait()
+                    protocol.send_response(conn, pending.results)
+            except (ConnectionError, OSError):
+                # Connection broke mid-response: close it so the reader
+                # unblocks out of recv, then keep DRAINING until the
+                # reader's final None — exiting early would leave the
+                # reader wedged in a full-queue put().
+                broken = True
+                try:
+                    conn.close()
+                except OSError:
+                    pass

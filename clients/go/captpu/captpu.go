@@ -85,6 +85,10 @@ type TPUBatchKeySet struct {
 
 	// DialTimeout bounds redials (default 10s).
 	DialTimeout time.Duration
+
+	// PipelineDepth caps the frames VerifyBatches keeps in flight
+	// (default 8 when zero).
+	PipelineDepth int
 }
 
 // NewTPUBatchKeySet connects to a verify worker. addr is "host:port"
@@ -199,6 +203,87 @@ func (k *TPUBatchKeySet) VerifyBatch(ctx context.Context, tokens []string) ([]Re
 		return nil, err
 	}
 	return res, nil
+}
+
+// VerifyBatches pipelines several batches over the one connection:
+// request frames are written ahead (up to PipelineDepth outstanding)
+// by a sender goroutine while responses stream back in request order
+// (CVB1 has no request ids — order is the correlation; the worker
+// reads eagerly and answers in order). Throughput is then bounded by
+// the worker's batcher, not by one round trip per batch. results[i]
+// corresponds to batches[i]; a non-nil error poisons the connection.
+func (k *TPUBatchKeySet) VerifyBatches(ctx context.Context, batches [][]string) ([][]Result, error) {
+	k.mu.Lock()
+	defer k.mu.Unlock()
+	if k.closed {
+		return nil, ErrClosed
+	}
+	if err := k.ensureConn(); err != nil {
+		return nil, err
+	}
+	if dl, ok := ctx.Deadline(); ok {
+		k.conn.SetDeadline(dl)
+		defer k.conn.SetDeadline(time.Time{})
+	}
+
+	depth := k.PipelineDepth
+	if depth <= 0 {
+		depth = 8
+	}
+	// Encode every frame BEFORE the first write: encodeRequest is pure
+	// local validation, so an oversized token in a late batch must
+	// fail the call up front — not poison a healthy connection after
+	// most batches already completed.
+	frames := make([][]byte, len(batches))
+	for i, toks := range batches {
+		frame, err := encodeRequest(toks)
+		if err != nil {
+			return nil, err
+		}
+		frames[i] = frame
+	}
+	conn := k.conn
+	slots := make(chan struct{}, depth)
+	stop := make(chan struct{})
+	defer close(stop)
+	var sendErr error
+	var sendMu sync.Mutex
+	go func() {
+		for _, frame := range frames {
+			select {
+			case slots <- struct{}{}:
+			case <-stop:
+				return
+			}
+			if _, err := conn.Write(frame); err != nil {
+				sendMu.Lock()
+				sendErr = err
+				sendMu.Unlock()
+				// Unblock the reader: it is mid-ReadFull on a
+				// response that will never come.
+				conn.Close()
+				return
+			}
+		}
+	}()
+
+	out := make([][]Result, 0, len(batches))
+	for i := range batches {
+		res, err := decodeResponse(k.conn, len(batches[i]))
+		if err != nil {
+			k.poison()
+			sendMu.Lock()
+			se := sendErr
+			sendMu.Unlock()
+			if se != nil {
+				return nil, fmt.Errorf("captpu: pipelined send: %w", se)
+			}
+			return nil, err
+		}
+		out = append(out, res)
+		<-slots
+	}
+	return out, nil
 }
 
 func (k *TPUBatchKeySet) ensureConn() error {

@@ -19,7 +19,6 @@ import json
 import math
 import os
 import sys
-import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -38,58 +37,100 @@ def _quantile(sorted_vals, q):
     return sorted_vals[max(0, math.ceil(q * len(sorted_vals)) - 1)]
 
 
+def _client_proc(host, port, tokens, req_tokens, depth, start_at,
+                 seconds, seed, outq):
+    """One client PROCESS: its own interpreter, so response decoding
+    never shares the worker's (or other clients') GIL — in-process
+    client threads cap the whole bench at one core of json parsing
+    (measured: ~15k verifies/s regardless of depth or batch knobs)."""
+    from collections import deque
+
+    from cap_tpu.serve.client import VerifyClient
+
+    # generous timeout: first flushes of a fresh shape bucket can hit
+    # an XLA compile (~40s over the tunnel) before the cache warms
+    cl = VerifyClient(host, port, timeout=180.0)
+    t0s: deque = deque()
+    lats = []
+    done = 0
+    while time.time() < start_at:
+        time.sleep(0.005)
+    deadline = time.time() + seconds
+
+    def gen():
+        rng = seed * 7919 + 17
+        while time.time() < deadline:
+            rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
+            lo = rng % max(1, len(tokens) - req_tokens)
+            t0s.append(time.perf_counter())
+            yield tokens[lo: lo + req_tokens]
+
+    err = None
+    try:
+        # depth > 1: the client keeps frames in flight, so request
+        # latency includes pipeline queueing — the honest number a
+        # pipelining caller experiences.
+        for out in cl.verify_stream(gen(), depth=depth):
+            in_window = time.time() < deadline
+            lats.append(time.perf_counter() - t0s.popleft())
+            bad = sum(1 for r in out if isinstance(r, Exception))
+            assert bad == 0, f"unexpected failures: {bad}"
+            if in_window:
+                done += len(out)
+    except BaseException as e:  # noqa: BLE001 - reported to the parent
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        cl.close()
+        # ALWAYS report, error or not — a silent child death would
+        # stall the parent's collection for its full timeout
+        outq.put((done, lats, err))
+
+
 def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
               req_tokens: int, seconds: float,
-              target_batch: int) -> dict:
-    from cap_tpu.serve.client import VerifyClient
+              target_batch: int, depth: int = 1) -> dict:
+    import multiprocessing as mp
+
     from cap_tpu.serve.worker import VerifyWorker
 
     worker = VerifyWorker(keyset, target_batch=target_batch,
                           max_wait_ms=max_wait_ms)
     host, port = worker.address
-    lat_per_thread = [[] for _ in range(n_clients)]
-    done = [0] * n_clients
-    stop = threading.Event()
+    # spawn (not fork): children must never inherit live TPU/jax state
+    ctx = mp.get_context("spawn")
+    outq = ctx.Queue()
+    start_at = time.time() + max(4.0, n_clients * 0.15)  # spawn lag
+    procs = [ctx.Process(
+        target=_client_proc,
+        args=(host, port, tokens, req_tokens, depth, start_at,
+              seconds, i, outq), daemon=True)
+        for i in range(n_clients)]
+    for p in procs:
+        p.start()
+    total = 0
+    lats = []
+    errors = []
+    try:
+        for _ in procs:
+            d, ls, err = outq.get(timeout=seconds + 300)
+            total += d
+            lats.extend(ls)
+            if err:
+                errors.append(err)
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        worker.close()
+    if errors:
+        raise RuntimeError(f"client processes failed: {errors[:3]}")
 
-    def client_loop(ti: int) -> None:
-        # generous timeout: first flushes of a fresh shape bucket can
-        # hit an XLA compile (~40s over the tunnel) before the cache
-        # warms
-        cl = VerifyClient(host, port, timeout=180.0)
-        rng = ti * 7919
-        try:
-            while not stop.is_set():
-                rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
-                lo = rng % max(1, len(tokens) - req_tokens)
-                req = tokens[lo: lo + req_tokens]
-                t0 = time.perf_counter()
-                out = cl.verify_batch(req)
-                lat_per_thread[ti].append(time.perf_counter() - t0)
-                bad = sum(1 for r in out if isinstance(r, Exception))
-                assert bad == 0, f"unexpected failures: {bad}"
-                done[ti] += len(req)
-        finally:
-            cl.close()
-
-    threads = [threading.Thread(target=client_loop, args=(i,),
-                                daemon=True) for i in range(n_clients)]
-    t_start = time.perf_counter()
-    for t in threads:
-        t.start()
-    time.sleep(seconds)
-    stop.set()
-    for t in threads:
-        t.join(timeout=30)
-    elapsed = time.perf_counter() - t_start
-    worker.close()
-
-    lats = sorted(x for sub in lat_per_thread for x in sub)
-    total = sum(done)
+    lats.sort()
     return {
         "max_wait_ms": max_wait_ms,
         "clients": n_clients,
         "req_tokens": req_tokens,
-        "throughput": round(total / elapsed, 1),
+        "pipeline_depth": depth,
+        "throughput": round(total / seconds, 1),
         "requests": len(lats),
         "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
         "p95_ms": round(_quantile(lats, 0.95) * 1e3, 1),
@@ -110,6 +151,8 @@ def main() -> None:
     waits = [float(w) for w in
              os.environ.get("CAP_SERVE_WAITS", "1,5,20").split(",")]
     target_batch = int(os.environ.get("CAP_SERVE_TARGET_BATCH", 8192))
+    depths = [int(d) for d in
+              os.environ.get("CAP_SERVE_DEPTHS", "1,2").split(",")]
 
     from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
 
@@ -124,13 +167,16 @@ def main() -> None:
 
     points = []
     for w in waits:
-        pt = run_point(ks, tokens, w, n_clients, req_tokens, seconds,
-                       target_batch)
-        points.append(pt)
-        print(f"max_wait={w:5.1f}ms  thr={pt['throughput']:>9.0f}/s  "
-              f"p50={pt['p50_ms']:6.1f}ms p95={pt['p95_ms']:7.1f}ms "
-              f"p99={pt['p99_ms']:7.1f}ms  reqs={pt['requests']}",
-              file=sys.stderr)
+        for depth in depths:
+            pt = run_point(ks, tokens, w, n_clients, req_tokens,
+                           seconds, target_batch, depth=depth)
+            points.append(pt)
+            print(f"max_wait={w:5.1f}ms depth={depth}  "
+                  f"thr={pt['throughput']:>9.0f}/s  "
+                  f"p50={pt['p50_ms']:6.1f}ms "
+                  f"p95={pt['p95_ms']:7.1f}ms "
+                  f"p99={pt['p99_ms']:7.1f}ms  reqs={pt['requests']}",
+                  file=sys.stderr)
 
     best = max(points, key=lambda p: p["throughput"])
     print(json.dumps({
