@@ -29,6 +29,76 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from cap_tpu.oidc import Config, Provider, Request, S256Verifier  # noqa: E402
 from cap_tpu.oidc.callback import SingleRequestReader, auth_code, implicit  # noqa: E402
 
+# Real success page, like the reference CLI's responses.go: the browser
+# tab a human lands on after login deserves more than a bare <h1>.
+SUCCESS_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+  <meta charset="UTF-8">
+  <meta name="viewport" content="width=device-width, initial-scale=1">
+  <title>Signed in</title>
+  <style>
+    body { margin: 0; font: 15px/1.5 system-ui, sans-serif;
+           background: #f4f6f8; color: #21262c; }
+    main { max-width: 26rem; margin: 18vh auto 0; background: #fff;
+           border: 1px solid #d7dde3; border-radius: 6px;
+           padding: 2rem 2.25rem; text-align: center; }
+    .tick { width: 3rem; height: 3rem; margin: 0 auto 1rem;
+            border-radius: 50%; background: #e6f4ea; color: #1a7f37;
+            font-size: 1.8rem; line-height: 3rem; }
+    h1 { font-size: 1.2rem; margin: 0 0 .4rem; }
+    p { margin: 0; color: #57606a; }
+  </style>
+</head>
+<body>
+  <main>
+    <div class="tick">&#10003;</div>
+    <h1>Authentication succeeded</h1>
+    <p>You are signed in. You can close this window and return to the
+       command line.</p>
+  </main>
+</body>
+</html>"""
+
+ERROR_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+  <meta charset="UTF-8">
+  <title>Sign-in failed</title>
+  <style>
+    body { margin: 0; font: 15px/1.5 system-ui, sans-serif;
+           background: #f4f6f8; color: #21262c; }
+    main { max-width: 26rem; margin: 18vh auto 0; background: #fff;
+           border: 1px solid #ecc8c8; border-radius: 6px;
+           padding: 2rem 2.25rem; text-align: center; }
+    h1 { font-size: 1.2rem; margin: 0 0 .4rem; color: #99242d; }
+    p { margin: 0; color: #57606a; }
+  </style>
+</head>
+<body>
+  <main>
+    <h1>Authentication failed</h1>
+    <p>%s</p>
+  </main>
+</body>
+</html>"""
+
+
+def printable_token(token) -> dict:
+    """Unwrap the redacted token fields for terminal output.
+
+    The reference CLI does the same (its Token redacts IDToken/
+    AccessToken/RefreshToken in JSON, examples/cli/main.go:372-381) —
+    an interactive login tool is the one place the operator explicitly
+    asked to SEE the credentials.
+    """
+    return {
+        "id_token": token.id_token().reveal(),
+        "access_token": token.access_token().reveal(),
+        "refresh_token": token.refresh_token().reveal(),
+        "expiry": token.expiry(),
+    }
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -67,14 +137,17 @@ def main() -> int:
     def success(state, token, environ):
         outcome["token"] = token
         done.set()
-        return (200, [("Content-Type", "text/html")],
-                "<h1>Login successful!</h1>You may close this window.")
+        return (200, [("Content-Type", "text/html")], SUCCESS_HTML)
 
     def error(state, resp, err, environ):
         outcome["error"] = resp.error if resp else str(err)
         done.set()
-        return (401, [("Content-Type", "text/plain")],
-                f"login failed: {outcome['error']}")
+        # the error string is attacker-influencable (the ?error= query
+        # param reaches here unvalidated) — escape it, and never tokens
+        import html
+
+        return (401, [("Content-Type", "text/html")],
+                ERROR_HTML % html.escape(outcome["error"]))
 
     holder = {}
     server = make_server("127.0.0.1", args.port,
@@ -136,6 +209,8 @@ def main() -> int:
             print(f"login failed: {outcome['error']}")
             return 1
         token = outcome["token"]
+        print("token:")
+        print(json.dumps(printable_token(token), indent=2))
         print("id_token claims:")
         print(json.dumps(token.id_token().claims(), indent=2))
         if token.valid():

@@ -139,3 +139,21 @@ def test_example_tpu_batch_keyset():
     results = keyset.verify_batch([good, "not-a-jwt"])
     assert results[0]["iss"] == "https://example.com/"
     assert isinstance(results[1], Exception)
+
+
+def test_readme_quickstart_snippet_is_literal():
+    """The README's Quickstart block, EXTRACTED from README.md and
+    executed verbatim — the snippet shown to users cannot drift from
+    the test that keeps it working (reference: docs_test.go:13-79
+    keeps its examples in the compiled test file for the same
+    reason)."""
+    import pathlib
+    import re
+
+    md = (pathlib.Path(__file__).resolve().parent.parent
+          / "README.md").read_text()
+    m = re.search(r"## Quickstart\n\n```python\n(.*?)```", md, re.S)
+    assert m, "README.md lost its Quickstart python block"
+    ns: dict = {}
+    exec(compile(m.group(1), "README.md#quickstart", "exec"), ns)
+    assert ns["claims"]["iss"] == "https://example.com/"

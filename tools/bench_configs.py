@@ -184,7 +184,12 @@ def config5():
 
 
 def main():
-    for fn in (config1, config2, config3, config4, config5):
+    only = os.environ.get("CAP_CFG_ONLY", "")
+    wanted = {int(c) for c in only.split(",") if c} if only else None
+    for i, fn in enumerate((config1, config2, config3, config4,
+                            config5), start=1):
+        if wanted is not None and i not in wanted:
+            continue
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - report per config
