@@ -448,6 +448,40 @@ class TPUBatchKeySet(KeySet):
         state = self._dispatch_batch(tokens)
         return lambda: self._collect_batch(state)
 
+    def verify_batch_raw(self, tokens: Sequence[str]) -> List[Any]:
+        """Like verify_batch, but verified tokens yield their RAW
+        payload bytes — the exact claims JSON the IdP signed."""
+        return self.verify_batch_async_raw(tokens)()
+
+    def verify_batch_async_raw(self, tokens: Sequence[str]):
+        """verify_batch_async returning payload BYTES for accepted
+        tokens instead of parsed dicts.
+
+        The serve path's zero-reserialization mode: the worker would
+        otherwise build 64k claims dicts (tape phase 2) only to
+        json.dumps them straight back onto the wire — the signed
+        payload bytes ARE that JSON. Signature semantics are identical,
+        including the claims()-path rejection of verified signatures
+        over non-object payloads (phase-1 validation still runs,
+        overlapping the device drain).
+        """
+        from ..runtime import prep
+
+        telemetry.count("verify_batch.calls")
+        telemetry.count("verify_batch.tokens", len(tokens))
+        if prep._load_native() is None:
+            results = self._verify_batch_objects(tokens)
+            for i, r in enumerate(results):
+                if not isinstance(r, Exception):
+                    # the dict was built from exactly these bytes
+                    from .jose import b64url_decode
+
+                    results[i] = b64url_decode(tokens[i].split(".")[1])
+            return lambda: results
+        state = self._dispatch_batch(tokens)
+        state["raw"] = True
+        return lambda: self._collect_batch(state)
+
     def verify_stream(self, batches, depth: int = 2):
         """Pipelined verification of an iterable of token batches.
 
@@ -553,20 +587,30 @@ class TPUBatchKeySet(KeySet):
         packed_parts = state["packed_parts"]
         packed_meta = state["packed_meta"]
 
+        raw = state.get("raw", False)
         with telemetry.span("device.sync"):
+            if raw:
+                # Raw mode replaces dict building with the phase-1-only
+                # object check; the mask drives _finish_arrays for the
+                # packed AND arrays paths, overlapping the drain.
+                with telemetry.span("claims.validate"):
+                    idxs = np.nonzero(ok)[0]
+                    mask = np.zeros(n, bool)
+                    mask[idxs] = pb.payload_object_ok(idxs)
+                    pb._raw_ok = mask
             if packed_parts:
                 import jax.numpy as jnp
 
                 flat_dev = (jnp.concatenate(packed_parts)
                             if len(packed_parts) > 1 else packed_parts[0])
-                # Overlap the host-side claims JSON parsing with the
-                # device drain (transfers + compute are still in
-                # flight; only np.asarray below truly blocks). Every
-                # ok-status token still has results[i] None here (only
-                # prep errors are filled), so the index set is just the
-                # ok mask — no per-token filtering.
-                with telemetry.span("claims.prefetch"):
-                    pb.prefetch_claims(np.nonzero(ok)[0])
+                # Overlap the host-side claims parsing with the device
+                # drain (transfers + compute are still in flight; only
+                # np.asarray below truly blocks). Every ok-status token
+                # still has results[i] None here (only prep errors are
+                # filled), so the index set is just the ok mask.
+                if not raw:
+                    with telemetry.span("claims.prefetch"):
+                        pb.prefetch_claims(np.nonzero(ok)[0])
                 flat = np.asarray(flat_dev)
                 off = 0
                 for n_slots, consume in packed_meta:
@@ -588,7 +632,11 @@ class TPUBatchKeySet(KeySet):
             telemetry.count("cpu_fallback.tokens", len(slow_set))
             with telemetry.span("cpu_fallback"):
                 for j in sorted(slow_set):
-                    results[j] = self._verify_one_parsed(pb.parsed(j))
+                    out = self._verify_one_parsed(pb.parsed(j))
+                    if raw and not isinstance(out, Exception):
+                        # the oracle built the dict from these bytes
+                        out = pb.payload_bytes(j)
+                    results[j] = out
         self._observe_wire(state)
         return results
 
@@ -624,7 +672,14 @@ class TPUBatchKeySet(KeySet):
 
     @staticmethod
     def _finish_arrays(chunk, okv, pb, results: List[Any]) -> None:
-        """Write per-token verdicts for one array-path device chunk."""
+        """Write per-token verdicts for one array-path device chunk.
+
+        Raw mode (``pb._raw_ok`` set by _collect_batch): accepted
+        tokens yield their payload BYTES; a verified signature over a
+        non-object payload raises through claims() so the error object
+        is byte-identical to the dict path's.
+        """
+        raw_ok = getattr(pb, "_raw_ok", None)
         cache = getattr(pb, "_claims_cache", None)
         if cache is None:
             cache = {}
@@ -634,6 +689,17 @@ class TPUBatchKeySet(KeySet):
         for j, good in zip(np.asarray(chunk).tolist(),
                            np.asarray(okv).tolist()):
             if good:
+                if raw_ok is not None:
+                    if raw_ok[j]:
+                        results[j] = pb.payload_bytes(j)
+                    else:
+                        try:
+                            claims(j)
+                            results[j] = MalformedTokenError(
+                                "payload is not a JSON object")
+                        except MalformedTokenError as e:
+                            results[j] = e
+                    continue
                 hit = cache.get(j)
                 if hit is None:
                     try:

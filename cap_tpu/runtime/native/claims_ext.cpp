@@ -599,37 +599,27 @@ PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
 // Module entry: parse_batch(scratch, offsets, lengths) → list
 // ---------------------------------------------------------------------------
 
-// Returns a list with one entry per token:
-//   dict  — parsed claims
-//   1     — malformed JSON        (int sentinel)
-//   2     — valid JSON, not an object
-//   3     — fallback: caller must json.loads this payload
-PyObject* parse_batch(PyObject*, PyObject* args) {
-  Py_buffer scratch, offv, lenv;
-  int n_threads = 0;
-  if (!PyArg_ParseTuple(args, "y*y*y*|i", &scratch, &offv, &lenv,
-                        &n_threads))
-    return nullptr;
-  const uint8_t* base = static_cast<const uint8_t*>(scratch.buf);
-  const int64_t* offs = static_cast<const int64_t*>(offv.buf);
-  const int64_t* lens = static_cast<const int64_t*>(lenv.buf);
-  Py_ssize_t n = offv.len / static_cast<Py_ssize_t>(sizeof(int64_t));
+// Shared phase-1 scaffolding: argument/bounds validation + the GIL-free
+// multithreaded scan. per_token(i, tape) runs off the GIL and must not
+// touch Python state; both parse_batch and validate_batch ride this so
+// a bounds or thread-sizing fix can never diverge between them.
+template <typename PerToken>
+bool run_phase1(Py_buffer* scratch, Py_buffer* offv, Py_buffer* lenv,
+                int n_threads, PerToken per_token) {
+  const uint8_t* base = static_cast<const uint8_t*>(scratch->buf);
+  const int64_t* offs = static_cast<const int64_t*>(offv->buf);
+  const int64_t* lens = static_cast<const int64_t*>(lenv->buf);
+  Py_ssize_t n = offv->len / static_cast<Py_ssize_t>(sizeof(int64_t));
 
-  bool bounds_ok = lenv.len == offv.len;
+  bool bounds_ok = lenv->len == offv->len;
   for (Py_ssize_t i = 0; bounds_ok && i < n; ++i) {
-    if (offs[i] < 0 || lens[i] < 0 ||
-        offs[i] + lens[i] > scratch.len)
+    if (offs[i] < 0 || lens[i] < 0 || offs[i] + lens[i] > scratch->len)
       bounds_ok = false;
   }
   if (!bounds_ok) {
-    PyBuffer_Release(&scratch);
-    PyBuffer_Release(&offv);
-    PyBuffer_Release(&lenv);
     PyErr_SetString(PyExc_ValueError, "offsets/lengths out of bounds");
-    return nullptr;
+    return false;
   }
-
-  std::vector<TokenTape> tapes(static_cast<size_t>(n));
 
   Py_BEGIN_ALLOW_THREADS
   unsigned hw = std::thread::hardware_concurrency();
@@ -639,8 +629,10 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
     workers = static_cast<size_t>(n);
   if (workers <= 1 || n < 256) {
     for (Py_ssize_t i = 0; i < n; ++i) {
-      Parser p(base + offs[i], static_cast<size_t>(lens[i]), &tapes[i]);
+      TokenTape tape;
+      Parser p(base + offs[i], static_cast<size_t>(lens[i]), &tape);
       p.run();
+      per_token(static_cast<size_t>(i), std::move(tape));
     }
   } else {
     std::vector<std::thread> pool;
@@ -654,9 +646,11 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
           size_t hi = lo + kGrain;
           if (hi > static_cast<size_t>(n)) hi = static_cast<size_t>(n);
           for (size_t i = lo; i < hi; ++i) {
+            TokenTape tape;
             Parser p(base + offs[i], static_cast<size_t>(lens[i]),
-                     &tapes[i]);
+                     &tape);
             p.run();
+            per_token(i, std::move(tape));
           }
         }
       });
@@ -664,6 +658,35 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
     for (auto& t : pool) t.join();
   }
   Py_END_ALLOW_THREADS
+  return true;
+}
+
+// Returns a list with one entry per token:
+//   dict  — parsed claims
+//   1     — malformed JSON        (int sentinel)
+//   2     — valid JSON, not an object
+//   3     — fallback: caller must json.loads this payload
+PyObject* parse_batch(PyObject*, PyObject* args) {
+  Py_buffer scratch, offv, lenv;
+  int n_threads = 0;
+  if (!PyArg_ParseTuple(args, "y*y*y*|i", &scratch, &offv, &lenv,
+                        &n_threads))
+    return nullptr;
+  const uint8_t* base = static_cast<const uint8_t*>(scratch.buf);
+  const int64_t* offs = static_cast<const int64_t*>(offv.buf);
+  Py_ssize_t n = offv.len / static_cast<Py_ssize_t>(sizeof(int64_t));
+
+  std::vector<TokenTape> tapes(static_cast<size_t>(n));
+  bool ok = run_phase1(&scratch, &offv, &lenv, n_threads,
+                       [&](size_t i, TokenTape&& tape) {
+                         tapes[i] = std::move(tape);
+                       });
+  if (!ok) {
+    PyBuffer_Release(&scratch);
+    PyBuffer_Release(&offv);
+    PyBuffer_Release(&lenv);
+    return nullptr;
+  }
 
   KeyCache keys;
   PyObject* out = PyList_New(n);
@@ -696,10 +719,52 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
   return out;
 }
 
+// Phase 1 ONLY: per-token payload status byte, no Python objects.
+// The serve path's raw-claims mode needs "is this a valid JSON object"
+// (the signed payload bytes then pass through verbatim) without paying
+// for dict construction. Status values are the parser's own:
+// 0 = valid object, 1 = malformed, 2 = valid JSON but not an object,
+// 3 = outside the strict parser's envelope (caller decides via
+// json.loads). Scan runs GIL-free across threads like parse_batch.
+PyObject* validate_batch(PyObject*, PyObject* args) {
+  Py_buffer scratch, offv, lenv;
+  int n_threads = 0;
+  if (!PyArg_ParseTuple(args, "y*y*y*|i", &scratch, &offv, &lenv,
+                        &n_threads))
+    return nullptr;
+  Py_ssize_t n = offv.len / static_cast<Py_ssize_t>(sizeof(int64_t));
+
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, n);
+  if (out == nullptr) {
+    PyBuffer_Release(&scratch);
+    PyBuffer_Release(&offv);
+    PyBuffer_Release(&lenv);
+    return nullptr;
+  }
+  uint8_t* st = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+
+  bool ok = run_phase1(&scratch, &offv, &lenv, n_threads,
+                       [&](size_t i, TokenTape&& tape) {
+                         st[i] = static_cast<uint8_t>(tape.status);
+                       });
+  PyBuffer_Release(&scratch);
+  PyBuffer_Release(&offv);
+  PyBuffer_Release(&lenv);
+  if (!ok) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"parse_batch", parse_batch, METH_VARARGS,
      "parse_batch(scratch, offsets_i64, lengths_i64, n_threads=0) -> "
      "list[dict | int-status]"},
+    {"validate_batch", validate_batch, METH_VARARGS,
+     "validate_batch(scratch, offsets_i64, lengths_i64, n_threads=0) "
+     "-> bytes (per-token status: 0 ok-object, 1 malformed, 2 "
+     "non-object, 3 outside-envelope)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

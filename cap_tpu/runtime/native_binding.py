@@ -253,7 +253,7 @@ class PreparedBatch:
     __slots__ = ("n", "status", "alg_id", "kid_mat", "kid_len", "sig_off",
                  "sig_len", "payload_off", "payload_len", "si_len", "digest",
                  "digest_len", "scratch", "blob", "tok_off", "alg_raw",
-                 "alg_len", "_claims_cache")
+                 "alg_len", "_claims_cache", "_raw_ok")
 
     def __init__(self, n, status, alg_id, kid_mat, kid_len, sig_off, sig_len,
                  payload_off, payload_len, si_len, digest, digest_len,
@@ -361,6 +361,40 @@ class PreparedBatch:
     def payload_bytes(self, i: int) -> bytes:
         o, l = int(self.payload_off[i]), int(self.payload_len[i])
         return self.scratch[o: o + l].tobytes()
+
+    def payload_object_ok(self, indices) -> np.ndarray:
+        """[len(indices)] bool: the payload parses as a JSON OBJECT.
+
+        Phase-1 only (no dicts built): the raw-claims serve path passes
+        the signed payload bytes through verbatim, but a verified
+        signature over a non-object payload must still reject exactly
+        like the claims() path. Status 3 (outside the strict native
+        parser's envelope) falls back to json.loads so the decision is
+        byte-identical to the Python path; without the extension every
+        token takes that fallback.
+        """
+        idx = np.ascontiguousarray(indices, np.int64)
+        out = np.zeros(len(idx), bool)
+        if _claims_ext is not None and hasattr(_claims_ext,
+                                               "validate_batch"):
+            offs = np.ascontiguousarray(self.payload_off[idx], np.int64)
+            lens = np.ascontiguousarray(self.payload_len[idx], np.int64)
+            st = np.frombuffer(
+                _claims_ext.validate_batch(self.scratch, offs, lens),
+                np.uint8)
+            out[:] = st == 0
+            for k in np.nonzero(st == 3)[0]:
+                out[k] = self._payload_is_object(int(idx[k]))
+            return out
+        for k, i in enumerate(idx):
+            out[k] = self._payload_is_object(int(i))
+        return out
+
+    def _payload_is_object(self, i: int) -> bool:
+        try:
+            return isinstance(json.loads(self.payload_bytes(i)), dict)
+        except (ValueError, UnicodeDecodeError):
+            return False
 
     def claims(self, i: int) -> Dict[str, Any]:
         cache = getattr(self, "_claims_cache", None)

@@ -68,12 +68,16 @@ def send_request(sock: socket.socket, tokens: Sequence[str]) -> None:
 
 
 def send_response(sock: socket.socket, results: Sequence[Any]) -> None:
-    """results: claims dict (verified) or Exception (rejected)."""
+    """results: claims (dict, or the raw payload-JSON bytes the worker
+    verified — sent verbatim, zero re-serialization) or Exception."""
     parts = [_HDR.pack(MAGIC, T_VERIFY_RESP, len(results))]
     for r in results:
         if isinstance(r, Exception):
             payload = f"{type(r).__name__}: {r}".encode()
             parts.append(struct.pack("<BI", 1, len(payload)))
+        elif isinstance(r, (bytes, bytearray, memoryview)):
+            payload = bytes(r)
+            parts.append(struct.pack("<BI", 0, len(payload)))
         else:
             payload = json.dumps(r, separators=(",", ":")).encode()
             parts.append(struct.pack("<BI", 0, len(payload)))

@@ -19,6 +19,19 @@ from . import protocol
 from .batcher import AdaptiveBatcher
 
 
+class _RawClaims:
+    """Route the batcher at a keyset's raw-claims entry points."""
+
+    def __init__(self, keyset):
+        self._keyset = keyset
+
+    def verify_batch_async(self, tokens):
+        return self._keyset.verify_batch_async_raw(tokens)
+
+    def verify_batch(self, tokens):
+        return self._keyset.verify_batch_raw(tokens)
+
+
 class VerifyWorker:
     """Serve ``keyset.verify_batch`` over the CVB1 protocol.
 
@@ -30,7 +43,15 @@ class VerifyWorker:
     def __init__(self, keyset, host: str = "127.0.0.1", port: int = 0,
                  uds_path: Optional[str] = None,
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
-                 max_batch: int = 32768):
+                 max_batch: int = 32768, raw_claims: bool = True):
+        # Raw-claims passthrough: the response payload for a verified
+        # token IS its claims JSON, and the signed payload bytes are
+        # exactly that — building dicts only to re-serialize them
+        # wastes the host core the worker shares with prep/packing.
+        # Keysets without the raw entry (stubs, plain KeySets) keep
+        # the dict path; the wire format is identical either way.
+        if raw_claims and hasattr(keyset, "verify_batch_async_raw"):
+            keyset = _RawClaims(keyset)
         self._batcher = AdaptiveBatcher(
             keyset, target_batch=target_batch, max_wait_ms=max_wait_ms,
             max_batch=max_batch)

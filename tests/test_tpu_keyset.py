@@ -342,3 +342,95 @@ def test_wire_adaptive_chunk_sizing():
         # the object fallback never dispatches device work, so the
         # estimate only updates on the native batch path
         assert ks._wire_bps is not None and ks._wire_bps > 0
+
+
+def _sign_raw_payload(priv, alg, payload: bytes, kid: str) -> str:
+    """Compact JWS over an ARBITRARY payload (sign_jwt forces a claims
+    dict; parity tests need e.g. a JSON array payload)."""
+    import json as jsonlib
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    from cap_tpu.jwt.jose import b64url_encode
+
+    header = jsonlib.dumps({"alg": alg, "typ": "JWT", "kid": kid},
+                           separators=(",", ":")).encode()
+    si = (b64url_encode(header) + "." + b64url_encode(payload)).encode()
+    der = priv.sign(si, cec.ECDSA(hashes.SHA256()))
+    r, s = decode_dss_signature(der)
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return si.decode() + "." + b64url_encode(sig)
+
+
+def test_verify_batch_raw_parity():
+    """Raw mode returns the SIGNED payload bytes for every token the
+    dict mode accepts, the same error classes for every token it
+    rejects — including a VALID signature over a non-object payload —
+    and json.loads(raw) == the dict-mode claims."""
+    import json as jsonlib
+
+    from cap_tpu.errors import MalformedTokenError
+
+    jwks, toks = captest.headline_fixtures(48)
+    es_priv, es_pub = captest.generate_keys("ES256")
+    ks = TPUBatchKeySet(jwks + [JWK(es_pub, kid="raw-es")])
+    arr_payload = _sign_raw_payload(es_priv, "ES256", b"[1,2,3]",
+                                    "raw-es")
+    bad_json = _sign_raw_payload(es_priv, "ES256", b"{not json",
+                                 "raw-es")
+    tampered = toks[0][:-8] + ("AAAAAAAA"
+                               if not toks[0].endswith("AAAAAAAA")
+                               else "BBBBBBBB")
+    batch = toks + [arr_payload, bad_json, tampered, "garbage"]
+
+    dicts = ks.verify_batch(batch)
+    raws = ks.verify_batch_raw(batch)
+    assert len(dicts) == len(raws)
+    for i, (d, r) in enumerate(zip(dicts, raws)):
+        if isinstance(d, Exception):
+            assert isinstance(r, Exception), f"tok {i}"
+            assert type(r) is type(d), f"tok {i}: {r!r} vs {d!r}"
+        else:
+            assert isinstance(r, bytes), f"tok {i}"
+            assert jsonlib.loads(r) == d, f"tok {i}"
+    # the two crafted tokens: valid signatures, claims-path rejects
+    assert isinstance(dicts[-4], MalformedTokenError)   # [1,2,3]
+    assert isinstance(raws[-4], MalformedTokenError)
+    assert isinstance(dicts[-3], MalformedTokenError)   # {not json
+    assert isinstance(raws[-3], MalformedTokenError)
+
+
+def test_payload_object_ok_matches_json_loads():
+    """The phase-1-only validity mask agrees with json.loads on
+    object/non-object/malformed/exotic payloads."""
+    import json as jsonlib
+
+    from cap_tpu.runtime import prep
+
+    if prep._load_native() is None:
+        pytest.skip("native runtime not built")
+    from cap_tpu.runtime.native_binding import prepare_batch_arrays
+
+    es_priv, es_pub = captest.generate_keys("ES256")
+    payloads = [
+        b'{"a":1}', b"[1,2]", b"42", b'"str"', b"{broken",
+        b'{"nested":{"deep":[1,{"x":null}]}}',
+        b'{"u":"\\ud83d\\ude00"}',           # surrogate pair: fallback
+        b'{"big":123456789012345678901234567890123456789012}',
+        "{\"k\":\"café\"}".encode(),
+        b'  {"ws": 1}  ',
+    ]
+    toks = [_sign_raw_payload(es_priv, "ES256", p, "k") for p in payloads]
+    pb = prepare_batch_arrays(toks)
+    assert (pb.status == 0).all()
+    got = pb.payload_object_ok(np.arange(len(toks)))
+    for i, p in enumerate(payloads):
+        try:
+            want = isinstance(jsonlib.loads(p), dict)
+        except ValueError:
+            want = False
+        assert got[i] == want, f"payload {i}: {p!r}"
