@@ -429,7 +429,8 @@ class TPUBatchKeySet(KeySet):
                 return self._collect_batch(self._dispatch_batch(tokens))
             return self._verify_batch_objects(tokens)
 
-    def verify_batch_async(self, tokens: Sequence[str]):
+    def verify_batch_async(self, tokens: Sequence[str],
+                           raw: bool = False):
         """Dispatch a batch; returns collect() → per-token results.
 
         All device work (transfers + programs) is queued before this
@@ -437,6 +438,9 @@ class TPUBatchKeySet(KeySet):
         sync. Dispatching the NEXT batch before collecting the previous
         one keeps the host↔device wire busy during host-side prep —
         the 2-deep pipelining the serve layer and bench use.
+
+        ``raw``: accepted tokens yield payload BYTES instead of claims
+        dicts (see verify_batch_async_raw).
         """
         from ..runtime import prep
 
@@ -444,14 +448,25 @@ class TPUBatchKeySet(KeySet):
         telemetry.count("verify_batch.tokens", len(tokens))
         if prep._load_native() is None:
             results = self._verify_batch_objects(tokens)
+            if raw:
+                from .jose import b64url_decode
+
+                for i, r in enumerate(results):
+                    if not isinstance(r, Exception):
+                        # the dict was built from exactly these bytes
+                        results[i] = b64url_decode(
+                            tokens[i].split(".")[1])
             return lambda: results
         state = self._dispatch_batch(tokens)
+        if raw:
+            state["raw"] = True
         return lambda: self._collect_batch(state)
 
     def verify_batch_raw(self, tokens: Sequence[str]) -> List[Any]:
         """Like verify_batch, but verified tokens yield their RAW
         payload bytes — the exact claims JSON the IdP signed."""
-        return self.verify_batch_async_raw(tokens)()
+        with telemetry.span("verify_batch.total"):
+            return self.verify_batch_async(tokens, raw=True)()
 
     def verify_batch_async_raw(self, tokens: Sequence[str]):
         """verify_batch_async returning payload BYTES for accepted
@@ -462,25 +477,12 @@ class TPUBatchKeySet(KeySet):
         json.dumps them straight back onto the wire — the signed
         payload bytes ARE that JSON. Signature semantics are identical,
         including the claims()-path rejection of verified signatures
-        over non-object payloads (phase-1 validation still runs,
-        overlapping the device drain).
+        over non-object payloads (phase-1 validation runs during the
+        device drain as a fast filter; json.loads stays authoritative
+        on the tokens it flags, so accept/reject decisions are
+        byte-identical to the dict path's).
         """
-        from ..runtime import prep
-
-        telemetry.count("verify_batch.calls")
-        telemetry.count("verify_batch.tokens", len(tokens))
-        if prep._load_native() is None:
-            results = self._verify_batch_objects(tokens)
-            for i, r in enumerate(results):
-                if not isinstance(r, Exception):
-                    # the dict was built from exactly these bytes
-                    from .jose import b64url_decode
-
-                    results[i] = b64url_decode(tokens[i].split(".")[1])
-            return lambda: results
-        state = self._dispatch_batch(tokens)
-        state["raw"] = True
-        return lambda: self._collect_batch(state)
+        return self.verify_batch_async(tokens, raw=True)
 
     def verify_stream(self, batches, depth: int = 2):
         """Pipelined verification of an iterable of token batches.
@@ -693,10 +695,13 @@ class TPUBatchKeySet(KeySet):
                     if raw_ok[j]:
                         results[j] = pb.payload_bytes(j)
                     else:
+                        # The phase-1 mask is only a FAST FILTER:
+                        # json.loads stays authoritative (it accepts
+                        # e.g. BOM-prefixed payloads the strict scan
+                        # flags), exactly like the dict path.
                         try:
                             claims(j)
-                            results[j] = MalformedTokenError(
-                                "payload is not a JSON object")
+                            results[j] = pb.payload_bytes(j)
                         except MalformedTokenError as e:
                             results[j] = e
                     continue

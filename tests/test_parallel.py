@@ -190,3 +190,27 @@ def test_meshed_keyset_mixed_families_rns(monkeypatch):
     `pytest -m heavy` or `make test-all`."""
     monkeypatch.setenv("CAP_TPU_RNS", "1")
     _meshed_mixed_parity()
+
+
+def test_meshed_raw_mode_parity():
+    """verify_batch_raw over a mesh: payload bytes match the unmeshed
+    dict path's claims for accepts, error classes for rejects."""
+    import json as jsonlib
+
+    from cap_tpu import testing as captest
+    from cap_tpu.jwt.jwk import JWK
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+    jwks, toks = captest.headline_fixtures(64)
+    tam = toks[0][:-8] + ("AAAAAAAA" if not toks[0].endswith("AAAAAAAA")
+                          else "BBBBBBBB")
+    batch = toks + [tam]
+    meshed = TPUBatchKeySet(jwks, mesh=make_mesh(8))
+    plain = TPUBatchKeySet(jwks)
+    raws = meshed.verify_batch_raw(batch)
+    dicts = plain.verify_batch(batch)
+    for i, (r, d) in enumerate(zip(raws, dicts)):
+        if isinstance(d, Exception):
+            assert type(r) is type(d), f"tok {i}"
+        else:
+            assert jsonlib.loads(r) == d, f"tok {i}"

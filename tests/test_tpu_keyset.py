@@ -382,10 +382,15 @@ def test_verify_batch_raw_parity():
                                     "raw-es")
     bad_json = _sign_raw_payload(es_priv, "ES256", b"{not json",
                                  "raw-es")
+    # BOM-prefixed object: the strict phase-1 scan flags it, but
+    # json.loads accepts — BOTH modes must accept (json.loads is
+    # authoritative; the native mask is only a fast filter)
+    bom = _sign_raw_payload(es_priv, "ES256", b'\xef\xbb\xbf{"b":1}',
+                            "raw-es")
     tampered = toks[0][:-8] + ("AAAAAAAA"
                                if not toks[0].endswith("AAAAAAAA")
                                else "BBBBBBBB")
-    batch = toks + [arr_payload, bad_json, tampered, "garbage"]
+    batch = toks + [bom, arr_payload, bad_json, tampered, "garbage"]
 
     dicts = ks.verify_batch(batch)
     raws = ks.verify_batch_raw(batch)
@@ -397,7 +402,9 @@ def test_verify_batch_raw_parity():
         else:
             assert isinstance(r, bytes), f"tok {i}"
             assert jsonlib.loads(r) == d, f"tok {i}"
-    # the two crafted tokens: valid signatures, claims-path rejects
+    # crafted tokens: valid signatures, divergent payloads
+    assert dicts[-5] == {"b": 1}                        # BOM accept
+    assert isinstance(raws[-5], bytes)
     assert isinstance(dicts[-4], MalformedTokenError)   # [1,2,3]
     assert isinstance(raws[-4], MalformedTokenError)
     assert isinstance(dicts[-3], MalformedTokenError)   # {not json
@@ -433,4 +440,14 @@ def test_payload_object_ok_matches_json_loads():
             want = isinstance(jsonlib.loads(p), dict)
         except ValueError:
             want = False
+        # The mask is ONE-SIDED: True must imply json.loads accepts
+        # (callers re-check the Falses with json.loads, which accepts
+        # some payloads the strict scan flags, e.g. BOM prefixes).
+        if got[i]:
+            assert want, f"payload {i}: {p!r}"
+        else:
+            continue
         assert got[i] == want, f"payload {i}: {p!r}"
+    # and for these plain-UTF-8 payloads the mask is exact
+    assert [bool(g) for g in got] == [
+        True, False, False, False, False, True, True, True, True, True]
