@@ -10,8 +10,10 @@
 //     a strict JSON parser into a flat numeric "tape" — string/number
 //     spans, structural ops — with all validation done here;
 //   phase 2 (GIL held, single pass): the tapes replay into Python
-//     objects. Claim KEYS repeat massively across tokens, so a small
-//     byte-exact intern table reuses one PyUnicode per distinct key.
+//     objects. Claim KEYS — and short string VALUES (issuer URLs,
+//     audiences, scopes) — repeat massively across tokens, so
+//     byte-exact hash intern tables reuse one PyUnicode per distinct
+//     byte string, and dicts are presized from phase-1 key counts.
 //
 // Fidelity contract: for any payload this parser accepts, the result
 // is indistinguishable from json.loads(payload); anything outside the
@@ -28,6 +30,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <dlfcn.h>
 
 #include <atomic>
 #include <cmath>
@@ -254,6 +257,9 @@ struct Parser {
     switch (c) {
       case '{': {
         ++i;
+        // Operand `a` of OP_OBJ_START is backpatched to the key count
+        // so phase 2 can presize the dict (0 = empty or unknown).
+        size_t hdr = out->ops.size();
         emit(OP_OBJ_START);
         ws();
         if (i < n && s[i] == '}') {
@@ -261,6 +267,7 @@ struct Parser {
           emit(OP_OBJ_END);
           return true;
         }
+        uint32_t nkeys = 0;
         while (true) {
           ws();
           if (i >= n || s[i] != '"') return false;
@@ -268,6 +275,7 @@ struct Parser {
           uint32_t off, len, esc;
           if (!scan_string(&off, &len, &esc, fb)) return false;
           emit(OP_KEY, off, (len << 1) | esc);
+          ++nkeys;
           ws();
           if (i >= n || s[i] != ':') return false;
           ++i;
@@ -281,6 +289,7 @@ struct Parser {
           }
           if (s[i] == '}') {
             ++i;
+            out->ops[hdr + 1] = nkeys;
             emit(OP_OBJ_END);
             return true;
           }
@@ -381,35 +390,85 @@ struct Parser {
 // Phase 2: tape → Python objects
 // ---------------------------------------------------------------------------
 
-// Byte-exact key intern table: claims keys ("iss", "sub", "exp", ...)
-// repeat across every token in a batch; one PyUnicode per distinct key
-// makes dict fills cheap (cached hash, pointer-equal keys).
-struct KeyCache {
-  struct Entry {
-    std::string bytes;
-    PyObject* obj;  // owned
+// Byte-exact string intern table (open addressing, FNV-1a). Two uses:
+//   keys   — claims keys ("iss", "sub", "exp", ...) repeat across every
+//            token in a batch; one interned PyUnicode per distinct key
+//            makes dict fills cheap (cached hash, pointer-equal keys);
+//   values — short unescaped string VALUES (issuer URLs, audiences,
+//            scopes) also repeat per-batch; sharing one PyUnicode turns
+//            ~half the per-token decodes into INCREFs. Strings are
+//            immutable, so sharing across result dicts is safe.
+// Bounded: past max_entries, get() declines and the caller decodes
+// directly (degenerate all-unique batches stay O(1) per miss because a
+// miss probes an under-half-full table, not a growing list).
+struct InternTable {
+  struct Slot {
+    uint64_t hash = 0;
+    uint32_t off = 0;
+    uint32_t len = 0;
+    PyObject* obj = nullptr;  // owned; nullptr = empty slot
   };
-  std::vector<Entry> entries;
+  std::vector<Slot> slots;  // power-of-two size, load factor ≤ 1/2
+  std::string arena;        // backing bytes for stored entries
+  size_t count = 0;
+  size_t max_entries;
+  bool intern;  // keys get PyUnicode_InternInPlace; values do not
 
-  ~KeyCache() {
-    for (auto& e : entries) Py_XDECREF(e.obj);
+  InternTable(size_t n_slots_pow2, size_t cap, bool intern_keys)
+      : slots(n_slots_pow2), max_entries(cap), intern(intern_keys) {}
+  ~InternTable() {
+    for (auto& s : slots) Py_XDECREF(s.obj);
   }
 
-  PyObject* get(const char* data, size_t len) {  // borrowed return
-    for (auto& e : entries) {
-      if (e.bytes.size() == len &&
-          std::memcmp(e.bytes.data(), data, len) == 0)
-        return e.obj;
+  static uint64_t fnv1a(const uint8_t* p, size_t n) {
+    uint64_t h = 1469598103934665603ull;
+    for (size_t i = 0; i < n; ++i) {
+      h ^= p[i];
+      h *= 1099511628211ull;
     }
-    if (entries.size() >= 256) return nullptr;  // degenerate batch: skip cache
-    PyObject* o = PyUnicode_DecodeUTF8(data, static_cast<Py_ssize_t>(len),
+    return h;
+  }
+
+  // Borrowed reference, or nullptr when the caller should decode
+  // directly (table full, or — impossible for phase-1-validated UTF-8 —
+  // decode failure; the caller's own decode then raises properly).
+  PyObject* get(const uint8_t* data, size_t len) {
+    uint64_t h = fnv1a(data, len);
+    size_t mask = slots.size() - 1;
+    size_t j = static_cast<size_t>(h) & mask;
+    while (slots[j].obj != nullptr) {
+      if (slots[j].hash == h && slots[j].len == len &&
+          std::memcmp(arena.data() + slots[j].off, data, len) == 0)
+        return slots[j].obj;
+      j = (j + 1) & mask;
+    }
+    if (count >= max_entries) return nullptr;
+    PyObject* o = PyUnicode_DecodeUTF8(reinterpret_cast<const char*>(data),
+                                       static_cast<Py_ssize_t>(len),
                                        nullptr);
     if (o == nullptr) return nullptr;
-    PyUnicode_InternInPlace(&o);
-    entries.push_back({std::string(data, len), o});
+    if (intern) PyUnicode_InternInPlace(&o);
+    slots[j].hash = h;
+    slots[j].off = static_cast<uint32_t>(arena.size());
+    slots[j].len = static_cast<uint32_t>(len);
+    slots[j].obj = o;
+    arena.append(reinterpret_cast<const char*>(data), len);
+    ++count;
     return o;
   }
 };
+
+// Value strings longer than this decode directly: long strings amortize
+// their own decode, and the arena stays small.
+constexpr size_t kMaxCachedValueLen = 64;
+
+// dlsym-resolved _PyDict_NewPresized (CPython private API, exported and
+// stable in practice; pydantic-core relies on it the same way). One
+// claims dict has ~8-12 keys — past 5, PyDict_New's initial table
+// resizes mid-fill, so presizing saves an alloc + rehash per token.
+// nullptr (symbol absent) falls back to PyDict_New.
+using DictNewPresizedFn = PyObject* (*)(Py_ssize_t);
+DictNewPresizedFn dict_new_presized = nullptr;
 
 PyObject* decode_escaped(const uint8_t* data, size_t len) {
   // Unescape into a scratch, then UTF-8 decode. Validation already
@@ -461,7 +520,7 @@ PyObject* decode_escaped(const uint8_t* data, size_t len) {
 // Replay one token's tape. Returns a new reference, or nullptr with a
 // Python exception set.
 PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
-                          KeyCache* keys) {
+                          InternTable* keys, InternTable* strs) {
   // Explicit container stack; values attach to the top container (dict
   // via pending key, list via append).
   struct Frame {
@@ -502,7 +561,11 @@ PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
     uint32_t op = ops[t], a = ops[t + 1], b = ops[t + 2];
     switch (op) {
       case OP_OBJ_START: {
-        PyObject* d = PyDict_New();
+        // `a` = key count (backpatched by phase 1); CPython's fresh
+        // dict already holds 5 entries, so presize only beyond that.
+        PyObject* d = (a > 5 && dict_new_presized != nullptr)
+                          ? dict_new_presized(static_cast<Py_ssize_t>(a))
+                          : PyDict_New();
         if (d == nullptr) return fail();
         stack.push_back({d, nullptr});
         break;
@@ -528,7 +591,8 @@ PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
         if (esc) {
           k = decode_escaped(payload + a, len);
         } else {
-          PyObject* cached = keys->get(data, len);
+          PyObject* cached =
+              keys->get(reinterpret_cast<const uint8_t*>(data), len);
           if (cached != nullptr) {
             Py_INCREF(cached);
             k = cached;
@@ -544,11 +608,20 @@ PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
       }
       case OP_STR: {
         uint32_t len = b >> 1, esc = b & 1;
-        PyObject* v =
-            esc ? decode_escaped(payload + a, len)
-                : PyUnicode_DecodeUTF8(
-                      reinterpret_cast<const char*>(payload + a),
-                      static_cast<Py_ssize_t>(len), nullptr);
+        PyObject* v = nullptr;
+        if (!esc && len <= kMaxCachedValueLen) {
+          PyObject* cached = strs->get(payload + a, len);
+          if (cached != nullptr) {
+            Py_INCREF(cached);
+            v = cached;
+          }
+        }
+        if (v == nullptr) {
+          v = esc ? decode_escaped(payload + a, len)
+                  : PyUnicode_DecodeUTF8(
+                        reinterpret_cast<const char*>(payload + a),
+                        static_cast<Py_ssize_t>(len), nullptr);
+        }
         if (v == nullptr || !attach(v)) return fail();
         break;
       }
@@ -596,7 +669,7 @@ PyObject* build_from_tape(const TokenTape& tape, const uint8_t* payload,
 }
 
 // ---------------------------------------------------------------------------
-// Module entry: parse_batch(scratch, offsets, lengths) → list
+// Module entry: parse_batch(scratch, offsets, lengths) → (list, n_bad)
 // ---------------------------------------------------------------------------
 
 // Shared phase-1 scaffolding: argument/bounds validation + the GIL-free
@@ -661,11 +734,13 @@ bool run_phase1(Py_buffer* scratch, Py_buffer* offv, Py_buffer* lenv,
   return true;
 }
 
-// Returns a list with one entry per token:
+// Returns (results, n_bad): results is a list with one entry per token:
 //   dict  — parsed claims
 //   1     — malformed JSON        (int sentinel)
 //   2     — valid JSON, not an object
 //   3     — fallback: caller must json.loads this payload
+// n_bad counts the non-dict entries, so the caller's common case
+// (n_bad == 0) can bulk-insert the list without a per-token type scan.
 PyObject* parse_batch(PyObject*, PyObject* args) {
   Py_buffer scratch, offv, lenv;
   int n_threads = 0;
@@ -688,7 +763,16 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
     return nullptr;
   }
 
-  KeyCache keys;
+  InternTable keys(/*n_slots_pow2=*/512, /*cap=*/256,
+                   /*intern_keys=*/true);
+  // Scale the value table with the batch so small batches (serve
+  // batches of ~256, handfuls in tests) don't pay a fixed ~200 KB
+  // zero-init before parsing the first token.
+  size_t str_slots = 64;
+  while (str_slots < static_cast<size_t>(n) * 8 && str_slots < 8192)
+    str_slots <<= 1;
+  InternTable strs(str_slots, str_slots / 2, /*intern_keys=*/false);
+  Py_ssize_t n_bad = 0;
   PyObject* out = PyList_New(n);
   if (out == nullptr) {
     PyBuffer_Release(&scratch);
@@ -701,10 +785,11 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
     PyObject* item;
     if (tapes[i].status == ST_OK) {
       item = build_from_tape(tapes[static_cast<size_t>(i)], base + offs[i],
-                             &keys);
+                             &keys, &strs);
       if (item == nullptr) err = true;
     } else {
       item = PyLong_FromLong(tapes[i].status);
+      ++n_bad;
       if (item == nullptr) err = true;
     }
     if (!err) PyList_SET_ITEM(out, i, item);
@@ -716,7 +801,15 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
     Py_DECREF(out);
     return nullptr;
   }
-  return out;
+  PyObject* nb = PyLong_FromSsize_t(n_bad);
+  if (nb == nullptr) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  PyObject* ret = PyTuple_Pack(2, out, nb);
+  Py_DECREF(out);
+  Py_DECREF(nb);
+  return ret;
 }
 
 // Phase 1 ONLY: per-token payload status byte, no Python objects.
@@ -760,7 +853,7 @@ PyObject* validate_batch(PyObject*, PyObject* args) {
 PyMethodDef methods[] = {
     {"parse_batch", parse_batch, METH_VARARGS,
      "parse_batch(scratch, offsets_i64, lengths_i64, n_threads=0) -> "
-     "list[dict | int-status]"},
+     "(list[dict | int-status], n_bad)"},
     {"validate_batch", validate_batch, METH_VARARGS,
      "validate_batch(scratch, offsets_i64, lengths_i64, n_threads=0) "
      "-> bytes (per-token status: 0 ok-object, 1 malformed, 2 "
@@ -777,5 +870,7 @@ PyModuleDef moduledef = {
 }  // namespace
 
 extern "C" PyMODINIT_FUNC PyInit__capclaims(void) {
+  dict_new_presized = reinterpret_cast<DictNewPresizedFn>(
+      dlsym(RTLD_DEFAULT, "_PyDict_NewPresized"));
   return PyModule_Create(&moduledef);
 }

@@ -441,9 +441,15 @@ class PreparedBatch:
         if _claims_ext is not None:
             offs = np.ascontiguousarray(off[idx], np.int64)
             lens = np.ascontiguousarray(ln[idx], np.int64)
-            parsed = _claims_ext.parse_batch(scratch, offs, lens)
-            for j, v in zip(idx, parsed):
-                j = int(j)
+            parsed, n_bad = _claims_ext.parse_batch(scratch, offs, lens)
+            idx_list = idx.tolist()
+            if n_bad == 0:
+                # All dicts: one C-level bulk insert, no per-token
+                # Python iteration (measurable at 64k tokens on a
+                # one-core host).
+                cache.update(zip(idx_list, parsed))
+                return
+            for j, v in zip(idx_list, parsed):
                 if type(v) is dict:
                     cache[j] = v
                 else:

@@ -188,7 +188,9 @@ def _run_claims_batch(payloads):
     lens = np.asarray([len(p) for p in payloads], np.int64)
     offs = np.zeros(len(payloads), np.int64)
     np.cumsum(lens[:-1], out=offs[1:])
-    return ext.parse_batch(blob, offs, lens)
+    out, n_bad = ext.parse_batch(blob, offs, lens)
+    assert n_bad == sum(1 for v in out if not isinstance(v, dict))
+    return out
 
 
 CLAIMS_EDGE = [

@@ -198,7 +198,6 @@ def test_meshed_raw_mode_parity():
     import json as jsonlib
 
     from cap_tpu import testing as captest
-    from cap_tpu.jwt.jwk import JWK
     from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
 
     jwks, toks = captest.headline_fixtures(64)

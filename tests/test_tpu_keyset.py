@@ -445,9 +445,6 @@ def test_payload_object_ok_matches_json_loads():
         # some payloads the strict scan flags, e.g. BOM prefixes).
         if got[i]:
             assert want, f"payload {i}: {p!r}"
-        else:
-            continue
-        assert got[i] == want, f"payload {i}: {p!r}"
     # and for these plain-UTF-8 payloads the mask is exact
     assert [bool(g) for g in got] == [
         True, False, False, False, False, True, True, True, True, True]

@@ -1,0 +1,82 @@
+"""Microbenchmark the native claims pipeline (phase 1 + phase 2).
+
+The serve path's binding constraint on a one-core host is host-side
+work; after raw passthrough removed serialization, what remains on the
+dict path is `_capclaims.parse_batch` (docs/PERF.md "Next levers").
+This times that call on bench-shaped payloads, next to json.loads.
+
+Usage: python tools/profile_claims.py [n_tokens]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cap_tpu.runtime import native_binding as nb
+
+
+def make_payloads(n: int):
+    payloads = []
+    for i in range(n):
+        claims = {
+            "iss": "https://issuer.example.com/",
+            "sub": f"user-{i:08d}",
+            "aud": ["api://default", "app-1"],
+            "exp": 1785500000 + i,
+            "nbf": 1785400000,
+            "iat": 1785400000 + i,
+            "jti": f"jti-{i:016x}",
+            "name": "Ada Lovelace",
+            "email_verified": True,
+            "scope": "openid profile email",
+        }
+        payloads.append(json.dumps(claims, separators=(",", ":")).encode())
+    return payloads
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    payloads = make_payloads(n)
+    scratch = bytearray()
+    offs = np.empty(n, np.int64)
+    lens = np.empty(n, np.int64)
+    for i, p in enumerate(payloads):
+        offs[i] = len(scratch)
+        lens[i] = len(p)
+        scratch += p
+    scratch = bytes(scratch)
+
+    ext = nb._claims_ext
+    if ext is None:
+        print("extension not built", file=sys.stderr)
+        return
+
+    # Warm + correctness spot-check against json.loads.
+    out, n_bad = ext.parse_batch(scratch, offs, lens)
+    ref = [json.loads(p) for p in payloads[:64]]
+    assert n_bad == 0 and out[:64] == ref, \
+        "native parse diverges from json.loads"
+
+    for name, fn in [
+        ("parse_batch (phase1+2)",
+         lambda: ext.parse_batch(scratch, offs, lens)),
+        ("validate_batch (phase1)",
+         lambda: ext.validate_batch(scratch, offs, lens)),
+        ("json.loads loop",
+         lambda: [json.loads(p) for p in payloads]),
+    ]:
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        print(f"{name:26s} {best * 1e3:8.1f} ms   "
+              f"{n / best / 1e3:8.0f} k tok/s")
+
+
+if __name__ == "__main__":
+    main()
