@@ -83,14 +83,22 @@ def _resident_mixed_vps(ks, tokens):
     reps = 4
     run(1)                            # compile + settle
     run(1 + reps)
-    t0 = time.perf_counter()
-    run(1)
-    t1 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    run(1 + reps)
-    tr = time.perf_counter() - t0
-    per = (tr - t1) / reps
-    return (n / per) if per > 0 else None
+    # MIN OF 3 slope trials: dispatch and the materializing sync ride
+    # the tunnel, so a single stall inside a timed window shifts a
+    # one-shot slope by 2× (docs/PERF.md round-4 methodology) — the
+    # minimum per-dispatch time is the engine's.
+    best_per = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run(1)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(1 + reps)
+        tr = time.perf_counter() - t0
+        per = (tr - t1) / reps
+        if per > 0 and (best_per is None or per < best_per):
+            best_per = per
+    return (n / best_per) if best_per else None
 
 
 def _probe_wire_mbps() -> float:

@@ -441,7 +441,14 @@ class PreparedBatch:
         if _claims_ext is not None:
             offs = np.ascontiguousarray(off[idx], np.int64)
             lens = np.ascontiguousarray(ln[idx], np.int64)
-            parsed, n_bad = _claims_ext.parse_batch(scratch, offs, lens)
+            res = _claims_ext.parse_batch(scratch, offs, lens)
+            if isinstance(res, tuple):
+                parsed, n_bad = res
+            else:
+                # pre-(list, n_bad) extension build still loaded (a
+                # failed rebuild keeps the old .so): no fast-path count,
+                # take the per-token branch below.
+                parsed, n_bad = res, -1
             idx_list = idx.tolist()
             if n_bad == 0:
                 # All dicts: one C-level bulk insert, no per-token
