@@ -273,6 +273,28 @@ def test_claims_ext_fuzz_parity():
             assert got == want and _same_typed(got, want), p
 
 
+def test_claims_ext_degenerate_batches_overflow_caches():
+    """Intern-table caps (256 keys / value-table entries / 64-byte value
+    threshold) must only change speed, never results: an all-unique
+    batch overflows every cache and still parses byte-identically."""
+    payloads = []
+    # > 256 distinct keys across the batch (key-cache cap), > 4096
+    # distinct short values (value-table cap), values straddling the
+    # 64-byte cache threshold, and > 5 keys per object (presize path).
+    for i in range(1200):
+        obj = {
+            f"uk{i}a": f"val-{i}-alpha", f"uk{i}b": f"val-{i}-beta",
+            f"uk{i}c": i, f"uk{i}d": f"v{i}" * 3, f"uk{i}e": True,
+            f"uk{i}f": "x" * 63, f"uk{i}g": "y" * 64, f"uk{i}h": "z" * 65,
+            "shared": "common-value",
+        }
+        payloads.append(json.dumps(obj, separators=(",", ":")).encode())
+    out = _run_claims_batch(payloads)
+    for p, got in zip(payloads, out):
+        want = json.loads(p)
+        assert got == want and _same_typed(got, want), p
+
+
 def test_prefetch_claims_uses_ext_with_identical_results():
     """PreparedBatch.prefetch_claims: ext path == pure-json path."""
     priv, _ = captest.generate_keys(algs.ES256)
