@@ -427,8 +427,21 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
              q_off + key_base + i * per])
         return add_from_table(state, d, row0)
 
-    X2, Y2, Z2, inf2, deg2 = lax.fori_loop(
-        0, n_windows, ladder_body, (X, Y, Z, inf, deg0))
+    if use_fused and pallas_madd.ladder_enabled():
+        # Whole-ladder fusion: one pallas_call, state VMEM-resident
+        # across all windows (pallas_madd.ladder_fused). Same math,
+        # same table rows, same masks — the per-window path above
+        # remains the A/B reference.
+        w_ids = jnp.arange(n_windows, dtype=jnp.int32)[:, None]
+        d_all = jnp.concatenate([dig1, dig2], axis=1)
+        row0_all = jnp.concatenate(
+            [jnp.broadcast_to(w_ids * per, (n_windows, n_tok)),
+             q_off + key_base[None, :] + w_ids * per], axis=1)
+        X2, Y2, Z2, inf2, deg2 = pallas_madd.ladder_fused(
+            c, tab, d_all, row0_all, interpret=interp)
+    else:
+        X2, Y2, Z2, inf2, deg2 = lax.fori_loop(
+            0, n_windows, ladder_body, (X, Y, Z, inf, deg0))
 
     def half(pair, lo):
         return (lax.dynamic_slice_in_dim(pair[0], lo, n_tok, axis=1),

@@ -54,28 +54,19 @@ def enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
-                 pxa_ref, pxb_ref, pya_ref, pyb_ref,
-                 has_ref, inf_ref,
-                 mA_ref, mB_ref, sigc_ref, nB_ref,
-                 wabh_ref, wabl_ref, wbah_ref, wbal_ref,
-                 amodb_ref, bmoda_ref, invab_ref, invmib_ref,
-                 cpA_ref, cpB_ref, oneA_ref, oneB_ref,
-                 c14a_ref, c14b_ref,
-                 oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
-                 deg_ref):
-    mA = mA_ref[:]                       # [IA, 1]
-    mB = mB_ref[:]
+def _madd_math(X, Y, Z, x2, y2, has, inf,
+               mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+               amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
+               c14a, c14b):
+    """One mixed-add step on VALUES (VMEM arrays, not refs).
+
+    Bit-identical to ec_rns._madd_rns + the lift/digit-0 select;
+    shared verbatim by the single-window kernel and the fused
+    multi-window ladder kernel so their numerics cannot diverge.
+    Returns (oxa, oxb, oya, oyb, oza, ozb, deg).
+    """
     invA_f = 1.0 / mA.astype(F32)
     invB_f = 1.0 / mB.astype(F32)
-    sigc = sigc_ref[:]
-    nB = nB_ref[:]
-    invab = invab_ref[:]
-    invmib = invmib_ref[:]
-    cpA = cpA_ref[:]                     # [IA, maxc] (pre-transposed:
-    cpB = cpB_ref[:]                     # static 2-D slices only —
-                                         # int indexing lowers to a
-                                         # gather Mosaic rejects)
 
     def fixA(v):
         return _fix(v, mA, invA_f)
@@ -85,16 +76,14 @@ def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
 
     def redc(pA, pB):
         sig = fixA(pA * sigc)
-        q_B = _extend_in_kernel(sig, invA_f, wabh_ref[:], wabl_ref[:],
-                                mB, invB_f, amodb_ref[:], -1e-4,
-                                c14b_ref[:])
+        q_B = _extend_in_kernel(sig, invA_f, wabh, wabl,
+                                mB, invB_f, amodb, -1e-4, c14b)
         # q·p + x < 2^28 — one fix covers the merged product-and-add
         t_B = fixB(pB + q_B * nB)
         t_B = fixB(t_B * invab)
         sig2 = fixB(t_B * invmib)
-        t_A = _extend_in_kernel(sig2, invB_f, wbah_ref[:], wbal_ref[:],
-                                mA, invA_f, bmoda_ref[:], 0.5 - 1e-4,
-                                c14a_ref[:])
+        t_A = _extend_in_kernel(sig2, invB_f, wbah, wbal,
+                                mA, invA_f, bmoda, 0.5 - 1e-4, c14a)
         return t_A, t_B
 
     def rmul(a, b):
@@ -113,14 +102,6 @@ def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
 
     def rfix(a):
         return (fixA(a[0]), fixB(a[1]))
-
-    X = (xa_ref[:], xb_ref[:])
-    Y = (ya_ref[:], yb_ref[:])
-    Z = (za_ref[:], zb_ref[:])
-    x2 = (pxa_ref[:], pxb_ref[:])
-    y2 = (pya_ref[:], pyb_ref[:])
-    has = has_ref[:]                     # [1, T] i32 0/1
-    inf = inf_ref[:]
 
     # _madd_rns, layer for layer (bounds comments live there).
     z1z1 = rmul(Z, Z)
@@ -158,8 +139,6 @@ def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
 
     # infinity lift + digit-0 select (ec_rns.add_from_table semantics)
     lift = inf & has
-    oneA = oneA_ref[:]
-    oneB = oneB_ref[:]
 
     def pick(res, addend, one_col, orig):
         sel_l = lift != 0
@@ -167,12 +146,43 @@ def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
             jnp.where(sel_l, jnp.broadcast_to(one_col, res.shape), res)
         return jnp.where(has != 0, r, orig)
 
-    oxa_ref[:] = pick(X3[0], x2[0], None, X[0])
-    oxb_ref[:] = pick(X3[1], x2[1], None, X[1])
-    oya_ref[:] = pick(Y3[0], y2[0], None, Y[0])
-    oyb_ref[:] = pick(Y3[1], y2[1], None, Y[1])
-    oza_ref[:] = pick(Z3[0], None, oneA, Z[0])
-    ozb_ref[:] = pick(Z3[1], None, oneB, Z[1])
+    return (pick(X3[0], x2[0], None, X[0]),
+            pick(X3[1], x2[1], None, X[1]),
+            pick(Y3[0], y2[0], None, Y[0]),
+            pick(Y3[1], y2[1], None, Y[1]),
+            pick(Z3[0], None, oneA, Z[0]),
+            pick(Z3[1], None, oneB, Z[1]),
+            deg)
+
+
+def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
+                 pxa_ref, pxb_ref, pya_ref, pyb_ref,
+                 has_ref, inf_ref,
+                 mA_ref, mB_ref, sigc_ref, nB_ref,
+                 wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                 amodb_ref, bmoda_ref, invab_ref, invmib_ref,
+                 cpA_ref, cpB_ref, oneA_ref, oneB_ref,
+                 c14a_ref, c14b_ref,
+                 oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
+                 deg_ref):
+    # cpA/cpB are [I, maxc] pre-transposed: static 2-D slices only —
+    # int indexing lowers to a gather Mosaic rejects.
+    oxa, oxb, oya, oyb, oza, ozb, deg = _madd_math(
+        (xa_ref[:], xb_ref[:]), (ya_ref[:], yb_ref[:]),
+        (za_ref[:], zb_ref[:]),
+        (pxa_ref[:], pxb_ref[:]), (pya_ref[:], pyb_ref[:]),
+        has_ref[:], inf_ref[:],
+        mA_ref[:], mB_ref[:], sigc_ref[:], nB_ref[:],
+        wabh_ref[:], wabl_ref[:], wbah_ref[:], wbal_ref[:],
+        amodb_ref[:], bmoda_ref[:], invab_ref[:], invmib_ref[:],
+        cpA_ref[:], cpB_ref[:], oneA_ref[:], oneB_ref[:],
+        c14a_ref[:], c14b_ref[:])
+    oxa_ref[:] = oxa
+    oxb_ref[:] = oxb
+    oya_ref[:] = oya
+    oyb_ref[:] = oyb
+    oza_ref[:] = oza
+    ozb_ref[:] = ozb
     deg_ref[:] = deg
 
 
@@ -244,6 +254,163 @@ def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
                         + [col_spec(1)]),
         interpret=interpret,
     )(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf, *consts)
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-window ladder: ALL windows of the table walk in ONE
+# pallas_call. Windows ride the minor grid axis; the X/Y/Z state planes
+# live in revisited VMEM output blocks for the whole ladder (the
+# initial state is all-zeros-at-infinity, so window 0 zero-initializes
+# them in-kernel), and only the pre-gathered per-window table rows
+# stream from HBM. Per-window entry-infinity masks precompute as an
+# exclusive any-scan of has = (digit > 0) — identical to the
+# sequential inf &= ~has updates of the per-window path.
+# ---------------------------------------------------------------------------
+
+
+def ladder_enabled() -> bool:
+    """Whole-ladder fusion: opt-in via CAP_TPU_PALLAS_LADDER=1.
+
+    Deliberately default-OFF: bit-exact (parity suites cover it
+    interpret-mode and compiled) but measured SLOWER on v5e — 47.6 ms
+    vs 39.5 ms per-window @32k resident ES256 — because the kernel is
+    VPU-bound and the mandatory pre-gather serializes ahead of it
+    (docs/PERF.md round-4 A/B). Kept as a tested reference for parts
+    with a different VPU/HBM balance.
+    """
+    v = os.environ.get("CAP_TPU_PALLAS_LADDER")
+    return v is not None and v not in ("0", "false", "no")
+
+
+def _ladder_kernel(g_ref, has_ref, inf_ref,
+                   mA_ref, mB_ref, sigc_ref, nB_ref,
+                   wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                   amodb_ref, bmoda_ref, invab_ref, invmib_ref,
+                   cpA_ref, cpB_ref, oneA_ref, oneB_ref,
+                   c14a_ref, c14b_ref,
+                   oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
+                   deg_ref, *, ia: int, ib: int):
+    from jax.experimental import pallas as pl
+
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        # Ladder starts at infinity: zero planes + inf=1 (the window-0
+        # inf mask is all-ones by construction of the entry-inf scan).
+        for ref in (oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref,
+                    ozb_ref, deg_ref):
+            ref[:] = jnp.zeros(ref.shape, ref.dtype)
+
+    iab = ia + ib
+    g = g_ref[:][0]                     # [1, 2*iab, T] → [2*iab, T]
+    x2 = (g[0:ia], g[ia:iab])
+    y2 = (g[iab:iab + ia], g[iab + ia:2 * iab])
+    oxa, oxb, oya, oyb, oza, ozb, deg = _madd_math(
+        (oxa_ref[:], oxb_ref[:]), (oya_ref[:], oyb_ref[:]),
+        (oza_ref[:], ozb_ref[:]), x2, y2,
+        has_ref[:][0], inf_ref[:][0],
+        mA_ref[:], mB_ref[:], sigc_ref[:], nB_ref[:],
+        wabh_ref[:], wabl_ref[:], wbah_ref[:], wbal_ref[:],
+        amodb_ref[:], bmoda_ref[:], invab_ref[:], invmib_ref[:],
+        cpA_ref[:], cpB_ref[:], oneA_ref[:], oneB_ref[:],
+        c14a_ref[:], c14b_ref[:])
+    oxa_ref[:] = oxa
+    oxb_ref[:] = oxb
+    oya_ref[:] = oya
+    oyb_ref[:] = oyb
+    oza_ref[:] = oza
+    ozb_ref[:] = ozb
+    deg_ref[:] = deg_ref[:] | deg
+
+
+@partial(jax.jit,
+         static_argnames=("ia", "ib", "n_windows", "interpret"))
+def _ladder_call(G, has, inf,
+                 mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+                 amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
+                 c14a, c14b,
+                 ia: int, ib: int, n_windows: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    iab = ia + ib
+    m = has.shape[2]
+    grid = (m // _TILE, n_windows)
+
+    def state_spec(rows):
+        # Same block for every window step at fixed tile: the state
+        # stays VMEM-resident across the whole ladder and flushes to
+        # HBM once per tile.
+        return pl.BlockSpec((rows, _TILE), lambda t, w: (0, t),
+                            memory_space=pltpu.VMEM)
+
+    # 3-D table blocks: the channel axis spans the FULL dimension (the
+    # Mosaic block rule needs last-two block dims divisible by (8, 128)
+    # or equal to the array's), window rides the leading axis.
+    g_spec = pl.BlockSpec((1, 2 * iab, _TILE), lambda t, w: (w, 0, t),
+                          memory_space=pltpu.VMEM)
+    win_spec = pl.BlockSpec((1, 1, _TILE), lambda t, w: (w, 0, t),
+                            memory_space=pltpu.VMEM)
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda t, w: tuple(0 for _ in shape),
+                            memory_space=pltpu.VMEM)
+
+    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+              invab, invmib, cpA, cpB, oneA, oneB, c14a, c14b)
+    outs = (jax.ShapeDtypeStruct((ia, m), I32),
+            jax.ShapeDtypeStruct((ib, m), I32)) * 3 + \
+        (jax.ShapeDtypeStruct((1, m), I32),)
+    return pl.pallas_call(
+        partial(_ladder_kernel, ia=ia, ib=ib),
+        out_shape=outs,
+        grid=grid,
+        in_specs=[g_spec, win_spec, win_spec]
+        + [const_spec(a.shape) for a in consts],
+        out_specs=tuple([state_spec(ia), state_spec(ib)] * 3
+                        + [state_spec(1)]),
+        interpret=interpret,
+    )(G, has, inf, *consts)
+
+
+def ladder_fused(c, tab, d_all, row0_all, interpret: bool = False):
+    """Run the whole window ladder in one kernel.
+
+    tab: fused [rows, 2I] x‖y window table (ec_rns layout);
+    d_all / row0_all: [W, M] per-window digits and table-row bases
+    (M = lane count, both accumulator chains concatenated).
+    Returns (X, Y, Z, inf, deg) exactly as the per-window fori_loop:
+    residue-plane pairs, final infinity mask, accumulated degeneracy.
+    """
+    ia, ib = c.A.count, c.B.count
+    iab = ia + ib
+    n_windows, m = d_all.shape
+    has_all = d_all > 0
+    idx = row0_all + jnp.where(has_all, d_all - 1, 0)
+    g = jnp.take(tab, idx.reshape(-1), axis=0)       # [W*M, 2I]
+    G = g.reshape(n_windows, m, 2 * iab).transpose(0, 2, 1)
+    has_i = has_all.astype(I32)
+    hc = jnp.cumsum(has_i, axis=0)
+    inf_i = ((hc - has_i) == 0).astype(I32)          # ENTRY infinity
+    pad = (-m) % _TILE
+    if pad:
+        G = jnp.pad(G, ((0, 0), (0, 0), (0, pad)))
+        has_i = jnp.pad(has_i, ((0, 0), (0, pad)))
+        # padding lanes: inf=1, has=0 → zero planes pass through
+        inf_i = jnp.pad(inf_i, ((0, 0), (0, pad)), constant_values=1)
+    # [W, 1, M]: singleton middle axis keeps Mosaic's last-two-dims
+    # block rule satisfied (block (1, 1, TILE))
+    has_i = has_i[:, None, :]
+    inf_i = inf_i[:, None, :]
+    out = _ladder_call(G, has_i, inf_i, *_ctx_consts(c),
+                       ia=ia, ib=ib, n_windows=n_windows,
+                       interpret=interpret)
+    oxa, oxb, oya, oyb, oza, ozb, deg = out
+    sl = slice(0, m)
+    inf_fin = hc[n_windows - 1] == 0
+    return ((oxa[:, sl], oxb[:, sl]), (oya[:, sl], oyb[:, sl]),
+            (oza[:, sl], ozb[:, sl]), inf_fin, deg[0, sl] != 0)
 
 
 def madd_fused(c, X, Y, Z, inf, has, x2, y2, interpret: bool = False):

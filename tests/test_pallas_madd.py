@@ -63,6 +63,47 @@ def test_fused_madd_matches_xla_path(monkeypatch):
 
 
 @pytest.mark.heavy
+def test_fused_ladder_matches_xla_path(monkeypatch):
+    """Whole-ladder fusion (pallas_madd.ladder_fused, interpret mode):
+    same verdicts as the XLA path on accepts, a tampered signature, and
+    an all-zero (range-rejected) signature. The per-window and fused
+    ladders share _madd_math, so this pins the grid/masking plumbing —
+    pre-gathered window rows, the entry-infinity scan, VMEM-resident
+    state init at window 0 — not re-derived arithmetic."""
+    monkeypatch.setenv("CAP_TPU_RNS", "1")
+
+    privs = [cec.generate_private_key(cec.SECP256R1()) for _ in range(2)]
+    digest = hashlib.sha256(b"ladder parity").digest()
+    sigs, rows = [], []
+    for i, p in enumerate(privs):
+        r, s = decode_dss_signature(
+            p.sign(b"ladder parity", cec.ECDSA(hashes.SHA256())))
+        sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+        rows.append(i)
+    bad = bytearray(sigs[0])
+    bad[-1] ^= 1
+    sigs.append(bytes(bad)); rows.append(0)
+    sigs.append(b"\x00" * 64); rows.append(0)
+    digests = [digest] * len(sigs)
+    rows = np.asarray(rows, np.int32)
+
+    monkeypatch.setenv("CAP_TPU_PALLAS_MADD", "0")
+    table = ECKeyTable("P-256", [p.public_key() for p in privs])
+    ok_xla = verify_ecdsa_batch(table, sigs, digests, rows)
+
+    from cap_tpu.tpu import ec_rns
+    monkeypatch.setenv("CAP_TPU_PALLAS_MADD", "1")
+    monkeypatch.setenv("CAP_TPU_PALLAS_LADDER", "1")
+    ec_rns._ecdsa_rns_core.clear_cache()
+    table2 = ECKeyTable("P-256", [p.public_key() for p in privs])
+    ok_ladder = verify_ecdsa_batch(table2, sigs, digests, rows)
+    ec_rns._ecdsa_rns_core.clear_cache()
+
+    assert list(ok_xla) == list(ok_ladder)
+    assert list(ok_xla) == [True, True, False, False]
+
+
+@pytest.mark.heavy
 def test_compiled_mosaic_parity_on_chip():
     """The COMPILED Mosaic kernel vs the XLA path on the real chip.
 
@@ -125,7 +166,14 @@ ec_rns._ecdsa_rns_core.clear_cache()
 table2 = ECKeyTable("P-256", [p.public_key() for p in privs])
 ok_mosaic = [bool(v)
              for v in verify_ecdsa_batch(table2, sigs, digests, rows)]
-print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic}))
+
+os.environ["CAP_TPU_PALLAS_LADDER"] = "1"  # fused whole-ladder kernel
+ec_rns._ecdsa_rns_core.clear_cache()
+table3 = ECKeyTable("P-256", [p.public_key() for p in privs])
+ok_ladder = [bool(v)
+             for v in verify_ecdsa_batch(table3, sigs, digests, rows)]
+print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic,
+                  "ladder": ok_ladder}))
 """ % (repo,)
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_", "CAP_TPU_"))}
@@ -136,4 +184,5 @@ print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic}))
     if "skip" in out:
         pytest.skip(out["skip"])
     assert out["xla"] == out["mosaic"], out
+    assert out["xla"] == out["ladder"], out
     assert out["xla"] == [True, True, False, False, False, False], out
