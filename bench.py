@@ -58,47 +58,17 @@ def _make_fixtures(n_unique: int):
 def _resident_mixed_vps(ks, tokens):
     """Engine-side number (VERDICT r3 #2): verifies/sec with the packed
     records already DEVICE-RESIDENT — no host prep, packing, or H2D on
-    the timed path. Slope-timed (t(1+R) - t(1)) / R so dispatch/sync
-    constants cancel; the tunnel's bandwidth cannot touch it. Each
-    dispatch's accept-bit sum is checked against the token count, so a
-    broken engine cannot produce a clean rate.
+    the timed path. Methodology (slope, min-of-3, accept-sum check)
+    lives in ``resident_slope_vps`` — one implementation shared with
+    tools/profile_families.py.
     """
-    from cap_tpu.jwt.tpu_keyset import resident_dispatchers
+    from cap_tpu.jwt.tpu_keyset import (
+        resident_dispatchers,
+        resident_slope_vps,
+    )
 
     n, fns = resident_dispatchers(ks, tokens)
-
-    def run(reps: int) -> None:
-        outs = []
-        for _ in range(reps):
-            outs.extend(fn() for _, fn in fns)
-        total = outs[0]
-        for o in outs[1:]:
-            total = total + o
-        got = int(total)              # materializing sync
-        if got != reps * n:
-            raise RuntimeError(
-                f"resident engine verdict mismatch: {got} accepts "
-                f"for {reps}×{n} valid tokens")
-
-    reps = 4
-    run(1)                            # compile + settle
-    run(1 + reps)
-    # MIN OF 3 slope trials: dispatch and the materializing sync ride
-    # the tunnel, so a single stall inside a timed window shifts a
-    # one-shot slope by 2× (docs/PERF.md round-4 methodology) — the
-    # minimum per-dispatch time is the engine's.
-    best_per = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run(1)
-        t1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        run(1 + reps)
-        tr = time.perf_counter() - t0
-        per = (tr - t1) / reps
-        if per > 0 and (best_per is None or per < best_per):
-            best_per = per
-    return (n / best_per) if best_per else None
+    return resident_slope_vps(n, fns)
 
 
 def _probe_wire_mbps() -> float:

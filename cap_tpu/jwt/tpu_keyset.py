@@ -279,6 +279,51 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str]):
     return int(covered.sum()), fns
 
 
+def resident_slope_vps(n: int, fns, reps: int = 4,
+                       trials: int = 3) -> Optional[float]:
+    """Slope-time resident dispatchers → verifies/sec, or None.
+
+    THE resident methodology (bench.py ``resident_mixed_vps``,
+    tools/profile_families.py — one implementation so a fix cannot
+    diverge): each trial times 1 reps and 1+``reps`` reps of the full
+    dispatcher set and takes the slope, cancelling dispatch/sync
+    constants; the MINIMUM per-dispatch time across ``trials`` trials
+    is the engine's (dispatch and the materializing sync ride the
+    tunnel, so one stall shifts a single-trial slope by 2× —
+    docs/PERF.md). Every dispatch's accept-bit sum is checked against
+    the token count, so a broken engine cannot produce a clean rate.
+    Returns None when no trial yields a positive slope (timer noise on
+    sub-millisecond families).
+    """
+    def run(reps_: int) -> None:
+        outs = []
+        for _ in range(reps_):
+            outs.extend(fn() for _, fn in fns)
+        total = outs[0]
+        for o in outs[1:]:
+            total = total + o
+        got = int(total)                  # materializing sync
+        if got != reps_ * n:
+            raise RuntimeError(
+                f"resident engine verdict mismatch: {got} accepts "
+                f"for {reps_}×{n} valid tokens")
+
+    run(1)                                # compile + settle
+    run(1 + reps)
+    best = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        run(1)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(1 + reps)
+        tr = time.perf_counter() - t0
+        per = (tr - t1) / reps
+        if per > 0 and (best is None or per < best):
+            best = per
+    return (n / best) if best else None
+
+
 class TPUBatchKeySet(KeySet):
     """KeySet whose batch path runs on the TPU verify engine.
 

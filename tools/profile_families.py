@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Resident packed-path verifies/sec for EVERY algorithm family.
+
+The headline bench measures the RS256/ES256 mix; this walks all ten
+JOSE algorithms through the same resident methodology (records already
+on device, min-of-3 slope, accept-sum checked) so the per-family
+engine rates are on record. Usage:
+
+    python tools/profile_families.py [n_tokens]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ALGS = ["RS256", "RS384", "RS512", "PS256", "PS384", "PS512",
+        "ES256", "ES384", "ES512", "EdDSA"]
+
+
+def measure(alg: str, n: int):
+    from cap_tpu import testing as T
+    from cap_tpu.jwt.jwk import JWK
+    from cap_tpu.jwt.tpu_keyset import (
+        TPUBatchKeySet,
+        resident_dispatchers,
+        resident_slope_vps,
+    )
+
+    priv, pub = T.generate_keys(alg)
+    ks = TPUBatchKeySet([JWK(pub, kid="k0")])
+    base = [T.sign_jwt(priv, alg, T.default_claims(sub=f"s{i}"), kid="k0")
+            for i in range(512)]
+    toks = (base * ((n // len(base)) + 1))[:n]
+    n_tok, fns = resident_dispatchers(ks, toks)
+    return n_tok, resident_slope_vps(n_tok, fns)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    print(f"resident packed path, {n} tokens/family, min-of-3 slope")
+    for alg in ALGS:
+        try:
+            n_tok, vps = measure(alg, n)
+            if vps is None:
+                print(f"{alg:6s} no clean slope (timer noise)",
+                      flush=True)
+                continue
+            print(f"{alg:6s} {n_tok / vps * 1e3:7.1f} ms  "
+                  f"{vps / 1e3:7.0f}k verifies/s", flush=True)
+        except Exception as e:  # noqa: BLE001 - report and continue
+            print(f"{alg:6s} FAILED: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
