@@ -1340,8 +1340,20 @@ class TPURemoteKeySet(KeySet):
             raise
 
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        return self._verify_rotation_aware(tokens, raw=False)
+
+    def verify_batch_raw(self, tokens: Sequence[str]) -> List[Any]:
+        """Raw-claims analog of ``verify_batch`` (the serve default):
+        accepted tokens yield their signed payload BYTES, rejects keep
+        the dict path's error classes, and the same at-most-one
+        rotation refetch applies."""
+        return self._verify_rotation_aware(tokens, raw=True)
+
+    def _verify_rotation_aware(self, tokens: Sequence[str],
+                               raw: bool) -> List[Any]:
         ks = self._ensure()
-        results = ks.verify_batch(tokens)
+        call = ks.verify_batch_raw if raw else ks.verify_batch
+        results = call(tokens)
         missed: List[int] = []
         for i, r in enumerate(results):
             if not isinstance(r, InvalidSignatureError):
@@ -1361,7 +1373,9 @@ class TPURemoteKeySet(KeySet):
             # original per-token InvalidSignatureError results instead.
             try:
                 ks = self._ensure(refresh=True)
-                retry = ks.verify_batch([tokens[i] for i in missed])
+                retry_call = ks.verify_batch_raw if raw else \
+                    ks.verify_batch
+                retry = retry_call([tokens[i] for i in missed])
             except Exception:  # noqa: BLE001 - network/IdP failure
                 telemetry.count("jwks.rotation_refetch_failed")
             else:

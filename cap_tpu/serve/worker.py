@@ -19,17 +19,23 @@ from . import protocol
 from .batcher import AdaptiveBatcher
 
 
-class _RawClaims:
-    """Route the batcher at a keyset's raw-claims entry points."""
+class _RawClaimsSync:
+    """Route the batcher at a keyset's SYNC raw-claims entry point
+    (rotation-aware keysets like TPURemoteKeySet: no async dispatch,
+    the batcher falls back to its sync path)."""
 
     def __init__(self, keyset):
         self._keyset = keyset
 
-    def verify_batch_async(self, tokens):
-        return self._keyset.verify_batch_async_raw(tokens)
-
     def verify_batch(self, tokens):
         return self._keyset.verify_batch_raw(tokens)
+
+
+class _RawClaims(_RawClaimsSync):
+    """Raw entry points including async dispatch (TPUBatchKeySet)."""
+
+    def verify_batch_async(self, tokens):
+        return self._keyset.verify_batch_async_raw(tokens)
 
 
 class VerifyWorker:
@@ -52,6 +58,8 @@ class VerifyWorker:
         # the dict path; the wire format is identical either way.
         if raw_claims and hasattr(keyset, "verify_batch_async_raw"):
             keyset = _RawClaims(keyset)
+        elif raw_claims and hasattr(keyset, "verify_batch_raw"):
+            keyset = _RawClaimsSync(keyset)
         self._batcher = AdaptiveBatcher(
             keyset, target_batch=target_batch, max_wait_ms=max_wait_ms,
             max_batch=max_batch)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import datetime
 import json
+from contextlib import contextmanager
 from typing import Any, Dict, Optional, Tuple
 
 from cryptography import x509
@@ -177,3 +178,39 @@ def generate_ca(common_name: str = "cap-tpu-test-ca") -> Tuple[str, Any, str]:
         serialization.NoEncryption(),
     ).decode()
     return cert_pem, key, key_pem
+
+
+@contextmanager
+def jwks_test_server(state: Dict[str, Any]):
+    """Serve ``{"keys": state["keys"]}`` over loopback HTTP.
+
+    The JWKS analog of :class:`TestProvider` for tests that need ONLY a
+    rotating key endpoint (remote/discovery keysets): mutate
+    ``state["keys"]`` between requests to rotate; every GET increments
+    ``state["fetches"]``. Yields ``(url, server)`` — the server handle
+    lets failure tests shut the endpoint down mid-test.
+    """
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    state.setdefault("fetches", 0)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            state["fetches"] += 1
+            body = json.dumps({"keys": state["keys"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}/jwks", srv
+    finally:
+        srv.shutdown()

@@ -180,6 +180,37 @@ def test_end_to_end_real_keyset():
         w.close()
 
 
+def test_worker_raw_over_remote_keyset():
+    """The serve default (raw claims) must work behind the
+    rotation-aware TPURemoteKeySet: the worker routes through the SYNC
+    raw adapter (no async entry on remote keysets) and the wire
+    responses match the plain-keyset dict path byte-for-byte."""
+    from cap_tpu import testing as captest
+    from cap_tpu.jwt.jwk import serialize_public_key
+    from cap_tpu.jwt.tpu_keyset import TPURemoteKeySet
+    from cap_tpu.serve.worker import _RawClaimsSync
+
+    priv, pub = captest.generate_keys("ES256")
+    state = {"keys": [serialize_public_key(pub, kid="r0")]}
+
+    with captest.jwks_test_server(state) as (url, _srv):
+        ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
+        good = captest.sign_jwt(priv, "ES256", captest.default_claims(),
+                                kid="r0")
+        bad = good[:-8] + ("AAAAAAAA" if not good.endswith("AAAAAAAA")
+                           else "BBBBBBBB")
+        w = VerifyWorker(ks, target_batch=4, max_wait_ms=5.0)
+        try:
+            assert isinstance(w._batcher._keyset, _RawClaimsSync)
+            host, port = w.address
+            with VerifyClient(host, port, timeout=600.0) as c:
+                res = c.verify_batch([good, bad, good])
+            assert res[0]["iss"] == res[2]["iss"]
+            assert isinstance(res[1], RemoteVerifyError)
+        finally:
+            w.close()
+
+
 def test_native_client_roundtrip():
     """The C ABI client shim against a live worker (built via make)."""
     pytest.importorskip("ctypes")

@@ -156,35 +156,14 @@ def test_es_falls_back_to_cpu_until_ec_engine(tpu_keyset, rsa_jwks):
 def test_remote_keyset_rotation():
     """TPURemoteKeySet: unknown kid triggers ONE refetch + table rebuild;
     bad signatures against known kids never refetch (no amplification)."""
-    import json as jsonlib
-    import threading
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
     from cap_tpu.jwt.jwk import serialize_public_key
     from cap_tpu.jwt.tpu_keyset import TPURemoteKeySet
 
     priv1, pub1 = captest.generate_keys("ES256")
     priv2, pub2 = captest.generate_keys("ES256")
-    state = {"keys": [serialize_public_key(pub1, kid="gen1")],
-             "fetches": 0}
+    state = {"keys": [serialize_public_key(pub1, kid="gen1")]}
 
-    class H(BaseHTTPRequestHandler):
-        def do_GET(self):
-            state["fetches"] += 1
-            body = jsonlib.dumps({"keys": state["keys"]}).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *a):
-            pass
-
-    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    try:
-        url = f"http://127.0.0.1:{srv.server_address[1]}/jwks"
+    with captest.jwks_test_server(state) as (url, _srv):
         ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
         claims = captest.default_claims()
         tok1 = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
@@ -231,18 +210,47 @@ def test_remote_keyset_rotation():
         assert state["fetches"] <= fetches + 1   # cooldown caps fetches
         assert ks2._ks is table_obj              # content unchanged →
         #                                          no table rebuild
-    finally:
-        srv.shutdown()
+
+
+def test_remote_keyset_raw_mode_rotation():
+    """TPURemoteKeySet.verify_batch_raw: accepted tokens yield payload
+    BYTES equal to the dict path's claims, and kid rotation still
+    triggers exactly one refetch with per-token verdicts preserved."""
+    import json as jsonlib
+
+    from cap_tpu.jwt.jwk import serialize_public_key
+    from cap_tpu.jwt.tpu_keyset import TPURemoteKeySet
+
+    priv1, pub1 = captest.generate_keys("ES256")
+    priv2, pub2 = captest.generate_keys("ES256")
+    state = {"keys": [serialize_public_key(pub1, kid="gen1")]}
+
+    with captest.jwks_test_server(state) as (url, _srv):
+        ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
+        claims = captest.default_claims()
+        tok1 = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
+        forged = tok1[:-8] + ("AAAAAAAA" if not tok1.endswith("AAAAAAAA")
+                              else "BBBBBBBB")
+        raws = ks.verify_batch_raw([tok1, forged])
+        want = ks.verify_batch([tok1])
+        assert isinstance(raws[0], bytes)
+        assert jsonlib.loads(raws[0]) == want[0]
+        assert isinstance(raws[1], InvalidSignatureError)
+
+        # rotation mid-stream, raw path: one refetch, bytes come back
+        state["keys"] = [serialize_public_key(pub2, kid="gen2")]
+        tok2 = captest.sign_jwt(priv2, "ES256", claims, kid="gen2")
+        fetches_before = state["fetches"]
+        raws = ks.verify_batch_raw([tok2])
+        assert isinstance(raws[0], bytes)
+        assert jsonlib.loads(raws[0])["iss"] == claims["iss"]
+        assert state["fetches"] == fetches_before + 1
 
 
 def test_remote_keyset_refetch_failure_keeps_verdicts():
     """A failed rotation refetch (IdP down) must NOT discard the batch's
     verdicts: known-key results stay dicts, the unknown-kid token keeps
     its per-token InvalidSignatureError (ADVICE r1, medium)."""
-    import json as jsonlib
-    import threading
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
     from cap_tpu.jwt.jwk import serialize_public_key
     from cap_tpu.jwt.tpu_keyset import TPURemoteKeySet
 
@@ -250,35 +258,22 @@ def test_remote_keyset_refetch_failure_keeps_verdicts():
     evil_priv, _ = captest.generate_keys("ES256")  # NOT in the JWKS
     state = {"keys": [serialize_public_key(pub1, kid="gen1")]}
 
-    class H(BaseHTTPRequestHandler):
-        def do_GET(self):
-            body = jsonlib.dumps({"keys": state["keys"]}).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+    with captest.jwks_test_server(state) as (url, srv):
+        ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
+        claims = captest.default_claims()
+        good = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
+        assert isinstance(ks.verify_batch([good])[0], dict)
 
-        def log_message(self, *a):
-            pass
-
-    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
-    threading.Thread(target=srv.serve_forever, daemon=True).start()
-    url = f"http://127.0.0.1:{srv.server_address[1]}/jwks"
-    ks = TPURemoteKeySet(url, min_refresh_interval=0.0)
-    claims = captest.default_claims()
-    good = captest.sign_jwt(priv1, "ES256", claims, kid="gen1")
-    assert isinstance(ks.verify_batch([good])[0], dict)
-
-    # IdP goes away; a batch with one attacker token (unknown kid)
-    # plus legitimate tokens must still return per-token verdicts.
-    srv.shutdown()
-    srv.server_close()
-    evil = captest.sign_jwt(evil_priv, "ES256", claims, kid="no-such-kid")
-    out = ks.verify_batch([good, evil, good])
-    assert isinstance(out[0], dict)
-    assert isinstance(out[1], InvalidSignatureError)
-    assert isinstance(out[2], dict)
+        # IdP goes away; a batch with one attacker token (unknown kid)
+        # plus legitimate tokens must still return per-token verdicts.
+        srv.shutdown()
+        srv.server_close()
+        evil = captest.sign_jwt(evil_priv, "ES256", claims,
+                                kid="no-such-kid")
+        out = ks.verify_batch([good, evil, good])
+        assert isinstance(out[0], dict)
+        assert isinstance(out[1], InvalidSignatureError)
+        assert isinstance(out[2], dict)
 
 
 def test_resident_dispatchers_headline_mix():
