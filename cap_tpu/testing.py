@@ -214,3 +214,4 @@ def jwks_test_server(state: Dict[str, Any]):
         yield f"http://127.0.0.1:{srv.server_address[1]}/jwks", srv
     finally:
         srv.shutdown()
+        srv.server_close()  # release the listening fd (idempotent)
