@@ -9,10 +9,10 @@ HBM-traffic-bound (docs/PERF.md: the 160-layer ladder chain measures
 
 This kernel runs the whole REDC — channel products, σ, A→B extension,
 the B-side multiplies, and the B→A extension — on VMEM-resident tiles,
-touching HBM once for inputs and once for outputs. Enabled for
-per-channel (EC/Ed) contexts via CAP_TPU_PALLAS=1; A/B numbers in
-docs/PERF.md. The RSA REDC (per-token key constants) stays on the XLA
-path.
+touching HBM once for inputs and once for outputs. Serves per-channel
+(EC/Ed) contexts, default ON for TPU backends since the round-4 A/B
+(CAP_TPU_PALLAS=0/1 overrides; numbers in docs/PERF.md). The RSA REDC
+(per-token key constants) stays on the XLA path.
 
 Numerical contract: identical to rns._redc. The Barrett quotient
 guess is within ±1 of floor(v/m) for v < 2^31 (see _fix), and the two
@@ -41,9 +41,20 @@ _TILE = 2048        # lanes per grid step (multiple of 128)
 
 
 def enabled() -> bool:
-    """Fused Pallas REDC: opt-in via CAP_TPU_PALLAS=1 (A/B gate)."""
+    """Fused Pallas REDC: CAP_TPU_PALLAS=1/0 overrides.
+
+    Default ON for the TPU backend only — a GPU backend keeps the XLA
+    path, like pallas_madd (round-4 A/B, resident packed paths @16k,
+    min-of-3: EdDSA 549→602k/s, ES384 268→321k/s, ES256 608→618k/s,
+    ES512 neutral — the non-madd REDCs in the EC/Ed ladders, batch
+    inversion, and accumulator merge all ride it). CPU defaults to the
+    XLA path (the parity reference); setting CAP_TPU_PALLAS=1 on CPU
+    runs the kernel in interpret mode, which the parity tests use.
+    """
     v = os.environ.get("CAP_TPU_PALLAS")
-    return v is not None and v not in ("0", "false", "no")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    return jax.default_backend() == "tpu"
 
 
 def _fix(v, m, inv_f):
@@ -151,10 +162,10 @@ def _ctx_consts(c) -> tuple:
     return out
 
 
-@partial(jax.jit, static_argnames=("ia", "ib"))
+@partial(jax.jit, static_argnames=("ia", "ib", "interpret"))
 def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
                amodb, bmoda, invab, invmib, c14a, c14b,
-               ia: int, ib: int):
+               ia: int, ib: int, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -179,6 +190,7 @@ def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
         in_specs=[col_spec(ia), col_spec(ib)]
         + [const_spec(a.shape) for a in consts],
         out_specs=(col_spec(ia), col_spec(ib)),
+        interpret=interpret,
     )(xA, xB, *consts)
 
 
@@ -195,7 +207,8 @@ def redc_fused(c, x_A, x_B):
     if pad:
         x_A = jnp.pad(x_A, ((0, 0), (0, pad)))
         x_B = jnp.pad(x_B, ((0, 0), (0, pad)))
-    tA, tB = _redc_call(x_A, x_B, *_ctx_consts(c), ia=ia, ib=ib)
+    tA, tB = _redc_call(x_A, x_B, *_ctx_consts(c), ia=ia, ib=ib,
+                        interpret=jax.default_backend() == "cpu")
     if pad:
         tA = tA[:, :n]
         tB = tB[:, :n]

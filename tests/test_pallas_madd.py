@@ -104,6 +104,62 @@ def test_fused_ladder_matches_xla_path(monkeypatch):
 
 
 @pytest.mark.heavy
+def test_fused_redc_matches_xla_path(monkeypatch):
+    """Fused REDC kernel (pallas_redc, interpret mode): same verdicts
+    as the XLA path for ECDSA and Ed25519 — it now defaults ON for TPU
+    backends, so its arithmetic needs its own parity pin, not just
+    incidental bench coverage."""
+    monkeypatch.setenv("CAP_TPU_RNS", "1")
+    monkeypatch.setenv("CAP_TPU_PALLAS_MADD", "0")
+
+    privs = [cec.generate_private_key(cec.SECP256R1()) for _ in range(2)]
+    digest = hashlib.sha256(b"redc parity").digest()
+    sigs, rows = [], []
+    for i, p in enumerate(privs):
+        r, s = decode_dss_signature(
+            p.sign(b"redc parity", cec.ECDSA(hashes.SHA256())))
+        sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+        rows.append(i)
+    bad = bytearray(sigs[0])
+    bad[-1] ^= 1
+    sigs.append(bytes(bad)); rows.append(0)
+    digests = [digest] * len(sigs)
+    rows = np.asarray(rows, np.int32)
+
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as ced
+    from cap_tpu.tpu import ec_rns, ed25519_rns
+    from cap_tpu.tpu.ed25519 import Ed25519KeyTable, verify_ed25519_batch
+
+    ed_priv = ced.Ed25519PrivateKey.generate()
+    ed_table_keys = [ed_priv.public_key()]
+    ed_msgs = [b"redc parity ed", b"redc parity ed 2"]
+    ed_sigs = [ed_priv.sign(m) for m in ed_msgs]
+    ed_bad = bytearray(ed_sigs[0])
+    ed_bad[-1] ^= 1
+    ed_msgs.append(ed_msgs[0])
+    ed_sigs.append(bytes(ed_bad))
+    ed_rows = np.zeros(3, np.int32)
+
+    results = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("CAP_TPU_PALLAS", flag)
+        ec_rns._ecdsa_rns_core.clear_cache()
+        ed25519_rns._ed25519_rns_core.clear_cache()
+        table = ECKeyTable("P-256", [p.public_key() for p in privs])
+        ok_ec = list(verify_ecdsa_batch(table, sigs, digests, rows))
+        ed_table = Ed25519KeyTable(ed_table_keys)
+        ok_ed = list(verify_ed25519_batch(ed_table, ed_sigs, ed_msgs,
+                                          ed_rows))
+        results[flag] = (ok_ec, ok_ed)
+        ec_rns._ecdsa_rns_core.clear_cache()
+        ed25519_rns._ed25519_rns_core.clear_cache()
+
+    assert results["0"] == results["1"]
+    assert results["0"][0] == [True, True, False]
+    assert results["0"][1] == [True, True, False]
+
+
+@pytest.mark.heavy
 def test_compiled_mosaic_parity_on_chip():
     """The COMPILED Mosaic kernel vs the XLA path on the real chip.
 
@@ -172,8 +228,15 @@ ec_rns._ecdsa_rns_core.clear_cache()
 table3 = ECKeyTable("P-256", [p.public_key() for p in privs])
 ok_ladder = [bool(v)
              for v in verify_ecdsa_batch(table3, sigs, digests, rows)]
+
+os.environ["CAP_TPU_PALLAS_LADDER"] = "0"
+os.environ["CAP_TPU_PALLAS"] = "1"         # fused REDC (TPU default)
+ec_rns._ecdsa_rns_core.clear_cache()
+table4 = ECKeyTable("P-256", [p.public_key() for p in privs])
+ok_redc = [bool(v)
+           for v in verify_ecdsa_batch(table4, sigs, digests, rows)]
 print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic,
-                  "ladder": ok_ladder}))
+                  "ladder": ok_ladder, "redc": ok_redc}))
 """ % (repo,)
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_", "CAP_TPU_"))}
@@ -185,4 +248,5 @@ print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic,
         pytest.skip(out["skip"])
     assert out["xla"] == out["mosaic"], out
     assert out["xla"] == out["ladder"], out
+    assert out["xla"] == out["redc"], out
     assert out["xla"] == [True, True, False, False, False, False], out
