@@ -231,6 +231,11 @@ def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
     cat_t2 = jnp.concatenate([tb_t2, ta_t2], axis=0)
     q_off = tb_ym.shape[0]
 
+    from . import pallas_edw
+
+    use_fused = pallas_edw.enabled()
+    interp = jax.default_backend() == "cpu"   # interpret mode on CPU
+
     def ladder_body(i, state):
         X, Y, Z, T = state
         d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
@@ -238,6 +243,11 @@ def _ed25519_rns_core(s, kk, yr, sign_r, bad_key, key_idx,
         idx = jnp.concatenate(
             [i * PER + d1, q_off + key_base + i * PER + d2])
         ym, yp, t2 = gather3(cat_ym, cat_yp, cat_t2, idx)
+        if use_fused:
+            # One VMEM-resident kernel for the whole mixed-add
+            # (pallas_edw; bit-identical to _edw_madd_rns).
+            return pallas_edw.edw_madd_fused(c, X, Y, Z, T, ym, yp, t2,
+                                             interpret=interp)
         return _edw_madd_rns(c, X, Y, Z, T, ym, yp, t2)
 
     X, Y, Z, T = lax.fori_loop(0, NW8, ladder_body, (X, Y, Z, T))

@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .pallas_redc import _extend_in_kernel, _fix
+from .pallas_redc import _fix, make_rns_ops
 
 I32 = jnp.int32
 F32 = jnp.float32
@@ -66,42 +66,9 @@ def _madd_math(X, Y, Z, x2, y2, has, inf,
     Returns (oxa, oxb, oya, oyb, oza, ozb, deg).
     """
     invA_f = 1.0 / mA.astype(F32)
-    invB_f = 1.0 / mB.astype(F32)
-
-    def fixA(v):
-        return _fix(v, mA, invA_f)
-
-    def fixB(v):
-        return _fix(v, mB, invB_f)
-
-    def redc(pA, pB):
-        sig = fixA(pA * sigc)
-        q_B = _extend_in_kernel(sig, invA_f, wabh, wabl,
-                                mB, invB_f, amodb, -1e-4, c14b)
-        # q·p + x < 2^28 — one fix covers the merged product-and-add
-        t_B = fixB(pB + q_B * nB)
-        t_B = fixB(t_B * invab)
-        sig2 = fixB(t_B * invmib)
-        t_A = _extend_in_kernel(sig2, invB_f, wbah, wbal,
-                                mA, invA_f, bmoda, 0.5 - 1e-4, c14a)
-        return t_A, t_B
-
-    def rmul(a, b):
-        return redc(fixA(a[0] * b[0]), fixB(a[1] * b[1]))
-
-    def radd(a, b):
-        return (a[0] + b[0], a[1] + b[1])
-
-    def rsub(a, b, cmul: int, guard: int):
-        # a + cmul·p − b + guard·m: mirrors ec_rns.rsub's value/digit
-        # bound discipline exactly (bounds documented there).
-        ga = guard * mA
-        gb = guard * mB
-        return (a[0] + cpA[:, cmul:cmul + 1] - b[0] + ga,
-                a[1] + cpB[:, cmul:cmul + 1] - b[1] + gb)
-
-    def rfix(a):
-        return (fixA(a[0]), fixB(a[1]))
+    _, _, rmul, radd, rsub, rfix = make_rns_ops(
+        mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+        amodb, bmoda, invab, invmib, cpA, cpB, c14a, c14b)
 
     # _madd_rns, layer for layer (bounds comments live there).
     z1z1 = rmul(Z, Z)

@@ -108,6 +108,59 @@ def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
     return _fix(comb - corr + m_dst, m_dst, inv_dst_f)
 
 
+def make_rns_ops(mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+                 amodb, bmoda, invab, invmib, cpA, cpB, c14a, c14b):
+    """In-kernel RNS field-op closures over VALUE arrays.
+
+    One implementation of the REDC (both base extensions) and the lazy
+    add/sub discipline, shared by the fused mixed-add (pallas_madd)
+    and the fused Edwards-add (pallas_edw) kernels — their numerics
+    cannot diverge from each other or from this module's REDC kernel.
+    cpA/cpB are [I, maxc] PRE-TRANSPOSED (static 2-D slices only: int
+    indexing lowers to a gather Mosaic rejects). Returns
+    (fixA, fixB, rmul, radd, rsub, rfix) on (A, B) residue-plane pairs.
+    """
+    invA_f = 1.0 / mA.astype(F32)
+    invB_f = 1.0 / mB.astype(F32)
+
+    def fixA(v):
+        return _fix(v, mA, invA_f)
+
+    def fixB(v):
+        return _fix(v, mB, invB_f)
+
+    def redc(pA, pB):
+        sig = fixA(pA * sigc)
+        q_B = _extend_in_kernel(sig, invA_f, wabh, wabl,
+                                mB, invB_f, amodb, -1e-4, c14b)
+        # q·p + x < 2^28 — one fix covers the merged product-and-add
+        t_B = fixB(pB + q_B * nB)
+        t_B = fixB(t_B * invab)
+        sig2 = fixB(t_B * invmib)
+        t_A = _extend_in_kernel(sig2, invB_f, wbah, wbal,
+                                mA, invA_f, bmoda, 0.5 - 1e-4, c14a)
+        return t_A, t_B
+
+    def rmul(a, b):
+        return redc(fixA(a[0] * b[0]), fixB(a[1] * b[1]))
+
+    def radd(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def rsub(a, b, cmul: int, guard: int):
+        # a + cmul·p − b + guard·m: mirrors ec_rns.rsub's value/digit
+        # bound discipline exactly (bounds documented there).
+        ga = guard * mA
+        gb = guard * mB
+        return (a[0] + cpA[:, cmul:cmul + 1] - b[0] + ga,
+                a[1] + cpB[:, cmul:cmul + 1] - b[1] + gb)
+
+    def rfix(a):
+        return (fixA(a[0]), fixB(a[1]))
+
+    return fixA, fixB, rmul, radd, rsub, rfix
+
+
 def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
                  wabh_ref, wabl_ref, wbah_ref, wbal_ref,
                  amodb_ref, bmoda_ref, invab_ref, invmib_ref,

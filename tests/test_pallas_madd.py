@@ -160,6 +160,40 @@ def test_fused_redc_matches_xla_path(monkeypatch):
 
 
 @pytest.mark.heavy
+def test_fused_edwards_add_matches_xla_path(monkeypatch):
+    """Fused Edwards mixed-add (pallas_edw, interpret mode): same
+    Ed25519 verdicts as the XLA ladder — default ON for TPU, so its
+    arithmetic gets its own parity pin."""
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as ced
+    from cap_tpu.tpu import ed25519_rns
+    from cap_tpu.tpu.ed25519 import Ed25519KeyTable, verify_ed25519_batch
+
+    monkeypatch.setenv("CAP_TPU_PALLAS", "0")
+    priv = ced.Ed25519PrivateKey.generate()
+    priv2 = ced.Ed25519PrivateKey.generate()
+    msgs = [b"edw parity %d" % i for i in range(4)]
+    sigs = [priv.sign(m) for m in msgs[:2]] + \
+        [priv2.sign(m) for m in msgs[2:]]
+    bad = bytearray(sigs[0])
+    bad[-1] ^= 1
+    msgs.append(msgs[0])
+    sigs.append(bytes(bad))
+    rows = np.asarray([0, 0, 1, 1, 0], np.int32)
+
+    results = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("CAP_TPU_PALLAS_EDW", flag)
+        ed25519_rns._ed25519_rns_core.clear_cache()
+        table = Ed25519KeyTable([priv.public_key(), priv2.public_key()])
+        results[flag] = [bool(v) for v in verify_ed25519_batch(
+            table, sigs, msgs, rows)]
+        ed25519_rns._ed25519_rns_core.clear_cache()
+
+    assert results["0"] == results["1"]
+    assert results["0"] == [True, True, True, True, False]
+
+
+@pytest.mark.heavy
 def test_compiled_mosaic_parity_on_chip():
     """The COMPILED Mosaic kernel vs the XLA path on the real chip.
 
@@ -235,8 +269,31 @@ ec_rns._ecdsa_rns_core.clear_cache()
 table4 = ECKeyTable("P-256", [p.public_key() for p in privs])
 ok_redc = [bool(v)
            for v in verify_ecdsa_batch(table4, sigs, digests, rows)]
+
+# Ed25519: compiled fused Edwards add (TPU default) vs XLA ladder.
+# Drop the fused-REDC default first — the EDW=0 baseline must be the
+# true XLA path or a shared-REDC miscompile hits both runs equally.
+os.environ["CAP_TPU_PALLAS"] = "0"
+from cryptography.hazmat.primitives.asymmetric import ed25519 as ced
+from cap_tpu.tpu import ed25519_rns
+from cap_tpu.tpu.ed25519 import Ed25519KeyTable, verify_ed25519_batch
+ed_priv = ced.Ed25519PrivateKey.generate()
+ed_msgs = [b"mosaic parity ed 1", b"mosaic parity ed 2"]
+ed_sigs = [ed_priv.sign(m) for m in ed_msgs]
+edb = bytearray(ed_sigs[0]); edb[-1] ^= 1
+ed_msgs.append(ed_msgs[0]); ed_sigs.append(bytes(edb))
+ed_rows = np.zeros(3, np.int32)
+ed_res = {}
+for flag in ("0", "1"):
+    os.environ["CAP_TPU_PALLAS_EDW"] = flag
+    ed25519_rns._ed25519_rns_core.clear_cache()
+    tbl = Ed25519KeyTable([ed_priv.public_key()])
+    ed_res[flag] = [bool(v) for v in verify_ed25519_batch(
+        tbl, ed_sigs, ed_msgs, ed_rows)]
+    ed25519_rns._ed25519_rns_core.clear_cache()
 print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic,
-                  "ladder": ok_ladder, "redc": ok_redc}))
+                  "ladder": ok_ladder, "redc": ok_redc,
+                  "ed_xla": ed_res["0"], "ed_fused": ed_res["1"]}))
 """ % (repo,)
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_", "CAP_TPU_"))}
@@ -250,3 +307,4 @@ print(json.dumps({"xla": ok_xla, "mosaic": ok_mosaic,
     assert out["xla"] == out["ladder"], out
     assert out["xla"] == out["redc"], out
     assert out["xla"] == [True, True, False, False, False, False], out
+    assert out["ed_xla"] == out["ed_fused"] == [True, True, False], out
