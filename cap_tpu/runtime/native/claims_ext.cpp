@@ -870,7 +870,15 @@ PyModuleDef moduledef = {
 }  // namespace
 
 extern "C" PyMODINIT_FUNC PyInit__capclaims(void) {
+#if PY_VERSION_HEX >= 0x03080000 && PY_VERSION_HEX < 0x030E0000
+  // _PyDict_NewPresized is private API; its export and semantics are
+  // verified against CPython 3.8-3.13 (the signature has been stable
+  // since 3.4, and pydantic-core ships the same lookup). On CPython
+  // versions outside that tested range the lookup is skipped entirely
+  // so a changed symbol can't be trusted blindly: dict_new_presized
+  // stays nullptr and every dict build takes the PyDict_New fallback.
   dict_new_presized = reinterpret_cast<DictNewPresizedFn>(
       dlsym(RTLD_DEFAULT, "_PyDict_NewPresized"));
+#endif
   return PyModule_Create(&moduledef);
 }

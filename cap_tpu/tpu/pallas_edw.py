@@ -108,18 +108,16 @@ def _ctx_consts(c) -> tuple:
     derivation to keep in sync), inserting only the pre-transposed
     c·p residue tables this kernel's rsub needs.
     """
-    key = id(c)
-    out = _CONSTS.get(key)
-    if out is None:
-        from . import pallas_redc
+    from . import pallas_redc
 
+    def build():
         r = pallas_redc._ctx_consts(c)
-        out = r[:12] + (
+        return r[:12] + (
             np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
             np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
         ) + r[12:]
-        _CONSTS[key] = out
-    return out
+
+    return pallas_redc.pinned_ctx_cache(_CONSTS, c, build)
 
 
 @partial(jax.jit, static_argnames=("ia", "ib", "interpret"))

@@ -157,31 +157,32 @@ _CONSTS: Dict[int, tuple] = {}
 
 
 def _ctx_consts(c) -> tuple:
-    key = id(c)
-    out = _CONSTS.get(key)
-    if out is None:
-        (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
+    from .pallas_redc import pinned_ctx_cache
 
-        def col(v):
-            # host numpy only: this cache must never hold tracers
-            return np.asarray(v, np.int32).reshape(-1, 1)
+    return pinned_ctx_cache(_CONSTS, c, lambda: _build_consts(c))
 
-        a_mod_p = c.A.prod % c.cp.p
-        one_a = col([a_mod_p % int(m) for m in c.A.m])
-        one_b = col([a_mod_p % int(m) for m in c.B.m])
-        out = (
-            col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
-            np.asarray(w_ab[0]), np.asarray(w_ab[1]),
-            np.asarray(w_ba[0]), np.asarray(w_ba[1]),
-            col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
-            np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
-            np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
-            one_a, one_b,
-            col((1 << 14) % np.asarray(c.A.m, np.int64)),
-            col((1 << 14) % np.asarray(c.B.m, np.int64)),
-        )
-        _CONSTS[key] = out
-    return out
+
+def _build_consts(c) -> tuple:
+    (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
+
+    def col(v):
+        # host numpy only: this cache must never hold tracers
+        return np.asarray(v, np.int32).reshape(-1, 1)
+
+    a_mod_p = c.A.prod % c.cp.p
+    one_a = col([a_mod_p % int(m) for m in c.A.m])
+    one_b = col([a_mod_p % int(m) for m in c.B.m])
+    return (
+        col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
+        np.asarray(w_ab[0]), np.asarray(w_ab[1]),
+        np.asarray(w_ba[0]), np.asarray(w_ba[1]),
+        col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
+        np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
+        np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
+        one_a, one_b,
+        col((1 << 14) % np.asarray(c.A.m, np.int64)),
+        col((1 << 14) % np.asarray(c.B.m, np.int64)),
+    )
 
 
 @partial(jax.jit, static_argnames=("ia", "ib", "interpret"))

@@ -191,28 +191,42 @@ def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
 _CONST_CACHE: Dict[int, tuple] = {}
 
 
+def pinned_ctx_cache(cache: Dict[int, tuple], c, build):
+    """id(c)-keyed constant cache whose value pins the context object.
+
+    Pinning is the whole fix: while the entry holds `c`, its id cannot
+    be recycled, so a hit is always for the right context. (Contexts
+    are module-level singletons in practice, so growth is bounded.)
+    """
+    hit = cache.get(id(c))
+    if hit is not None:
+        return hit[1]
+    out = build()
+    cache[id(c)] = (c, out)
+    return out
+
+
 def _ctx_consts(c) -> tuple:
     """Per-context 2-D constant arrays for the kernel (cached)."""
-    key = id(c)
-    out = _CONST_CACHE.get(key)
-    if out is None:
-        (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
+    return pinned_ctx_cache(_CONST_CACHE, c, lambda: _build_consts(c))
 
-        def col(v):
-            # numpy on host: redc_fused runs inside jit traces, and
-            # tracer-created arrays must never be cached (they leak);
-            # numpy constants embed safely into every trace.
-            return np.asarray(v, np.int32).reshape(-1, 1)
 
-        out = (
-            col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
-            w_ab[0], w_ab[1], w_ba[0], w_ba[1],
-            col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
-            col((1 << 14) % np.asarray(c.A.m, np.int64)),
-            col((1 << 14) % np.asarray(c.B.m, np.int64)),
-        )
-        _CONST_CACHE[key] = out
-    return out
+def _build_consts(c) -> tuple:
+    (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
+
+    def col(v):
+        # numpy on host: redc_fused runs inside jit traces, and
+        # tracer-created arrays must never be cached (they leak);
+        # numpy constants embed safely into every trace.
+        return np.asarray(v, np.int32).reshape(-1, 1)
+
+    return (
+        col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
+        w_ab[0], w_ab[1], w_ba[0], w_ba[1],
+        col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
+        col((1 << 14) % np.asarray(c.A.m, np.int64)),
+        col((1 << 14) % np.asarray(c.B.m, np.int64)),
+    )
 
 
 @partial(jax.jit, static_argnames=("ia", "ib", "interpret"))

@@ -201,7 +201,9 @@ def test_worker_raw_over_remote_keyset():
                            else "BBBBBBBB")
         w = VerifyWorker(ks, target_batch=4, max_wait_ms=5.0)
         try:
-            assert isinstance(w._batcher._keyset, _RawClaimsSync)
+            # Exact type: isinstance would also pass for the async
+            # subclass, which is the wrong routing for a sync keyset.
+            assert type(w._batcher._keyset) is _RawClaimsSync
             host, port = w.address
             with VerifyClient(host, port, timeout=600.0) as c:
                 res = c.verify_batch([good, bad, good])
