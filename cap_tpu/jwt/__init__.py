@@ -15,7 +15,7 @@ from .algs import (
     SUPPORTED_ALGORITHMS,
     supported_signing_algorithm,
 )
-from .jose import ParsedJWS, parse_compact
+from .jose import ParsedJWS, json_to_compact, parse_compact, parse_json, parse_jws
 from .pem import parse_public_key_pem
 from .keyset import (
     KeySet,
@@ -29,7 +29,8 @@ __all__ = [
     "Alg", "RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
     "PS256", "PS384", "PS512", "EdDSA", "SUPPORTED_ALGORITHMS",
     "supported_signing_algorithm",
-    "ParsedJWS", "parse_compact", "parse_public_key_pem",
+    "ParsedJWS", "parse_compact", "parse_json", "parse_jws",
+    "json_to_compact", "parse_public_key_pem",
     "KeySet", "StaticKeySet", "JSONWebKeySet", "new_oidc_discovery_keyset",
     "DEFAULT_LEEWAY_SECONDS", "Expected", "Validator",
 ]

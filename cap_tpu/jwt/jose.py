@@ -1,16 +1,28 @@
-"""JOSE compact-serialization (JWS) parsing.
+"""JOSE (JWS) parsing: compact and JSON serializations.
 
 The reference delegates to go-jose's ``jose.ParseSigned``
 (jwt/jwt.go:212, jwt/keyset.go:155); this is a from-scratch strict
-parser for the compact form ``b64url(header).b64url(payload).b64url(sig)``
+parser. Compact form ``b64url(header).b64url(payload).b64url(sig)``
 per RFC 7515:
 - exactly three dot-separated segments;
 - base64url *without* padding, no whitespace;
 - the protected header must be a JSON object;
-- the ``alg`` header must be present and a string.
+- the ``alg`` header must be present and a string;
+- any ``crit`` protected header is rejected (go-jose rejects every
+  JWS bearing one — "unsupported crit header" — and this framework
+  matches that verdict bit-for-bit, jwt/jwt.go:212 via ParseSigned).
 
-A native C++ batch version of this parse lives in cap_tpu/runtime; this
-module is the reference implementation and single-token path.
+The JSON serialization (RFC 7515 §7.2, both flattened and general
+forms) is accepted with exactly ONE signature, matching the
+reference's post-parse check (jwt/jwt.go:212-227): go-jose
+auto-detects a leading ``{`` and the reference then requires
+``len(parsedJWT.Headers) == 1``.
+
+A native C++ batch version of the compact parse lives in
+cap_tpu/runtime; this module is the reference implementation and
+single-token path. ``parse_jws`` dispatches on serialization form;
+``json_to_compact`` re-serializes a JSON-form token so the batch
+paths (native prep, TPU packing, serve) stay compact-only.
 """
 
 from __future__ import annotations
@@ -99,13 +111,20 @@ def _split_and_header(token: str):
     alg = header.get("alg")
     if not isinstance(alg, str) or not alg:
         raise MalformedTokenError("protected header missing alg parameter")
+    if "crit" in header:
+        # go-jose rejects any JWS carrying a crit header, regardless of
+        # its value; matching that keeps rejection parity with the
+        # reference's verify path (jwt/keyset.go:155-167).
+        raise MalformedTokenError("unsupported crit header")
     return header, raw_header, raw_payload, raw_sig
 
 
 def peek_alg(token: str) -> str:
-    """Return the alg header of a compact JWS, enforcing the same
-    structural rules as :func:`parse_compact` but without decoding the
-    payload segment (cheap header-only inspection)."""
+    """Return the alg header of a JWS, enforcing the same structural
+    rules as :func:`parse_jws` but (for the compact form) without
+    decoding the payload segment — cheap header-only inspection."""
+    if is_json_form(token):
+        return parse_json(token).alg
     header, _, raw_payload, raw_sig = _split_and_header(token)
     # Validate payload/signature segment charsets without decoding bytes.
     for seg in (raw_payload, raw_sig):
@@ -130,3 +149,173 @@ def parse_compact(token: str) -> ParsedJWS:
         signature=signature,
         signing_input=signing_input,
     )
+
+
+def is_json_form(token) -> bool:
+    """True when the token uses the JWS JSON serialization (go-jose's
+    detection rule: first non-whitespace byte is ``{``)."""
+    return isinstance(token, str) and token.lstrip()[:1] == "{"
+
+
+def _json_segment(obj, field: str, what: str) -> str:
+    v = obj.get(field)
+    if not isinstance(v, str):
+        raise MalformedTokenError(f"JSON JWS {what} missing {field!r}")
+    if not set(v) <= _B64URL_CHARS or len(v) % 4 == 1:
+        raise MalformedTokenError("illegal base64url segment")
+    return v
+
+
+def _parse_json_signature(doc, sig_obj) -> ParsedJWS:
+    """One signature object (+ shared payload) → ParsedJWS."""
+    raw_payload = _json_segment(doc, "payload", "document")
+    raw_header = _json_segment(sig_obj, "protected", "signature")
+    raw_sig = _json_segment(sig_obj, "signature", "signature")
+
+    header_bytes = b64url_decode(raw_header)
+    try:
+        header = json.loads(header_bytes)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MalformedTokenError(
+            f"protected header is not valid JSON: {e}") from e
+    if not isinstance(header, dict):
+        raise MalformedTokenError("protected header is not a JSON object")
+
+    unprotected = sig_obj.get("header")
+    if unprotected is not None:
+        if not isinstance(unprotected, dict):
+            raise MalformedTokenError(
+                "JSON JWS unprotected header is not a JSON object")
+        # RFC 7515 §7.2.1: the two header sets MUST be disjoint.
+        dup = set(header) & set(unprotected)
+        if dup:
+            raise MalformedTokenError(
+                f"duplicate header parameter {sorted(dup)[0]!r}")
+        merged = dict(unprotected)
+        merged.update(header)
+        header = merged
+
+    alg = header.get("alg")
+    if not isinstance(alg, str) or not alg:
+        raise MalformedTokenError("protected header missing alg parameter")
+    if "crit" in header:
+        raise MalformedTokenError("unsupported crit header")
+
+    payload = b64url_decode(raw_payload)
+    signature = b64url_decode(raw_sig)
+    if len(signature) == 0:
+        raise TokenNotSignedError("token must be signed")
+    return ParsedJWS(
+        header=header,
+        payload=payload,
+        signature=signature,
+        signing_input=(raw_header + "." + raw_payload).encode("ascii"),
+    )
+
+
+def parse_json(token: str) -> ParsedJWS:
+    """Parse a JSON-serialization JWS (RFC 7515 §7.2) with exactly one
+    signature — flattened or general form.
+
+    The reference accepts this form through go-jose's ParseSigned and
+    then enforces the single signature itself (jwt/jwt.go:212-227);
+    more than one signature is rejected the same way here.
+    """
+    try:
+        doc = json.loads(token)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise MalformedTokenError(f"JSON JWS is not valid JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise MalformedTokenError("JSON JWS is not a JSON object")
+
+    sigs = doc.get("signatures")
+    if sigs is None:
+        return _parse_json_signature(doc, doc)  # flattened form
+    if not isinstance(sigs, list) or len(sigs) != 1:
+        raise MalformedTokenError(
+            "JSON JWS must carry exactly one signature")
+    if "signature" in doc or "protected" in doc or "header" in doc:
+        # RFC 7515 §7.2.1/§7.2.2: the general and flattened members are
+        # mutually exclusive in one document.
+        raise MalformedTokenError(
+            "JSON JWS mixes general and flattened members")
+    if not isinstance(sigs[0], dict):
+        raise MalformedTokenError("JSON JWS signature is not an object")
+    return _parse_json_signature(doc, sigs[0])
+
+
+def parse_jws(token: str) -> ParsedJWS:
+    """Parse a JWS in either serialization (go-jose ParseSigned's
+    dispatch rule: a leading ``{`` means the JSON form)."""
+    if is_json_form(token):
+        return parse_json(token)
+    return parse_compact(token)
+
+
+def json_normalize(token: str):
+    """Parse a JSON-form JWS; return ``(compact_or_None, parsed)``.
+
+    ``compact`` preserves the signing input byte-for-byte (protected +
+    "." + payload as they appear in the document), so signatures verify
+    identically. Dropping the unprotected header usually only WIDENS
+    key selection (a kid hint disappears) — but when ``alg`` itself
+    lives only in the unprotected header, the compact form would parse
+    as alg-less and flip an accept into a reject. ``compact`` is None
+    for such tokens; callers must verify via the returned ParsedJWS
+    (whose merged header is authoritative) instead.
+    """
+    parsed = parse_json(token)
+    doc = json.loads(token)
+    sig_obj = doc if doc.get("signatures") is None else doc["signatures"][0]
+    protected = json.loads(b64url_decode(sig_obj["protected"]))
+    if not isinstance(protected.get("alg"), str) or not protected["alg"]:
+        return None, parsed
+    return ".".join((sig_obj["protected"], doc["payload"],
+                     sig_obj["signature"])), parsed
+
+
+def json_to_compact(token: str) -> str:
+    """Re-serialize a JSON-form JWS as the equivalent compact token.
+
+    Raises for tokens only representable in JSON form (alg present
+    solely in the unprotected header) — batch machinery uses
+    :func:`normalize_batch`, which falls back to object-path
+    verification for those instead.
+    """
+    compact, _ = json_normalize(token)
+    if compact is None:
+        raise MalformedTokenError(
+            "JSON JWS has no protected alg; not representable compactly")
+    return compact
+
+
+def normalize_batch(tokens):
+    """Shared batch normalization: JSON-form entries → compact.
+
+    Returns ``(tokens', specials)``. ``tokens'`` is ``tokens`` with
+    every JSON-form entry replaced by its compact re-serialization
+    (or ``""`` when it has none); ``specials`` maps those indices that
+    can't ride the compact machinery to either the ParsedJWS to verify
+    on the object path (valid but non-compactable) or the exact parse
+    exception. The single source of truth for prep and the TPU batch
+    dispatcher, so their error channels can never diverge.
+    """
+    out = None
+    specials = {}
+    for i, t in enumerate(tokens):
+        if not is_json_form(t):
+            continue
+        if out is None:
+            out = list(tokens)
+        try:
+            compact, parsed = json_normalize(t)
+        except Exception as e:  # noqa: BLE001 - per-token error channel
+            specials[i] = e
+            out[i] = ""
+            continue
+        if compact is None:
+            specials[i] = parsed
+            out[i] = ""
+        else:
+            out[i] = compact
+    return (tokens if out is None else out), specials

@@ -3,12 +3,21 @@
 The reference gets this from coreos go-oidc's RemoteKeySet; here it is
 implemented directly: RSA (kty=RSA: n,e), EC (kty=EC: crv,x,y on
 P-256/P-384/P-521), and OKP Ed25519 (kty=OKP, crv=Ed25519: x).
+
+``x5c`` certificate chains (RFC 7517 §4.7) are accepted the way the
+go-jose JSONWebKey the reference wraps accepts them (jwt/keyset.go:
+109-122): a key whose material arrives only as a certificate chain
+takes its public key from the first certificate's SPKI, and a key
+carrying BOTH parameters and a chain must have them agree.
 """
 
 from __future__ import annotations
 
+import base64
+import binascii
 from typing import Any, Dict, List, Optional
 
+from cryptography import x509
 from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
 
 from ..errors import InvalidJWKSError
@@ -43,39 +52,112 @@ def _b64_uint(data: Dict[str, Any], field: str) -> int:
     return int.from_bytes(b64url_decode(v), "big")
 
 
+def _x5c_public_key(data: Dict[str, Any]):
+    """Public key from the first x5c certificate, or None when absent.
+
+    Per RFC 7517 §4.7 each entry is STANDARD base64 (not base64url) of
+    a DER certificate; the first entry is the key's own certificate. A
+    present-but-invalid chain is an error, as in go-jose.
+    """
+    x5c = data.get("x5c")
+    if x5c is None:
+        return None
+    if not isinstance(x5c, list) or not x5c or not all(
+            isinstance(c, str) for c in x5c):
+        raise InvalidJWKSError("jwk x5c must be a non-empty string array")
+    try:
+        der = base64.b64decode(x5c[0], validate=True)
+        cert = x509.load_der_x509_certificate(der)
+    except (binascii.Error, ValueError) as err:
+        raise InvalidJWKSError(f"invalid x5c certificate: {err}") from err
+    key = cert.public_key()
+    if not isinstance(key, (rsa.RSAPublicKey, ec.EllipticCurvePublicKey,
+                            ed25519.Ed25519PublicKey)):
+        raise InvalidJWKSError(
+            "x5c certificate carries an unsupported key type")
+    return key
+
+
+def _keys_equal(a, b) -> bool:
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, ed25519.Ed25519PublicKey):
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat,
+        )
+        return (a.public_bytes(Encoding.Raw, PublicFormat.Raw)
+                == b.public_bytes(Encoding.Raw, PublicFormat.Raw))
+    return a.public_numbers() == b.public_numbers()
+
+
 def parse_jwk(data: Dict[str, Any]) -> JWK:
     """Parse one JWK dict into a JWK with a usable public key."""
     kty = data.get("kty")
+    cert_key = _x5c_public_key(data)
+    key = None
     if kty == "RSA":
-        n = _b64_uint(data, "n")
-        e = _b64_uint(data, "e")
-        try:
-            key = rsa.RSAPublicNumbers(e, n).public_key()
-        except ValueError as err:
-            raise InvalidJWKSError(f"invalid RSA jwk: {err}") from err
+        # presence-gated, not type-gated: a MALFORMED n/e must reject
+        # (as go-jose does), never silently defer to the x5c key
+        if "n" in data or "e" in data or cert_key is None:
+            n = _b64_uint(data, "n")
+            e = _b64_uint(data, "e")
+            try:
+                key = rsa.RSAPublicNumbers(e, n).public_key()
+            except ValueError as err:
+                raise InvalidJWKSError(f"invalid RSA jwk: {err}") from err
+        expected_type = rsa.RSAPublicKey
     elif kty == "EC":
         crv = data.get("crv")
-        if crv not in _CURVES:
+        if "x" in data or "y" in data or cert_key is None:
+            if crv not in _CURVES:
+                raise InvalidJWKSError(f"unsupported EC curve {crv!r}")
+            curve_cls, _ = _CURVES[crv]
+            x = _b64_uint(data, "x")
+            y = _b64_uint(data, "y")
+            try:
+                key = ec.EllipticCurvePublicNumbers(
+                    x, y, curve_cls()).public_key()
+            except ValueError as err:
+                raise InvalidJWKSError(f"invalid EC jwk: {err}") from err
+        elif crv is not None and crv not in _CURVES:
             raise InvalidJWKSError(f"unsupported EC curve {crv!r}")
-        curve_cls, _ = _CURVES[crv]
-        x = _b64_uint(data, "x")
-        y = _b64_uint(data, "y")
-        try:
-            key = ec.EllipticCurvePublicNumbers(x, y, curve_cls()).public_key()
-        except ValueError as err:
-            raise InvalidJWKSError(f"invalid EC jwk: {err}") from err
+        expected_type = ec.EllipticCurvePublicKey
     elif kty == "OKP":
         if data.get("crv") != "Ed25519":
             raise InvalidJWKSError(f"unsupported OKP curve {data.get('crv')!r}")
-        raw = data.get("x")
-        if not isinstance(raw, str):
-            raise InvalidJWKSError("jwk missing field 'x'")
-        try:
-            key = ed25519.Ed25519PublicKey.from_public_bytes(b64url_decode(raw))
-        except ValueError as err:
-            raise InvalidJWKSError(f"invalid Ed25519 jwk: {err}") from err
+        if "x" in data or cert_key is None:
+            raw = data.get("x")
+            if not isinstance(raw, str):
+                raise InvalidJWKSError("jwk missing field 'x'")
+            try:
+                key = ed25519.Ed25519PublicKey.from_public_bytes(
+                    b64url_decode(raw))
+            except ValueError as err:
+                raise InvalidJWKSError(
+                    f"invalid Ed25519 jwk: {err}") from err
+        expected_type = ed25519.Ed25519PublicKey
     else:
         raise InvalidJWKSError(f"unsupported jwk kty {kty!r}")
+
+    if cert_key is not None:
+        if not isinstance(cert_key, expected_type):
+            raise InvalidJWKSError(
+                "x5c certificate key type does not match jwk kty")
+        if isinstance(cert_key, ec.EllipticCurvePublicKey):
+            cert_crv = _CURVE_NAME_FOR_KEY.get(cert_key.curve.name)
+            if cert_crv is None:
+                raise InvalidJWKSError(
+                    f"unsupported EC curve {cert_key.curve.name!r} in x5c")
+            declared = data.get("crv")
+            if declared is not None and declared != cert_crv:
+                raise InvalidJWKSError(
+                    "jwk crv does not match x5c certificate curve")
+        if key is None:
+            key = cert_key          # material arrived only via x5c
+        elif not _keys_equal(key, cert_key):
+            raise InvalidJWKSError(
+                "jwk parameters do not match x5c certificate key")
+
     kid = data.get("kid") if isinstance(data.get("kid"), str) else None
     alg = data.get("alg") if isinstance(data.get("alg"), str) else None
     use = data.get("use") if isinstance(data.get("use"), str) else None

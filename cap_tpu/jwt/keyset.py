@@ -29,7 +29,7 @@ from ..errors import (
     NilParameterError,
 )
 from ..utils import http as _http
-from .jose import ParsedJWS, parse_compact
+from .jose import ParsedJWS, parse_jws
 from .jwk import JWK, parse_jwks
 from .verify import key_matches_alg, verify_parsed
 
@@ -72,7 +72,7 @@ class StaticKeySet(KeySet):
         self._keys = list(public_keys)
 
     def verify_signature(self, token: str) -> Dict[str, Any]:
-        parsed = parse_compact(token)
+        parsed = parse_jws(token)
         last_err: Optional[Exception] = None
         for key in self._keys:
             try:
@@ -142,7 +142,7 @@ class JSONWebKeySet(KeySet):
         return out
 
     def verify_signature(self, token: str) -> Dict[str, Any]:
-        parsed = parse_compact(token)
+        parsed = parse_jws(token)
         keys = self.keys()
         candidates = self._candidates(keys, parsed)
         last_err: Optional[Exception] = None

@@ -34,7 +34,7 @@ from ..errors import (
     NilParameterError,
 )
 from . import algs
-from .jose import ParsedJWS, parse_compact
+from .jose import ParsedJWS, is_json_form, parse_jws
 from .jwk import JWK
 from .keyset import KeySet
 from .verify import key_matches_alg, verify_parsed
@@ -450,7 +450,14 @@ class TPUBatchKeySet(KeySet):
                 if key_matches_alg(self._jwks[i].key, parsed.alg)]
 
     def verify_signature(self, token: str) -> Dict[str, Any]:
-        parsed = parse_compact(token)
+        return self._verify_parsed_trial(parse_jws(token))
+
+    # -- batch path --------------------------------------------------------
+
+    def _verify_parsed_trial(self, parsed: ParsedJWS):
+        """Trial-verify one parsed token against the candidate keys —
+        the single-token verdict logic, shared by verify_signature and
+        the batch path's non-compactable JSON-form fallback."""
         last: Optional[Exception] = None
         for i in self._candidate_indices(parsed):
             try:
@@ -462,8 +469,6 @@ class TPUBatchKeySet(KeySet):
             "no known key successfully validated the token signature"
         ) from last
 
-    # -- batch path --------------------------------------------------------
-
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
         from ..runtime import prep
 
@@ -472,6 +477,7 @@ class TPUBatchKeySet(KeySet):
         with telemetry.span("verify_batch.total"):
             if prep._load_native() is not None:
                 return self._collect_batch(self._dispatch_batch(tokens))
+            # non-native prep parses every serialization itself
             return self._verify_batch_objects(tokens)
 
     def verify_batch_async(self, tokens: Sequence[str],
@@ -499,8 +505,11 @@ class TPUBatchKeySet(KeySet):
                 for i, r in enumerate(results):
                     if not isinstance(r, Exception):
                         # the dict was built from exactly these bytes
-                        results[i] = b64url_decode(
-                            tokens[i].split(".")[1])
+                        if is_json_form(tokens[i]):
+                            results[i] = parse_jws(tokens[i]).payload
+                        else:
+                            results[i] = b64url_decode(
+                                tokens[i].split(".")[1])
             return lambda: results
         state = self._dispatch_batch(tokens)
         if raw:
@@ -557,6 +566,9 @@ class TPUBatchKeySet(KeySet):
         # would overestimate the link (the sync would block briefly on
         # an already-drained wire).
         t_dispatch = time.perf_counter()
+        from .jose import normalize_batch
+
+        tokens, specials = normalize_batch(tokens)
         with telemetry.span("prep.native"):
             pb = prepare_batch_arrays(tokens)
         n = pb.n
@@ -564,6 +576,19 @@ class TPUBatchKeySet(KeySet):
         ok = pb.status == 0
         for i in np.nonzero(~ok)[0]:
             results[int(i)] = pb.error(int(i))
+        special_payloads: Dict[int, bytes] = {}
+        for i, sp in specials.items():
+            # normalization verdicts outrank the ""-sentinel's prep
+            # error: the exact parse exception, or (non-compactable
+            # JSON form) the single-token trial verdict.
+            if isinstance(sp, Exception):
+                results[i] = sp
+            else:
+                try:
+                    results[i] = self._verify_parsed_trial(sp)
+                    special_payloads[i] = sp.payload
+                except Exception as e:  # noqa: BLE001 - per-token
+                    results[i] = e
 
         slow: List[int] = []
         # Two-phase device interaction: every bucket's device work is
@@ -624,7 +649,8 @@ class TPUBatchKeySet(KeySet):
         return dict(pb=pb, n=n, ok=ok, results=results, slow=slow,
                     pending=pending, packed_parts=packed_parts,
                     packed_meta=packed_meta, stats=stats,
-                    t_dispatch=t_dispatch)
+                    t_dispatch=t_dispatch,
+                    special_payloads=special_payloads)
 
     def _collect_batch(self, state: dict) -> List[Any]:
         """Phase 2: claims prefetch, materializing sync, verdicts."""
@@ -684,6 +710,12 @@ class TPUBatchKeySet(KeySet):
                         # the oracle built the dict from these bytes
                         out = pb.payload_bytes(j)
                     results[j] = out
+        if raw:
+            # non-compactable JSON-form tokens verified on the object
+            # path during dispatch: same raw contract, their bytes.
+            for i, pay in state.get("special_payloads", {}).items():
+                if not isinstance(results[i], Exception):
+                    results[i] = pay
         self._observe_wire(state)
         return results
 
@@ -1334,7 +1366,7 @@ class TPURemoteKeySet(KeySet):
         try:
             return ks.verify_signature(token)
         except InvalidSignatureError:
-            parsed = parse_compact(token)
+            parsed = parse_jws(token)
             if parsed.kid is not None and parsed.kid not in self._kids:
                 return self._ensure(refresh=True).verify_signature(token)
             raise
@@ -1359,7 +1391,7 @@ class TPURemoteKeySet(KeySet):
             if not isinstance(r, InvalidSignatureError):
                 continue
             try:
-                parsed = parse_compact(tokens[i])
+                parsed = parse_jws(tokens[i])
             except Exception:  # noqa: BLE001 - malformed keeps its error
                 continue
             if parsed.kid is not None and parsed.kid not in self._kids:

@@ -23,7 +23,7 @@ from ..errors import (
     UnsupportedAlgError,
 )
 from ..jwt import algs as _algs
-from ..jwt.jose import b64url_decode, b64url_encode, parse_compact
+from ..jwt.jose import b64url_decode, b64url_encode, parse_jws
 from ..utils.redact import RedactedString
 
 _HASH_BY_SUFFIX = {"256": "sha256", "384": "sha384", "512": "sha512"}
@@ -54,7 +54,7 @@ class IDToken(RedactedString):
         return claims
 
     def signing_alg(self) -> str:
-        return parse_compact(self.reveal()).alg
+        return parse_jws(self.reveal()).alg
 
     def _verify_hash_claim(self, claim_name: str, value: str,
                            mismatch_exc) -> bool:

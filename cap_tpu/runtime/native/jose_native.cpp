@@ -475,7 +475,7 @@ struct JsonScanner {
     switch (*p) {
       case '"': return parse_string(nullptr);
       case '{': return skip_object_with_kidflag(depth + 1, nullptr, nullptr,
-                                                nullptr);
+                                                nullptr, nullptr);
       case '[': {
         p++;
         ws();
@@ -499,8 +499,12 @@ struct JsonScanner {
   // top-level string members (top level only when depth == 1);
   // kid_found reports whether a top-level string "kid" member existed
   // (distinguishing an absent kid from an empty-string kid).
+  // crit_found flags a top-level "crit" member of ANY value type —
+  // go-jose rejects every JWS bearing one, and the Python parser
+  // (jwt/jose.py) matches, so the native prep must too.
   bool skip_object_with_kidflag(int depth, std::string* alg,
-                                std::string* kid, bool* kid_found) {
+                                std::string* kid, bool* kid_found,
+                                bool* crit_found) {
     if (depth > 64) return false;
     ws();
     if (p >= end || *p != '{') return false;
@@ -515,6 +519,7 @@ struct JsonScanner {
       if (p >= end || *p != ':') return false;
       p++;
       ws();
+      if (depth == 1 && crit_found && key == "crit") *crit_found = true;
       bool captured = false;
       if (depth == 1 && p < end && *p == '"' && (alg || kid)) {
         if (alg && key == "alg") {
@@ -547,6 +552,7 @@ enum Status : int32_t {
   ERR_HEADER_JSON = 3,  // header not a JSON object
   ERR_NO_ALG = 4,       // missing/empty alg
   ERR_UNSIGNED = 5,     // empty signature segment
+  ERR_CRIT = 6,         // crit protected header present (go-jose parity)
 };
 
 // Alg ids (order matches ALG_NAMES in the binding)
@@ -616,7 +622,9 @@ static void prepare_one(const char* tok, int64_t len, TokOut* out,
   std::string alg;
   std::string kid;
   bool kid_present = false;
-  if (!js.skip_object_with_kidflag(1, &alg, &kid, &kid_present)) {
+  bool crit_present = false;
+  if (!js.skip_object_with_kidflag(1, &alg, &kid, &kid_present,
+                                   &crit_present)) {
     out->status = ERR_HEADER_JSON;
     return;
   }
@@ -627,6 +635,10 @@ static void prepare_one(const char* tok, int64_t len, TokOut* out,
   }
   if (alg.empty()) {
     out->status = ERR_NO_ALG;
+    return;
+  }
+  if (crit_present) {  // same check order as jose.py: alg, then crit
+    out->status = ERR_CRIT;
     return;
   }
   // payload + signature decode into the caller's scratch region

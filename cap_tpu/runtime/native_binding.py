@@ -36,8 +36,8 @@ _lib = ctypes.CDLL(_LIB_PATH)
 ALG_NAMES = ["RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
              "PS256", "PS384", "PS512", "EdDSA"]
 
-_OK, _ERR_SEGMENTS, _ERR_B64, _ERR_HEADER_JSON, _ERR_NO_ALG, _ERR_UNSIGNED = \
-    range(6)
+(_OK, _ERR_SEGMENTS, _ERR_B64, _ERR_HEADER_JSON, _ERR_NO_ALG, _ERR_UNSIGNED,
+ _ERR_CRIT) = range(7)
 
 
 class _TokOut(ctypes.Structure):
@@ -225,6 +225,8 @@ def prepare_batch(tokens: Sequence[str],
         elif o.status == _ERR_HEADER_JSON:
             results.append(MalformedTokenError(
                 "protected header is not a JSON object"))
+        elif o.status == _ERR_CRIT:
+            results.append(MalformedTokenError("unsupported crit header"))
         else:
             results.append(MalformedTokenError(
                 "invalid base64url segment"))
@@ -533,6 +535,8 @@ class PreparedBatch:
         if s == _ERR_HEADER_JSON:
             return MalformedTokenError(
                 "protected header is not a JSON object")
+        if s == _ERR_CRIT:
+            return MalformedTokenError("unsupported crit header")
         return MalformedTokenError("invalid base64url segment")
 
     def parsed(self, i: int) -> "NativeParsed":

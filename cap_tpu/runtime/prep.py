@@ -1,7 +1,7 @@
 """Batch JOSE preparation: C++ fast path with Python fallback.
 
-``prepare_batch(tokens)`` parses every token (strict compact-JWS rules,
-identical to cap_tpu.jwt.jose.parse_compact) and returns one entry per
+``prepare_batch(tokens)`` parses every token (strict JWS rules,
+identical to cap_tpu.jwt.jose.parse_jws) and returns one entry per
 token: a ParsedJWS or the exception that token fails with. The native
 implementation (capruntime.so, see cap_tpu/runtime/native/) does the
 splitting, base64url decoding, and SHA-2 hashing in multithreaded C++.
@@ -11,14 +11,14 @@ from __future__ import annotations
 
 from typing import Any, List, Sequence
 
-from ..jwt.jose import parse_compact
+from ..jwt.jose import parse_jws
 
 
 def _prepare_python(tokens: Sequence[str]) -> List[Any]:
     out: List[Any] = []
     for t in tokens:
         try:
-            out.append(parse_compact(t))
+            out.append(parse_jws(t))
         except Exception as e:  # noqa: BLE001 - per-token error channel
             out.append(e)
     return out
@@ -26,9 +26,20 @@ def _prepare_python(tokens: Sequence[str]) -> List[Any]:
 
 def prepare_batch(tokens: Sequence[str]) -> List[Any]:
     native = _load_native()
-    if native is not None:
-        return native.prepare_batch(tokens)
-    return _prepare_python(tokens)
+    if native is None:
+        return _prepare_python(tokens)
+    # The C++ parser is compact-only; JSON-serialization tokens (rare)
+    # are re-serialized first — same signing input, same verdict. A
+    # valid-but-non-compactable token (alg only in the unprotected
+    # header) comes back from normalize_batch as a ready ParsedJWS,
+    # which is exactly this function's per-token success type.
+    from ..jwt.jose import normalize_batch
+
+    tokens, specials = normalize_batch(tokens)
+    out = native.prepare_batch(tokens)
+    for i, sp in specials.items():
+        out[i] = sp
+    return out
 
 
 _native_mod = None

@@ -147,6 +147,59 @@ def headline_fixtures(n_unique: int):
     return jwks, sign_unique_jwts(signers, n_unique)
 
 
+def to_json_form(token: str, flattened: bool = True,
+                 unprotected: Optional[Dict[str, Any]] = None) -> str:
+    """Re-serialize a compact JWS as its RFC 7515 §7.2 JSON form.
+
+    ``flattened`` picks §7.2.2 (flattened) vs §7.2.1 (general, one
+    signature); ``unprotected`` becomes the per-signature unprotected
+    header. Fixture helper for the JSON-serialization parity tests.
+    """
+    h, p, s = token.split(".")
+    sig_obj: Dict[str, Any] = {"protected": h, "signature": s}
+    if unprotected is not None:
+        sig_obj["header"] = unprotected
+    if flattened:
+        return json.dumps({"payload": p, **sig_obj})
+    return json.dumps({"payload": p, "signatures": [sig_obj]})
+
+
+def x5c_jwk(priv, pub, kid: Optional[str] = None,
+            include_params: bool = False) -> Dict[str, Any]:
+    """A JWK whose key material rides an ``x5c`` self-signed cert.
+
+    With ``include_params=False`` (the default) the n/e, x/y, or OKP x
+    members are stripped so the chain is the ONLY key material — the
+    go-jose-accepted shape the x5c parity tests pin. The cert is signed
+    with ``priv`` itself (self-signed leaf).
+    """
+    import base64
+
+    from .jwt.jwk import serialize_public_key
+
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "cap-tpu-x5c")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(pub)
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+    )
+    sign_hash = (None if isinstance(priv, ed25519.Ed25519PrivateKey)
+                 else hashes.SHA256())
+    cert = builder.sign(priv, sign_hash)
+    der = cert.public_bytes(serialization.Encoding.DER)
+    jwk = serialize_public_key(pub, kid=kid)
+    jwk["x5c"] = [base64.b64encode(der).decode("ascii")]
+    if not include_params:
+        for field in ("n", "e", "x", "y"):
+            jwk.pop(field, None)
+    return jwk
+
+
 def generate_ca(common_name: str = "cap-tpu-test-ca") -> Tuple[str, Any, str]:
     """Generate a self-signed CA; returns (cert_pem, private_key, key_pem)."""
     key = ec.generate_private_key(ec.SECP256R1())

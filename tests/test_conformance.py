@@ -1,0 +1,385 @@
+"""Rejection-parity conformance: crit headers, JSON-serialization JWS,
+and x5c JWKs (VERDICT r4 gaps 1-3).
+
+The bar: identical verdicts to the reference's go-jose path across ALL
+four verify surfaces — CPU oracle (StaticKeySet), TPU batch
+(TPUBatchKeySet), native prep (prepare_batch), and the serve worker.
+Reference semantics: jwt/jwt.go:212-227 (ParseSigned + one-signature
+rule), jwt/keyset.go:109-122 (go-jose JSONWebKey x5c),
+jwt/keyset.go:155-167 (crit rejection via .Claims).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cap_tpu import testing as captest
+from cap_tpu.errors import (
+    InvalidJWKSError,
+    InvalidSignatureError,
+    MalformedTokenError,
+)
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.jose import (
+    json_to_compact,
+    parse_compact,
+    parse_json,
+    parse_jws,
+    peek_alg,
+)
+from cap_tpu.jwt.jwk import parse_jwk, parse_jwks, serialize_public_key
+from cap_tpu.jwt.keyset import StaticKeySet
+from cap_tpu.runtime import prep
+
+
+@pytest.fixture(scope="module")
+def es_pair():
+    return captest.generate_keys(algs.ES256)
+
+
+@pytest.fixture(scope="module")
+def good_token(es_pair):
+    priv, _ = es_pair
+    return captest.sign_jwt(priv, algs.ES256, captest.default_claims(),
+                            kid="c0")
+
+
+def _tpu_keyset(pubs_jwks):
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+    return TPUBatchKeySet(parse_jwks({"keys": pubs_jwks}))
+
+
+# ---------------------------------------------------------------------------
+# crit header
+# ---------------------------------------------------------------------------
+
+class TestCritRejection:
+    def _crit_token(self, es_pair):
+        priv, _ = es_pair
+        # A VALID signature whose protected header carries crit: the
+        # reject must come from the header rule, not the signature.
+        return captest.sign_jwt(
+            priv, algs.ES256, captest.default_claims(), kid="c0",
+            extra_headers={"crit": ["exp"], "exp": 1})
+
+    def test_python_parse_rejects(self, es_pair):
+        tok = self._crit_token(es_pair)
+        with pytest.raises(MalformedTokenError, match="crit"):
+            parse_compact(tok)
+        with pytest.raises(MalformedTokenError, match="crit"):
+            peek_alg(tok)
+
+    def test_crit_value_is_irrelevant(self, es_pair):
+        priv, _ = es_pair
+        # go-jose rejects on PRESENCE, whatever the value.
+        for crit_val in ([], ["b64"], "exp", 7, None):
+            tok = captest.sign_jwt(priv, algs.ES256,
+                                   captest.default_claims(),
+                                   extra_headers={"crit": crit_val})
+            with pytest.raises(MalformedTokenError, match="crit"):
+                parse_jws(tok)
+
+    def test_native_prep_rejects(self, es_pair, good_token):
+        tok = self._crit_token(es_pair)
+        out = prep.prepare_batch([good_token, tok])
+        assert not isinstance(out[0], Exception)
+        assert isinstance(out[1], MalformedTokenError)
+        assert "crit" in str(out[1])
+
+    def test_cpu_and_tpu_batch_agree(self, es_pair, good_token):
+        _, pub = es_pair
+        tok = self._crit_token(es_pair)
+        oracle = StaticKeySet([pub]).verify_batch([good_token, tok])
+        device = _tpu_keyset(
+            [serialize_public_key(pub, kid="c0")]).verify_batch(
+                [good_token, tok])
+        for o, d in zip(oracle, device):
+            assert isinstance(o, Exception) == isinstance(d, Exception)
+        assert isinstance(oracle[1], MalformedTokenError)
+        assert isinstance(device[1], MalformedTokenError)
+        assert "crit" in str(device[1])
+
+    def test_json_form_crit_rejected_in_either_location(self, es_pair):
+        tok = self._crit_token(es_pair)
+        with pytest.raises(MalformedTokenError, match="crit"):
+            parse_json(captest.to_json_form(tok))
+        # crit in the UNPROTECTED header is equally fatal
+        clean = captest.sign_jwt(es_pair[0], algs.ES256,
+                                 captest.default_claims())
+        with pytest.raises(MalformedTokenError, match="crit"):
+            parse_json(captest.to_json_form(
+                clean, unprotected={"crit": ["exp"]}))
+
+
+# ---------------------------------------------------------------------------
+# JSON serialization
+# ---------------------------------------------------------------------------
+
+class TestJSONSerialization:
+    def test_flattened_and_general_parse_equal_compact(self, good_token):
+        ref = parse_compact(good_token)
+        for flattened in (True, False):
+            got = parse_jws(captest.to_json_form(good_token,
+                                                 flattened=flattened))
+            assert got.header == ref.header
+            assert got.payload == ref.payload
+            assert got.signature == ref.signature
+            assert got.signing_input == ref.signing_input
+
+    def test_two_signatures_rejected(self, good_token):
+        h, p, s = good_token.split(".")
+        doc = {"payload": p,
+               "signatures": [{"protected": h, "signature": s},
+                              {"protected": h, "signature": s}]}
+        with pytest.raises(MalformedTokenError, match="exactly one"):
+            parse_jws(json.dumps(doc))
+
+    def test_mixed_members_rejected(self, good_token):
+        h, p, s = good_token.split(".")
+        doc = {"payload": p, "protected": h, "signature": s,
+               "signatures": [{"protected": h, "signature": s}]}
+        with pytest.raises(MalformedTokenError, match="mixes"):
+            parse_jws(json.dumps(doc))
+
+    def test_duplicate_header_param_rejected(self, good_token):
+        with pytest.raises(MalformedTokenError, match="duplicate"):
+            parse_json(captest.to_json_form(
+                good_token, unprotected={"kid": "c0"}))
+
+    def test_unprotected_kid_merges(self, es_pair):
+        priv, _ = es_pair
+        tok = captest.sign_jwt(priv, algs.ES256, captest.default_claims())
+        parsed = parse_json(captest.to_json_form(
+            tok, unprotected={"kid": "side"}))
+        assert parsed.kid == "side"
+
+    def test_json_to_compact_round_trip(self, good_token):
+        for flattened in (True, False):
+            jf = captest.to_json_form(good_token, flattened=flattened)
+            assert json_to_compact(jf) == good_token
+
+    def test_cpu_oracle_accepts_json_form(self, es_pair, good_token):
+        _, pub = es_pair
+        ks = StaticKeySet([pub])
+        want = ks.verify_signature(good_token)
+        assert ks.verify_signature(captest.to_json_form(good_token)) == want
+
+    def test_tpu_batch_accepts_json_form_mixed(self, es_pair, good_token):
+        _, pub = es_pair
+        ks = _tpu_keyset([serialize_public_key(pub, kid="c0")])
+        jf_flat = captest.to_json_form(good_token)
+        jf_gen = captest.to_json_form(good_token, flattened=False)
+        tampered = good_token[:-6] + (
+            "AAAAAA" if not good_token.endswith("AAAAAA") else "BBBBBB")
+        jf_tampered = captest.to_json_form(tampered)
+        h, p, s = good_token.split(".")
+        two_sigs = json.dumps({
+            "payload": p,
+            "signatures": [{"protected": h, "signature": s}] * 2})
+        res = ks.verify_batch(
+            [good_token, jf_flat, jf_gen, jf_tampered, two_sigs])
+        assert res[0] == res[1] == res[2]
+        assert isinstance(res[3], InvalidSignatureError)
+        assert isinstance(res[4], MalformedTokenError)
+        assert "exactly one" in str(res[4])
+
+    def test_unprotected_kid_still_verifies_in_batch(self, es_pair):
+        # Normalization drops the unprotected kid; key selection widens
+        # to trial verification — verdict must not change.
+        priv, pub = es_pair
+        other_priv, other_pub = captest.generate_keys(algs.ES256)
+        ks = _tpu_keyset([serialize_public_key(other_pub, kid="a"),
+                          serialize_public_key(pub, kid="b")])
+        tok = captest.sign_jwt(priv, algs.ES256, captest.default_claims())
+        jf = captest.to_json_form(tok, unprotected={"kid": "b"})
+        res = ks.verify_batch([jf])
+        assert not isinstance(res[0], Exception)
+        assert res[0]["iss"] == "https://example.com/"
+
+    def test_validator_and_provider_peek(self, good_token):
+        assert peek_alg(captest.to_json_form(good_token)) == algs.ES256
+
+    def test_alg_only_in_unprotected_header_batch_parity(self, es_pair):
+        # go-jose verifies against the MERGED headers, so alg may live
+        # only in the unprotected header. Such a token has no compact
+        # form; the batch path must fall back to object-path
+        # verification instead of flipping the verdict.
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec as _ec
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+        )
+
+        from cap_tpu.jwt.jose import b64url_encode
+
+        priv, pub = es_pair
+        claims = captest.default_claims()
+        h = b64url_encode(json.dumps({"kid": "c0"}).encode())
+        p = b64url_encode(json.dumps(claims).encode())
+        der = priv.sign((h + "." + p).encode(), _ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        sig = b64url_encode(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+        doc = json.dumps({"payload": p, "protected": h, "signature": sig,
+                          "header": {"alg": algs.ES256}})
+
+        ks = _tpu_keyset([serialize_public_key(pub, kid="c0")])
+        single = ks.verify_signature(doc)
+        assert single["iss"] == "https://example.com/"
+        batch = ks.verify_batch([doc])
+        assert batch[0] == single
+        raw = ks.verify_batch_raw([doc])
+        assert raw[0] == json.dumps(claims).encode()
+        # prep returns a ready ParsedJWS for it, not an error
+        prepped = prep.prepare_batch([doc])
+        assert not isinstance(prepped[0], Exception)
+        assert prepped[0].alg == algs.ES256
+
+
+# ---------------------------------------------------------------------------
+# x5c JWKs
+# ---------------------------------------------------------------------------
+
+class TestX5CKeys:
+    @pytest.mark.parametrize("alg", [algs.RS256, algs.ES256, algs.EdDSA])
+    def test_cert_only_jwk_parses_and_verifies(self, alg):
+        priv, pub = captest.generate_keys(alg)
+        jwk_dict = captest.x5c_jwk(priv, pub, kid="x1")
+        # the chain really is the only key material
+        fields = ("n", "e") if alg == algs.RS256 else ("x", "y")
+        assert not any(f in jwk_dict for f in fields)
+        jwk = parse_jwk(jwk_dict)
+        tok = captest.sign_jwt(priv, alg, captest.default_claims(), kid="x1")
+        claims = StaticKeySet([jwk.key]).verify_signature(tok)
+        assert claims["iss"] == "https://example.com/"
+
+    def test_cert_only_jwk_through_tpu_batch(self):
+        priv, pub = captest.generate_keys(algs.ES256)
+        ks = _tpu_keyset([captest.x5c_jwk(priv, pub, kid="x1")])
+        tok = captest.sign_jwt(priv, algs.ES256, captest.default_claims(),
+                               kid="x1")
+        tampered = tok[:-6] + ("AAAAAA" if not tok.endswith("AAAAAA")
+                               else "BBBBBB")
+        res = ks.verify_batch([tok, tampered])
+        assert not isinstance(res[0], Exception)
+        assert isinstance(res[1], InvalidSignatureError)
+
+    def test_params_and_matching_x5c(self):
+        priv, pub = captest.generate_keys(algs.ES256)
+        jwk = parse_jwk(captest.x5c_jwk(priv, pub, kid="x1",
+                                        include_params=True))
+        assert jwk.kid == "x1"
+
+    def test_params_mismatching_x5c_rejected(self):
+        priv, pub = captest.generate_keys(algs.ES256)
+        _, other_pub = captest.generate_keys(algs.ES256)
+        bad = captest.x5c_jwk(priv, pub, include_params=True)
+        # swap in a different key's parameters
+        bad.update({k: v for k, v in serialize_public_key(other_pub).items()
+                    if k in ("x", "y")})
+        with pytest.raises(InvalidJWKSError, match="match"):
+            parse_jwk(bad)
+
+    def test_kty_cert_type_mismatch_rejected(self):
+        priv, pub = captest.generate_keys(algs.ES256)
+        bad = captest.x5c_jwk(priv, pub)
+        bad["kty"] = "RSA"
+        with pytest.raises(InvalidJWKSError):
+            parse_jwk(bad)
+
+    def test_malformed_params_with_x5c_rejected(self):
+        # malformed n/e (or x/y) must reject even when a valid chain is
+        # present — go-jose fails to unmarshal such a key.
+        priv, pub = captest.generate_keys(algs.ES256)
+        bad = captest.x5c_jwk(priv, pub)
+        bad.update({"x": 123, "y": 456})
+        with pytest.raises(InvalidJWKSError):
+            parse_jwk(bad)
+        rpriv, rpub = captest.generate_keys(algs.RS256)
+        bad = captest.x5c_jwk(rpriv, rpub)
+        bad["n"] = 17
+        with pytest.raises(InvalidJWKSError):
+            parse_jwk(bad)
+
+    def test_bad_x5c_rejected(self):
+        priv, pub = captest.generate_keys(algs.ES256)
+        for bad_chain in ([], ["!!!"], "not-a-list", [42]):
+            bad = captest.x5c_jwk(priv, pub)
+            bad["x5c"] = bad_chain
+            with pytest.raises(InvalidJWKSError):
+                parse_jwk(bad)
+
+    def test_x5c_jwks_over_http(self):
+        priv, pub = captest.generate_keys(algs.ES256)
+        from cap_tpu.jwt.keyset import JSONWebKeySet
+
+        state = {"keys": [captest.x5c_jwk(priv, pub, kid="x1")]}
+        with captest.jwks_test_server(state) as (url, _srv):
+            ks = JSONWebKeySet(url)
+            tok = captest.sign_jwt(priv, algs.ES256,
+                                   captest.default_claims(), kid="x1")
+            assert ks.verify_signature(tok)["iss"] == "https://example.com/"
+
+
+# ---------------------------------------------------------------------------
+# Four-surface differential
+# ---------------------------------------------------------------------------
+
+def test_four_surface_verdict_parity(es_pair, good_token):
+    """One mixed vector batch; accept/reject must agree on every
+    surface (CPU oracle / TPU batch / native prep / serve worker)."""
+    priv, pub = es_pair
+    crit_tok = captest.sign_jwt(priv, algs.ES256, captest.default_claims(),
+                                kid="c0", extra_headers={"crit": ["x"]})
+    tampered = good_token[:-6] + (
+        "AAAAAA" if not good_token.endswith("AAAAAA") else "BBBBBB")
+    vectors = [
+        good_token,
+        crit_tok,
+        captest.to_json_form(good_token),
+        captest.to_json_form(good_token, flattened=False),
+        captest.to_json_form(tampered),
+        tampered,
+        "definitely-not-a-jws",
+    ]
+    want_accept = [True, False, True, True, False, False, False]
+
+    oracle = StaticKeySet([pub]).verify_batch(vectors)
+    tpu = _tpu_keyset(
+        [serialize_public_key(pub, kid="c0")]).verify_batch(vectors)
+    prepped = prep.prepare_batch(vectors)
+
+    for i, want in enumerate(want_accept):
+        assert (not isinstance(oracle[i], Exception)) == want, \
+            f"oracle vector {i}"
+        assert (not isinstance(tpu[i], Exception)) == want, \
+            f"tpu vector {i}"
+        if want:
+            assert oracle[i] == tpu[i], f"claims mismatch vector {i}"
+            assert not isinstance(prepped[i], Exception)
+        if isinstance(oracle[i], Exception):
+            # error CLASS parity between oracle and device paths
+            assert type(oracle[i]) is type(tpu[i]), f"class vector {i}"
+
+    # serve worker: same batch over the wire
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+    from cap_tpu.serve.client import RemoteVerifyError, VerifyClient
+    from cap_tpu.serve.worker import VerifyWorker
+
+    ks = TPUBatchKeySet(parse_jwks(
+        {"keys": [serialize_public_key(pub, kid="c0")]}))
+    w = VerifyWorker(ks, target_batch=8, max_wait_ms=5.0)
+    try:
+        host, port = w.address
+        with VerifyClient(host, port, timeout=600.0) as c:
+            res = c.verify_batch(vectors)
+    finally:
+        w.close()
+    for i, want in enumerate(want_accept):
+        if want:
+            assert not isinstance(res[i], RemoteVerifyError), f"serve {i}"
+            assert res[i]["iss"] == "https://example.com/"
+        else:
+            assert isinstance(res[i], RemoteVerifyError), f"serve {i}"
