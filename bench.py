@@ -68,7 +68,7 @@ def _resident_mixed_vps(ks, tokens):
     )
 
     n, fns = resident_dispatchers(ks, tokens)
-    return resident_slope_vps(n, fns)
+    return resident_slope_vps(n, fns, details=True)
 
 
 def _probe_wire_mbps() -> float:
@@ -151,11 +151,21 @@ def main() -> None:
     med_interval = statistics.median(intervals)
     eff_mbps = (bytes_per_batch / med_interval) / (1 << 20)
     probe_mbps = _probe_wire_mbps()
+
+    # Self-describing weather (VERDICT r4 #6): a BENCH record must
+    # explain its own p99 and headline without docs/PERF.md. A "stall"
+    # is a completion interval >3× the window median — the tunnel's
+    # 10-90 s dropouts, which no engine change can subdivide.
+    stall = [dt for dt in intervals if dt > 3 * med_interval]
+    bytes_per_token = bytes_per_batch / batch
+    link_ceiling = (probe_mbps * (1 << 20) / bytes_per_token
+                    if bytes_per_token else None)
+
     try:
-        resident = _resident_mixed_vps(ks, tokens)
+        resident, resident_trials = _resident_mixed_vps(ks, tokens)
     except Exception as e:  # noqa: BLE001 - resident metric is advisory
         print(f"resident_mixed_vps failed: {e!r}", file=sys.stderr)
-        resident = None
+        resident, resident_trials = None, []
 
     print(f"sign={sign_s:.1f}s window={window} "
           f"rates={[round(r) for r in rates]} "
@@ -179,11 +189,24 @@ def main() -> None:
         "wire_probe_mbps": round(probe_mbps, 2),
         "wire_efficiency": round(eff_mbps / probe_mbps, 3)
         if probe_mbps else None,
+        # Weather self-description: how many completion intervals were
+        # tunnel stalls (>3× median) and how much of the window they
+        # ate; what the link could carry at most for THIS record size.
+        # value ≈ link_implied_ceiling_vps × wire_efficiency — a low
+        # headline with a low ceiling is the wire, not the engine.
+        "stall_intervals": len(stall),
+        "stall_seconds": round(sum(stall), 3),
+        "bytes_per_token": round(bytes_per_token, 1),
+        "link_implied_ceiling_vps": round(link_ceiling, 1)
+        if link_ceiling else None,
         # Engine speed with records device-resident (no wire): the
         # number that measures THIS repo's progress regardless of the
         # tunnel's minute-to-minute bandwidth. `value` stays the honest
-        # end-to-end rate.
+        # end-to-end rate. Trials published so measurement spread is
+        # visible; the estimate is min-of-3 on TIME, i.e. the MAX of
+        # resident_trials_vps (slower trials ate a tunnel stall).
         "resident_mixed_vps": round(resident, 1) if resident else None,
+        "resident_trials_vps": [round(v, 1) for v in resident_trials],
     }))
 
 

@@ -280,7 +280,8 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str]):
 
 
 def resident_slope_vps(n: int, fns, reps: int = 4,
-                       trials: int = 3) -> Optional[float]:
+                       trials: int = 3,
+                       details: bool = False):
     """Slope-time resident dispatchers → verifies/sec, or None.
 
     THE resident methodology (bench.py ``resident_mixed_vps``,
@@ -294,6 +295,12 @@ def resident_slope_vps(n: int, fns, reps: int = 4,
     the token count, so a broken engine cannot produce a clean rate.
     Returns None when no trial yields a positive slope (timer noise on
     sub-millisecond families).
+
+    ``details=True`` returns ``(vps_or_None, per_trial_vps)`` so
+    callers can publish measurement spread alongside the estimate
+    (VERDICT r4 #5: the point estimate alone hides stability). Note
+    min-of-3 is over per-dispatch TIME, so in vps terms the estimate
+    is the FASTEST trial: ``vps == max(per_trial_vps)``.
     """
     def run(reps_: int) -> None:
         outs = []
@@ -310,7 +317,7 @@ def resident_slope_vps(n: int, fns, reps: int = 4,
 
     run(1)                                # compile + settle
     run(1 + reps)
-    best = None
+    per_trial = []
     for _ in range(trials):
         t0 = time.perf_counter()
         run(1)
@@ -319,9 +326,12 @@ def resident_slope_vps(n: int, fns, reps: int = 4,
         run(1 + reps)
         tr = time.perf_counter() - t0
         per = (tr - t1) / reps
-        if per > 0 and (best is None or per < best):
-            best = per
-    return (n / best) if best else None
+        if per > 0:
+            per_trial.append(n / per)
+    vps = max(per_trial) if per_trial else None
+    if details:
+        return vps, per_trial
+    return vps
 
 
 class TPUBatchKeySet(KeySet):
