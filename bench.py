@@ -67,6 +67,11 @@ def _resident_mixed_vps(ks, tokens):
         resident_slope_vps,
     )
 
+    # Dispatch-slope mode: the scaled-record mode (fns_scaled) was
+    # measured to UNDER-report the engine ~20% — (1+reps)x-tiled
+    # batches run genuinely slower per token (bigger HBM working set),
+    # so it cancels dispatch overhead by changing the workload. The
+    # plain slope matches the device-timeline trace (docs/PERF.md r5).
     n, fns = resident_dispatchers(ks, tokens)
     return resident_slope_vps(n, fns, details=True)
 

@@ -544,8 +544,7 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
         ok_dev, deg_dev = ec_rns._ecdsa_rns_core(
             r_limbs, s_limbs, e_limbs,
             jnp.asarray(key_idx, jnp.int32),
-            rtab.tqx, rtab.tqy,
-            *ec_rns.g_residue_tables(cp.name, rtab.ctx.w_bits),
+            rtab.tab,
             *consts[4:9],
             crv=cp.name, nbits=cp.nbits, wbits=rtab.ctx.w_bits,
         )
@@ -659,7 +658,7 @@ def es_packed_records(table: ECKeyTable, sig_mat: np.ndarray,
     return rec
 
 
-def _es_packed_rns_impl(packed, tqx, tqy, g_tabs, consts, *, crv: str,
+def _es_packed_rns_impl(packed, tab, consts, *, crv: str,
                         nbits: int, wbits: int, k: int, cb: int,
                         hlen: int):
     from . import ec_rns
@@ -669,7 +668,7 @@ def _es_packed_rns_impl(packed, tqx, tqy, g_tabs, consts, *, crv: str,
     flags = packed[:, 2 * cb + hlen] != 0
     idx = packed[:, 2 * cb + hlen + 1].astype(jnp.int32)
     r, s, e = _ec_prep(sig, dig, k=k)
-    ok, deg = ec_rns._ecdsa_rns_core(r, s, e, idx, tqx, tqy, *g_tabs,
+    ok, deg = ec_rns._ecdsa_rns_core(r, s, e, idx, tab,
                                      *consts, crv=crv, nbits=nbits,
                                      wbits=wbits)
     return ok & flags, deg & flags
@@ -727,10 +726,7 @@ def verify_es_packed_pending(table: ECKeyTable, rec: np.ndarray,
         fn = _es_packed_jit("rns", _es_packed_rns_impl,
                             ("crv", "nbits", "wbits", "k", "cb",
                              "hlen"))
-        return fn(dev, place(rtab.tqx), place(rtab.tqy),
-                  tuple(place(a) for a in
-                        ec_rns.g_residue_tables(cp.name,
-                                                rtab.ctx.w_bits)),
+        return fn(dev, place(rtab.tab),
                   tuple(place(a) for a in consts[4:9]),
                   crv=cp.name, nbits=cp.nbits, wbits=rtab.ctx.w_bits,
                   k=cp.k, cb=cp.coord_bytes, hlen=hash_len)

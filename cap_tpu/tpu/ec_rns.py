@@ -316,13 +316,14 @@ def _digits_of(u, w_bits: int, n_windows: int):
 
 
 @partial(jax.jit, static_argnames=("crv", "nbits", "wbits"))
-def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
+def _ecdsa_rns_core(r, s, e, key_idx, tab,
                     n, npp, nr2, none_, nm2,
                     crv: str, nbits: int, wbits: int = 8):
     """ECDSA verify: scalar math in limbs, point math in RNS.
 
-    r, s, e: [K, N] limb values; key_idx [N]; tq*/tg*: window tables
-    as RNS residue rows [rows, I_A + I_B] (A-domain, width ``wbits``).
+    r, s, e: [K, N] limb values; key_idx [N]; ``tab``: THE fused
+    window-major packed window table (ECRNSKeyTable.tab —
+    [W·(nk+1)·per, 2·iap] i32 A|B<<16 words, G at slot 0).
     n..nm2: [K, 1] scalar-field constants. Returns (ok, deg) [N] bools.
     """
     from . import bignum as B
@@ -350,10 +351,10 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
 
     dig1 = _digits_of(u1, wbits, n_windows)
     dig2 = _digits_of(u2, wbits, n_windows)
-    key_base = key_idx.astype(jnp.int32) * (n_windows * per)
 
     ia = c.A.count
-    iab = ia + c.B.count
+    ib = c.B.count
+    iap = packed_cols(c)
 
     # 3. TWO-ACCUMULATOR ladder: the per-window G-digit and Q-digit
     # additions are independent chains, so both run as ONE mixed-add
@@ -376,15 +377,18 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     deg0 = jnp.zeros(2 * n_tok, bool)
     one_d = _one_dom(c)
 
-    tab = jnp.concatenate(
-        [jnp.concatenate([tgx, tqx], axis=0),
-         jnp.concatenate([tgy, tqy], axis=0)], axis=1)  # [rows, 2I]
-    q_off = tgx.shape[0]
+    # tab is window-major ([window][slot][digit], G at slot 0 —
+    # ECRNSKeyTable): a window's gather touches ONE contiguous
+    # (nk+1)·per-row block.
+    nk = tab.shape[0] // (n_windows * per) - 1
+    win_stride = (nk + 1) * per
+    key_base = (key_idx.astype(jnp.int32) + 1) * per
 
     def gather_pt(idx):
-        g = jnp.take(tab, idx, axis=0).T          # [2I, M]
-        return ((g[:ia], g[ia:iab]),
-                (g[iab:iab + ia], g[iab + ia:]))
+        # Packed i32 rows (A|B<<16 per word): half the gather bytes of
+        # the old [rows, 2I] layout at native word granularity.
+        g = jnp.take(tab, idx, axis=0).T          # [2·iap, M] packed
+        return g[:iap], g[iap:]
 
     from . import pallas_madd
 
@@ -395,13 +399,16 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         X, Y, Z, inf, deg = state
         has = d > 0
         idx = row0 + jnp.where(has, d - 1, 0)
-        x2, y2 = gather_pt(idx)
+        x2p, y2p = gather_pt(idx)
         if use_fused:
             # One VMEM-resident kernel for the whole mixed-add incl.
-            # the lift/select bookkeeping (pallas_madd).
+            # the lift/select bookkeeping and the table-word unpack
+            # (pallas_madd).
             Xn, Yn, Zn, dd = pallas_madd.madd_fused(
-                c, X, Y, Z, inf, has, x2, y2, interpret=interp)
+                c, X, Y, Z, inf, has, x2p, y2p, interpret=interp)
             return Xn, Yn, Zn, inf & ~has, deg | dd
+        x2 = unpack_pt(x2p, ia, ib)
+        y2 = unpack_pt(y2p, ia, ib)
         X3, Y3, Z3, dd = _madd_rns(c, X, Y, Z, inf, x2, y2)
         # infinity accumulator: result is the (lifted) affine addend
         lift = inf & has
@@ -423,8 +430,8 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
         d = jnp.concatenate([d1, d2])
         row0 = jnp.concatenate(
-            [jnp.full((n_tok,), i * per, jnp.int32),
-             q_off + key_base + i * per])
+            [jnp.full((n_tok,), i * win_stride, jnp.int32),
+             i * win_stride + key_base])
         return add_from_table(state, d, row0)
 
     if use_fused and pallas_madd.ladder_enabled():
@@ -435,8 +442,8 @@ def _ecdsa_rns_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
         w_ids = jnp.arange(n_windows, dtype=jnp.int32)[:, None]
         d_all = jnp.concatenate([dig1, dig2], axis=1)
         row0_all = jnp.concatenate(
-            [jnp.broadcast_to(w_ids * per, (n_windows, n_tok)),
-             q_off + key_base[None, :] + w_ids * per], axis=1)
+            [jnp.broadcast_to(w_ids * win_stride, (n_windows, n_tok)),
+             key_base[None, :] + w_ids * win_stride], axis=1)
         X2, Y2, Z2, inf2, deg2 = pallas_madd.ladder_fused(
             c, tab, d_all, row0_all, interpret=interp)
     else:
@@ -490,26 +497,73 @@ def _limb_pair_to_rns(c: ECRNSContext, limbs):
 # Key tables in RNS form
 # ---------------------------------------------------------------------------
 
+def packed_cols(c) -> int:
+    """Packed-word columns per coordinate: max(I_A, I_B)."""
+    return max(c.A.count, c.B.count)
+
+
+def _pack_residue_rows(c, r: np.ndarray) -> np.ndarray:
+    """[rows, I_A + I_B] residues → [rows, max(I_A, I_B)] i32 words.
+
+    Word j holds A-channel j in its low 16 bits and B-channel j in its
+    high 16 (residues < 2^13). TPU gathers are word-granular — an i16
+    table measured 2.4× SLOWER to gather than i32 — so packing pairs
+    halves the gather bytes while keeping native i32 rows; the kernels
+    unpack with one mask and one shift on VMEM tiles.
+    """
+    ia, ib = c.A.count, c.B.count
+    out = np.zeros((r.shape[0], packed_cols(c)), np.int32)
+    out[:, :ia] = r[:, :ia]
+    out[:, :ib] |= r[:, ia:].astype(np.int32) << 16
+    return out
+
+
+def unpack_pt(g, ia: int, ib: int):
+    """[iap, M] packed words → ((A [ia, M], B [ib, M])) i32 planes.
+
+    THE unpack for _pack_residue_rows' format — also called inside
+    the Pallas kernels (pallas_madd), so a packing change has exactly
+    one encode and one decode to keep in sync.
+    """
+    return ((g & 0xFFFF)[:ia], (g >> 16)[:ib])
+
+
 class ECRNSKeyTable:
-    """Window tables as A-domain residue rows [rows, I_A + I_B]."""
+    """THE device window table for one curve + key set.
+
+    ``tab``: [n_windows·(nk+1)·per, 2·iap] i32, window-major with G as
+    slot 0 and key k as slot k+1; each row is the packed x residues
+    (iap words, A|B<<16 — _pack_residue_rows) followed by the packed
+    y residues. Window-major means a window's gather touches ONE
+    contiguous (nk+1)·per-row block; fusing x‖y means one take per
+    window. Built ONCE here (host numpy), so no per-dispatch
+    reordering ever runs on device. Row addressing (see
+    _ecdsa_rns_core): window i, slot s, digit d → row
+    i·(nk+1)·per + s·per + (d−1).
+    """
 
     def __init__(self, crv: str, keys: Sequence,
                  w_bits: Optional[int] = None):
         self.ctx = ctx_for(crv, w_bits)
         self.cp = self.ctx.cp
         c = self.ctx
-        nk = len(keys)
-        rows = c.n_windows * ((1 << c.w_bits) - 1)
-        ia, ib = c.A.count, c.B.count
-        tqx = np.empty((nk * rows, ia + ib), np.int32)
-        tqy = np.empty((nk * rows, ia + ib), np.int32)
-        for j, key in enumerate(keys):
+        self.nk = nk = len(keys)
+        per = (1 << c.w_bits) - 1
+        nw = c.n_windows
+        iap = packed_cols(c)
+        gx, gy = _g_packed_np(crv, c.w_bits)
+        parts = [(gx, gy)]
+        for key in keys:
             nums = key.public_numbers()
             rx, ry = _window_residue_rows(c, (nums.x, nums.y))
-            tqx[j * rows:(j + 1) * rows] = rx
-            tqy[j * rows:(j + 1) * rows] = ry
-        self.tqx = jnp.asarray(tqx)
-        self.tqy = jnp.asarray(tqy)
+            parts.append((_pack_residue_rows(c, rx),
+                          _pack_residue_rows(c, ry)))
+        # [slots, W, per, iap] → window-major [W, slots, per, iap]
+        tx = np.stack([px.reshape(nw, per, iap) for px, _ in parts])
+        ty = np.stack([py.reshape(nw, per, iap) for _, py in parts])
+        tx = tx.transpose(1, 0, 2, 3).reshape(nw * (nk + 1) * per, iap)
+        ty = ty.transpose(1, 0, 2, 3).reshape(nw * (nk + 1) * per, iap)
+        self.tab = jnp.asarray(np.concatenate([tx, ty], axis=1))
 
 
 def _residue_matrix(c: ECRNSContext, vals: List[int]) -> np.ndarray:
@@ -552,14 +606,15 @@ def _window_residue_rows(c: ECRNSContext, point) -> Tuple[np.ndarray,
     return rx, ry
 
 
-_G_TABLES: Dict[tuple, tuple] = {}
+_G_PACKED_NP: Dict[tuple, tuple] = {}
 
 
-def g_residue_tables(crv: str, w_bits: Optional[int] = None):
-    c = ctx_for(crv, w_bits)
-    key = (crv, c.w_bits)
-    if key not in _G_TABLES:
-        cp = c.cp
-        rx, ry = _window_residue_rows(c, (cp.gx, cp.gy))
-        _G_TABLES[key] = (jnp.asarray(rx), jnp.asarray(ry))
-    return _G_TABLES[key]
+def _g_packed_np(crv: str, w_bits: int):
+    """Host-cached packed G window rows (x, y), each [W·per, iap]."""
+    key = (crv, w_bits)
+    if key not in _G_PACKED_NP:
+        c = ctx_for(crv, w_bits)
+        rx, ry = _window_residue_rows(c, (c.cp.gx, c.cp.gy))
+        _G_PACKED_NP[key] = (_pack_residue_rows(c, rx),
+                             _pack_residue_rows(c, ry))
+    return _G_PACKED_NP[key]

@@ -59,14 +59,14 @@ def _edw_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
                 ta_ref, tb_ref,
                 yma_ref, ymb_ref, ypa_ref, ypb_ref, t2a_ref, t2b_ref,
                 mA_ref, mB_ref, sigc_ref, nB_ref,
-                wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                wab_ref, wba_ref,
                 amodb_ref, bmoda_ref, invab_ref, invmib_ref,
                 cpA_ref, cpB_ref, c14a_ref, c14b_ref,
                 oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
                 ota_ref, otb_ref):
     _, _, rmul, radd, rsub, _ = make_rns_ops(
         mA_ref[:], mB_ref[:], sigc_ref[:], nB_ref[:],
-        wabh_ref[:], wabl_ref[:], wbah_ref[:], wbal_ref[:],
+        wab_ref[:], wba_ref[:],
         amodb_ref[:], bmoda_ref[:], invab_ref[:], invmib_ref[:],
         cpA_ref[:], cpB_ref[:], c14a_ref[:], c14b_ref[:])
 
@@ -111,11 +111,13 @@ def _ctx_consts(c) -> tuple:
     from . import pallas_redc
 
     def build():
+        # pallas_redc's 12-entry tuple ends (..., invmib, c14a, c14b);
+        # this kernel's signature wants cpA/cpB before the c14 pair.
         r = pallas_redc._ctx_consts(c)
-        return r[:12] + (
+        return r[:10] + (
             np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
             np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
-        ) + r[12:]
+        ) + r[10:]
 
     return pallas_redc.pinned_ctx_cache(_CONSTS, c, build)
 
@@ -123,7 +125,7 @@ def _ctx_consts(c) -> tuple:
 @partial(jax.jit, static_argnames=("ia", "ib", "interpret"))
 def _edw_call(xa, xb, ya, yb, za, zb, ta, tb,
               yma, ymb, ypa, ypb, t2a, t2b,
-              mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+              mA, mB, sigc, nB, wab, wba,
               amodb, bmoda, invab, invmib, cpA, cpB, c14a, c14b,
               ia: int, ib: int, interpret: bool):
     from jax.experimental import pallas as pl
@@ -140,7 +142,7 @@ def _edw_call(xa, xb, ya, yb, za, zb, ta, tb,
         return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
                             memory_space=pltpu.VMEM)
 
-    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+    consts = (mA, mB, sigc, nB, wab, wba, amodb, bmoda,
               invab, invmib, cpA, cpB, c14a, c14b)
     outs = (jax.ShapeDtypeStruct((ia, n), I32),
             jax.ShapeDtypeStruct((ib, n), I32)) * 4

@@ -55,7 +55,7 @@ def enabled() -> bool:
 
 
 def _madd_math(X, Y, Z, x2, y2, has, inf,
-               mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+               mA, mB, sigc, nB, wab, wba,
                amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
                c14a, c14b):
     """One mixed-add step on VALUES (VMEM arrays, not refs).
@@ -67,7 +67,7 @@ def _madd_math(X, Y, Z, x2, y2, has, inf,
     """
     invA_f = 1.0 / mA.astype(F32)
     _, _, rmul, radd, rsub, rfix = make_rns_ops(
-        mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+        mA, mB, sigc, nB, wab, wba,
         amodb, bmoda, invab, invmib, cpA, cpB, c14a, c14b)
 
     # _madd_rns, layer for layer (bounds comments live there).
@@ -123,24 +123,31 @@ def _madd_math(X, Y, Z, x2, y2, has, inf,
 
 
 def _madd_kernel(xa_ref, xb_ref, ya_ref, yb_ref, za_ref, zb_ref,
-                 pxa_ref, pxb_ref, pya_ref, pyb_ref,
+                 pxa_ref, pya_ref,
                  has_ref, inf_ref,
                  mA_ref, mB_ref, sigc_ref, nB_ref,
-                 wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                 wab_ref, wba_ref,
                  amodb_ref, bmoda_ref, invab_ref, invmib_ref,
                  cpA_ref, cpB_ref, oneA_ref, oneB_ref,
                  c14a_ref, c14b_ref,
                  oxa_ref, oxb_ref, oya_ref, oyb_ref, oza_ref, ozb_ref,
                  deg_ref):
     # cpA/cpB are [I, maxc] pre-transposed: static 2-D slices only —
-    # int indexing lowers to a gather Mosaic rejects.
+    # int indexing lowers to a gather Mosaic rejects. Table points
+    # arrive as packed A|B<<16 words (halved gather traffic,
+    # ec_rns._pack_residue_rows) and unpack here on VMEM.
+    from .ec_rns import unpack_pt
+
+    ia = xa_ref.shape[0]
+    ib = xb_ref.shape[0]
     oxa, oxb, oya, oyb, oza, ozb, deg = _madd_math(
         (xa_ref[:], xb_ref[:]), (ya_ref[:], yb_ref[:]),
         (za_ref[:], zb_ref[:]),
-        (pxa_ref[:], pxb_ref[:]), (pya_ref[:], pyb_ref[:]),
+        unpack_pt(pxa_ref[:], ia, ib),
+        unpack_pt(pya_ref[:], ia, ib),
         has_ref[:], inf_ref[:],
         mA_ref[:], mB_ref[:], sigc_ref[:], nB_ref[:],
-        wabh_ref[:], wabl_ref[:], wbah_ref[:], wbal_ref[:],
+        wab_ref[:], wba_ref[:],
         amodb_ref[:], bmoda_ref[:], invab_ref[:], invmib_ref[:],
         cpA_ref[:], cpB_ref[:], oneA_ref[:], oneB_ref[:],
         c14a_ref[:], c14b_ref[:])
@@ -172,10 +179,11 @@ def _build_consts(c) -> tuple:
     a_mod_p = c.A.prod % c.cp.p
     one_a = col([a_mod_p % int(m) for m in c.A.m])
     one_b = col([a_mod_p % int(m) for m in c.B.m])
+    from .pallas_redc import _w_block
+
     return (
         col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
-        np.asarray(w_ab[0]), np.asarray(w_ab[1]),
-        np.asarray(w_ba[0]), np.asarray(w_ba[1]),
+        _w_block(w_ab), _w_block(w_ba),
         col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
         np.ascontiguousarray(np.asarray(c.cp_A, np.int32).T),
         np.ascontiguousarray(np.asarray(c.cp_B, np.int32).T),
@@ -186,8 +194,8 @@ def _build_consts(c) -> tuple:
 
 
 @partial(jax.jit, static_argnames=("ia", "ib", "interpret"))
-def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
-               mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+def _madd_call(xa, xb, ya, yb, za, zb, pxp, pyp, has, inf,
+               mA, mB, sigc, nB, wab, wba,
                amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
                c14a, c14b,
                ia: int, ib: int, interpret: bool):
@@ -196,6 +204,7 @@ def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
 
     n = xa.shape[1]
     grid = n // _TILE
+    iap = pxp.shape[0]
 
     def col_spec(rows):
         return pl.BlockSpec((rows, _TILE), lambda i: (0, i),
@@ -205,7 +214,7 @@ def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
         return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
                             memory_space=pltpu.VMEM)
 
-    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+    consts = (mA, mB, sigc, nB, wab, wba, amodb, bmoda,
               invab, invmib, cpA, cpB, oneA, oneB, c14a, c14b)
     outs = (jax.ShapeDtypeStruct((ia, n), I32),
             jax.ShapeDtypeStruct((ib, n), I32)) * 3 + \
@@ -215,13 +224,13 @@ def _madd_call(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf,
         out_shape=outs,
         grid=(grid,),
         in_specs=[col_spec(ia), col_spec(ib)] * 3
-        + [col_spec(ia), col_spec(ib)] * 2
+        + [col_spec(iap)] * 2
         + [col_spec(1), col_spec(1)]
         + [const_spec(a.shape) for a in consts],
         out_specs=tuple([col_spec(ia), col_spec(ib)] * 3
                         + [col_spec(1)]),
         interpret=interpret,
-    )(xa, xb, ya, yb, za, zb, pxa, pxb, pya, pyb, has, inf, *consts)
+    )(xa, xb, ya, yb, za, zb, pxp, pyp, has, inf, *consts)
 
 
 # ---------------------------------------------------------------------------
@@ -252,7 +261,7 @@ def ladder_enabled() -> bool:
 
 def _ladder_kernel(g_ref, has_ref, inf_ref,
                    mA_ref, mB_ref, sigc_ref, nB_ref,
-                   wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                   wab_ref, wba_ref,
                    amodb_ref, bmoda_ref, invab_ref, invmib_ref,
                    cpA_ref, cpB_ref, oneA_ref, oneB_ref,
                    c14a_ref, c14b_ref,
@@ -270,16 +279,18 @@ def _ladder_kernel(g_ref, has_ref, inf_ref,
                     ozb_ref, deg_ref):
             ref[:] = jnp.zeros(ref.shape, ref.dtype)
 
-    iab = ia + ib
-    g = g_ref[:][0]                     # [1, 2*iab, T] → [2*iab, T]
-    x2 = (g[0:ia], g[ia:iab])
-    y2 = (g[iab:iab + ia], g[iab + ia:2 * iab])
+    from .ec_rns import unpack_pt
+
+    iap = max(ia, ib)
+    g = g_ref[:][0]                     # [1, 2*iap, T] → [2*iap, T]
+    x2 = unpack_pt(g[:iap], ia, ib)
+    y2 = unpack_pt(g[iap:], ia, ib)
     oxa, oxb, oya, oyb, oza, ozb, deg = _madd_math(
         (oxa_ref[:], oxb_ref[:]), (oya_ref[:], oyb_ref[:]),
         (oza_ref[:], ozb_ref[:]), x2, y2,
         has_ref[:][0], inf_ref[:][0],
         mA_ref[:], mB_ref[:], sigc_ref[:], nB_ref[:],
-        wabh_ref[:], wabl_ref[:], wbah_ref[:], wbal_ref[:],
+        wab_ref[:], wba_ref[:],
         amodb_ref[:], bmoda_ref[:], invab_ref[:], invmib_ref[:],
         cpA_ref[:], cpB_ref[:], oneA_ref[:], oneB_ref[:],
         c14a_ref[:], c14b_ref[:])
@@ -295,14 +306,14 @@ def _ladder_kernel(g_ref, has_ref, inf_ref,
 @partial(jax.jit,
          static_argnames=("ia", "ib", "n_windows", "interpret"))
 def _ladder_call(G, has, inf,
-                 mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+                 mA, mB, sigc, nB, wab, wba,
                  amodb, bmoda, invab, invmib, cpA, cpB, oneA, oneB,
                  c14a, c14b,
                  ia: int, ib: int, n_windows: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    iab = ia + ib
+    iap = max(ia, ib)
     m = has.shape[2]
     grid = (m // _TILE, n_windows)
 
@@ -316,7 +327,7 @@ def _ladder_call(G, has, inf,
     # 3-D table blocks: the channel axis spans the FULL dimension (the
     # Mosaic block rule needs last-two block dims divisible by (8, 128)
     # or equal to the array's), window rides the leading axis.
-    g_spec = pl.BlockSpec((1, 2 * iab, _TILE), lambda t, w: (w, 0, t),
+    g_spec = pl.BlockSpec((1, 2 * iap, _TILE), lambda t, w: (w, 0, t),
                           memory_space=pltpu.VMEM)
     win_spec = pl.BlockSpec((1, 1, _TILE), lambda t, w: (w, 0, t),
                             memory_space=pltpu.VMEM)
@@ -325,7 +336,7 @@ def _ladder_call(G, has, inf,
         return pl.BlockSpec(shape, lambda t, w: tuple(0 for _ in shape),
                             memory_space=pltpu.VMEM)
 
-    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+    consts = (mA, mB, sigc, nB, wab, wba, amodb, bmoda,
               invab, invmib, cpA, cpB, oneA, oneB, c14a, c14b)
     outs = (jax.ShapeDtypeStruct((ia, m), I32),
             jax.ShapeDtypeStruct((ib, m), I32)) * 3 + \
@@ -352,12 +363,12 @@ def ladder_fused(c, tab, d_all, row0_all, interpret: bool = False):
     residue-plane pairs, final infinity mask, accumulated degeneracy.
     """
     ia, ib = c.A.count, c.B.count
-    iab = ia + ib
+    iap = max(ia, ib)
     n_windows, m = d_all.shape
     has_all = d_all > 0
     idx = row0_all + jnp.where(has_all, d_all - 1, 0)
-    g = jnp.take(tab, idx.reshape(-1), axis=0)       # [W*M, 2I]
-    G = g.reshape(n_windows, m, 2 * iab).transpose(0, 2, 1)
+    g = jnp.take(tab, idx.reshape(-1), axis=0)       # [W*M, 2*iap]
+    G = g.reshape(n_windows, m, 2 * iap).transpose(0, 2, 1)
     has_i = has_all.astype(I32)
     hc = jnp.cumsum(has_i, axis=0)
     inf_i = ((hc - has_i) == 0).astype(I32)          # ENTRY infinity
@@ -381,31 +392,32 @@ def ladder_fused(c, tab, d_all, row0_all, interpret: bool = False):
             (oza[:, sl], ozb[:, sl]), inf_fin, deg[0, sl] != 0)
 
 
-def madd_fused(c, X, Y, Z, inf, has, x2, y2, interpret: bool = False):
+def madd_fused(c, X, Y, Z, inf, has, x2p, y2p, interpret: bool = False):
     """Fused add_from_table step: returns (X', Y', Z', deg_bool).
 
-    X/Y/Z/x2/y2: (A, B) residue-plane pairs [I, N]; inf/has: [N] bool.
-    The caller keeps the cheap [N]-wide bookkeeping (inf' = inf & ~has,
-    deg accumulation) in XLA.
+    X/Y/Z: (A, B) residue-plane pairs [I, N]; x2p/y2p: PACKED table
+    words [max(I_A, I_B), N] (A|B<<16, ec_rns._pack_residue_rows —
+    unpacked in-kernel); inf/has: [N] bool. The caller keeps the cheap
+    [N]-wide bookkeeping (inf' = inf & ~has, deg accumulation) in XLA.
     """
     ia = X[0].shape[0]
     ib = X[1].shape[0]
     n = X[0].shape[1]
     pad = (-n) % _TILE
 
-    def p2(pair):
-        if not pad:
-            return pair
-        return (jnp.pad(pair[0], ((0, 0), (0, pad))),
-                jnp.pad(pair[1], ((0, 0), (0, pad))))
+    def p1(a):
+        return jnp.pad(a, ((0, 0), (0, pad))) if pad else a
 
-    Xp, Yp, Zp, x2p, y2p = p2(X), p2(Y), p2(Z), p2(x2), p2(y2)
+    def p2(pair):
+        return (p1(pair[0]), p1(pair[1]))
+
+    Xp, Yp, Zp = p2(X), p2(Y), p2(Z)
     has_i = jnp.pad(has.astype(I32)[None, :], ((0, 0), (0, pad)))
     # padding lanes: inf=1, has=0 → pass-through of zero planes
     inf_i = jnp.pad(inf.astype(I32)[None, :], ((0, 0), (0, pad)),
                     constant_values=1)
     out = _madd_call(Xp[0], Xp[1], Yp[0], Yp[1], Zp[0], Zp[1],
-                     x2p[0], x2p[1], y2p[0], y2p[1], has_i, inf_i,
+                     p1(x2p), p1(y2p), has_i, inf_i,
                      *_ctx_consts(c), ia=ia, ib=ib,
                      interpret=interpret)
     oxa, oxb, oya, oyb, oza, ozb, deg = out

@@ -73,11 +73,21 @@ def _fix(v, m, inv_f):
     return r
 
 
-def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
+def _extend_in_kernel(sig, inv_src_f, w_blk, m_dst, inv_dst_f,
                       src_prod_mod_dst, offset, c14):
     """rns._extend on VMEM tiles: [I_src, T] -> [I_dst, T].
 
-    Recombination bounds (EC/Ed contexts: I ≤ ~25 channels of 13-bit
+    The hi/lo 7-bit product split rides the M and K matmul axes, not
+    the lane axis: w_blk is the host-built block matrix
+    ``[[wh, 0], [0, wl], [wl, wh]]`` of shape [3J, 2I], multiplied by
+    ``[sig>>7 ; sig&127]`` [2I, T] — one pass of N=T lanes instead of
+    two passes of the old [2J, I] @ [I, 2T] layout. On the 128×128 MXU
+    both layouts fit one M·K block for every curve context (3J ≤ 135
+    padded, 2I ≤ 92 for P-521), so halving N halves the MXU unit
+    count outright; every product term stays 127·127 and every f32
+    accumulation < 2^20, bit-identical to the two-pass form.
+
+    Recombination bounds (EC/Ed contexts: I ≤ ~45 channels of 13-bit
     primes): hh/mid/ll ≤ 2I·127² < 2^20; 2^7 mod m = 128 EXACTLY
     (m ≥ 2^12), so mid·128 + ll < 2^28 needs no per-term fixes; only
     hh (weight 2^14 > m) reduces first. α ∈ [-1, I_src], so its mod-m
@@ -86,18 +96,16 @@ def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
     """
     # Structural overflow guard (shapes are static at trace time):
     # fix(hh)·c14 + mid·128 + ll < 2^28 + I·16129·257 stays below 2^31
-    # only for I ≤ 448 — ample for per-channel contexts (P-521 ≈ 43),
+    # only for I ≤ 448 — ample for per-channel contexts (P-521 ≈ 45),
     # but any future reuse beyond that must restore per-term fixes.
     assert sig.shape[0] <= 448, "extension recombination would overflow"
-    j = wh.shape[0]
-    t = sig.shape[1]
-    w_cat = jnp.concatenate([wh, wl], axis=0)              # [2J, I]
-    x_cat = jnp.concatenate(
-        [(sig >> 7).astype(BF16), (sig & 127).astype(BF16)], axis=1)
-    c = jnp.dot(w_cat, x_cat, preferred_element_type=F32).astype(I32)
-    hh = c[:j, :t]
-    mid = c[:j, t:] + c[j:, :t]
-    ll = c[j:, t:]
+    j = w_blk.shape[0] // 3
+    x_blk = jnp.concatenate(
+        [(sig >> 7).astype(BF16), (sig & 127).astype(BF16)], axis=0)
+    c = jnp.dot(w_blk, x_blk, preferred_element_type=F32).astype(I32)
+    hh = c[:j]
+    ll = c[j:2 * j]
+    mid = c[2 * j:]
     alpha = jnp.floor(
         jnp.sum(sig.astype(F32) * inv_src_f, axis=0, keepdims=True)
         + offset).astype(I32)                              # [1, T]
@@ -105,10 +113,13 @@ def _extend_in_kernel(sig, inv_src_f, wh, wl, m_dst, inv_dst_f,
                 + mid * 128 + ll, m_dst, inv_dst_f)
     alpha_adj = jnp.where(alpha < 0, alpha + m_dst, alpha)
     corr = _fix(alpha_adj * src_prod_mod_dst, m_dst, inv_dst_f)
-    return _fix(comb - corr + m_dst, m_dst, inv_dst_f)
+    # comb, corr < m → comb − corr + m ∈ (0, 2m): one conditional
+    # subtract replaces the full Barrett pass (same result exactly).
+    r = comb - corr + m_dst
+    return jnp.where(r >= m_dst, r - m_dst, r)
 
 
-def make_rns_ops(mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+def make_rns_ops(mA, mB, sigc, nB, wab, wba,
                  amodb, bmoda, invab, invmib, cpA, cpB, c14a, c14b):
     """In-kernel RNS field-op closures over VALUE arrays.
 
@@ -116,9 +127,11 @@ def make_rns_ops(mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
     add/sub discipline, shared by the fused mixed-add (pallas_madd)
     and the fused Edwards-add (pallas_edw) kernels — their numerics
     cannot diverge from each other or from this module's REDC kernel.
-    cpA/cpB are [I, maxc] PRE-TRANSPOSED (static 2-D slices only: int
-    indexing lowers to a gather Mosaic rejects). Returns
-    (fixA, fixB, rmul, radd, rsub, rfix) on (A, B) residue-plane pairs.
+    wab/wba are the [3J, 2I] extension block matrices (see
+    _extend_in_kernel); cpA/cpB are [I, maxc] PRE-TRANSPOSED (static
+    2-D slices only: int indexing lowers to a gather Mosaic rejects).
+    Returns (fixA, fixB, rmul, radd, rsub, rfix) on (A, B)
+    residue-plane pairs.
     """
     invA_f = 1.0 / mA.astype(F32)
     invB_f = 1.0 / mB.astype(F32)
@@ -131,13 +144,13 @@ def make_rns_ops(mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
 
     def redc(pA, pB):
         sig = fixA(pA * sigc)
-        q_B = _extend_in_kernel(sig, invA_f, wabh, wabl,
+        q_B = _extend_in_kernel(sig, invA_f, wab,
                                 mB, invB_f, amodb, -1e-4, c14b)
         # q·p + x < 2^28 — one fix covers the merged product-and-add
         t_B = fixB(pB + q_B * nB)
         t_B = fixB(t_B * invab)
         sig2 = fixB(t_B * invmib)
-        t_A = _extend_in_kernel(sig2, invB_f, wbah, wbal,
+        t_A = _extend_in_kernel(sig2, invB_f, wba,
                                 mA, invA_f, bmoda, 0.5 - 1e-4, c14a)
         return t_A, t_B
 
@@ -162,7 +175,7 @@ def make_rns_ops(mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
 
 
 def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
-                 wabh_ref, wabl_ref, wbah_ref, wbal_ref,
+                 wab_ref, wba_ref,
                  amodb_ref, bmoda_ref, invab_ref, invmib_ref,
                  c14a_ref, c14b_ref,
                  tA_ref, tB_ref):
@@ -174,14 +187,14 @@ def _redc_kernel(xA_ref, xB_ref, mA_ref, mB_ref, sigc_ref, nB_ref,
     invB_f = 1.0 / mB.astype(F32)
 
     sig = _fix(xA * sigc_ref[:], mA, invA_f)
-    q_B = _extend_in_kernel(sig, invA_f, wabh_ref[:], wabl_ref[:],
+    q_B = _extend_in_kernel(sig, invA_f, wab_ref[:],
                             mB, invB_f, amodb_ref[:], -1e-4,
                             c14b_ref[:])
     # q·n + x < 2^28 — one fix covers the merged product-and-add
     t_B = _fix(xB + q_B * nB_ref[:], mB, invB_f)
     t_B = _fix(t_B * invab_ref[:], mB, invB_f)
     sig2 = _fix(t_B * invmib_ref[:], mB, invB_f)
-    t_A = _extend_in_kernel(sig2, invB_f, wbah_ref[:], wbal_ref[:],
+    t_A = _extend_in_kernel(sig2, invB_f, wba_ref[:],
                             mA, invA_f, bmoda_ref[:], 0.5 - 1e-4,
                             c14a_ref[:])
     tA_ref[:] = t_A
@@ -211,6 +224,25 @@ def _ctx_consts(c) -> tuple:
     return pinned_ctx_cache(_CONST_CACHE, c, lambda: _build_consts(c))
 
 
+def _w_block(pair):
+    """(Wh, Wl) [J, I] halves → the [3J, 2I] extension block matrix
+    ``[[Wh, 0], [0, Wl], [Wl, Wh]]`` (see _extend_in_kernel). Entries
+    stay 7-bit, so bf16 is exact. HOST numpy (ml_dtypes bf16): this
+    feeds the pinned const caches, which must never hold JAX arrays —
+    one created inside a jit trace leaks that trace."""
+    import ml_dtypes
+
+    wh = np.asarray(pair[0], np.float32)
+    wl = np.asarray(pair[1], np.float32)
+    j, i = wh.shape
+    out = np.zeros((3 * j, 2 * i), np.float32)
+    out[:j, :i] = wh
+    out[j:2 * j, i:] = wl
+    out[2 * j:, :i] = wl
+    out[2 * j:, i:] = wh
+    return out.astype(ml_dtypes.bfloat16)
+
+
 def _build_consts(c) -> tuple:
     (dA, dB, w_ab, w_ba, Amod_B, Bmod_A, invA_B) = c.consts
 
@@ -222,7 +254,7 @@ def _build_consts(c) -> tuple:
 
     return (
         col(dA["m"]), col(dB["m"]), col(c.sig_c), col(c.p_B),
-        w_ab[0], w_ab[1], w_ba[0], w_ba[1],
+        _w_block(w_ab), _w_block(w_ba),
         col(Amod_B), col(Bmod_A), col(invA_B), col(dB["inv_Mi"]),
         col((1 << 14) % np.asarray(c.A.m, np.int64)),
         col((1 << 14) % np.asarray(c.B.m, np.int64)),
@@ -230,7 +262,7 @@ def _build_consts(c) -> tuple:
 
 
 @partial(jax.jit, static_argnames=("ia", "ib", "interpret"))
-def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
+def _redc_call(xA, xB, mA, mB, sigc, nB, wab, wba,
                amodb, bmoda, invab, invmib, c14a, c14b,
                ia: int, ib: int, interpret: bool = False):
     from jax.experimental import pallas as pl
@@ -247,7 +279,7 @@ def _redc_call(xA, xB, mA, mB, sigc, nB, wabh, wabl, wbah, wbal,
         return pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape),
                             memory_space=pltpu.VMEM)
 
-    consts = (mA, mB, sigc, nB, wabh, wabl, wbah, wbal, amodb, bmoda,
+    consts = (mA, mB, sigc, nB, wab, wba, amodb, bmoda,
               invab, invmib, c14a, c14b)
     return pl.pallas_call(
         _redc_kernel,

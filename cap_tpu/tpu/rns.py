@@ -250,20 +250,27 @@ def _split_matmul(w_pair, x: jnp.ndarray):
     """Σ W·x via ONE exact bf16 matmul → (hh, mid, ll) f32→i32.
 
     w_pair: (Wh, Wl) bf16 [J, I] 7-bit halves; x: [I, N] i32 < 2^14.
-    The four half-products are packed into a single [2J, I] @ [I, 2N]
-    matmul (better MXU utilization than four small dispatches); the
-    quadrants recombine with weights hh·2^14 + mid·2^7 + ll.
+    The hi/lo split rides the M and K axes: the block matrix
+    ``[[Wh, 0], [0, Wl], [Wl, Wh]]`` [3J, 2I] multiplies
+    ``[x>>7 ; x&127]`` [2I, N] — half the MXU unit count of the old
+    [2J, I] @ [I, 2N] layout, since N halves while 3J and 2I stay
+    within one 128-lane block for every context in use (the blocked W
+    is built from constants, so XLA folds it at compile time). Row
+    groups: hh (weight 2^14 via c14), ll, mid (weight 2^7).
     """
     wh, wl = w_pair
-    j = wh.shape[0]
-    n = x.shape[1]
-    w_cat = jnp.concatenate([wh, wl], axis=0)            # [2J, I]
-    x_cat = jnp.concatenate(
-        [(x >> 7).astype(BF16), (x & 127).astype(BF16)], axis=1)
-    c = jnp.dot(w_cat, x_cat, preferred_element_type=F32).astype(I32)
-    hh = c[:j, :n]
-    mid = c[:j, n:] + c[j:, :n]
-    ll = c[j:, n:]
+    j, i = wh.shape
+    z = jnp.zeros((j, i), wh.dtype)
+    w_blk = jnp.concatenate([
+        jnp.concatenate([wh, z], axis=1),
+        jnp.concatenate([z, wl], axis=1),
+        jnp.concatenate([wl, wh], axis=1)], axis=0)      # [3J, 2I]
+    x_blk = jnp.concatenate(
+        [(x >> 7).astype(BF16), (x & 127).astype(BF16)], axis=0)
+    c = jnp.dot(w_blk, x_blk, preferred_element_type=F32).astype(I32)
+    hh = c[:j]
+    ll = c[j:2 * j]
+    mid = c[2 * j:]
     return hh, mid, ll
 
 
@@ -299,7 +306,10 @@ def _extend(sig: jnp.ndarray, src_dev, dst_dev, w_pair,
     alpha_adj = jnp.where(alpha < 0, alpha[None, :] + m,
                           alpha[None, :])
     corr = fix(alpha_adj * src_prod_mod_dst[:, None])
-    return fix(comb - corr + m)
+    # comb, corr < m → comb − corr + m ∈ (0, 2m): one conditional
+    # subtract replaces the full Barrett pass (identical result).
+    r = comb - corr + m
+    return jnp.where(r >= m, r - m, r)
 
 
 def _redc(x_A, x_B, sig_c, n_B, ctx_consts):

@@ -52,11 +52,11 @@ def main():
     s = jax.device_put(s_np)
     e = jax.device_put(e_np)
     idx = jax.device_put(idx_np)
-    g = ec_rns.g_residue_tables(cp.name)
+
 
     def run():
         return ec_rns._ecdsa_rns_core(
-            r, s, e, idx, rtab.tqx, rtab.tqy, *g, *consts[4:9],
+            r, s, e, idx, rtab.tab, *consts[4:9],
             crv=cp.name, nbits=cp.nbits)
 
     # compile + settle

@@ -78,13 +78,13 @@ def main():
     table = tpuec.ECKeyTable("P-256", keys)
     rtab = table.rns()
     idx = jax.device_put(
-        rng.integers(0, rtab.tqx.shape[0], 2 * N).astype(np.int32))
+        rng.integers(0, rtab.tab.shape[0], 2 * N).astype(np.int32))
 
     @partial(jax.jit, static_argnames=("reps",))
     def gathers(idx, reps: int):
         def body(i, acc):
-            gx = jnp.take(rtab.tqx, idx + i, axis=0)
-            gy = jnp.take(rtab.tqy, idx + i, axis=0)
+            gx = jnp.take(rtab.tab, idx + i, axis=0)
+            gy = gx
             return acc + gx[0] + gy[0]
 
         return lax.fori_loop(0, reps * 32, body,

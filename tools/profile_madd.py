@@ -62,7 +62,14 @@ def main():
                 jax.device_put(rng.integers(
                     0, 4000, (ib, lanes)).astype(np.int32)))
 
-    X, Y, Z, x2, y2 = plane(), plane(), plane(), plane(), plane()
+    X, Y, Z = plane(), plane(), plane()
+    iap = ec_rns.packed_cols(c)
+    x2 = jax.device_put(  # packed A|B<<16 table words
+        (rng.integers(0, 4000, (iap, lanes))
+         | (rng.integers(0, 4000, (iap, lanes)) << 16)).astype(np.int32))
+    y2 = jax.device_put(
+        (rng.integers(0, 4000, (iap, lanes))
+         | (rng.integers(0, 4000, (iap, lanes)) << 16)).astype(np.int32))
     inf = jax.device_put(np.zeros(lanes, bool))
     has = jax.device_put(np.ones(lanes, bool))
 
@@ -89,10 +96,7 @@ def main():
     keys = [T.generate_keys("ES256")[1] for _ in range(8)]
     table = tpuec.ECKeyTable("P-256", keys)
     rtab = table.rns()
-    tgx, tgy = ec_rns.g_residue_tables("P-256")
-    tab = jnp.concatenate(
-        [jnp.concatenate([tgx, rtab.tqx], axis=0),
-         jnp.concatenate([tgy, rtab.tqy], axis=0)], axis=1)
+    tab = rtab.tab
     print(f"table: {tab.shape} = {tab.nbytes/(1<<20):.1f} MB")
     idx = jax.device_put(
         rng.integers(0, tab.shape[0], lanes).astype(np.int32))
@@ -114,7 +118,6 @@ def main():
     # (c) full core
     cp = table.curve
     consts = cp.device_consts()
-    g = ec_rns.g_residue_tables(cp.name)
     k = cp.k
     r_np = rng.integers(1, 1 << 16, (k, N), dtype=np.int64).astype(np.uint32)
     s_np = rng.integers(1, 1 << 16, (k, N), dtype=np.int64).astype(np.uint32)
@@ -127,7 +130,7 @@ def main():
 
     def run():
         return ec_rns._ecdsa_rns_core(
-            rr, ss, ee, kidd, rtab.tqx, rtab.tqy, *g, *consts[4:9],
+            rr, ss, ee, kidd, rtab.tab, *consts[4:9],
             crv=cp.name, nbits=cp.nbits)
 
     ok, deg = run()
