@@ -250,27 +250,44 @@ def _split_matmul(w_pair, x: jnp.ndarray):
     """Σ W·x via ONE exact bf16 matmul → (hh, mid, ll) f32→i32.
 
     w_pair: (Wh, Wl) bf16 [J, I] 7-bit halves; x: [I, N] i32 < 2^14.
-    The hi/lo split rides the M and K axes: the block matrix
-    ``[[Wh, 0], [0, Wl], [Wl, Wh]]`` [3J, 2I] multiplies
-    ``[x>>7 ; x&127]`` [2I, N] — half the MXU unit count of the old
-    [2J, I] @ [I, 2N] layout, since N halves while 3J and 2I stay
-    within one 128-lane block for every context in use (the blocked W
-    is built from constants, so XLA folds it at compile time). Row
-    groups: hh (weight 2^14 via c14), ll, mid (weight 2^7).
+
+    Two layouts, chosen by context width:
+    - SMALL contexts (every EC/Ed field: 3J ≤ 128 and 2I ≤ 128): the
+      hi/lo split rides the M and K axes via the shared block matrix
+      ``[[Wh,0],[0,Wl],[Wl,Wh]]`` [3J, 2I] (pallas_redc._w_block —
+      ONE encoder for kernel and XLA paths) times ``[x>>7 ; x&127]``
+      [2I, N]. N halves while M and K stay inside one 128-lane MXU
+      block, so the unit count halves outright. The one-dot mid
+      accumulation is ≤ 2I·127² — f32-exact through I ≤ 520, amply
+      guarded by the 2I ≤ 128 gate.
+    - WIDE contexts (RSA: I ≈ nbits/12, hundreds of channels): keep
+      the [2J, I] @ [I, 2N] quadrant layout. The block form's M/K are
+      already multi-block there, so it pads ~1.5× MORE MXU work, and
+      its single-dot mid would overflow f32 past I = 520. Quadrant
+      mids accumulate only I ≤ 1040 terms (asserted), covering every
+      modulus the prime pool itself can support.
+    Row groups either way: hh (weight 2^14 via c14), mid (2^7), ll.
     """
     wh, wl = w_pair
     j, i = wh.shape
-    z = jnp.zeros((j, i), wh.dtype)
-    w_blk = jnp.concatenate([
-        jnp.concatenate([wh, z], axis=1),
-        jnp.concatenate([z, wl], axis=1),
-        jnp.concatenate([wl, wh], axis=1)], axis=0)      # [3J, 2I]
-    x_blk = jnp.concatenate(
-        [(x >> 7).astype(BF16), (x & 127).astype(BF16)], axis=0)
-    c = jnp.dot(w_blk, x_blk, preferred_element_type=F32).astype(I32)
-    hh = c[:j]
-    ll = c[j:2 * j]
-    mid = c[2 * j:]
+    if 3 * j <= 128 and 2 * i <= 128:
+        from .pallas_redc import _w_block
+
+        w_blk = jnp.asarray(_w_block((wh, wl)))          # [3J, 2I]
+        x_blk = jnp.concatenate(
+            [(x >> 7).astype(BF16), (x & 127).astype(BF16)], axis=0)
+        c = jnp.dot(w_blk, x_blk,
+                    preferred_element_type=F32).astype(I32)
+        return c[:j], c[2 * j:], c[j:2 * j]
+    assert i <= 1040, "quadrant mid accumulation would overflow f32"
+    n = x.shape[1]
+    w_cat = jnp.concatenate([wh, wl], axis=0)            # [2J, I]
+    x_cat = jnp.concatenate(
+        [(x >> 7).astype(BF16), (x & 127).astype(BF16)], axis=1)
+    c = jnp.dot(w_cat, x_cat, preferred_element_type=F32).astype(I32)
+    hh = c[:j, :n]
+    mid = c[:j, n:] + c[j:, :n]
+    ll = c[j:, n:]
     return hh, mid, ll
 
 

@@ -529,6 +529,29 @@ def _pss_hash_fns(hash_name: str):
     raise ValueError(f"unsupported PSS hash {hash_name!r}")
 
 
+def _vshift_left(mat, sh, max_shift: int):
+    """out[i, j] = mat[i, j + sh[i]] (zero fill), sh ∈ [0, max_shift].
+
+    Binary-decomposed variable shift: log2 masked STATIC slices. A
+    per-token ``take_along_axis`` byte gather here measured ~40 ms per
+    call @16k on chip (u8 lane gathers scalarize); these ~9 selects
+    are plain elementwise traffic (docs/PERF.md r5 PSS section).
+    """
+    import jax.numpy as jnp
+
+    n = mat.shape[0]
+    x = mat
+    bits = max(1, int(max_shift).bit_length())
+    for b in range(bits):
+        step = 1 << b
+        if step > max_shift:
+            break
+        shifted = jnp.concatenate(
+            [x[:, step:], jnp.zeros((n, step), x.dtype)], axis=1)
+        x = jnp.where((sh[:, None] & step) != 0, shifted, x)
+    return x
+
+
 def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
                        hash_name: str):
     """RFC 8017 §9.1.2 on device, salt auto-recovered: [N] bool.
@@ -539,6 +562,8 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
     (tpu/sha256.py, tpu/sha512.py — all three PS* families), so NO EM
     bytes ever leave the device; the reference computes all of this
     per token on CPU (jwt/keyset.go:126-139 → crypto/rsa.VerifyPSS).
+    All per-token-offset extraction uses _vshift_left — no dynamic
+    gathers anywhere.
 
     Bit-exact with pss_check_em/cap_pss_check_batch: every structural
     rejection (short emLen, missing 0xBC, nonzero leading bits/bytes,
@@ -561,12 +586,12 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
     len_ok = em_len >= h_len + 2
     trailer_ok = em_bytes[:, width - 1] == 0xBC
 
-    # H and maskedDB, gathered at per-token offsets.
+    # H and maskedDB, extracted at per-token offsets (variable shift).
     h_mat = em_bytes[:, width - 1 - h_len: width - 1]       # [N, h_len]
     db_max = width - h_len - 1
     dbj = jnp.arange(db_max, dtype=jnp.int32)[None, :]
-    db_idx = jnp.clip(start[:, None] + dbj, 0, width - 1)
-    masked_db = jnp.take_along_axis(em_bytes, db_idx, axis=1)
+    start_c = jnp.clip(start, 0, width)
+    masked_db = _vshift_left(em_bytes, start_c, width)[:, :db_max]
     in_db = dbj < db_len[:, None]
     masked_db = jnp.where(in_db, masked_db, 0)
 
@@ -593,17 +618,17 @@ def _pss_verify_device(em_bytes, mhash, mod_bits, *, width: int,
     nz = (db != 0) & in_db
     sep = jnp.argmax(nz, axis=1).astype(jnp.int32)  # 0 when none
     any_nz = jnp.any(nz, axis=1)
-    sep_ok = any_nz & \
-        (jnp.take_along_axis(db, sep[:, None], axis=1)[:, 0] == 1)
+    sep_byte = jnp.sum(
+        jnp.where(dbj == sep[:, None], db.astype(jnp.int32), 0), axis=1)
+    sep_ok = any_nz & (sep_byte == 1)
     salt_len = db_len - sep - 1                     # [N]
 
-    # M' = 0^8 ‖ mHash ‖ salt; salt gathered from db[sep+1 ...].
+    # M' = 0^8 ‖ mHash ‖ salt; salt = db shifted left by sep+1.
     salt_max = db_max - 1
     mp_len = 8 + h_len + salt_len
     mp_max = 8 + h_len + salt_max
     sj = jnp.arange(salt_max, dtype=jnp.int32)[None, :]
-    salt_idx = jnp.clip(sep[:, None] + 1 + sj, 0, db_max - 1)
-    salt = jnp.take_along_axis(db, salt_idx, axis=1)
+    salt = _vshift_left(db, sep + 1, db_max)[:, :salt_max]
     salt = jnp.where(sj < salt_len[:, None], salt, 0)
     mprime = jnp.zeros((n, mp_max), jnp.uint8)
     mprime = mprime.at[:, 8:8 + h_len].set(mhash[:, :h_len])

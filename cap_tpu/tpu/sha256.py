@@ -45,18 +45,59 @@ def _ror(x, r: int):
     return (x >> r) | (x << (32 - r))
 
 
+def _unrolled() -> bool:
+    """Fully unrolled rounds: opt-in (CAP_TPU_SHA_UNROLL=1) only.
+
+    Measured on-chip (round 5): unrolling did NOT beat the scan inside
+    the PSS program (86 vs 74 ms/16k — the scan was never the binding
+    term) and costs minutes of XLA compile per call site. Kept as a
+    tested experiment flag; the scan is the default everywhere.
+    """
+    import os
+
+    return os.environ.get("CAP_TPU_SHA_UNROLL") in ("1", "true", "yes")
+
+
+def _round_ops(t, a, b, c, d, e, f, g, h, w_t, kt):
+    s1 = _ror(e, 6) ^ _ror(e, 11) ^ _ror(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + kt + w_t
+    s0 = _ror(a, 2) ^ _ror(a, 13) ^ _ror(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    t2 = s0 + maj
+    return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+
+def _compress_unrolled(state, words):
+    """compress() with the 64 rounds as one fused op chain."""
+    w = [words[i] for i in range(16)]
+    s = tuple(state)
+    for t in range(64):
+        if t >= 16:
+            ws0 = _ror(w[t - 15], 7) ^ _ror(w[t - 15], 18) ^ \
+                (w[t - 15] >> 3)
+            ws1 = _ror(w[t - 2], 17) ^ _ror(w[t - 2], 19) ^ \
+                (w[t - 2] >> 10)
+            w.append(w[t - 16] + ws0 + w[t - 7] + ws1)
+        s = _round_ops(t, *s, w[t], jnp.uint32(_K[t]))
+    return tuple(a + b for a, b in zip(state, s))
+
+
 def compress(state, words):
     """One SHA-256 compression over the batch.
 
     state: tuple of 8 [N] uint32; words: [16, N] uint32 message words.
     Returns the new 8-tuple. uint32 adds wrap, matching the spec.
 
-    The 64 rounds run as a lax.scan with a rolling 16-word schedule
-    window (W[t+16] = W[t] + σ0(W[t+1]) + W[t+9] + σ1(W[t+14])): a
-    fully unrolled compression is ~3.5k XLA ops and takes minutes to
-    compile per call site on CPU; the scan body is ~60 ops.
+    Default everywhere: a lax.scan with a rolling 16-word schedule
+    window (W[t+16] = W[t] + σ0(W[t+1]) + W[t+9] + σ1(W[t+14])).
+    CAP_TPU_SHA_UNROLL=1 opts into the fully unrolled rounds — see
+    _unrolled for why that experiment stays off.
     """
     from jax import lax
+
+    if _unrolled():
+        return _compress_unrolled(state, words)
 
     k_arr = jnp.asarray(_K)
 

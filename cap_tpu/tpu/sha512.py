@@ -90,13 +90,51 @@ def _xor2(*xs):
     return (hi, lo)
 
 
+def _round512(st, w_t, kt64):
+    a, b, c, d, e, f, g, h = st
+    s1 = _xor2(_ror2(e, 14), _ror2(e, 18), _ror2(e, 41))
+    ch = ((e[0] & f[0]) ^ (~e[0] & g[0]),
+          (e[1] & f[1]) ^ (~e[1] & g[1]))
+    t1 = _add2(_add2(_add2(h, s1), _add2(ch, kt64)), w_t)
+    s0 = _xor2(_ror2(a, 28), _ror2(a, 34), _ror2(a, 39))
+    maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+           (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+    t2 = _add2(s0, maj)
+    return (_add2(t1, t2), a, b, c, _add2(d, t1), e, f, g)
+
+
+def _compress512_unrolled(state, words):
+    """compress512() with the 80 rounds as one fused op chain
+    (opt-in experiment — see sha256._unrolled)."""
+    w = [(words[2 * i], words[2 * i + 1]) for i in range(16)]
+    s = tuple(state)
+    for t in range(80):
+        if t >= 16:
+            w1 = w[t - 15]
+            w14 = w[t - 2]
+            sg0 = _xor2(_ror2(w1, 1), _ror2(w1, 8), _shr2(w1, 7))
+            sg1 = _xor2(_ror2(w14, 19), _ror2(w14, 61), _shr2(w14, 6))
+            w.append(_add2(_add2(w[t - 16], sg0), _add2(w[t - 7], sg1)))
+        kt = _K512[t]
+        s = _round512(s, w[t], (jnp.uint32(kt >> 32),
+                                jnp.uint32(kt & 0xFFFFFFFF)))
+    return tuple(_add2(a, b) for a, b in zip(state, s))
+
+
 def compress512(state, words):
     """One SHA-512 compression over the batch.
 
     state: tuple of 8 (hi, lo) pairs of [N] uint32; words: [32, N]
     uint32 — the 16 message words as interleaved (hi, lo) rows
-    (row 2t = hi of word t, row 2t+1 = lo).
+    (row 2t = hi of word t, row 2t+1 = lo). The scan is the default
+    everywhere; CAP_TPU_SHA_UNROLL=1 opts into unrolled rounds (see
+    sha256._unrolled).
     """
+    from .sha256 import _unrolled
+
+    if _unrolled():
+        return _compress512_unrolled(state, words)
+
     k_hi = jnp.asarray([k >> 32 for k in _K512], np.uint32)
     k_lo = jnp.asarray([k & 0xFFFFFFFF for k in _K512], np.uint32)
     k_arr = jnp.stack([k_hi, k_lo], axis=1)       # [80, 2]
