@@ -176,6 +176,13 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
 
         if repeat > 1:
             rec = np.tile(rec, (repeat,) + (1,) * (rec.ndim - 1))
+        if ks._mesh is not None:
+            # Place SHARDED up front: the verify fns' own shard_batch
+            # then sees the target sharding and is a no-op, keeping
+            # the timed path free of cross-device copies.
+            from ..parallel.place import shard_batch
+
+            return shard_batch(ks._mesh, rec)
         return jax.device_put(rec)
 
     for alg_name, hash_name in list(_RS.items()) + list(_PS.items()):
@@ -211,8 +218,8 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             def fn(rec=rec, table=table, hash_name=hash_name,
                    verify=verify):
                 # device_put inside is a no-op: rec is already resident
-                return jnp.sum(verify(table, rec, hash_name)
-                               .astype(jnp.int32))
+                return jnp.sum(verify(table, rec, hash_name,
+                                      mesh=ks._mesh).astype(jnp.int32))
 
             fns.append((len(chunk), fn))
 
@@ -247,7 +254,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             # contribute nothing). The OR also keeps the deg output
             # live so XLA cannot dead-code any of the ladder.
             ok_dev, deg_dev = tpuec.verify_es_packed_pending(
-                table, rec, hash_len)
+                table, rec, hash_len, mesh=ks._mesh)
             return jnp.sum((ok_dev | deg_dev).astype(jnp.int32))
 
         fns.append((len(idx), fn))
@@ -278,8 +285,8 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             table, sigs + [b""] * fill, msgs + [b""] * fill, key_idx))
 
         def fn(rec=rec, table=table):
-            return jnp.sum(tpued.verify_ed_packed_pending(table, rec)
-                           .astype(jnp.int32))
+            return jnp.sum(tpued.verify_ed_packed_pending(
+                table, rec, mesh=ks._mesh).astype(jnp.int32))
 
         fns.append((len(idx), fn))
 

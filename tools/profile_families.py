@@ -7,6 +7,17 @@ on device, min-of-3 slope, accept-sum checked) so the per-family
 engine rates are on record. Usage:
 
     python tools/profile_families.py [n_tokens]
+    python tools/profile_families.py [n_tokens] --mesh N
+
+``--mesh N`` runs every family's packed program under ``shard_map``
+on an N-device mesh (VERDICT r4 #7). Without real multi-chip
+hardware it forces the N-virtual-device CPU backend, where absolute
+rates are meaningless but the SHARDED step itself compiles, executes,
+and splits the batch n/N per device — so a sharding-overhead
+regression (replication of the batch, a stray all-gather) shows up
+as a per-device dispatch-size change long before real hardware does,
+and on a real N-chip slice the same command captures the scaling
+number.
 """
 import os
 import sys
@@ -17,7 +28,39 @@ ALGS = ["RS256", "RS384", "RS512", "PS256", "PS384", "PS512",
         "ES256", "ES384", "ES512", "EdDSA"]
 
 
-def measure(alg: str, n: int):
+def _parse_args(argv):
+    n, mesh_n = 16384, None
+    pos = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--mesh":
+            if i + 1 >= len(argv):
+                sys.exit("usage: profile_families.py [n_tokens] --mesh N")
+            mesh_n = int(argv[i + 1])
+            i += 2
+        else:
+            pos.append(argv[i])
+            i += 1
+    if pos:
+        n = int(pos[0])
+    return n, mesh_n
+
+
+# --mesh needs the virtual devices BEFORE first backend use. Env vars
+# are not enough on this image (the axon sitecustomize pins the TPU
+# platform — tests/conftest.py); jax.config.update still wins when it
+# runs before any device call. A real multi-chip slice sets
+# CAP_MESH_REAL=1 to keep its native backend instead.
+_N_TOKENS, _MESH_N = _parse_args(sys.argv[1:])
+if _MESH_N is not None and os.environ.get("CAP_MESH_REAL") != "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", _MESH_N)
+    os.environ.setdefault("CAP_TPU_RNS", "1")
+
+
+def measure(alg: str, n: int, mesh=None):
     from cap_tpu import testing as T
     from cap_tpu.jwt.jwk import JWK
     from cap_tpu.jwt.tpu_keyset import (
@@ -27,7 +70,7 @@ def measure(alg: str, n: int):
     )
 
     priv, pub = T.generate_keys(alg)
-    ks = TPUBatchKeySet([JWK(pub, kid="k0")])
+    ks = TPUBatchKeySet([JWK(pub, kid="k0")], mesh=mesh)
     base = [T.sign_jwt(priv, alg, T.default_claims(sub=f"s{i}"), kid="k0")
             for i in range(512)]
     toks = (base * ((n // len(base)) + 1))[:n]
@@ -36,11 +79,18 @@ def measure(alg: str, n: int):
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    n = _N_TOKENS
+    mesh = None
+    if _MESH_N is not None:
+        from cap_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(_MESH_N)
+        print(f"mesh: {len(mesh.devices.flat)} devices "
+              f"({mesh.devices.flat[0].platform})")
     print(f"resident packed path, {n} tokens/family, min-of-3 slope")
     for alg in ALGS:
         try:
-            n_tok, vps = measure(alg, n)
+            n_tok, vps = measure(alg, n, mesh=mesh)
             if vps is None:
                 print(f"{alg:6s} no clean slope (timer noise)",
                       flush=True)
