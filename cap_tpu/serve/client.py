@@ -34,10 +34,12 @@ class VerifyClient:
             self._sock = socket.create_connection((host, port),
                                                   timeout=timeout)
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # The client owns this socket's read side: buffered reader.
+        self._reader = protocol.FrameReader(self._sock)
 
     def ping(self) -> bool:
         protocol.send_ping(self._sock)
-        ftype, _ = protocol.recv_frame(self._sock)
+        ftype, _ = self._reader.recv_frame()
         return ftype == protocol.T_PONG
 
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
@@ -111,7 +113,7 @@ class VerifyClient:
                 self.close()
 
     def _read_response(self, n_tokens: int) -> List[Any]:
-        ftype, entries = protocol.recv_frame(self._sock)
+        ftype, entries = self._reader.recv_frame()
         if ftype != protocol.T_VERIFY_RESP:
             raise protocol.ProtocolError(f"expected response, got {ftype}")
         if len(entries) != n_tokens:

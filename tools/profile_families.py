@@ -37,6 +37,9 @@ def _parse_args(argv):
             if i + 1 >= len(argv):
                 sys.exit("usage: profile_families.py [n_tokens] --mesh N")
             mesh_n = int(argv[i + 1])
+            if mesh_n < 1 or mesh_n & (mesh_n - 1):
+                sys.exit("--mesh N must be a power of two (packed "
+                         "records pad to power-of-two batch sizes)")
             i += 2
         else:
             pos.append(argv[i])
