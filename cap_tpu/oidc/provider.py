@@ -40,7 +40,7 @@ from ..errors import (
     UnsupportedAlgError,
     UserInfoFailedError,
 )
-from ..jwt.jose import peek_alg
+from ..jwt.jose import is_json_form, peek_alg
 from ..jwt.keyset import JSONWebKeySet, KeySet
 from ..utils import http as _http
 from ..utils.strutils import remove_duplicates_stable, str_list_contains
@@ -68,6 +68,10 @@ class Provider:
         config.validate()
         self.config = config
         self._ssl_ctx = _http.ssl_context_for_ca(config.provider_ca or None)
+        # alg by compact header segment: tokens from one IdP share the
+        # exact header bytes, and peek_alg's per-token re-parse was the
+        # binding term of the batched id_token path (docs/PERF.md r5).
+        self._alg_cache: Dict[str, str] = {}
 
         if discovery_doc is None:
             discovery_doc = _http.fetch_discovery(config.issuer, self._ssl_ctx)
@@ -259,15 +263,43 @@ class Provider:
         return self._validate_id_claims(claims, t.reveal(), request)
 
     def verify_id_token_batch(self, id_tokens: Sequence[str],
-                              request: Request) -> List[Any]:
+                              request: Request,
+                              raw: bool = False) -> List[Any]:
         """Batched verify_id_token: one device dispatch for signatures
         (when the injected keyset is a TPUBatchKeySet), then per-token
-        claim validation. Returns claims dict or exception per token."""
+        claim validation. Returns claims dict or exception per token.
+
+        ``raw=True`` (the serve-style zero-rematerialization mode):
+        accepted tokens yield their signed payload BYTES — already the
+        claims JSON — instead of parsed dicts, and validation reads a
+        native registered-claims SUBSET (iss/sub/aud/exp/nbf/iat/
+        nonce/azp/auth_time) off the phase-1 tape, so no full claims
+        dict is ever built. Verdicts are identical to the dict path:
+        the validator only reads registered claims, and every parse
+        corner falls back to the full json.loads dict. Requires a
+        keyset with ``verify_batch_raw`` (the TPU keysets).
+        """
         raws = [t.reveal() if isinstance(t, IDToken) else str(t)
                 for t in id_tokens]
-        results = self._keyset.verify_batch(raws)
+        if raw:
+            if not hasattr(self._keyset, "verify_batch_raw"):
+                raise InvalidParameterError(
+                    "raw id_token batch mode needs a keyset with "
+                    "verify_batch_raw (TPUBatchKeySet/TPURemoteKeySet)")
+            results = self._keyset.verify_batch_raw(raws)
+            from ..runtime.native_binding import (
+                registered_claims_from_payloads,
+            )
+
+            acc = [i for i, r in enumerate(results)
+                   if not isinstance(r, Exception)]
+            claims_sub = registered_claims_from_payloads(
+                [results[i] for i in acc])
+            claims_for = dict(zip(acc, claims_sub))
+        else:
+            results = self._keyset.verify_batch(raws)
         out: List[Any] = []
-        for raw, res in zip(raws, results):
+        for i, (raw_tok, res) in enumerate(zip(raws, results)):
             if isinstance(res, Exception):
                 # same wrapping as the single-token path so callers see
                 # one taxonomy regardless of which API they used
@@ -277,9 +309,13 @@ class Provider:
                     out.append(InvalidSignatureError(
                         f"failed to verify id token signature: {res}"))
                 continue
+            claims = claims_for[i] if raw else res
             try:
-                self._check_times(res)
-                out.append(self._validate_id_claims(res, raw, request))
+                if isinstance(claims, Exception):
+                    raise claims
+                self._check_times(claims)
+                self._validate_id_claims(claims, raw_tok, request)
+                out.append(res if raw else claims)
             except Exception as e:  # noqa: BLE001 - per-token error channel
                 out.append(e)
         return out
@@ -294,6 +330,29 @@ class Provider:
                 f"failed to verify id token signature: {e}") from e
         self._check_times(claims)
         return claims
+
+    def _alg_of(self, raw: str) -> str:
+        """peek_alg with a header-segment cache.
+
+        alg is a pure function of the compact header segment, and the
+        token already parsed successfully upstream, so caching by that
+        segment is exact; JSON-form tokens (no stable prefix) always
+        take the full peek. The cache is bounded — a rotating IdP has
+        a handful of distinct headers, an attacker spraying unique
+        headers just evicts.
+        """
+        if is_json_form(raw):           # no stable prefix to key on
+            return peek_alg(raw)
+        seg, _, rest = raw.partition(".")
+        if not rest:
+            return peek_alg(raw)
+        alg = self._alg_cache.get(seg)
+        if alg is None:
+            alg = peek_alg(raw)
+            if len(self._alg_cache) >= 1024:
+                self._alg_cache.clear()
+            self._alg_cache[seg] = alg
+        return alg
 
     def _check_times(self, claims: Dict[str, Any]) -> None:
         now = self.config.now()
@@ -315,7 +374,7 @@ class Provider:
             raise InvalidIssuerError(
                 "id token issued by a different provider")
         # signing alg must be in the configured supported list
-        alg = peek_alg(raw)
+        alg = self._alg_of(raw)
         if alg not in self.config.supported_signing_algs:
             raise UnsupportedAlgError(
                 f"id_token signed with unsupported algorithm {alg!r}")

@@ -850,10 +850,252 @@ PyObject* validate_batch(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---------------------------------------------------------------------------
+// registered_batch: ONLY the registered id_token claims, no full dicts
+// ---------------------------------------------------------------------------
+//
+// The OIDC batch validator reads exactly these top-level claims:
+// iss, sub, aud, exp, nbf, iat, nonce, azp, auth_time. Materializing a
+// 9-key subset dict from the phase-1 tape skips the full claims dict
+// (every key, every value, every nested container) for tokens whose
+// payload is only being VALIDATED — the raw-claims OIDC mode, where
+// accepted tokens return their signed payload bytes verbatim
+// (provider.verify_id_token_batch(raw=True); the serve-path analog).
+//
+// Conservative fallbacks (status 3 → caller re-parses with json.loads
+// and validates from the full dict, so semantics never diverge):
+//   - any ESCAPED top-level key (an escape could spell a registered
+//     name; the full parser would match it);
+//   - a registered claim whose value is an object or a non-flat array
+//     (the validator's type checks must see the exact parsed shape).
+
+static const struct {
+  const char* name;
+  uint32_t len;
+} kRegistered[] = {
+    {"iss", 3}, {"sub", 3}, {"aud", 3},   {"exp", 3},       {"nbf", 3},
+    {"iat", 3}, {"azp", 3}, {"nonce", 5}, {"auth_time", 9},
+};
+constexpr int kNumRegistered =
+    static_cast<int>(sizeof(kRegistered) / sizeof(kRegistered[0]));
+
+// Scalar tape entry → new ref; nullptr with *is_scalar=false for
+// container ops (no Python error raised in that case).
+PyObject* scalar_of(uint32_t op, uint32_t a, uint32_t b,
+                    const uint8_t* payload, bool* is_scalar) {
+  *is_scalar = true;
+  switch (op) {
+    case OP_STR: {
+      uint32_t len = b >> 1, esc = b & 1;
+      return esc ? decode_escaped(payload + a, len)
+                 : PyUnicode_DecodeUTF8(
+                       reinterpret_cast<const char*>(payload + a),
+                       static_cast<Py_ssize_t>(len), nullptr);
+    }
+    case OP_INT:
+      return PyLong_FromLongLong(static_cast<int64_t>(
+          (static_cast<uint64_t>(b) << 32) | a));
+    case OP_BIGINT: {
+      char buf[kMaxIntDigits + 2];
+      std::memcpy(buf, payload + a, b);
+      buf[b] = 0;
+      return PyLong_FromString(buf, nullptr, 10);
+    }
+    case OP_FLOAT: {
+      uint64_t bits = (static_cast<uint64_t>(b) << 32) | a;
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return PyFloat_FromDouble(d);
+    }
+    case OP_TRUE:
+      Py_RETURN_TRUE;
+    case OP_FALSE:
+      Py_RETURN_FALSE;
+    case OP_NULL:
+      Py_RETURN_NONE;
+    default:
+      *is_scalar = false;
+      return nullptr;
+  }
+}
+
+// Subset dict from one ST_OK tape; nullptr + *fallback for the
+// conservative cases above; nullptr without *fallback on real errors.
+PyObject* build_registered(const TokenTape& tape, const uint8_t* payload,
+                           bool* fallback) {
+  *fallback = false;
+  const uint32_t* ops = tape.ops.data();
+  size_t nops = tape.ops.size();
+  PyObject* out = PyDict_New();
+  if (out == nullptr) return nullptr;
+  int depth = 0;
+  int reg = -1;  // pending registered key index at depth 1
+
+  auto bail = [&](bool fb) -> PyObject* {
+    *fallback = fb;
+    Py_DECREF(out);
+    return nullptr;
+  };
+  auto set_reg = [&](PyObject* v) -> bool {  // steals v
+    int rc = PyDict_SetItemString(out, kRegistered[reg].name, v);
+    Py_DECREF(v);
+    reg = -1;
+    return rc == 0;
+  };
+
+  for (size_t t = 0; t < nops; t += 3) {
+    uint32_t op = ops[t], a = ops[t + 1], b = ops[t + 2];
+    switch (op) {
+      case OP_OBJ_START:
+        if (reg >= 0 && depth == 1) return bail(true);
+        ++depth;
+        break;
+      case OP_ARR_START: {
+        if (reg >= 0 && depth == 1) {
+          // flat scalar array (the aud shape); anything nested → full
+          PyObject* lst = PyList_New(0);
+          if (lst == nullptr) return bail(false);
+          size_t u = t + 3;
+          for (; u < nops; u += 3) {
+            if (ops[u] == OP_ARR_END) break;
+            bool is_scalar;
+            PyObject* v = scalar_of(ops[u], ops[u + 1], ops[u + 2],
+                                    payload, &is_scalar);
+            if (!is_scalar) {
+              Py_DECREF(lst);
+              return bail(true);
+            }
+            if (v == nullptr || PyList_Append(lst, v) != 0) {
+              Py_XDECREF(v);
+              Py_DECREF(lst);
+              return bail(false);
+            }
+            Py_DECREF(v);
+          }
+          if (u >= nops) {
+            Py_DECREF(lst);
+            PyErr_SetString(PyExc_SystemError, "corrupt claims tape");
+            return bail(false);
+          }
+          if (!set_reg(lst)) return bail(false);
+          t = u;  // at OP_ARR_END; loop increment skips it
+          break;
+        }
+        ++depth;
+        break;
+      }
+      case OP_OBJ_END:
+      case OP_ARR_END:
+        --depth;
+        break;
+      case OP_KEY: {
+        if (depth != 1) break;
+        uint32_t len = b >> 1, esc = b & 1;
+        if (esc) return bail(true);  // could spell a registered name
+        reg = -1;
+        for (int r = 0; r < kNumRegistered; ++r) {
+          if (kRegistered[r].len == len &&
+              std::memcmp(payload + a, kRegistered[r].name, len) == 0) {
+            reg = r;
+            break;
+          }
+        }
+        break;
+      }
+      default: {
+        if (reg >= 0 && depth == 1) {
+          bool is_scalar;
+          PyObject* v = scalar_of(op, a, b, payload, &is_scalar);
+          if (v == nullptr) {
+            if (!is_scalar)  // unknown future op: fail LOUDLY, like
+                             // build_from_tape's corrupt-tape guard
+              PyErr_SetString(PyExc_SystemError, "corrupt claims tape");
+            return bail(false);
+          }
+          if (!set_reg(v)) return bail(false);
+        }
+        break;
+      }
+    }
+  }
+  return out;
+}
+
+// Same calling convention and status protocol as parse_batch, but list
+// entries are SUBSET dicts (registered claims only). Status 3 also
+// covers the conservative fallbacks documented above.
+PyObject* registered_batch(PyObject*, PyObject* args) {
+  Py_buffer scratch, offv, lenv;
+  int n_threads = 0;
+  if (!PyArg_ParseTuple(args, "y*y*y*|i", &scratch, &offv, &lenv,
+                        &n_threads))
+    return nullptr;
+  const uint8_t* base = static_cast<const uint8_t*>(scratch.buf);
+  const int64_t* offs = static_cast<const int64_t*>(offv.buf);
+  Py_ssize_t n = offv.len / static_cast<Py_ssize_t>(sizeof(int64_t));
+
+  std::vector<TokenTape> tapes(static_cast<size_t>(n));
+  bool ok = run_phase1(&scratch, &offv, &lenv, n_threads,
+                       [&](size_t i, TokenTape&& tape) {
+                         tapes[i] = std::move(tape);
+                       });
+  if (!ok) {
+    PyBuffer_Release(&scratch);
+    PyBuffer_Release(&offv);
+    PyBuffer_Release(&lenv);
+    return nullptr;
+  }
+  Py_ssize_t n_bad = 0;
+  PyObject* out = PyList_New(n);
+  bool err = out == nullptr;
+  for (Py_ssize_t i = 0; i < n && !err; ++i) {
+    PyObject* item = nullptr;
+    int32_t status = tapes[i].status;
+    if (status == ST_OK) {
+      bool fb = false;
+      item = build_registered(tapes[static_cast<size_t>(i)],
+                              base + offs[i], &fb);
+      if (item == nullptr) {
+        if (!fb) {
+          err = true;
+        } else {
+          status = ST_FALLBACK;
+        }
+      }
+    }
+    if (!err && item == nullptr) {
+      item = PyLong_FromLong(status);
+      ++n_bad;
+      if (item == nullptr) err = true;
+    }
+    if (!err) PyList_SET_ITEM(out, i, item);
+  }
+  PyBuffer_Release(&scratch);
+  PyBuffer_Release(&offv);
+  PyBuffer_Release(&lenv);
+  if (err) {
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  PyObject* nb = PyLong_FromSsize_t(n_bad);
+  if (nb == nullptr) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  PyObject* ret = PyTuple_Pack(2, out, nb);
+  Py_DECREF(out);
+  Py_DECREF(nb);
+  return ret;
+}
+
 PyMethodDef methods[] = {
     {"parse_batch", parse_batch, METH_VARARGS,
      "parse_batch(scratch, offsets_i64, lengths_i64, n_threads=0) -> "
      "(list[dict | int-status], n_bad)"},
+    {"registered_batch", registered_batch, METH_VARARGS,
+     "registered_batch(scratch, offsets_i64, lengths_i64, n_threads=0)"
+     " -> (list[subset-dict | int-status], n_bad); registered id_token"
+     " claims only (iss sub aud exp nbf iat nonce azp auth_time)"},
     {"validate_batch", validate_batch, METH_VARARGS,
      "validate_batch(scratch, offsets_i64, lengths_i64, n_threads=0) "
      "-> bytes (per-token status: 0 ok-object, 1 malformed, 2 "

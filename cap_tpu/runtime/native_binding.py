@@ -233,6 +233,44 @@ def prepare_batch(tokens: Sequence[str],
     return results
 
 
+def _loads_claims(raw: bytes):
+    """ONE json.loads-payload-to-claims helper: dict or the
+    MalformedTokenError whose class/wording every parse path shares
+    (prefetch fallbacks, the raw OIDC mode, _parse_one)."""
+    try:
+        c = json.loads(raw)
+        return c if isinstance(c, dict) else \
+            MalformedTokenError("payload is not a JSON object")
+    except (ValueError, UnicodeDecodeError) as e:
+        return MalformedTokenError(f"payload is not valid JSON: {e}")
+
+
+def registered_claims_from_payloads(payloads: Sequence[bytes]):
+    """[payload bytes] → per-payload claims for VALIDATION only.
+
+    Each entry is a dict (the native extension's registered-claims
+    SUBSET — iss/sub/aud/exp/nbf/iat/nonce/azp/auth_time — or the
+    json.loads full dict on its conservative fallbacks) or a
+    MalformedTokenError. The OIDC raw mode reads only registered
+    claims, so the subset is indistinguishable from the full parse
+    there while skipping the full dict build per token.
+    """
+    full = _loads_claims
+    if _claims_ext is None or not hasattr(_claims_ext,
+                                          "registered_batch"):
+        return [full(p) for p in payloads]
+    scratch = b"".join(payloads)
+    lens = np.asarray([len(p) for p in payloads], np.int64)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64) \
+        if len(payloads) else np.zeros(0, np.int64)
+    parsed, n_bad = _claims_ext.registered_batch(
+        scratch, np.ascontiguousarray(offs), np.ascontiguousarray(lens))
+    if n_bad == 0:
+        return parsed
+    return [v if type(v) is dict else full(payloads[i])
+            for i, v in enumerate(parsed)]
+
+
 def _copy_claims(v):
     """Independent copy of a parsed-JSON value (containers only)."""
     if isinstance(v, dict):
@@ -481,13 +519,7 @@ class PreparedBatch:
 
     def _parse_one(self, off: int, ln: int) -> Any:
         """json.loads one payload → dict or MalformedTokenError."""
-        raw = self.scratch[off: off + ln].tobytes()
-        try:
-            c = json.loads(raw)
-            return c if isinstance(c, dict) else \
-                MalformedTokenError("payload is not a JSON object")
-        except (ValueError, UnicodeDecodeError) as e:
-            return MalformedTokenError(f"payload is not valid JSON: {e}")
+        return _loads_claims(self.scratch[off: off + ln].tobytes())
 
     def signature(self, i: int) -> bytes:
         o, l = int(self.sig_off[i]), int(self.sig_len[i])

@@ -1,0 +1,111 @@
+"""verify_id_token_batch(raw=True) differential parity.
+
+The raw mode validates registered claims off the native tape subset
+and returns payload BYTES for accepted tokens; its VERDICTS (and error
+classes) must be identical to the dict path for every vector —
+including the subset extractor's conservative fallbacks (escaped keys,
+container-valued registered claims). Reference semantics:
+/root/reference/oidc/provider.go:418-511.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cap_tpu import testing as captest
+from cap_tpu.errors import InvalidParameterError
+from cap_tpu.jwt.jwk import JWK
+from cap_tpu.oidc import Config, Provider, Request
+from cap_tpu.oidc.testing import TestProvider
+
+
+@pytest.fixture(scope="module")
+def rig():
+    idp = TestProvider().start()
+    try:
+        cfg = Config(issuer=idp.issuer(), client_id=idp.client_id,
+                     client_secret=idp.client_secret,
+                     supported_signing_algs=["ES256"],
+                     allowed_redirect_urls=["http://127.0.0.1:1/cb"],
+                     provider_ca=idp.ca_cert())
+        priv, pub, alg, kid = idp.signing_keys()
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet([JWK(pub, kid=kid)])
+        p = Provider(cfg, keyset=ks)
+        req = Request(3600.0, "http://127.0.0.1:1/cb")
+        yield idp, p, req, priv, alg, kid
+    finally:
+        idp.stop()
+
+
+def _vectors(idp, req, priv, alg, kid):
+    def claims(**over):
+        c = captest.default_claims(issuer=idp.issuer(), ttl=3600.0,
+                                   aud=[idp.client_id])
+        c["nonce"] = req.nonce()
+        c.update(over)
+        return c
+
+    sign = lambda c: captest.sign_jwt(priv, alg, c, kid=kid)  # noqa: E731
+    good = sign(claims())
+    return [
+        ("good", good),
+        ("expired", sign(claims(exp=1000))),
+        ("future-nbf", sign(claims(nbf=2 ** 33))),
+        ("wrong-nonce", sign(claims(nonce="nope"))),
+        ("wrong-aud", sign(claims(aud=["other"]))),
+        ("aud-string", sign(claims(aud=idp.client_id))),
+        ("multi-aud-azp", sign(claims(aud=[idp.client_id, "x"],
+                                      azp=idp.client_id))),
+        ("multi-aud-bad-azp", sign(claims(aud=[idp.client_id, "x"],
+                                          azp="intruder"))),
+        ("aud-object-fallback", sign(claims(aud={"weird": 1}))),
+        ("escaped-key-fallback",
+         sign(json.loads(json.dumps(claims()).replace(
+             '"iss"', '"i\\u0073s"')))),
+        ("wrong-issuer", sign(claims(iss="https://evil.example/"))),
+        ("tampered", good[:-6] + ("AAAAAA" if not good.endswith("AAAAAA")
+                                  else "BBBBBB")),
+        ("not-a-jwt", "garbage"),
+    ]
+
+
+def test_raw_mode_verdict_parity(rig):
+    idp, p, req, priv, alg, kid = rig
+    names, toks = zip(*_vectors(idp, req, priv, alg, kid))
+    dict_out = p.verify_id_token_batch(list(toks), req)
+    raw_out = p.verify_id_token_batch(list(toks), req, raw=True)
+    assert len(dict_out) == len(raw_out) == len(toks)
+    for name, d, r in zip(names, dict_out, raw_out):
+        assert isinstance(d, Exception) == isinstance(r, Exception), \
+            f"{name}: dict={d!r} raw={r!r}"
+        if isinstance(d, Exception):
+            assert type(d) is type(r), f"{name}: {type(d)} vs {type(r)}"
+        else:
+            # raw mode returns the signed payload bytes — the exact
+            # JSON the dict path parsed
+            assert json.loads(r) == d, name
+
+
+def test_raw_accepted_bytes_are_payload(rig):
+    idp, p, req, priv, alg, kid = rig
+    c = captest.default_claims(issuer=idp.issuer(), ttl=3600.0,
+                               aud=[idp.client_id])
+    c["nonce"] = req.nonce()
+    tok = captest.sign_jwt(priv, alg, c, kid=kid)
+    out = p.verify_id_token_batch([tok], req, raw=True)
+    assert isinstance(out[0], bytes)
+    assert out[0] == json.dumps(c, separators=(",", ":")).encode()
+
+
+def test_raw_mode_requires_raw_keyset(rig):
+    idp, p, req, priv, alg, kid = rig
+    from cap_tpu.jwt.keyset import StaticKeySet
+
+    _, pub, _, _ = idp.signing_keys()
+    p2 = Provider(p.config, keyset=StaticKeySet([pub]))
+    with pytest.raises(InvalidParameterError, match="raw"):
+        p2.verify_id_token_batch(["x.y.z"], req, raw=True)

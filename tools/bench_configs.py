@@ -179,6 +179,15 @@ def config5():
             assert bad == 0, bad
 
         emit("cfg5_oidc_verify_id_token_e2e", rate(run, n), n)
+
+        def run_raw():
+            # the serve-style mode: registered-claims validation off
+            # the native tape, accepted tokens return payload bytes
+            out = p.verify_id_token_batch(toks, req, raw=True)
+            bad = sum(1 for r in out if isinstance(r, Exception))
+            assert bad == 0, bad
+
+        emit("cfg5_oidc_verify_id_token_e2e_raw", rate(run_raw, n), n)
     finally:
         idp.stop()
 
