@@ -21,6 +21,16 @@ Prints exactly ONE JSON line on stdout.
 
 Environment knobs: CAP_BENCH_BATCH (default 65536), CAP_BENCH_WINDOW
 (default 8 measured batches), CAP_BENCH_UNIQUE (default = batch).
+
+CAP_BENCH_MESH=N (VERDICT r5 #7) additionally runs the resident mix
+under ``shard_map`` on an N-device mesh and records
+``resident_mesh_vps`` plus the ACTUAL per-device shard sizes of every
+placed record in the JSON. Without real multi-chip hardware this
+forces an N-virtual-device CPU backend (absolute rates are then
+meaningless — pair it with a small CAP_BENCH_BATCH; the value is the
+structure: the sharded programs compile, run, and split n/N with no
+stray replication); a real slice sets CAP_MESH_REAL=1 to keep its
+native backend and the same command captures the scaling number.
 """
 
 import json
@@ -97,7 +107,56 @@ def _probe_wire_mbps() -> float:
     return best
 
 
+def _setup_mesh_backend() -> int:
+    """CAP_BENCH_MESH=N: force the N-virtual-device CPU backend (must
+    run before first backend use) unless CAP_MESH_REAL=1 says the
+    process already owns a real N-device slice. Returns N (0 = off).
+    """
+    mesh_n = int(os.environ.get("CAP_BENCH_MESH", "0") or 0)
+    if not mesh_n:
+        return 0
+    if mesh_n < 1 or mesh_n & (mesh_n - 1):
+        raise SystemExit("CAP_BENCH_MESH must be a power of two")
+    if os.environ.get("CAP_MESH_REAL") != "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", mesh_n)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={mesh_n}")
+    return mesh_n
+
+
+def _resident_mesh_fields(jwks, tokens, mesh_n: int) -> dict:
+    """Slope-time the packed mix on an N-device mesh; report the rate
+    and the actual per-device shard rows of every placed record."""
+    from cap_tpu.jwt.tpu_keyset import (
+        TPUBatchKeySet,
+        resident_dispatchers,
+        resident_slope_vps,
+    )
+    from cap_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(mesh_n)
+    ks = TPUBatchKeySet(jwks, mesh=mesh)
+    records = []
+    n_tok, fns = resident_dispatchers(ks, tokens, records_out=records)
+    vps, trials = resident_slope_vps(n_tok, fns, details=True)
+    shards = [sorted(s.data.shape[0] for s in rec.addressable_shards)
+              for rec in records]
+    return {
+        "resident_mesh_vps": round(vps, 1) if vps else None,
+        "resident_mesh_trials_vps": [round(v, 1) for v in trials],
+        "mesh_devices": mesh_n,
+        "mesh_record_shard_rows": shards,
+    }
+
+
 def main() -> None:
+    mesh_n = _setup_mesh_backend()
     _ensure_native()
     from cap_tpu import compile_cache, telemetry
 
@@ -172,6 +231,16 @@ def main() -> None:
         print(f"resident_mixed_vps failed: {e!r}", file=sys.stderr)
         resident, resident_trials = None, []
 
+    mesh_fields = {}
+    if mesh_n:
+        try:
+            mesh_fields = _resident_mesh_fields(jwks, tokens, mesh_n)
+        except Exception as e:  # noqa: BLE001 - mesh metric is advisory
+            print(f"resident_mesh_vps failed: {e!r}", file=sys.stderr)
+            mesh_fields = {"resident_mesh_vps": None,
+                           "mesh_devices": mesh_n,
+                           "mesh_error": repr(e)}
+
     print(f"sign={sign_s:.1f}s window={window} "
           f"rates={[round(r) for r in rates]} "
           f"interval_s p50={slats[len(slats) // 2]:.3f} p99={p99:.3f} "
@@ -212,6 +281,9 @@ def main() -> None:
         # resident_trials_vps (slower trials ate a tunnel stall).
         "resident_mixed_vps": round(resident, 1) if resident else None,
         "resident_trials_vps": [round(v, 1) for v in resident_trials],
+        # CAP_BENCH_MESH=N only: the same resident mix under shard_map
+        # (resident_mesh_vps, per-record sorted per-device shard rows).
+        **mesh_fields,
     }))
 
 

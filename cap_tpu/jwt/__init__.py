@@ -16,14 +16,20 @@ from .algs import (
     supported_signing_algorithm,
 )
 from .jose import ParsedJWS, json_to_compact, parse_compact, parse_json, parse_jws
-from .pem import parse_public_key_pem
-from .keyset import (
-    KeySet,
-    StaticKeySet,
-    JSONWebKeySet,
-    new_oidc_discovery_keyset,
-)
 from .validator import DEFAULT_LEEWAY_SECONDS, Expected, Validator
+
+# The PEM/JWKS/verify surface needs the ``cryptography`` package; it is
+# re-exported lazily (same pattern as the TPU keyset below) so the
+# pure-parsing core stays importable on hosts without the OpenSSL
+# stack — the missing dependency then surfaces at first USE with its
+# real ImportError instead of poisoning every `import cap_tpu.jwt`.
+_CRYPTO_EXPORTS = {
+    "parse_public_key_pem": "pem",
+    "KeySet": "keyset",
+    "StaticKeySet": "keyset",
+    "JSONWebKeySet": "keyset",
+    "new_oidc_discovery_keyset": "keyset",
+}
 
 __all__ = [
     "Alg", "RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
@@ -49,4 +55,10 @@ def __getattr__(name):
                 f"(unavailable in this checkout: {e})"
             ) from e
         return getattr(tpu_keyset, name)
+    if name in _CRYPTO_EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f".{_CRYPTO_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(name)

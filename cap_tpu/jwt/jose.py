@@ -257,18 +257,28 @@ def json_normalize(token: str):
 
     ``compact`` preserves the signing input byte-for-byte (protected +
     "." + payload as they appear in the document), so signatures verify
-    identically. Dropping the unprotected header usually only WIDENS
-    key selection (a kid hint disappears) — but when ``alg`` itself
-    lives only in the unprotected header, the compact form would parse
-    as alg-less and flip an accept into a reject. ``compact`` is None
-    for such tokens; callers must verify via the returned ParsedJWS
-    (whose merged header is authoritative) instead.
+    identically. ``compact`` is None — callers must verify via the
+    returned ParsedJWS, whose merged header is authoritative — when
+    the compact re-serialization would change the VERDICT, not just
+    the bytes:
+
+    - ``alg`` lives only in the unprotected header: the compact form
+      would parse as alg-less and flip an accept into a reject;
+    - ``kid`` lives only in the unprotected header: compacting drops
+      it, so key selection would widen from "the kid-named key" to
+      "every key of the alg's type" — a token whose unprotected kid
+      names a different trusted key would then accept on the batch
+      path while ``verify_signature`` (merged-header kid routing)
+      rejects it.
     """
     parsed = parse_json(token)
     doc = json.loads(token)
     sig_obj = doc if doc.get("signatures") is None else doc["signatures"][0]
     protected = json.loads(b64url_decode(sig_obj["protected"]))
     if not isinstance(protected.get("alg"), str) or not protected["alg"]:
+        return None, parsed
+    unprotected = sig_obj.get("header")
+    if isinstance(unprotected, dict) and "kid" in unprotected:
         return None, parsed
     return ".".join((sig_obj["protected"], doc["payload"],
                      sig_obj["signature"])), parsed
@@ -277,15 +287,17 @@ def json_normalize(token: str):
 def json_to_compact(token: str) -> str:
     """Re-serialize a JSON-form JWS as the equivalent compact token.
 
-    Raises for tokens only representable in JSON form (alg present
-    solely in the unprotected header) — batch machinery uses
-    :func:`normalize_batch`, which falls back to object-path
-    verification for those instead.
+    Raises for tokens whose compact form would verify differently
+    (alg or kid present solely in the unprotected header) — batch
+    machinery uses :func:`normalize_batch`, which falls back to
+    object-path verification for those instead.
     """
     compact, _ = json_normalize(token)
     if compact is None:
         raise MalformedTokenError(
-            "JSON JWS has no protected alg; not representable compactly")
+            "JSON JWS is not representable compactly without changing "
+            "its verification semantics (alg or kid only in the "
+            "unprotected header)")
     return compact
 
 

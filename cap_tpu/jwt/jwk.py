@@ -56,8 +56,11 @@ def _x5c_public_key(data: Dict[str, Any]):
     """Public key from the first x5c certificate, or None when absent.
 
     Per RFC 7517 §4.7 each entry is STANDARD base64 (not base64url) of
-    a DER certificate; the first entry is the key's own certificate. A
-    present-but-invalid chain is an error, as in go-jose.
+    a DER certificate; the first entry is the key's own certificate.
+    EVERY entry must decode and parse as a certificate — go-jose DER-
+    parses the whole chain up front, so a garbage intermediate/root
+    entry rejects the key even though only the leaf's SPKI is used; a
+    present-but-invalid chain is an error, never silently truncated.
     """
     x5c = data.get("x5c")
     if x5c is None:
@@ -65,12 +68,15 @@ def _x5c_public_key(data: Dict[str, Any]):
     if not isinstance(x5c, list) or not x5c or not all(
             isinstance(c, str) for c in x5c):
         raise InvalidJWKSError("jwk x5c must be a non-empty string array")
-    try:
-        der = base64.b64decode(x5c[0], validate=True)
-        cert = x509.load_der_x509_certificate(der)
-    except (binascii.Error, ValueError) as err:
-        raise InvalidJWKSError(f"invalid x5c certificate: {err}") from err
-    key = cert.public_key()
+    certs = []
+    for i, entry in enumerate(x5c):
+        try:
+            der = base64.b64decode(entry, validate=True)
+            certs.append(x509.load_der_x509_certificate(der))
+        except (binascii.Error, ValueError) as err:
+            raise InvalidJWKSError(
+                f"invalid x5c certificate at index {i}: {err}") from err
+    key = certs[0].public_key()
     if not isinstance(key, (rsa.RSAPublicKey, ec.EllipticCurvePublicKey,
                             ed25519.Ed25519PublicKey)):
         raise InvalidJWKSError(

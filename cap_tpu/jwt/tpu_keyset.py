@@ -130,7 +130,7 @@ def _pack_es_record(pb, table, chunk: np.ndarray, crows: np.ndarray,
 
 
 def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
-                         repeat: int = 1):
+                         repeat: int = 1, records_out: Optional[list] = None):
     """Device-RESIDENT dispatch closures for the engine benchmark.
 
     Preps + packs ``tokens`` ONCE, places every packed family record on
@@ -155,6 +155,11 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
     host/tunnel overhead exactly (resident_slope_vps scaled mode).
     The advertised token count stays the base n; accept sums are
     checked against repeat·n.
+
+    ``records_out``: optional list the placed device records are
+    appended to — bench.py's mesh mode reads their
+    ``addressable_shards`` to publish the ACTUAL per-device shard
+    sizes rather than the intended n/N split.
     """
     import jax.numpy as jnp
 
@@ -182,8 +187,12 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             # the timed path free of cross-device copies.
             from ..parallel.place import shard_batch
 
-            return shard_batch(ks._mesh, rec)
-        return jax.device_put(rec)
+            rec = shard_batch(ks._mesh, rec)
+        else:
+            rec = jax.device_put(rec)
+        if records_out is not None:
+            records_out.append(rec)
+        return rec
 
     for alg_name, hash_name in list(_RS.items()) + list(_PS.items()):
         kind = "rs" if alg_name in _RS else "ps"
@@ -254,7 +263,8 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             # contribute nothing). The OR also keeps the deg output
             # live so XLA cannot dead-code any of the ladder.
             ok_dev, deg_dev = tpuec.verify_es_packed_pending(
-                table, rec, hash_len, mesh=ks._mesh)
+                table, rec, hash_len, mesh=ks._mesh,
+                ladder=ks._ec_ladder)
             return jnp.sum((ok_dev | deg_dev).astype(jnp.int32))
 
         fns.append((len(idx), fn))
@@ -386,14 +396,25 @@ class TPUBatchKeySet(KeySet):
     devices with replicated key tables (SURVEY.md §2.6 batch-DP +
     key-gather; validated on the virtual 8-device mesh by
     tests/test_parallel.py and the driver's dryrun_multichip).
+
+    ``ec_ladder``: the ES* window-add law — ``"jacobian"``,
+    ``"affine"``, or None for the global default
+    (``cap_tpu.tpu.ec.ladder_mode``, env CAP_TPU_EC_LADDER). Verdicts
+    are bit-exact either way; see docs/PERF.md for the A/B.
     """
 
     def __init__(self, jwks: Sequence[JWK], max_chunk: int = 32768,
-                 cpu_fallback: bool = True, mesh=None):
+                 cpu_fallback: bool = True, mesh=None,
+                 ec_ladder: Optional[str] = None):
         from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
 
         if not jwks:
             raise NilParameterError("at least one key is required")
+        if ec_ladder is not None:
+            from ..tpu.ec import resolve_ladder
+
+            resolve_ladder(ec_ladder)     # raises on unknown modes
+        self._ec_ladder = ec_ladder
         self._jwks = list(jwks)
         self._max_chunk = max_chunk
         self._cpu_fallback = cpu_fallback
@@ -957,7 +978,8 @@ class TPUBatchKeySet(KeySet):
                 telemetry.count("h2d.bytes", rec.nbytes)
                 stats["h2d"] += rec.nbytes
                 ok_dev, deg_dev = tpuec.verify_es_packed_pending(
-                    table, rec, hash_len, mesh=self._mesh)
+                    table, rec, hash_len, mesh=self._mesh,
+                    ladder=self._ec_ladder)
             packed_parts.append(ok_dev)
             packed_parts.append(deg_dev)
 
@@ -1070,7 +1092,8 @@ class TPUBatchKeySet(KeySet):
             stats["h2d"] += h2d
             with telemetry.span(f"dispatch.es.{crv}"):
                 fin = tpuec.verify_ecdsa_arrays_pending(
-                    table, sig_mat, sig_lens, hash_mat, hash_len, key_idx)
+                    table, sig_mat, sig_lens, hash_mat, hash_len,
+                    key_idx, ladder=self._ec_ladder)
             pending.append((chunk, m, fin))
 
     def _run_ed_packed(self, idx: np.ndarray, pb,

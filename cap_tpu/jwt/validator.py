@@ -34,7 +34,10 @@ from ..errors import (
 from . import algs
 from ..errors import CapError
 from .jose import peek_alg
-from .keyset import KeySet
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only: keyset pulls in the crypto stack
+    from .keyset import KeySet
 
 # Leeway used by default for "nbf" and "exp" (reference: jwt/jwt.go:16).
 DEFAULT_LEEWAY_SECONDS = 150

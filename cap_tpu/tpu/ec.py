@@ -30,6 +30,7 @@ bucket.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -40,6 +41,30 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import limbs as L
+
+
+def ladder_mode() -> str:
+    """Window-add law for the ES* ladders: ``jacobian`` (default) or
+    ``affine``.
+
+    ``affine`` replaces the 11-mul mixed Jacobian/affine window madd
+    with a 2M+1S affine add whose per-lane division is amortized by ONE
+    batched product-tree inversion mod p across all lanes per window
+    step (the round-5 verdict's A/B ask). Selectable per keyset
+    (``TPUBatchKeySet(ec_ladder=...)``) or globally via
+    ``CAP_TPU_EC_LADDER=affine``; docs/PERF.md records the measured
+    A/B and why the default stays Jacobian.
+    """
+    v = os.environ.get("CAP_TPU_EC_LADDER", "").strip().lower()
+    return "affine" if v == "affine" else "jacobian"
+
+
+def resolve_ladder(ladder: Optional[str]) -> str:
+    if ladder is None:
+        return ladder_mode()
+    if ladder not in ("jacobian", "affine"):
+        raise ValueError(f"unknown EC ladder mode {ladder!r}")
+    return ladder
 
 # NIST curve domain parameters (FIPS 186-4 / SEC 2).
 _CURVE_INTS = {
@@ -97,6 +122,11 @@ class CurveParams:
         self.nr2_limbs = L.int_to_limbs(nr2, k)
         self.none_limbs = L.int_to_limbs(none_, k)
         self.nm2_limbs = L.int_to_limbs(self.n - 2, k)   # Fermat exponent
+        # Field-side Fermat exponent p−2: the affine ladder's batched
+        # inversion tree inverts its root mod p (the Jacobian ladder
+        # never inverts in the field).
+        self.pbits: int = self.p.bit_length()
+        self.pm2_limbs = L.int_to_limbs(self.p - 2, k)
         # G in field-Montgomery form.
         r_mod_p = pone
         self.gx_m = L.int_to_limbs(self.gx * r_mod_p % self.p, k)
@@ -115,7 +145,7 @@ class CurveParams:
                     self.p_limbs, self.pprime_limbs, self.pr2_limbs,
                     self.pone_limbs, self.n_limbs, self.nprime_limbs,
                     self.nr2_limbs, self.none_limbs, self.nm2_limbs,
-                    self.gx_m, self.gy_m))
+                    self.gx_m, self.gy_m, self.pm2_limbs))
         return self._dev_consts
 
     # -- host affine arithmetic (table precompute only) -------------------
@@ -380,10 +410,58 @@ def _jac_madd(X1, Y1, Z1, x2, y2, p, pp, one_m):
     return X3, Y3, Z3, degenerate
 
 
-@partial(jax.jit, static_argnames=("nbits", "n_windows"))
+def _affine_madd(x, y, inf, ax, ay, has, p, pp, one_m,
+                 p1, pp1, pr2_1, pone1, pm2_1, pbits: int):
+    """Batched affine + affine addition, 2M + 1S + one batched inverse.
+
+    x, y: [K, M] affine accumulator (field-Montgomery form, canonical);
+    inf: [M] explicit infinity lane; ax, ay: gathered table points
+    (never at infinity); has: [M] lanes that add this step (digit > 0).
+    The per-lane division λ = (ay−y)/(ax−x) is ONE product-tree
+    inversion mod p across all M lanes (``bignum.batch_mont_inverse``
+    with the field constants p1..pm2_1 [K, 1]), so the per-lane
+    multiply count is 3 + ~3 tree multiplies instead of the Jacobian
+    madd's 11. The exceptional cases the complete Jacobian law absorbs
+    are explicit here:
+
+    - infinity accumulator → masked select of the addend (lift);
+    - doubling (P == Q) and inverse (P == −Q), both x(P) == x(ax) →
+      flagged ``degenerate`` (the caller re-verifies on the CPU
+      oracle, the same contract as ``_jac_madd``), with the zero
+      denominator replaced by 1 so the inversion tree stays
+      invertible.
+
+    Returns (x3, y3, inf3, degenerate).
+    """
+    from . import bignum as B
+
+    dx = B.sub_mod(ax, x, p)
+    eqx = B.is_zero(dx)
+    live = has & ~inf
+    degenerate = live & eqx
+    den = jnp.where((live & ~eqx)[None, :], dx, one_m)
+    inv = B.batch_mont_inverse(den, p1, pp1, pr2_1, pone1, pm2_1,
+                               nbits=pbits)
+    dy = B.sub_mod(ay, y, p)
+    lam = B.mont_mul(dy, inv, p, pp)
+    sq = B.mont_mul(lam, lam, p, pp)
+    x3 = B.sub_mod(B.sub_mod(sq, x, p), ax, p)
+    y3 = B.sub_mod(B.mont_mul(lam, B.sub_mod(x, x3, p), p, pp), y, p)
+
+    lift = (inf & has)[None, :]
+    x3 = jnp.where(lift, ax, x3)
+    y3 = jnp.where(lift, ay, y3)
+    sel = has[None, :]
+    return (jnp.where(sel, x3, x), jnp.where(sel, y3, y),
+            inf & ~has, degenerate)
+
+
+@partial(jax.jit, static_argnames=("nbits", "n_windows", "pbits",
+                                   "ladder"))
 def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
-                p, pp, pr2, pone, n, npp, nr2, none_, nm2, gx, gy,
-                nbits: int, n_windows: int):
+                p, pp, pr2, pone, n, npp, nr2, none_, nm2, gx, gy, pm2,
+                nbits: int, n_windows: int, pbits: int = 0,
+                ladder: str = "jacobian"):
     """Batched ECDSA verify core.
 
     r, s, e: [K, N] plain limb values (signature halves, hash int);
@@ -392,6 +470,14 @@ def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     tqx/tqy: [nk·n_windows·15, K]; tgx/tgy: [n_windows·15, K] for G.
     Remaining args: [K, 1] curve constants (broadcast on-device here —
     transferred once per curve, not per batch).
+
+    ``ladder`` selects the window-add law: ``jacobian`` (the complete
+    mixed madd, interleaved G/Q chains in one accumulator) or
+    ``affine`` (two lane-concatenated affine chains, one batched
+    product-tree inversion mod p per window step — see
+    :func:`ladder_mode`). Verdicts are bit-exact across both (the
+    affine parity suite pins it).
+
     Returns (ok [N], degenerate [N]).
     """
     from . import bignum as B
@@ -399,6 +485,7 @@ def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     k = r.shape[0]
     shape = r.shape
     n1, npp1, nr21, none1, nm21 = n, npp, nr2, none_, nm2
+    p1, pp1, pr2_1, pone1, pm2_1 = p, pp, pr2, pone, pm2
     (p, pp, pr2, pone, n, npp, nr2) = (
         jnp.broadcast_to(a, shape)
         for a in (p, pp, pr2, pone, n, npp, nr2))
@@ -432,6 +519,13 @@ def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     dig1 = nibbles(u1)
     dig2 = nibbles(u2)
     key_base = key_idx.astype(jnp.int32) * (n_windows * 15)
+
+    if ladder == "affine":
+        return _ecdsa_affine_tail(
+            r, r_ok, s_ok, dig1, dig2, key_base, tqx, tqy, tgx, tgy,
+            p, pp, pr2, pone, n,
+            p1, pp1, pr2_1, pone1, pm2_1,
+            k=k, n_windows=n_windows, pbits=pbits)
 
     zeros = jnp.zeros_like(r)
     X0, Y0, Z0 = pone, pone, zeros          # point at infinity (Z = 0)
@@ -479,6 +573,91 @@ def _ecdsa_core(r, s, e, key_idx, tqx, tqy, tgx, tgy,
     return ok, deg & r_ok & s_ok
 
 
+def _ecdsa_affine_tail(r, r_ok, s_ok, dig1, dig2, key_base,
+                       tqx, tqy, tgx, tgy,
+                       p, pp, pr2, pone, n,
+                       p1, pp1, pr2_1, pone1, pm2_1,
+                       k: int, n_windows: int, pbits: int):
+    """Affine-ladder tail of the limb-engine verify core.
+
+    The G-digit and Q-digit chains run as TWO lane-concatenated affine
+    accumulators ([K, 2N] state), so each window step is ONE affine add
+    whose divisions amortize into a single batched product-tree
+    inversion over all 2N lanes; the chains merge with one more affine
+    add (one inversion over N lanes) and the final check is a direct
+    field compare x == r·R mod p — no Z coordinate anywhere.
+
+    Separate chains also shrink the degenerate surface: a single
+    prefix-sum chain of one scalar u < n can never hit its own window
+    multiple (every partial sum and addend are distinct multiples
+    d·P with 0 < d < n of a prime-order point), so in-ladder ``deg``
+    flags are adversarially unreachable and only the MERGE can
+    degenerate (u1·G == ±u2·Q) — still flagged and CPU-re-verified,
+    same contract as the Jacobian path.
+    """
+    from . import bignum as B
+
+    n_tok = r.shape[1]
+    shape2 = (k, 2 * n_tok)
+    p2, pp2, pone2 = (jnp.broadcast_to(a, shape2)
+                      for a in (p1, pp1, pone1))
+
+    tab_x = jnp.concatenate([tgx, tqx], axis=0)
+    tab_y = jnp.concatenate([tgy, tqy], axis=0)
+    g_rows = tgx.shape[0]
+
+    x0 = jnp.broadcast_to(pone1, shape2)
+    inf0 = jnp.ones(2 * n_tok, dtype=bool)
+    deg0 = jnp.zeros(2 * n_tok, dtype=bool)
+
+    def ladder_body(i, carry):
+        x, y, inf, deg = carry
+        d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
+        d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
+        d = jnp.concatenate([d1, d2])
+        row0 = jnp.concatenate(
+            [jnp.zeros((n_tok,), jnp.int32) + i * 15,
+             g_rows + key_base + i * 15])
+        has = d > 0
+        idx = row0 + jnp.where(has, d - 1, 0)
+        ax = jnp.take(tab_x, idx, axis=0).T
+        ay = jnp.take(tab_y, idx, axis=0).T
+        x, y, inf, dd = _affine_madd(
+            x, y, inf, ax, ay, has, p2, pp2, pone2,
+            p1, pp1, pr2_1, pone1, pm2_1, pbits)
+        return x, y, inf, deg | dd
+
+    x, y, inf, deg2 = lax.fori_loop(0, n_windows, ladder_body,
+                                    (x0, x0, inf0, deg0))
+
+    xg, yg = x[:, :n_tok], y[:, :n_tok]
+    xq, yq = x[:, n_tok:], y[:, n_tok:]
+    inf_g, inf_q = inf[:n_tok], inf[n_tok:]
+    deg = deg2[:n_tok] | deg2[n_tok:]
+
+    # Merge: one more affine add with (xq, yq) as the addend; lanes
+    # whose addend is at infinity pass the G accumulator through.
+    xm, ym, inf_m, ddm = _affine_madd(
+        xg, yg, inf_g, xq, yq, ~inf_q, p, pp, pone,
+        p1, pp1, pr2_1, pone1, pm2_1, pbits)
+    deg = deg | ddm
+    not_inf = ~inf_m
+
+    # Affine final check: x == r·R or (r+n)·R (mod p), both canonical.
+    r_pm = B.mont_mul(r, pr2, p, pp)
+    ok1 = jnp.all(xm == r_pm, axis=0)
+
+    zero_row = jnp.zeros_like(r[:1])
+    rpn = B.carry_normalize(jnp.concatenate([r + n, zero_row], axis=0))
+    p_pad = jnp.concatenate([p, zero_row], axis=0)
+    rpn_lt_p = ~B.compare_ge(rpn, p_pad)
+    rpn_pm = B.mont_mul(rpn[:k], pr2, p, pp)
+    ok2 = jnp.all(xm == rpn_pm, axis=0) & rpn_lt_p
+
+    ok = r_ok & s_ok & not_inf & (ok1 | ok2)
+    return ok, deg & r_ok & s_ok
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _ec_prep(sig_bytes, dig, k: int):
     """Device: raw signature/digest bytes → (r, s, e) limb arrays.
@@ -500,13 +679,16 @@ def _ec_prep(sig_bytes, dig, k: int):
 def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
                                 sig_lens: np.ndarray,
                                 hash_mat: np.ndarray, hash_len: int,
-                                key_idx: np.ndarray):
+                                key_idx: np.ndarray,
+                                ladder: Optional[str] = None):
     """Dispatch the ES* device work; return a finalize() → [N] bool.
 
     Asynchronous dispatch (see verify_pkcs1v15_arrays_pending);
     degenerate-flagged tokens are re-verified on the CPU oracle inside
-    finalize, preserving bit-exact parity.
+    finalize, preserving bit-exact parity. ``ladder`` selects the
+    window-add law (None → :func:`ladder_mode`).
     """
+    ladder = resolve_ladder(ladder)
     cp = table.curve
     k = cp.k
     cb = cp.coord_bytes
@@ -547,6 +729,7 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
             rtab.tab,
             *consts[4:9],
             crv=cp.name, nbits=cp.nbits, wbits=rtab.ctx.w_bits,
+            ladder=ladder,
         )
     else:
         ok_dev, deg_dev = _ecdsa_core(
@@ -555,6 +738,7 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
             table.tqx, table.tqy, *cp.g_tables(),
             *cp.device_consts(),
             nbits=cp.nbits, n_windows=cp.n_windows,
+            pbits=cp.pbits, ladder=ladder,
         )
 
     def finalize() -> np.ndarray:
@@ -572,7 +756,8 @@ def verify_ecdsa_arrays_pending(table: ECKeyTable, sig_mat: np.ndarray,
 def verify_ecdsa_arrays(table: ECKeyTable, sig_mat: np.ndarray,
                         sig_lens: np.ndarray, hash_mat: np.ndarray,
                         hash_len: int,
-                        key_idx: np.ndarray) -> np.ndarray:
+                        key_idx: np.ndarray,
+                        ladder: Optional[str] = None) -> np.ndarray:
     """Array-native ES* verify: [N] bool verdicts.
 
     sig_mat: [N, W] left-aligned JOSE raw signatures (r ‖ s, fixed
@@ -581,19 +766,29 @@ def verify_ecdsa_arrays(table: ECKeyTable, sig_mat: np.ndarray,
     re-verified on the CPU oracle for bit-exact parity.
     """
     return verify_ecdsa_arrays_pending(table, sig_mat, sig_lens,
-                                       hash_mat, hash_len, key_idx)()
+                                       hash_mat, hash_len, key_idx,
+                                       ladder=ladder)()
 
 
 def _cpu_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,
                     digest: bytes) -> bool:
     """CPU oracle for one (degenerate-flagged) token."""
-    from cryptography.exceptions import InvalidSignature
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec as cec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
-        Prehashed,
-        encode_dss_signature,
-    )
+    if not hasattr(table.keys[row], "verify"):
+        # HostECPublicKey tables (no OpenSSL object behind the row)
+        return _py_verify_one(table, int(row), sig_raw, digest)
+    try:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec as cec
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            Prehashed,
+            encode_dss_signature,
+        )
+    except ImportError:
+        # No OpenSSL stack in this environment: fall back to the exact
+        # host-integer ECDSA oracle below (same verdicts — SEC1 §4.1.4
+        # over the curve's own affine arithmetic).
+        return _py_verify_one(table, int(row), sig_raw, digest)
 
     cb = table.curve.coord_bytes
     r = int.from_bytes(sig_raw[:cb], "big")
@@ -608,9 +803,95 @@ def _cpu_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,
         return False
 
 
+def scalar_mult(cp: CurveParams, k: int,
+                P: Optional[Tuple[int, int]]) -> Optional[Tuple[int, int]]:
+    """Host double-and-add k·P over the curve's affine arithmetic."""
+    acc = None
+    add = P
+    while k:
+        if k & 1:
+            acc = cp.affine_add(acc, add)
+        add = cp.affine_add(add, add)
+        k >>= 1
+    return acc
+
+
+class HostECPublicKey:
+    """Dependency-free EC public key for device-table construction.
+
+    ``ECKeyTable`` only reads ``public_numbers().x/.y``; this provides
+    exactly that surface from host integers, so tables (and the
+    pure-integer oracle above) work where the ``cryptography`` package
+    is unavailable. Not a drop-in for the OpenSSL-backed key anywhere
+    else — the CPU trial-verify paths still require the real stack.
+    """
+
+    class _Numbers:
+        def __init__(self, x: int, y: int):
+            self.x, self.y = x, y
+
+    def __init__(self, crv: str, x: int, y: int):
+        self.curve_name = crv
+        self._nums = self._Numbers(x, y)
+
+    def public_numbers(self):
+        return self._nums
+
+    @classmethod
+    def from_private(cls, crv: str, d: int) -> "HostECPublicKey":
+        cp = curve(crv)
+        qx, qy = scalar_mult(cp, d, (cp.gx, cp.gy))
+        return cls(crv, qx, qy)
+
+
+def host_ecdsa_sign(crv: str, d: int, e: int, k: int) -> Tuple[int, int]:
+    """Textbook ECDSA signing over host ints (test/bench fixtures only
+    — k must be unique per signature; nothing here is constant-time).
+    Returns (r, s); raises if the chosen k yields r == 0 or s == 0.
+    """
+    cp = curve(crv)
+    R = scalar_mult(cp, k, (cp.gx, cp.gy))
+    r = R[0] % cp.n
+    s = pow(k, -1, cp.n) * (e + r * d) % cp.n
+    if r == 0 or s == 0:
+        raise ValueError("degenerate nonce; pick another k")
+    return r, s
+
+
+def _py_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,
+                   digest: bytes) -> bool:
+    """Pure-integer ECDSA verify (SEC1 §4.1.4), dependency-free.
+
+    The oracle of last resort when the ``cryptography`` package is
+    absent: same acceptance rule as Go crypto/ecdsa and OpenSSL —
+    range checks 1 <= r, s < n, left-bits hash truncation, accept iff
+    (u1·G + u2·Q).x ≡ r (mod n).
+    """
+    cp = table.curve
+    cb = cp.coord_bytes
+    r = int.from_bytes(sig_raw[:cb], "big")
+    s = int.from_bytes(sig_raw[cb:], "big")
+    if not (1 <= r < cp.n and 1 <= s < cp.n):
+        return False
+    e = int.from_bytes(digest, "big")
+    excess = 8 * len(digest) - cp.nbits
+    if excess > 0:
+        e >>= excess
+    nums = table.keys[row].public_numbers()
+    w = pow(s, -1, cp.n)
+    u1 = (e * w) % cp.n
+    u2 = (r * w) % cp.n
+    R = cp.affine_add(scalar_mult(cp, u1, (cp.gx, cp.gy)),
+                      scalar_mult(cp, u2, (nums.x, nums.y)))
+    if R is None:
+        return False
+    return R[0] % cp.n == r
+
+
 def verify_ecdsa_batch(table: ECKeyTable, sigs: Sequence[bytes],
                        msg_hashes: Sequence[bytes],
-                       key_idx: np.ndarray) -> np.ndarray:
+                       key_idx: np.ndarray,
+                       ladder: Optional[str] = None) -> np.ndarray:
     """[N] bool verdicts for one ES* bucket (list-of-bytes interface)."""
     cb = table.curve.coord_bytes
     n_tok = len(sigs)
@@ -626,7 +907,7 @@ def verify_ecdsa_batch(table: ECKeyTable, sigs: Sequence[bytes],
     for j, h in enumerate(msg_hashes):
         hash_mat[j] = np.frombuffer(h[:hash_len], np.uint8)
     return verify_ecdsa_arrays(table, sig_mat, sig_lens, hash_mat,
-                               hash_len, key_idx)
+                               hash_len, key_idx, ladder=ladder)
 
 
 # ---------------------------------------------------------------------------
@@ -660,7 +941,7 @@ def es_packed_records(table: ECKeyTable, sig_mat: np.ndarray,
 
 def _es_packed_rns_impl(packed, tab, consts, *, crv: str,
                         nbits: int, wbits: int, k: int, cb: int,
-                        hlen: int):
+                        hlen: int, ladder: str = "jacobian"):
     from . import ec_rns
 
     sig = packed[:, :2 * cb]
@@ -670,19 +951,21 @@ def _es_packed_rns_impl(packed, tab, consts, *, crv: str,
     r, s, e = _ec_prep(sig, dig, k=k)
     ok, deg = ec_rns._ecdsa_rns_core(r, s, e, idx, tab,
                                      *consts, crv=crv, nbits=nbits,
-                                     wbits=wbits)
+                                     wbits=wbits, ladder=ladder)
     return ok & flags, deg & flags
 
 
 def _es_packed_limb_impl(packed, tqx, tqy, g_tabs, consts, *, nbits: int,
-                         n_windows: int, k: int, cb: int, hlen: int):
+                         n_windows: int, k: int, cb: int, hlen: int,
+                         pbits: int = 0, ladder: str = "jacobian"):
     sig = packed[:, :2 * cb]
     dig = packed[:, 2 * cb:2 * cb + hlen]
     flags = packed[:, 2 * cb + hlen] != 0
     idx = packed[:, 2 * cb + hlen + 1].astype(jnp.int32)
     r, s, e = _ec_prep(sig, dig, k=k)
     ok, deg = _ecdsa_core(r, s, e, idx, tqx, tqy, *g_tabs, *consts,
-                          nbits=nbits, n_windows=n_windows)
+                          nbits=nbits, n_windows=n_windows,
+                          pbits=pbits, ladder=ladder)
     return ok & flags, deg & flags
 
 
@@ -698,14 +981,17 @@ def _es_packed_jit(name: str, impl, static_names):
 
 
 def verify_es_packed_pending(table: ECKeyTable, rec: np.ndarray,
-                             hash_len: int, mesh=None):
+                             hash_len: int, mesh=None,
+                             ladder: Optional[str] = None):
     """Dispatch one packed ES* chunk; returns device ([N] ok, [N] deg).
 
     Degenerate-flagged tokens (deg True) must be re-verified on the CPU
     oracle by the caller after the sync wave — same contract as
     verify_ecdsa_arrays_pending. With a mesh the record shards along
-    the batch axis; tables replicate (SURVEY.md §2.6).
+    the batch axis; tables replicate (SURVEY.md §2.6). ``ladder``
+    selects the window-add law (None → :func:`ladder_mode`).
     """
+    ladder = resolve_ladder(ladder)
     cp = table.curve
     if mesh is not None:
         from ..parallel.place import replicated, shard_batch
@@ -725,16 +1011,18 @@ def verify_es_packed_pending(table: ECKeyTable, rec: np.ndarray,
         consts = cp.device_consts()
         fn = _es_packed_jit("rns", _es_packed_rns_impl,
                             ("crv", "nbits", "wbits", "k", "cb",
-                             "hlen"))
+                             "hlen", "ladder"))
         return fn(dev, place(rtab.tab),
                   tuple(place(a) for a in consts[4:9]),
                   crv=cp.name, nbits=cp.nbits, wbits=rtab.ctx.w_bits,
-                  k=cp.k, cb=cp.coord_bytes, hlen=hash_len)
+                  k=cp.k, cb=cp.coord_bytes, hlen=hash_len,
+                  ladder=ladder)
     fn = _es_packed_jit("limb", _es_packed_limb_impl,
-                        ("nbits", "n_windows", "k", "cb", "hlen"))
+                        ("nbits", "n_windows", "k", "cb", "hlen",
+                         "pbits", "ladder"))
     return fn(dev, place(table.tqx), place(table.tqy),
               tuple(place(a) for a in cp.g_tables()),
               tuple(place(a) for a in cp.device_consts()),
               nbits=cp.nbits,
               n_windows=cp.n_windows, k=cp.k, cb=cp.coord_bytes,
-              hlen=hash_len)
+              hlen=hash_len, pbits=cp.pbits, ladder=ladder)

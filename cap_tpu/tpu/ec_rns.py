@@ -292,6 +292,114 @@ def _one_dom(c: ECRNSContext):
 
 
 # ---------------------------------------------------------------------------
+# Affine window addition: batched RNS inversion + 2M+1S law
+# ---------------------------------------------------------------------------
+
+_PM2_BITS: Dict[str, np.ndarray] = {}
+
+
+def _pm2_bits_np(crv: str) -> np.ndarray:
+    """MSB-first bits of p−2 — the field-side Fermat exponent that
+    inverts the product tree's root."""
+    if crv not in _PM2_BITS:
+        p = curve(crv).p
+        e = p - 2
+        nb = p.bit_length()
+        _PM2_BITS[crv] = np.asarray(
+            [(e >> (nb - 1 - i)) & 1 for i in range(nb)], np.int32)
+    return _PM2_BITS[crv]
+
+
+def rns_batch_inverse(c: ECRNSContext, den, min_width: int = 128):
+    """Simultaneous inversion of an A-domain residue batch mod p.
+
+    den: (A, B) residue pair [I, M], digits ≤ 3m (lazily-grown ok),
+    values < 8p, every lane ≢ 0 (mod p), M a power of two. This is
+    Montgomery's product-tree trick in rmul form — the same shape as
+    ``bignum.batch_mont_inverse`` (rmul is closed over the A-domain:
+    rmul(ã, b̃) = (ab)·A, so the tree, the root Fermat p−2 ladder, and
+    the walk back down all stay in-domain): ~3 rmuls per lane plus the
+    root ladder amortized over min_width lanes, instead of a
+    ~1.5·pbits-rmul Fermat per lane. Returns per-lane inverses
+    (ĩnv = den⁻¹·A), digit-canonical, values < 3p.
+    """
+    levels = [den]
+    cur = den
+    while cur[0].shape[1] > min_width and cur[0].shape[1] % 2 == 0:
+        cur = rmul(c, (cur[0][:, 0::2], cur[1][:, 0::2]),
+                   (cur[0][:, 1::2], cur[1][:, 1::2]))
+        levels.append(cur)
+
+    root = cur
+    w = root[0].shape[1]
+    bits = jnp.asarray(_pm2_bits_np(c.cp.name))
+    one_d = _one_dom(c)
+    acc0 = (jnp.broadcast_to(one_d[0], (c.A.count, w)),
+            jnp.broadcast_to(one_d[1], (c.B.count, w)))
+
+    def body(i, acc):
+        acc = rmul(c, acc, acc)
+        mul = rmul(c, acc, root)
+        take = jnp.broadcast_to(bits[i] != 0, (w,))
+        return rsel(take, mul, acc)
+
+    inv = lax.fori_loop(0, int(bits.shape[0]), body, acc0)
+
+    for lvl in levels[-2::-1]:
+        left = (lvl[0][:, 0::2], lvl[1][:, 0::2])
+        right = (lvl[0][:, 1::2], lvl[1][:, 1::2])
+        il, ir = rmul_many(c, [(inv, right), (inv, left)])
+        inv = (jnp.stack([il[0], ir[0]], axis=2).reshape(lvl[0].shape),
+               jnp.stack([il[1], ir[1]], axis=2).reshape(lvl[1].shape))
+    return inv
+
+
+def _affine_madd_rns(c: ECRNSContext, x, y, inf, x2, y2, has, one_b):
+    """Affine + affine window addition with explicit infinity lane.
+
+    State x, y digit-canonical, values < 3p (stationary); x2, y2 < p
+    (table points, never infinity); has: lanes adding this step. The
+    division λ = (y2−y)/(x2−x) amortizes into ONE product-tree
+    inversion across all lanes (``rns_batch_inverse``); the law itself
+    is 3 rmuls (λ = dy·inv, λ², λ·(x−x3)) plus 2 bound-reduction
+    rmuls that re-enter the additive results into the < 3p invariant
+    (the Jacobian forms get this reduction for free because their
+    state only ever passes through multiplies — an affine state is
+    used additively, so it must be re-reduced explicitly; this is
+    half of where the "2M+1S" headline goes, see docs/PERF.md).
+
+    Exceptional cases, explicit where the complete-ish Jacobian madd
+    absorbed them:
+    - infinity accumulator + digit > 0 → masked lift of the addend;
+    - doubling (P == Q) and inverse (P == −Q → infinity): both have
+      x(P) ≡ x2, caught by the 2-channel congruence probe → flagged
+      ``degenerate`` (CPU oracle re-verifies — the _madd_rns
+      contract), denominator masked to 1 so the tree stays
+      invertible.
+    """
+    dxl = rsub(c, x2, x, 4, guard=1)             # < 5p, ≤ 3m
+    dd = has & ~inf & congruent_zero_probe(c, dxl, 5)
+    good = has & ~inf & ~dd
+    den = rsel(good, dxl, one_b)
+    inv = rns_batch_inverse(c, den)              # < 3p, ≤ m
+    dyl = rsub(c, y2, y, 4, guard=1)             # < 5p, ≤ 3m
+    lam = rmul(c, dyl, inv)                      # 15·λ ✓ → < 3p, ≤ m
+    sq = rmul(c, lam, lam)                       # < 3p, ≤ m
+    x3l = rsub(c, rsub(c, sq, x, 4, guard=1), x2, 2,
+               guard=1)                          # < 9p, ≤ 5m
+    xdiff = rsub(c, x, x3l, 16, guard=5)         # < 19p, ≤ 7m
+    y3t, x3 = rmul_many(c, [(xdiff, lam), (x3l, one_b)])  # < 3p, ≤ m
+    y3l = rsub(c, y3t, y, 4, guard=1)            # < 7p, ≤ 3m
+    y3 = rmul(c, y3l, one_b)                     # < 3p, ≤ m
+    lift = inf & has
+    x3 = rsel(lift, x2, x3)
+    y3 = rsel(lift, y2, y3)
+    x = rsel(has, x3, x)
+    y = rsel(has, y3, y)
+    return x, y, inf & ~has, dd
+
+
+# ---------------------------------------------------------------------------
 # The batched verify core
 # ---------------------------------------------------------------------------
 
@@ -315,16 +423,20 @@ def _digits_of(u, w_bits: int, n_windows: int):
     return jnp.stack(outs).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("crv", "nbits", "wbits"))
+@partial(jax.jit, static_argnames=("crv", "nbits", "wbits", "ladder"))
 def _ecdsa_rns_core(r, s, e, key_idx, tab,
                     n, npp, nr2, none_, nm2,
-                    crv: str, nbits: int, wbits: int = 8):
+                    crv: str, nbits: int, wbits: int = 8,
+                    ladder: str = "jacobian"):
     """ECDSA verify: scalar math in limbs, point math in RNS.
 
     r, s, e: [K, N] limb values; key_idx [N]; ``tab``: THE fused
     window-major packed window table (ECRNSKeyTable.tab —
     [W·(nk+1)·per, 2·iap] i32 A|B<<16 words, G at slot 0).
-    n..nm2: [K, 1] scalar-field constants. Returns (ok, deg) [N] bools.
+    n..nm2: [K, 1] scalar-field constants. ``ladder`` selects the
+    window-add law — ``jacobian`` (mixed madd, default) or ``affine``
+    (2M+1S adds + one batched product-tree inversion per window step,
+    ec.ladder_mode). Returns (ok, deg) [N] bools.
     """
     from . import bignum as B
 
@@ -434,7 +546,37 @@ def _ecdsa_rns_core(r, s, e, key_idx, tab,
              i * win_stride + key_base])
         return add_from_table(state, d, row0)
 
-    if use_fused and pallas_madd.ladder_enabled():
+    if ladder == "affine":
+        # Affine-law ladder (the round-5 verdict A/B): same two-chain
+        # lane concat, same digits and table rows, but the accumulator
+        # stays affine and each window's divisions amortize into ONE
+        # batched product-tree inversion over the 2N lanes. The merge
+        # and final projective check below are shared — the affine
+        # chains lift to Jacobian with Z = 1.
+        one_bc = (jnp.broadcast_to(one_d[0], (ia, 2 * n_tok)),
+                  jnp.broadcast_to(one_d[1], (ib, 2 * n_tok)))
+
+        def affine_body(i, state):
+            xv, yv, infv, degv = state
+            d1 = lax.dynamic_slice_in_dim(dig1, i, 1, axis=0)[0]
+            d2 = lax.dynamic_slice_in_dim(dig2, i, 1, axis=0)[0]
+            d = jnp.concatenate([d1, d2])
+            row0 = jnp.concatenate(
+                [jnp.full((n_tok,), i * win_stride, jnp.int32),
+                 i * win_stride + key_base])
+            has = d > 0
+            idx = row0 + jnp.where(has, d - 1, 0)
+            x2p, y2p = gather_pt(idx)
+            x2 = unpack_pt(x2p, ia, ib)
+            y2 = unpack_pt(y2p, ia, ib)
+            xv, yv, infv, dd = _affine_madd_rns(
+                c, xv, yv, infv, x2, y2, has, one_d)
+            return xv, yv, infv, degv | dd
+
+        X2, Y2, inf2, deg2 = lax.fori_loop(
+            0, n_windows, affine_body, (one_bc, one_bc, inf, deg0))
+        Z2 = one_bc
+    elif use_fused and pallas_madd.ladder_enabled():
         # Whole-ladder fusion: one pallas_call, state VMEM-resident
         # across all windows (pallas_madd.ladder_fused). Same math,
         # same table rows, same masks — the per-window path above

@@ -25,7 +25,15 @@ if _spec is None or not (_spec.origin or "").startswith(_REPO + os.sep):
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.4.34-ish) has no jax_num_cpu_devices option; the
+    # only way to get virtual host devices is the XLA flag, which is
+    # read at first backend init — and nothing above touched a device,
+    # so setting it here still works.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 # Persistent compilation cache: the suite is compile-dominated on CPU
 # (engine programs per shape bucket); warm runs skip all of it.
