@@ -199,7 +199,16 @@ def main() -> None:
                               "vs_baseline": 0.0}))
             return
     telemetry.disable()
-    h2d_bytes = rec.counters().get("h2d.bytes", 0)
+    all_counters = rec.counters()
+    h2d_bytes = all_counters.get("h2d.bytes", 0)
+    # Fleet/serve health counters ride along in the BENCH record (the
+    # retry/failover/stall story of the run, zero when nothing fired):
+    # fleet.* comes from any FleetClient/WorkerPool activity in-process,
+    # worker.*/batcher.* from serve components.
+    health_counters = {
+        k: v for k, v in sorted(all_counters.items())
+        if k.startswith(("fleet.", "worker.", "batcher."))
+    }
 
     intervals = [b - a for a, b in zip(done_t, done_t[1:])]
     rates = [batch / dt for dt in intervals]
@@ -270,6 +279,10 @@ def main() -> None:
         # headline with a low ceiling is the wire, not the engine.
         "stall_intervals": len(stall),
         "stall_seconds": round(sum(stall), 3),
+        # Retry/failover/serve-health counters observed during the
+        # window (fleet.failovers, fleet.fallback_tokens, worker.*,
+        # batcher.* — empty dict = clean run, nothing fired).
+        "health_counters": health_counters,
         "bytes_per_token": round(bytes_per_token, 1),
         "link_implied_ceiling_vps": round(link_ceiling, 1)
         if link_ceiling else None,

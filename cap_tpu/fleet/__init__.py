@@ -1,0 +1,19 @@
+"""Serve fleet: multi-worker pool, failover routing, fault injection.
+
+The production-scale layer above :mod:`cap_tpu.serve` (ROADMAP: "heavy
+traffic from millions of users"): one ``VerifyWorker`` process per
+exclusive device group (``parallel.place.single_owner_placement``),
+supervised by :class:`WorkerPool` (health pings, crash detection,
+respawn with graceful drain), fronted by :class:`FleetClient`
+(balancing, per-worker deadlines, circuit breakers, hedged retry,
+checksummed frames, terminal CPU-oracle fallback). ``chaos`` is the
+fault-injection harness the availability contract is tested against:
+zero wrong verdicts, zero lost submissions, under kill -9, stalls,
+black holes, and corrupt frames. See docs/SERVE.md.
+"""
+
+from .pool import FleetError, WorkerPool
+from .router import FleetClient, FleetExhaustedError
+
+__all__ = ["FleetClient", "FleetError", "FleetExhaustedError",
+           "WorkerPool"]

@@ -1,0 +1,145 @@
+"""Fleet worker subprocess entry: ``python -m cap_tpu.fleet.worker_main``.
+
+One process = one :class:`~cap_tpu.serve.worker.VerifyWorker` = one
+exclusive device group (the pool passes the placement as environment —
+see ``parallel.place.WorkerPlacement.env``). The process:
+
+1. builds its keyset from ``--keyset`` (below), honoring the placement
+   env BEFORE any jax backend init;
+2. binds the serve socket and prints ONE machine-readable ready line on
+   stdout (``CAP_FLEET_READY port=<p> pid=<p>``) — the pool parses it
+   to learn the ephemeral port;
+3. serves until SIGTERM, then drains gracefully: stops accepting,
+   flushes every queued batch, answers the in-flight connections, and
+   exits 0 (kill -9 is the CRASH path, exercised by the chaos suite).
+
+Keyset specs (``--keyset``):
+
+- ``stub`` / ``stub:batch_ms=F,token_us=F`` — the deterministic test
+  engine (tokens ending ``.ok`` verify). The optional knobs sleep per
+  flushed batch / per token to model DEVICE occupancy: ``time.sleep``
+  releases the GIL and the "device time" of two worker processes then
+  genuinely overlaps, which is exactly the fleet's scaling claim. No
+  jax import — stub workers start in ~0.2 s.
+- ``jwks:<path>`` — a real ``TPUBatchKeySet`` over the JWKS JSON file
+  at ``<path>`` (imports jax + the crypto stack; the placement env
+  decides which devices the backend sees).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+
+class StubKeySet:
+    """Deterministic verdict engine: tokens ending ``.ok`` verify.
+
+    The fleet tests' ground truth — the router's CPU-oracle fallback
+    uses the SAME class, so a verdict produced by any path (worker,
+    failover peer, fallback) is comparable bit-for-bit.
+    """
+
+    def __init__(self, batch_ms: float = 0.0, token_us: float = 0.0):
+        self._batch_s = batch_ms / 1e3
+        self._token_s = token_us / 1e6
+
+    def verify_batch(self, tokens):
+        from ..errors import InvalidSignatureError
+
+        sleep_s = self._batch_s + self._token_s * len(tokens)
+        if sleep_s > 0.0:
+            time.sleep(sleep_s)      # models device occupancy (no GIL)
+        return [
+            {"sub": t} if t.endswith(".ok")
+            else InvalidSignatureError(
+                "no known key successfully validated the token signature")
+            for t in tokens
+        ]
+
+
+def make_keyset(spec: str):
+    """Build the worker's engine from a ``--keyset`` spec string."""
+    if spec == "stub" or spec.startswith("stub:"):
+        kwargs = {}
+        if spec.startswith("stub:"):
+            for kv in spec[len("stub:"):].split(","):
+                if not kv:
+                    continue
+                k, _, v = kv.partition("=")
+                if k not in ("batch_ms", "token_us"):
+                    raise ValueError(f"unknown stub option {k!r}")
+                kwargs[k] = float(v)
+        return StubKeySet(**kwargs)
+    if spec.startswith("jwks:"):
+        _configure_devices()
+        import json
+
+        from ..jwt.jwk import parse_jwks
+        from ..jwt.tpu_keyset import TPUBatchKeySet
+
+        with open(spec[len("jwks:"):], "r") as f:
+            doc = json.load(f)
+        return TPUBatchKeySet(parse_jwks(doc))
+    raise ValueError(f"unknown keyset spec {spec!r}")
+
+
+def _configure_devices() -> None:
+    """Apply the placement env to jax BEFORE first backend use."""
+    n_cpu = int(os.environ.get("CAP_FLEET_CPU_DEVICES", "0") or 0)
+    if n_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", n_cpu)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n_cpu}")
+    # platform="tpu": TPU_VISIBLE_DEVICES is already in the env and
+    # libtpu reads it at backend init — nothing to do here.
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cap_tpu.fleet.worker_main")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--keyset", default="stub")
+    ap.add_argument("--target-batch", type=int, default=4096)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=32768)
+    ap.add_argument("--drain-deadline-s", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    from .. import telemetry
+    from ..serve.worker import VerifyWorker
+
+    telemetry.enable()               # STATS op serves real numbers
+    keyset = make_keyset(args.keyset)
+    worker = VerifyWorker(keyset, host=args.host, port=args.port,
+                          target_batch=args.target_batch,
+                          max_wait_ms=args.max_wait_ms,
+                          max_batch=args.max_batch)
+    host, port = worker.address
+    # The ONE ready line the pool parses; flushed so it cannot sit in a
+    # stdio buffer while the pool's spawn timeout burns.
+    print(f"CAP_FLEET_READY port={port} pid={os.getpid()}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    # Graceful drain: stop accepting, flush queued batches (bounded),
+    # give the responder threads a beat to write the last frames out.
+    worker.close(deadline_s=args.drain_deadline_s)
+    time.sleep(0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from .. import telemetry
 
@@ -122,6 +122,14 @@ class AdaptiveBatcher:
             self._queued_tokens += len(p.tokens)
             self._cv.notify_all()
         return p
+
+    def depth(self) -> Dict[str, int]:
+        """Queue-depth snapshot: tokens awaiting dispatch + batches in
+        flight on the device (the fleet STATS op reads this)."""
+        with self._lock:
+            queued = self._queued_tokens
+        return {"queued_tokens": queued,
+                "inflight_batches": self._inflight.qsize()}
 
     def close(self, deadline_s: float = 120.0) -> None:
         with self._cv:

@@ -25,7 +25,13 @@ class VerifyClient:
     """Blocking client; one socket, pipelined request/response frames."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 uds_path: Optional[str] = None, timeout: float = 30.0):
+                 uds_path: Optional[str] = None, timeout: float = 30.0,
+                 crc: bool = False):
+        # crc=True: speak the checksummed frame pair (REQ_CRC/RESP_CRC)
+        # so byte corruption anywhere on the path raises
+        # FrameCorruptError instead of returning a wrong verdict — the
+        # fleet router always sets this.
+        self._crc = crc
         if uds_path is not None:
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             self._sock.settimeout(timeout)
@@ -42,11 +48,21 @@ class VerifyClient:
         ftype, _ = self._reader.recv_frame()
         return ftype == protocol.T_PONG
 
+    def stats(self) -> dict:
+        """The worker's STATS snapshot (queue depth, inflight,
+        counters, per-series p50/p95/p99)."""
+        protocol.send_stats_request(self._sock)
+        ftype, entries = self._reader.recv_frame()
+        if ftype != protocol.T_STATS_RESP or len(entries) != 1:
+            raise protocol.ProtocolError(
+                f"expected stats response, got type {ftype}")
+        return json.loads(entries[0][1].decode())
+
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
         """Claims dict per verified token; RemoteVerifyError per reject."""
         if not tokens:
             return []
-        protocol.send_request(self._sock, tokens)
+        protocol.send_request(self._sock, tokens, crc=self._crc)
         return self._read_response(len(tokens))
 
     def verify_stream(self, batches, depth: int = 4):
@@ -83,7 +99,8 @@ class VerifyClient:
                     if stop.is_set():
                         return
                     if toks:
-                        protocol.send_request(self._sock, toks)
+                        protocol.send_request(self._sock, toks,
+                                              crc=self._crc)
                     sent.put(len(toks))
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 send_err.append(e)
@@ -114,8 +131,13 @@ class VerifyClient:
 
     def _read_response(self, n_tokens: int) -> List[Any]:
         ftype, entries = self._reader.recv_frame()
-        if ftype != protocol.T_VERIFY_RESP:
-            raise protocol.ProtocolError(f"expected response, got {ftype}")
+        # In crc mode a plain (unchecksummed) response is a protocol
+        # violation — integrity must not be silently downgradable.
+        want = (protocol.T_VERIFY_RESP_CRC if self._crc
+                else protocol.T_VERIFY_RESP)
+        if ftype != want:
+            raise protocol.ProtocolError(f"expected response type "
+                                         f"{want}, got {ftype}")
         if len(entries) != n_tokens:
             raise protocol.ProtocolError(
                 f"response count {len(entries)} != request {n_tokens}")

@@ -7,7 +7,9 @@ Frame layout (all integers little-endian):
 
     magic   u32   0x31425643 ("CVB1")
     type    u8    1 = verify request, 2 = verify response, 3 = ping,
-                  4 = pong
+                  4 = pong, 5 = stats request, 6 = stats response,
+                  7 = checksummed verify request,
+                  8 = checksummed verify response
     count   u32   number of entries
     entries:
       request entry:   len u32, token bytes (UTF-8 compact JWS)
@@ -16,6 +18,28 @@ Frame layout (all integers little-endian):
                        (claims JSON when verified; error string when
                        rejected — the error CLASS name plus message,
                        never the token itself)
+      stats response:  exactly one response-shaped entry whose payload
+                       is the worker's stats JSON (counts and timings
+                       only — redaction discipline applies)
+    trailer (types 7/8 only):
+      crc32   u32   zlib.crc32 over every frame byte from the magic
+                    through the last entry byte
+
+Types 7/8 are the fleet router's integrity envelope: a worker answers
+a checksummed request with a checksummed response, so a flipped byte
+anywhere in either direction (status, lengths, payload) surfaces as
+:class:`FrameCorruptError` instead of a silently wrong verdict. Plain
+clients (Go, native, VerifyClient default) keep the exact CVB1 bytes
+of types 1-4 — the golden vectors are unchanged.
+
+Hardening stance: every length prefix is bound-checked BEFORE any
+allocation or read of entry bytes (a hostile or corrupt frame cannot
+make the parser allocate unbounded memory), and malformed values
+(unknown type, bad magic, nonzero ping/pong count, status byte
+outside {0, 1}) raise typed subclasses of :class:`ProtocolError`.
+Liveness against a peer that claims N entries and then stalls is the
+CALLER's job (socket timeouts / fleet router deadlines) — a blocking
+read cannot be both exact and self-timing.
 
 Secrets stance: tokens cross this boundary by necessity (the worker
 must verify them); nothing here logs, copies, or echoes them beyond
@@ -27,13 +51,18 @@ from __future__ import annotations
 import json
 import socket
 import struct
-from typing import Any, List, Sequence, Tuple
+import zlib
+from typing import Any, Callable, List, Sequence, Tuple
 
 MAGIC = 0x31425643
 T_VERIFY_REQ = 1
 T_VERIFY_RESP = 2
 T_PING = 3
 T_PONG = 4
+T_STATS_REQ = 5
+T_STATS_RESP = 6
+T_VERIFY_REQ_CRC = 7
+T_VERIFY_RESP_CRC = 8
 
 _HDR = struct.Struct("<IBI")
 
@@ -43,7 +72,23 @@ MAX_FRAME_BYTES = 1 << 28        # aggregate cap: one frame ≤ 256 MiB
 
 
 class ProtocolError(Exception):
-    pass
+    """Base class for CVB1 wire-format violations."""
+
+
+class MalformedFrameError(ProtocolError):
+    """Structurally invalid frame: bad magic, unknown type, nonzero
+    ping/pong count, or a response status byte outside {0, 1}."""
+
+
+class FrameTooLargeError(ProtocolError):
+    """A length prefix or entry count exceeds the protocol bounds.
+
+    Raised BEFORE any allocation for the oversized region — a hostile
+    length (e.g. 0xFFFFFFFF, a "negative" i32) costs nothing."""
+
+
+class FrameCorruptError(ProtocolError):
+    """A checksummed frame's CRC32 trailer does not match its bytes."""
 
 
 _LEN_U32 = struct.Struct("<I")
@@ -62,19 +107,29 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def send_request(sock: socket.socket, tokens: Sequence[str]) -> None:
-    parts = [_HDR.pack(MAGIC, T_VERIFY_REQ, len(tokens))]
+def _with_crc(parts: List[bytes]) -> List[bytes]:
+    crc = 0
+    for p in parts:
+        crc = zlib.crc32(p, crc)
+    parts.append(_LEN_U32.pack(crc & 0xFFFFFFFF))
+    return parts
+
+
+def send_request(sock: socket.socket, tokens: Sequence[str],
+                 crc: bool = False) -> None:
+    ftype = T_VERIFY_REQ_CRC if crc else T_VERIFY_REQ
+    parts = [_HDR.pack(MAGIC, ftype, len(tokens))]
     for t in tokens:
         raw = t.encode()
         parts.append(struct.pack("<I", len(raw)))
         parts.append(raw)
+    if crc:
+        _with_crc(parts)
     sock.sendall(b"".join(parts))
 
 
-def send_response(sock: socket.socket, results: Sequence[Any]) -> None:
-    """results: claims (dict, or the raw payload-JSON bytes the worker
-    verified — sent verbatim, zero re-serialization) or Exception."""
-    parts = [_HDR.pack(MAGIC, T_VERIFY_RESP, len(results))]
+def _response_parts(ftype: int, results: Sequence[Any]) -> List[bytes]:
+    parts = [_HDR.pack(MAGIC, ftype, len(results))]
     for r in results:
         if isinstance(r, Exception):
             payload = f"{type(r).__name__}: {r}".encode()
@@ -86,6 +141,17 @@ def send_response(sock: socket.socket, results: Sequence[Any]) -> None:
             payload = json.dumps(r, separators=(",", ":")).encode()
             parts.append(struct.pack("<BI", 0, len(payload)))
         parts.append(payload)
+    return parts
+
+
+def send_response(sock: socket.socket, results: Sequence[Any],
+                  crc: bool = False) -> None:
+    """results: claims (dict, or the raw payload-JSON bytes the worker
+    verified — sent verbatim, zero re-serialization) or Exception."""
+    if crc:
+        parts = _with_crc(_response_parts(T_VERIFY_RESP_CRC, results))
+    else:
+        parts = _response_parts(T_VERIFY_RESP, results)
     sock.sendall(b"".join(parts))
 
 
@@ -95,6 +161,17 @@ def send_ping(sock: socket.socket) -> None:
 
 def send_pong(sock: socket.socket) -> None:
     sock.sendall(_HDR.pack(MAGIC, T_PONG, 0))
+
+
+def send_stats_request(sock: socket.socket) -> None:
+    sock.sendall(_HDR.pack(MAGIC, T_STATS_REQ, 0))
+
+
+def send_stats_response(sock: socket.socket, stats: Any) -> None:
+    """One response-shaped entry carrying the stats JSON object."""
+    payload = json.dumps(stats, separators=(",", ":")).encode()
+    sock.sendall(_HDR.pack(MAGIC, T_STATS_RESP, 1)
+                 + struct.pack("<BI", 0, len(payload)) + payload)
 
 
 def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
@@ -112,34 +189,70 @@ def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
 
 
 def _parse_frame(take) -> Tuple[int, List[Any]]:
-    """Shared CVB1 frame parse over a ``take(n) -> bytes`` source."""
-    magic, ftype, count = _HDR.unpack(take(_HDR.size))
+    """Shared CVB1 frame parse over a ``take(n) -> bytes`` source.
+
+    Every length is validated BEFORE the corresponding ``take`` — the
+    parser never allocates for an out-of-bounds prefix. Checksummed
+    frame types defer UTF-8 decoding and status validation until the
+    CRC trailer has matched, so a flipped byte anywhere in the frame
+    surfaces as :class:`FrameCorruptError`.
+    """
+    raw_take = take
+    hdr = raw_take(_HDR.size)
+    magic, ftype, count = _HDR.unpack(hdr)
     if magic != MAGIC:
-        raise ProtocolError(f"bad magic 0x{magic:08x}")
+        raise MalformedFrameError(f"bad magic 0x{magic:08x}")
     if count > MAX_FRAME_ENTRIES:
-        raise ProtocolError(f"frame too large: {count} entries")
+        raise FrameTooLargeError(f"frame too large: {count} entries")
+    checksummed = ftype in (T_VERIFY_REQ_CRC, T_VERIFY_RESP_CRC)
+    if checksummed:
+        crc_state = [zlib.crc32(hdr)]
+
+        def take(n: int, _t: Callable[[int], bytes] = raw_take) -> bytes:
+            b = _t(n)
+            crc_state[0] = zlib.crc32(b, crc_state[0])
+            return b
+
     entries: List[Any] = []
     total = 0
     u32 = _LEN_U32.unpack
     bu32 = _LEN_BU32.unpack
-    if ftype == T_VERIFY_REQ:
+    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC):
         for _ in range(count):
             (ln,) = u32(take(4))
             total += ln
             if ln > MAX_ENTRY_BYTES or total > MAX_FRAME_BYTES:
-                raise ProtocolError(f"frame too large ({total} bytes)")
-            entries.append(take(ln).decode())
-    elif ftype == T_VERIFY_RESP:
+                raise FrameTooLargeError(f"frame too large ({total} bytes)")
+            entries.append(take(ln))
+    elif ftype in (T_VERIFY_RESP, T_VERIFY_RESP_CRC, T_STATS_RESP):
         for _ in range(count):
             status, ln = bu32(take(5))
+            if not checksummed and status not in (0, 1):
+                raise MalformedFrameError(f"bad status byte {status}")
             total += ln
             if ln > MAX_ENTRY_BYTES or total > MAX_FRAME_BYTES:
-                raise ProtocolError(f"frame too large ({total} bytes)")
+                raise FrameTooLargeError(f"frame too large ({total} bytes)")
             entries.append((status, take(ln)))
-    elif ftype in (T_PING, T_PONG):
-        pass
+    elif ftype in (T_PING, T_PONG, T_STATS_REQ):
+        if count:
+            raise MalformedFrameError(
+                f"type-{ftype} frame with nonzero count {count}")
     else:
-        raise ProtocolError(f"unknown frame type {ftype}")
+        raise MalformedFrameError(f"unknown frame type {ftype}")
+
+    if checksummed:
+        (want,) = u32(raw_take(4))          # trailer: outside the CRC
+        if want != (crc_state[0] & 0xFFFFFFFF):
+            raise FrameCorruptError(
+                f"crc mismatch (frame type {ftype}): wire says "
+                f"0x{want:08x}")
+        for e in entries:                   # deferred status validation
+            if isinstance(e, tuple) and e[0] not in (0, 1):
+                raise MalformedFrameError(f"bad status byte {e[0]}")
+    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC):
+        # Token decode AFTER integrity: corruption inside a checksummed
+        # frame can never masquerade as a different (valid) token.
+        entries = [e.decode() for e in entries]
     return ftype, entries
 
 

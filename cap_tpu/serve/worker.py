@@ -88,7 +88,22 @@ class VerifyWorker:
         """(host, port) for TCP, (path, 0) for UDS."""
         return self._addr
 
-    def close(self) -> None:
+    def stats(self) -> dict:
+        """Process-local load/health snapshot (the STATS op payload).
+
+        Counts and timings only — never tokens, keys, or claims. The
+        telemetry recorder may be off (empty dicts then); queue depth
+        and inflight come straight from the batcher either way.
+        """
+        rec = telemetry.active()
+        return {
+            "pid": os.getpid(),
+            **self._batcher.depth(),
+            "counters": rec.counters() if rec is not None else {},
+            "series": rec.summary() if rec is not None else {},
+        }
+
+    def close(self, deadline_s: float = 120.0) -> None:
         self._closed = True
         try:
             self._sock.close()
@@ -99,7 +114,7 @@ class VerifyWorker:
                 os.unlink(self._uds_path)
             except OSError:
                 pass
-        self._batcher.close()
+        self._batcher.close(deadline_s=deadline_s)
 
     # -- internals --------------------------------------------------------
 
@@ -155,11 +170,19 @@ class VerifyWorker:
                 if ftype == protocol.T_PING:
                     respq.put(("pong", None))
                     continue
-                if ftype != protocol.T_VERIFY_REQ:
+                if ftype == protocol.T_STATS_REQ:
+                    respq.put(("stats", None))
+                    continue
+                if ftype not in (protocol.T_VERIFY_REQ,
+                                 protocol.T_VERIFY_REQ_CRC):
                     return  # protocol violation → drop the connection
                 telemetry.count("worker.requests")
                 telemetry.count("worker.tokens", len(entries))
-                respq.put(("batch", self._batcher.submit_nowait(entries)))
+                # A checksummed request gets a checksummed response —
+                # the fleet router's end-to-end integrity envelope.
+                crc = ftype == protocol.T_VERIFY_REQ_CRC
+                respq.put(("batch_crc" if crc else "batch",
+                           self._batcher.submit_nowait(entries)))
         finally:
             respq.put(None)
             try:
@@ -167,8 +190,7 @@ class VerifyWorker:
             except OSError:
                 pass
 
-    @staticmethod
-    def _respond_loop(conn: socket.socket, respq) -> None:
+    def _respond_loop(self, conn: socket.socket, respq) -> None:
         broken = False
         while True:
             item = respq.get()
@@ -180,9 +202,15 @@ class VerifyWorker:
             try:
                 if kind == "pong":
                     protocol.send_pong(conn)
+                elif kind == "stats":
+                    # Snapshot at RESPOND time (in-order with verifies
+                    # on this connection, so a stats probe sent after a
+                    # batch reflects that batch's accounting).
+                    protocol.send_stats_response(conn, self.stats())
                 else:
                     pending.event.wait()
-                    protocol.send_response(conn, pending.results)
+                    protocol.send_response(conn, pending.results,
+                                           crc=kind == "batch_crc")
             except (ConnectionError, OSError):
                 # Connection broke mid-response: close it so the reader
                 # unblocks out of recv, then keep DRAINING until the

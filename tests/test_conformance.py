@@ -1,5 +1,6 @@
 """Rejection-parity conformance: crit headers, JSON-serialization JWS,
-and x5c JWKs (VERDICT r4 gaps 1-3).
+x5c JWKs (VERDICT r4 gaps 1-3), and adversarial SIGNATURE ENCODINGS
+(VERDICT r5 open item, pinned golden vectors).
 
 The bar: identical verdicts to the reference's go-jose path across ALL
 four verify surfaces — CPU oracle (StaticKeySet), TPU batch
@@ -7,15 +8,21 @@ four verify surfaces — CPU oracle (StaticKeySet), TPU batch
 Reference semantics: jwt/jwt.go:212-227 (ParseSigned + one-signature
 rule), jwt/keyset.go:109-122 (go-jose JSONWebKey x5c),
 jwt/keyset.go:155-167 (crit rejection via .Claims).
+
+The classic suites need the ``cryptography`` stack for fixtures and
+skip cleanly where it is absent; the golden-vector signature-encoding
+suite is dependency-free down to the device engines (pinned tokens +
+host-integer keys) and runs everywhere.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 
 import pytest
 
-from cap_tpu import testing as captest
 from cap_tpu.errors import (
     InvalidJWKSError,
     InvalidSignatureError,
@@ -29,9 +36,20 @@ from cap_tpu.jwt.jose import (
     parse_jws,
     peek_alg,
 )
-from cap_tpu.jwt.jwk import parse_jwk, parse_jwks, serialize_public_key
-from cap_tpu.jwt.keyset import StaticKeySet
 from cap_tpu.runtime import prep
+
+try:
+    from cap_tpu import testing as captest
+    from cap_tpu.jwt.jwk import parse_jwk, parse_jwks, serialize_public_key
+    from cap_tpu.jwt.keyset import StaticKeySet
+    _HAVE_CRYPTO = True
+except ModuleNotFoundError:
+    captest = None
+    parse_jwk = parse_jwks = serialize_public_key = StaticKeySet = None
+    _HAVE_CRYPTO = False
+
+needs_crypto = pytest.mark.skipif(
+    not _HAVE_CRYPTO, reason="cryptography package not installed")
 
 
 @pytest.fixture(scope="module")
@@ -56,6 +74,7 @@ def _tpu_keyset(pubs_jwks):
 # crit header
 # ---------------------------------------------------------------------------
 
+@needs_crypto
 class TestCritRejection:
     def _crit_token(self, es_pair):
         priv, _ = es_pair
@@ -118,6 +137,7 @@ class TestCritRejection:
 # JSON serialization
 # ---------------------------------------------------------------------------
 
+@needs_crypto
 class TestJSONSerialization:
     def test_flattened_and_general_parse_equal_compact(self, good_token):
         ref = parse_compact(good_token)
@@ -242,6 +262,7 @@ class TestJSONSerialization:
 # x5c JWKs
 # ---------------------------------------------------------------------------
 
+@needs_crypto
 class TestX5CKeys:
     @pytest.mark.parametrize("alg", [algs.RS256, algs.ES256, algs.EdDSA])
     def test_cert_only_jwk_parses_and_verifies(self, alg):
@@ -327,6 +348,7 @@ class TestX5CKeys:
 # Four-surface differential
 # ---------------------------------------------------------------------------
 
+@needs_crypto
 def test_four_surface_verdict_parity(es_pair, good_token):
     """One mixed vector batch; accept/reject must agree on every
     surface (CPU oracle / TPU batch / native prep / serve worker)."""
@@ -383,3 +405,156 @@ def test_four_surface_verdict_parity(es_pair, good_token):
             assert res[i]["iss"] == "https://example.com/"
         else:
             assert isinstance(res[i], RemoteVerifyError), f"serve {i}"
+
+
+# ---------------------------------------------------------------------------
+# Adversarial signature encodings (pinned golden vectors)
+# ---------------------------------------------------------------------------
+
+_GOLDEN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "clients", "go", "captpu", "testdata", "sig_conformance.json")
+
+
+@pytest.fixture(scope="module")
+def sig_golden():
+    with open(_GOLDEN_PATH) as f:
+        return json.load(f)
+
+
+def _split_vector(vec):
+    """(signing_input, sig_bytes, digest, want_accept) for one vector."""
+    from cap_tpu.jwt.jose import b64url_decode
+
+    h, p, s = vec["token"].split(".")
+    signing_input = (h + "." + p).encode()
+    return (signing_input, b64url_decode(s),
+            hashlib.sha256(signing_input).digest(),
+            vec["verdict"] == "accept")
+
+
+class TestSigEncodingGolden:
+    """The golden vectors' verdicts pin go-jose → Go stdlib semantics;
+    this class is dependency-free down to the device engines (pinned
+    tokens, host-integer keys — no ``cryptography`` needed), so the
+    encoding rules are enforced in EVERY environment. The four-surface
+    differential below re-pins them through the full jwt/serve stack
+    where the crypto fixtures exist."""
+
+    def test_vector_inventory(self, sig_golden):
+        names = [v["name"] for v in sig_golden["vectors"]]
+        assert len(names) == len(set(names))
+        # The VERDICT r5 checklist is present.
+        for required in ("es256-high-s", "es256-der-encoded",
+                         "es256-der-trailing-garbage",
+                         "es256-sig-63-bytes", "es256-sig-65-bytes",
+                         "rs256-leading-zero-stripped"):
+            assert required in names, required
+        # Each family carries its accept control.
+        verdicts = {v["name"]: v["verdict"] for v in sig_golden["vectors"]}
+        assert verdicts["es256-valid"] == "accept"
+        assert verdicts["rs256-valid"] == "accept"
+        assert verdicts["rs256-leading-zero-full-width"] == "accept"
+
+    def test_all_tokens_parse_as_jws(self, sig_golden):
+        # Structurally the vectors are well-formed compact JWS: the
+        # reject must come from the SIGNATURE layer, never the parser
+        # — with ONE exception: an empty signature segment is "token
+        # must be signed" at parse time (go-jose ParseSigned parity),
+        # which is equally a reject.
+        from cap_tpu.errors import TokenNotSignedError
+
+        out = prep.prepare_batch([v["token"] for v in
+                                  sig_golden["vectors"]])
+        for v, r in zip(sig_golden["vectors"], out):
+            if v["name"] == "es256-sig-empty":
+                assert isinstance(r, TokenNotSignedError)
+            else:
+                assert not isinstance(r, Exception), \
+                    f"{v['name']} failed parse: {r!r}"
+
+    def test_ec_engine_matches_pinned_verdicts(self, sig_golden):
+        import numpy as np
+
+        from cap_tpu.jwt.jose import b64url_decode
+        from cap_tpu.tpu import ec as tpuec
+
+        jwk = next(k for k in sig_golden["keys"]["keys"]
+                   if k["kty"] == "EC")
+        key = tpuec.HostECPublicKey(
+            "P-256",
+            int.from_bytes(b64url_decode(jwk["x"]), "big"),
+            int.from_bytes(b64url_decode(jwk["y"]), "big"))
+        table = tpuec.ECKeyTable("P-256", [key])
+        vecs = [v for v in sig_golden["vectors"] if v["alg"] == "ES256"]
+        parts = [_split_vector(v) for v in vecs]
+        got = tpuec.verify_ecdsa_batch(
+            table, [sig for _, sig, _, _ in parts],
+            [dig for _, _, dig, _ in parts],
+            np.zeros(len(parts), np.int64))
+        for v, (_, sig, dig, want), ok in zip(vecs, parts, got):
+            assert bool(ok) == want, \
+                f"device engine verdict for {v['name']}: {bool(ok)}"
+            if len(sig) == 64:
+                # host-integer oracle agrees on every full-width sig
+                assert tpuec._py_verify_one(table, 0, sig, dig) == want, \
+                    f"host oracle verdict for {v['name']}"
+            else:
+                # wrong-width sigs are rejected by the length gate on
+                # every surface (RFC 7518 §3.4 fixed width)
+                assert not want
+
+    def test_rsa_engine_matches_pinned_verdicts(self, sig_golden):
+        import numpy as np
+
+        from cap_tpu.jwt.jose import b64url_decode
+        from cap_tpu.tpu import rsa as tpursa
+
+        jwk = next(k for k in sig_golden["keys"]["keys"]
+                   if k["kty"] == "RSA")
+        n = int.from_bytes(b64url_decode(jwk["n"]), "big")
+        e = int.from_bytes(b64url_decode(jwk["e"]), "big")
+        table = tpursa.RSAKeyTable([(n, e)])
+        vecs = [v for v in sig_golden["vectors"] if v["alg"] == "RS256"]
+        parts = [_split_vector(v) for v in vecs]
+        got = tpursa.verify_pkcs1v15_batch(
+            table, [sig for _, sig, _, _ in parts],
+            [dig for _, _, dig, _ in parts], "sha256",
+            np.zeros(len(parts), np.int64))
+        for v, (_, _, _, want), ok in zip(vecs, parts, got):
+            assert bool(ok) == want, \
+                f"device engine verdict for {v['name']}: {bool(ok)}"
+
+
+@needs_crypto
+def test_sig_encoding_four_surface_parity(sig_golden):
+    """Golden vectors through the full stack: CPU oracle, TPU batch,
+    native prep, serve worker — every verdict pinned."""
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+    from cap_tpu.serve.client import RemoteVerifyError, VerifyClient
+    from cap_tpu.serve.worker import VerifyWorker
+
+    jwks = parse_jwks(sig_golden["keys"])
+    tokens = [v["token"] for v in sig_golden["vectors"]]
+    wants = [v["verdict"] == "accept" for v in sig_golden["vectors"]]
+
+    oracle = StaticKeySet([j.key for j in jwks]).verify_batch(tokens)
+    tpu = TPUBatchKeySet(jwks).verify_batch(tokens)
+    for v, o, t, want in zip(sig_golden["vectors"], oracle, tpu, wants):
+        assert (not isinstance(o, Exception)) == want, \
+            f"oracle {v['name']}"
+        assert (not isinstance(t, Exception)) == want, f"tpu {v['name']}"
+        if want:
+            assert o == t, f"claims mismatch {v['name']}"
+
+    w = VerifyWorker(TPUBatchKeySet(jwks), target_batch=16,
+                     max_wait_ms=5.0)
+    try:
+        host, port = w.address
+        with VerifyClient(host, port, timeout=600.0) as c:
+            res = c.verify_batch(tokens)
+    finally:
+        w.close()
+    for v, r, want in zip(sig_golden["vectors"], res, wants):
+        assert (not isinstance(r, RemoteVerifyError)) == want, \
+            f"serve {v['name']}"

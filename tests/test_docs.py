@@ -157,3 +157,19 @@ def test_readme_quickstart_snippet_is_literal():
     ns: dict = {}
     exec(compile(m.group(1), "README.md#quickstart", "exec"), ns)
     assert ns["claims"]["iss"] == "https://example.com/"
+
+
+def test_example_fleet_serving():
+    """docs/SERVE.md: spawn a supervised 2-worker fleet and route
+    through the failover client (stub engine — the same example with
+    ``keyset_spec="jwks:..."`` and a StaticKeySet fallback is the
+    production shape)."""
+    from cap_tpu.fleet import FleetClient, WorkerPool
+    from cap_tpu.fleet.worker_main import StubKeySet
+
+    with WorkerPool(2, keyset_spec="stub") as pool:
+        assert pool.wait_all_ready(30)
+        client = FleetClient(pool, fallback=StubKeySet())
+        res = client.verify_batch(["alice.ok", "mallory.bad"])
+        assert res[0] == {"sub": "alice.ok"}
+        assert isinstance(res[1], Exception)

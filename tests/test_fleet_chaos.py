@@ -1,0 +1,310 @@
+"""Chaos suite: the fleet availability contract under injected faults.
+
+For EVERY fault mode — worker kill -9 mid-batch, socket stall, black
+hole, corrupt response frame, delayed accepts — every submitted token
+must still receive its bit-exact-correct verdict (via failover or the
+terminal CPU-oracle fallback): **zero wrong verdicts, zero lost
+submissions**. Ground truth is the stub engine's deterministic rule
+(``*.ok`` verifies), shared between the workers and the fallback
+oracle, so a verdict is comparable wherever it was produced.
+
+Tier-1 discipline: stub workers (no jax import in children), every
+blocking primitive carries a timeout, and a SIGALRM watchdog gives
+each test a HARD deadline — a hung worker can never wedge the suite.
+"""
+
+import signal
+import threading
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet import FleetClient, WorkerPool
+from cap_tpu.fleet.chaos import ChaosProxy, kill9
+from cap_tpu.fleet.worker_main import StubKeySet
+
+pytestmark = pytest.mark.chaos
+
+HARD_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    """Per-test SIGALRM watchdog: a wedged socket/worker fails the
+    test instead of hanging the tier-1 run."""
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded hard {HARD_TIMEOUT_S}s timeout")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _expected(tokens):
+    """Ground truth: what every token's verdict MUST be."""
+    return [t.endswith(".ok") for t in tokens]
+
+
+def _assert_verdicts(tokens, results):
+    """Zero lost: one verdict per token. Zero wrong: accept/reject
+    matches ground truth exactly; accepted claims carry the token."""
+    assert len(results) == len(tokens), "lost submissions"
+    for t, r, want_ok in zip(tokens, results, _expected(tokens)):
+        if want_ok:
+            assert r == {"sub": t}, f"WRONG verdict for {t!r}: {r!r}"
+        else:
+            assert isinstance(r, Exception), \
+                f"WRONG verdict for {t!r}: accepted"
+
+
+@pytest.fixture
+def fleet():
+    """2 stub workers with ~80 ms of simulated device time per batch
+    (sleep releases the GIL), so a kill -9 lands MID-BATCH reliably."""
+    pool = WorkerPool(2, keyset_spec="stub:batch_ms=80",
+                      ping_interval=0.2, max_restarts=20,
+                      max_wait_ms=1.0)
+    assert pool.wait_all_ready(30), "fleet did not come up"
+    yield pool
+    pool.close()
+
+
+def _proxied_client(pool, proxies, **kw):
+    kw.setdefault("attempt_timeout", 2.0)
+    kw.setdefault("total_deadline", 30.0)
+    kw.setdefault("hedge_after", 0.5)
+    kw.setdefault("breaker_reset_s", 0.5)
+    kw.setdefault("rr_seed", 0)      # deterministic: first pick is p0
+    return FleetClient(lambda: [p.address for p in proxies],
+                       fallback=StubKeySet(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# fault: worker kill -9 mid-batch
+# ---------------------------------------------------------------------------
+
+def test_kill9_mid_batch_failover_and_respawn(fleet):
+    cl = FleetClient(fleet, fallback=StubKeySet(), attempt_timeout=2.0,
+                     total_deadline=30.0)
+    batches = [[f"k{i}-{j}.ok" for j in range(4)] + [f"k{i}-bad"]
+               for i in range(8)]
+    results = {}
+
+    def submit(i):
+        results[i] = cl.verify_batch(batches[i])
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(len(batches))]
+    victim = fleet.pid(0)
+    for t in threads:
+        t.start()
+    # Batches are in flight (80 ms simulated device time each): this
+    # SIGKILL lands mid-batch on worker 0.
+    time.sleep(0.05)
+    kill9(victim)
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "submission thread wedged"
+    for i, toks in enumerate(batches):
+        _assert_verdicts(toks, results[i])
+    # The pool detects the crash and respawns onto the same devices.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if (fleet.state(0) == "ready" and fleet.pid(0) != victim):
+            break
+        time.sleep(0.1)
+    assert fleet.state(0) == "ready" and fleet.pid(0) != victim
+    assert fleet.restarts(0) >= 1
+    # And the respawned worker serves.
+    _assert_verdicts(["post.ok"], cl.verify_batch(["post.ok"]))
+
+
+def test_kill9_sole_worker_falls_back_to_oracle():
+    pool = WorkerPool(1, keyset_spec="stub:batch_ms=200",
+                      ping_interval=0.2, max_restarts=20)
+    try:
+        assert pool.wait_all_ready(30)
+        with telemetry.recording() as rec:
+            cl = FleetClient(pool, fallback=StubKeySet(),
+                             attempt_timeout=1.0, total_deadline=8.0,
+                             max_rounds=2, breaker_reset_s=0.2)
+            done = {}
+
+            def submit():
+                done["res"] = cl.verify_batch(["solo.ok", "solo.bad"])
+
+            t = threading.Thread(target=submit)
+            t.start()
+            time.sleep(0.05)          # batch is on the "device"
+            kill9(pool.pid(0))
+            t.join(timeout=30)
+            assert not t.is_alive()
+        _assert_verdicts(["solo.ok", "solo.bad"], done["res"])
+        # With no peer to fail over to, the oracle produced the
+        # verdicts (or the respawned worker did — both are correct;
+        # the contract is verdicts, not the path).
+        c = rec.counters()
+        assert (c.get("fleet.fallback_tokens", 0) >= 2
+                or c.get("fleet.failovers", 0) >= 1)
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# fault: socket stall (bytes stop moving, connection stays open)
+# ---------------------------------------------------------------------------
+
+def test_stall_hedges_to_healthy_peer(fleet):
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        warm = _proxied_client(fleet, [p0, p1])
+        _assert_verdicts(["warm.ok"], warm.verify_batch(["warm.ok"]))
+        p0.stall()
+        # Fresh client: round-robin starts at p0, so the batch
+        # DETERMINISTICALLY hits the stalled path first.
+        cl = _proxied_client(fleet, [p0, p1])
+        with telemetry.recording() as rec:
+            tokens = [f"s{i}.ok" for i in range(4)] + ["s-bad"]
+            t0 = time.monotonic()
+            res = cl.verify_batch(tokens)
+            dt = time.monotonic() - t0
+        _assert_verdicts(tokens, res)
+        c = rec.counters()
+        # Either the hedge answered while the primary hung, or the
+        # primary timed out and failed over — both bounded, both right.
+        assert (c.get("fleet.hedges", 0) >= 1
+                or c.get("fleet.failovers", 0) >= 1)
+        assert dt < 10.0, f"stall cost {dt:.1f}s"
+
+
+def test_stall_everything_terminal_oracle(fleet):
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=1.0,
+                             total_deadline=10.0, max_rounds=2)
+        p0.stall()
+        p1.stall()
+        with telemetry.recording() as rec:
+            tokens = ["t1.ok", "t2.bad", "t3.ok"]
+            res = cl.verify_batch(tokens)
+        _assert_verdicts(tokens, res)
+        assert rec.counters().get("fleet.fallback_tokens", 0) == 3
+
+
+# ---------------------------------------------------------------------------
+# fault: black hole (bytes read and dropped)
+# ---------------------------------------------------------------------------
+
+def test_blackhole_one_worker_fails_over(fleet):
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=1.0)
+        p0.blackhole()
+        for i in range(3):
+            tokens = [f"b{i}.ok", f"b{i}-bad"]
+            _assert_verdicts(tokens, cl.verify_batch(tokens))
+        # Clearing the fault lets worker 0 rejoin (breaker half-open
+        # probe re-admits it after breaker_reset_s).
+        p0.clear()
+        time.sleep(0.6)
+        with telemetry.recording():
+            for i in range(4):
+                _assert_verdicts([f"c{i}.ok"],
+                                 cl.verify_batch([f"c{i}.ok"]))
+
+
+def test_blackhole_all_terminal_oracle(fleet):
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=1.0,
+                             total_deadline=10.0, max_rounds=2)
+        p0.blackhole()
+        p1.blackhole()
+        with telemetry.recording() as rec:
+            tokens = [f"bh{i}.ok" for i in range(5)]
+            res = cl.verify_batch(tokens)
+        _assert_verdicts(tokens, res)
+        assert rec.counters().get("fleet.fallback_batches", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# fault: corrupt response frame
+# ---------------------------------------------------------------------------
+
+def test_corrupt_response_frame_is_never_a_wrong_verdict(fleet):
+    """The deadliest corruption is a flipped STATUS byte (offset 9):
+    without the checksummed frames it would silently turn a verified
+    token into a rejection. With them it MUST surface as a transport
+    error and the verdict must come from a clean path. Sweep several
+    offsets through header, status, and payload bytes."""
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=2.0,
+                             hedge_after=None)
+        _assert_verdicts(["warm.ok"], cl.verify_batch(["warm.ok"]))
+        offsets = [0, 4, 9, 10, 14, 20]   # magic, type-ish, status,
+        with telemetry.recording() as rec:  # len, payload, payload
+            for n, off in enumerate(offsets):
+                p0.corrupt(direction="s2c", offset=off, xor=0x01,
+                           times=1)
+                p1.corrupt(direction="s2c", offset=off, xor=0x01,
+                           times=1)
+                tokens = [f"x{n}.ok", f"x{n}-bad", f"y{n}.ok"]
+                _assert_verdicts(tokens, cl.verify_batch(tokens))
+        # Every corruption was DETECTED (never absorbed): each batch
+        # needed at least one extra attempt or the oracle.
+        c = rec.counters()
+        detected = (c.get("fleet.failovers", 0)
+                    + c.get("fleet.fallback_batches", 0))
+        assert detected >= len(offsets), c
+
+
+def test_corrupt_request_frame_detected_worker_side(fleet):
+    """c2s corruption: the worker's CRC check rejects the request
+    (drops the connection) instead of verifying an altered token."""
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], hedge_after=None)
+        # Offset 30 lands inside the first token's bytes.
+        p0.corrupt(direction="c2s", offset=30, xor=0xFF, times=1)
+        p1.corrupt(direction="c2s", offset=30, xor=0xFF, times=1)
+        tokens = ["req-corrupt-a.ok", "req-corrupt-b.bad"]
+        with telemetry.recording() as rec:
+            _assert_verdicts(tokens, cl.verify_batch(tokens))
+        assert (rec.counters().get("fleet.failovers", 0)
+                + rec.counters().get("fleet.fallback_batches", 0)) >= 1
+
+
+# ---------------------------------------------------------------------------
+# fault: delayed accepts
+# ---------------------------------------------------------------------------
+
+def test_delayed_accepts_within_deadline(fleet):
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=3.0)
+        p0.delay_accept(0.4)
+        p1.delay_accept(0.4)
+        tokens = [f"da{i}.ok" for i in range(3)] + ["da-bad"]
+        res = cl.verify_batch(tokens)
+        _assert_verdicts(tokens, res)
+
+
+def test_delayed_accepts_beyond_deadline_oracle(fleet):
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=0.5,
+                             total_deadline=6.0, max_rounds=2,
+                             hedge_after=None)
+        p0.delay_accept(5.0)
+        p1.delay_accept(5.0)
+        tokens = ["slow.ok", "slow.bad"]
+        with telemetry.recording() as rec:
+            _assert_verdicts(tokens, cl.verify_batch(tokens))
+        assert rec.counters().get("fleet.fallback_tokens", 0) == 2

@@ -264,6 +264,190 @@ def test_worker_drops_malformed_frames_quietly(stub_worker):
         assert c.verify_batch(["z.ok"])[0] == {"sub": "z.ok"}
 
 
+class _FakeSock:
+    """Byte-buffer socket for parser-level frame tests."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._off = 0
+
+    def recv(self, n):
+        chunk = self._data[self._off:self._off + n]
+        self._off += len(chunk)
+        return chunk
+
+    def recv_into(self, view, n):
+        chunk = self.recv(n)
+        view[:len(chunk)] = chunk
+        return len(chunk)
+
+    def sendall(self, b):
+        self._data += b
+
+
+def _parse_bytes(data: bytes):
+    from cap_tpu.serve import protocol as P
+
+    return P.FrameReader(_FakeSock(data)).recv_frame()
+
+
+class TestFrameHardening:
+    """Satellite: bound-check length prefixes, reject oversized /
+    negative frames with TYPED errors instead of an allocation or
+    hang, validate status bytes and ping/pong counts."""
+
+    def test_oversized_entry_count_typed(self):
+        import struct
+
+        from cap_tpu.serve import protocol as P
+
+        data = struct.pack("<IBI", P.MAGIC, P.T_VERIFY_REQ,
+                           P.MAX_FRAME_ENTRIES + 1)
+        with pytest.raises(P.FrameTooLargeError, match="entries"):
+            _parse_bytes(data)
+
+    def test_negative_length_prefix_typed_no_allocation(self):
+        # 0xFFFFFFFF is "-1" to a careless i32 reader and a 4 GiB
+        # allocation to a careless parser; it must be a typed reject
+        # BEFORE any take/allocation of entry bytes.
+        import struct
+
+        from cap_tpu.serve import protocol as P
+
+        data = (struct.pack("<IBI", P.MAGIC, P.T_VERIFY_REQ, 1)
+                + struct.pack("<I", 0xFFFFFFFF))
+        with pytest.raises(P.FrameTooLargeError, match="bytes"):
+            _parse_bytes(data)
+
+    def test_aggregate_frame_cap(self):
+        import struct
+
+        from cap_tpu.serve import protocol as P
+
+        # Each entry is legal on its own; the SUM crosses the
+        # aggregate cap and must be rejected at the crossing entry.
+        n = P.MAX_FRAME_BYTES // P.MAX_ENTRY_BYTES + 1
+        parts = [struct.pack("<IBI", P.MAGIC, P.T_VERIFY_REQ, n)]
+        entry = b"\x00" * P.MAX_ENTRY_BYTES
+        for _ in range(n):
+            parts.append(struct.pack("<I", P.MAX_ENTRY_BYTES))
+            parts.append(entry)
+        with pytest.raises(P.FrameTooLargeError):
+            _parse_bytes(b"".join(parts))
+
+    def test_bad_magic_typed(self):
+        from cap_tpu.serve import protocol as P
+
+        with pytest.raises(P.MalformedFrameError, match="magic"):
+            _parse_bytes(b"\xde\xad\xbe\xef\x01\x00\x00\x00\x00")
+
+    def test_unknown_type_typed(self):
+        import struct
+
+        from cap_tpu.serve import protocol as P
+
+        with pytest.raises(P.MalformedFrameError, match="unknown"):
+            _parse_bytes(struct.pack("<IBI", P.MAGIC, 99, 0))
+
+    def test_ping_with_nonzero_count_rejected(self):
+        # A corrupt count on an entry-less frame would desync every
+        # later frame on the connection — reject it outright.
+        import struct
+
+        from cap_tpu.serve import protocol as P
+
+        data = struct.pack("<IBI", P.MAGIC, P.T_PING, 3)
+        with pytest.raises(P.MalformedFrameError, match="nonzero"):
+            _parse_bytes(data)
+
+    def test_bad_status_byte_rejected(self):
+        import struct
+
+        from cap_tpu.serve import protocol as P
+
+        data = (struct.pack("<IBI", P.MAGIC, P.T_VERIFY_RESP, 1)
+                + struct.pack("<BI", 7, 2) + b"{}")
+        with pytest.raises(P.MalformedFrameError, match="status"):
+            _parse_bytes(data)
+
+    def test_crc_roundtrip_and_every_byte_protected(self):
+        from cap_tpu.serve import protocol as P
+
+        sock = _FakeSock(b"")
+        P.send_response(sock, [{"sub": "a"}, ValueError("no")], crc=True)
+        frame = sock._data
+        ftype, entries = _parse_bytes(frame)
+        assert ftype == P.T_VERIFY_RESP_CRC
+        assert entries[0] == (0, b'{"sub":"a"}')
+        assert entries[1][0] == 1
+        # Flip EVERY byte in turn: each corruption must raise — a
+        # typed ProtocolError, or ConnectionError when the flip makes
+        # a length field overrun the buffered bytes. NEVER altered
+        # entries returned as data.
+        for off in range(len(frame)):
+            bad = bytearray(frame)
+            bad[off] ^= 0x01
+            with pytest.raises((P.ProtocolError, ConnectionError)):
+                _parse_bytes(bytes(bad))
+
+    def test_crc_request_roundtrip(self):
+        from cap_tpu.serve import protocol as P
+
+        sock = _FakeSock(b"")
+        P.send_request(sock, ["tok-a", "tok-b"], crc=True)
+        ftype, entries = _parse_bytes(sock._data)
+        assert ftype == P.T_VERIFY_REQ_CRC
+        assert entries == ["tok-a", "tok-b"]
+        bad = bytearray(sock._data)
+        bad[-6] ^= 0x40                  # inside the last token
+        with pytest.raises(P.FrameCorruptError):
+            _parse_bytes(bytes(bad))
+
+    def test_plain_frames_byte_identical_to_cvb1(self):
+        # The crc pair is ADDITIVE: default framing must stay exactly
+        # the golden-vector CVB1 bytes (Go/native clients).
+        from cap_tpu.serve import protocol as P
+
+        s1, s2 = _FakeSock(b""), _FakeSock(b"")
+        P.send_request(s1, ["x.y.z"])
+        P.send_request(s2, ["x.y.z"], crc=False)
+        assert s1._data == s2._data
+        assert s1._data[4] == P.T_VERIFY_REQ
+
+
+def test_worker_stats_op(stub_worker):
+    """Satellite: telemetry over the wire. The STATS op returns the
+    worker's queue depth, inflight, and telemetry snapshot in-order
+    with verifies on the same connection."""
+    _, w = stub_worker
+    host, port = w.address
+    with telemetry.recording():
+        with VerifyClient(host, port) as c:
+            c.verify_batch(["s1.ok", "s2.ok"])
+            st = c.stats()
+    assert st["queued_tokens"] == 0
+    assert st["inflight_batches"] == 0
+    assert st["counters"]["worker.tokens"] == 2
+    assert st["counters"]["worker.requests"] == 1
+    assert "batcher.batch_size" in st["series"]
+    assert st["pid"] > 0
+
+
+def test_crc_client_end_to_end(stub_worker):
+    """A crc=True client speaks the checksummed pair with the worker
+    and refuses a downgrade to plain frames."""
+    _, w = stub_worker
+    host, port = w.address
+    with VerifyClient(host, port, crc=True) as c:
+        res = c.verify_batch(["e.ok", "e.bad"])
+        assert res[0] == {"sub": "e.ok"}
+        assert isinstance(res[1], RemoteVerifyError)
+        # pipelined stream over crc frames too
+        outs = list(c.verify_stream(iter([["p1.ok"], ["p2.ok"]]),
+                                    depth=2))
+    assert [o[0]["sub"] for o in outs] == ["p1.ok", "p2.ok"]
+
+
 def test_batcher_max_wait_bounds_latency():
     """A lone submission flushes within ~max_wait_ms even though the
     batch-size target is never reached (the p99 bound of VERDICT r1

@@ -11,6 +11,21 @@ Env knobs: CAP_SERVE_CLIENTS (32), CAP_SERVE_REQ_TOKENS (64),
 CAP_SERVE_SECONDS (12 per point), CAP_SERVE_WAITS ("1,5,20"),
 CAP_SERVE_TARGET_BATCH (8192).
 
+FLEET MODE (``CAP_SERVE_FLEET="1,2"``): instead of one in-process
+worker, spin a ``WorkerPool`` per listed size under the single-owner
+placement model (one worker process per device group — NO chip
+sharing, fixing the VERDICT r5 shared-chip extrapolation) and drive it
+with ``FleetClient`` processes. Reports per-size throughput and the
+scaling ratio of the largest over the smallest size. Fleet knobs:
+``CAP_SERVE_FLEET_KEYSET`` (worker ``--keyset`` spec; default
+``stub:batch_ms=1,token_us=300`` — simulated device occupancy that
+sleeps WITHOUT the GIL so cross-process overlap is real even on a
+1-core host, sized so the WORKER is the bottleneck (the regime a
+fleet exists for; at ~100 µs/token and below, this host's single
+core saturates on the Python serve+client chains first and the
+measurement stops being about placement); use ``jwks:<path>`` for
+real engines on real hardware).
+
 Prints one JSON line on stdout: per-point results + the best-throughput
 point's p99 as the headline fields.
 """
@@ -138,7 +153,142 @@ def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
     }
 
 
+def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
+                       seed, outq):
+    """One closed-loop FleetClient PROCESS (own interpreter)."""
+    from cap_tpu.fleet import FleetClient
+
+    cl = FleetClient(endpoints, attempt_timeout=30.0,
+                     total_deadline=120.0)
+    lats = []
+    done = 0
+    rng = seed * 7919 + 17
+    while time.time() < start_at:
+        time.sleep(0.005)
+    deadline = time.time() + seconds
+    err = None
+    try:
+        while time.time() < deadline:
+            rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
+            lo = rng % max(1, len(tokens) - req_tokens)
+            t0 = time.perf_counter()
+            out = cl.verify_batch(tokens[lo: lo + req_tokens])
+            lats.append(time.perf_counter() - t0)
+            bad = sum(1 for r in out if isinstance(r, Exception))
+            assert bad == 0, f"unexpected failures: {bad}"
+            done += len(out)
+    except BaseException as e:  # noqa: BLE001 - reported to the parent
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        outq.put((done, lats, err))
+
+
+def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
+                    n_clients: int, req_tokens: int, seconds: float,
+                    max_wait_ms: float, target_batch: int) -> dict:
+    """Throughput of an n-worker fleet under single-owner placement."""
+    import multiprocessing as mp
+
+    from cap_tpu.fleet import WorkerPool
+
+    pool = WorkerPool(n_workers, keyset_spec=keyset_spec,
+                      target_batch=target_batch, max_wait_ms=max_wait_ms,
+                      ping_interval=1.0)
+    try:
+        if not pool.wait_all_ready(120.0):
+            raise RuntimeError("fleet did not come up")
+        endpoints = sorted(pool.endpoints().values())
+        ctx = mp.get_context("spawn")
+        outq = ctx.Queue()
+        start_at = time.time() + max(4.0, n_clients * 0.15)
+        procs = [ctx.Process(
+            target=_fleet_client_proc,
+            args=(endpoints, tokens, req_tokens, start_at, seconds, i,
+                  outq), daemon=True)
+            for i in range(n_clients)]
+        for p in procs:
+            p.start()
+        total, lats, errors = 0, [], []
+        for _ in procs:
+            d, ls, err = outq.get(timeout=seconds + 300)
+            total += d
+            lats.extend(ls)
+            if err:
+                errors.append(err)
+        for p in procs:
+            p.join(timeout=30)
+        if errors:
+            raise RuntimeError(f"fleet clients failed: {errors[:3]}")
+        stats = pool.stats()
+        served = {wid: (s or {}).get("counters", {}).get(
+            "worker.tokens", 0) for wid, s in stats.items()}
+    finally:
+        pool.close()
+    lats.sort()
+    return {
+        "n_workers": n_workers,
+        "keyset_spec": keyset_spec,
+        "clients": n_clients,
+        "req_tokens": req_tokens,
+        "throughput": round(total / seconds, 1),
+        "requests": len(lats),
+        "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
+        "p99_ms": round(_quantile(lats, 0.99) * 1e3, 1),
+        "per_worker_tokens": served,
+        "placement": {w: list(d) for w, d in
+                      pool.placement_map().items()},
+    }
+
+
+def fleet_main() -> None:
+    sizes = [int(s) for s in
+             os.environ["CAP_SERVE_FLEET"].split(",") if s]
+    keyset_spec = os.environ.get("CAP_SERVE_FLEET_KEYSET",
+                                 "stub:batch_ms=1,token_us=300")
+    n_clients = int(os.environ.get("CAP_SERVE_CLIENTS", 8))
+    req_tokens = int(os.environ.get("CAP_SERVE_REQ_TOKENS", 64))
+    seconds = float(os.environ.get("CAP_SERVE_SECONDS", 12))
+    max_wait_ms = float(os.environ.get("CAP_SERVE_WAITS", "2").split(",")[0])
+    target_batch = int(os.environ.get("CAP_SERVE_TARGET_BATCH", 8192))
+    if keyset_spec.startswith("stub"):
+        tokens = [f"bench-{i:06d}.ok" for i in range(16384)]
+    else:
+        from cap_tpu import testing as T
+
+        _, tokens = T.headline_fixtures(16384)
+
+    points = []
+    for n in sizes:
+        pt = run_fleet_point(n, keyset_spec, tokens, n_clients,
+                             req_tokens, seconds, max_wait_ms,
+                             target_batch)
+        points.append(pt)
+        print(f"fleet n={n}  thr={pt['throughput']:>9.0f}/s  "
+              f"p50={pt['p50_ms']:6.1f}ms p99={pt['p99_ms']:7.1f}ms  "
+              f"per-worker={pt['per_worker_tokens']}", file=sys.stderr)
+
+    best = max(points, key=lambda p: p["throughput"])
+    smallest = min(points, key=lambda p: p["n_workers"])
+    scaling = (round(best["throughput"] / smallest["throughput"], 3)
+               if smallest["throughput"] else None)
+    print(json.dumps({
+        "metric": "serve_fleet_verifies_per_sec",
+        "value": best["throughput"],
+        "unit": "verifies/sec",
+        "p99_request_latency_ms": best["p99_ms"],
+        "fleet_scaling_vs_smallest": scaling,
+        "placement_model": "single-owner-per-device",
+        "points": points,
+    }))
+
+
 def main() -> None:
+    if os.environ.get("CAP_SERVE_FLEET"):
+        # Fleet mode builds no in-process engine: workers own their
+        # devices exclusively (single-owner placement).
+        fleet_main()
+        return
+
     from cap_tpu import compile_cache
     from cap_tpu._build import build_native
 

@@ -1,15 +1,29 @@
 #!/usr/bin/env python3
-"""Generate CVB1 golden frames for the Go client's byte-parity tests.
+"""Generate golden vectors: CVB1 frames and adversarial JWS encodings.
 
 The Go toolchain is not available in this image, so the Go package's
 framing is pinned against the Python protocol implementation via these
 golden vectors: the Python side (the worker's source of truth) writes
 request/response frames to clients/go/captpu/testdata/, and
-captpu_test.go asserts byte equality / decode equality.
+captpu_test.go asserts byte equality / decode equality. The
+checksummed frame pair (types 7/8) and the STATS frames get their own
+golden files the same way.
+
+``sig_conformance.json`` pins the adversarial SIGNATURE-ENCODING
+vectors (VERDICT r5 open item): high-S ECDSA, DER-instead-of-raw and
+trailing-garbage ES signatures, wrong-length raw sigs, leading-zero-
+stripped RSA signatures — each with the verdict the reference's
+go-jose → Go stdlib path produces. Keys and nonces are FIXED
+constants, so regeneration is byte-stable; signing is pure host
+integer math (tpu/ec host signer + textbook RSA over pinned primes),
+so this tool runs with or without the ``cryptography`` package.
+tests/test_conformance.py pins all four verify surfaces to these
+verdicts.
 
 Run after any protocol change:  python tools/gen_go_golden.py
 """
 
+import hashlib
 import io
 import json
 import os
@@ -43,6 +57,203 @@ class _Sock:
         self.buf.write(b)
 
 
+# ---------------------------------------------------------------------------
+# adversarial signature-encoding conformance vectors
+# ---------------------------------------------------------------------------
+
+# Pinned P-256 private scalar (test-only, never a real credential).
+EC_D = 0x1B493A7B224D954F5D893F3A21DFD54DDBE14E1D4B83E339E2C0DCA70E7E2E01
+
+# Pinned RSA-2048 primes (deterministic Miller-Rabin search, seed
+# 0xCAB2024; test-only). e = 65537.
+RSA_P = int(
+    "ace2006657a2b4ad544d0954bce7d1e37fe4b537f74e7536c52c88ed72e7d62b"
+    "19667309bd9fcce4c3c45a07b260403087876c148c05d84a90f41273382f18fe"
+    "2fe198fc5e1384f492f9f24211adc82b229c1b6c7d9be2c160d02313df3d8212"
+    "2f2ae6b3828e8fac496ef4ac4f31be57336494bcd1a8c1529185aef89bfd52cf", 16)
+RSA_Q = int(
+    "fdc56bde8ee8d655b614f1fa82f5ffa6f0b479f4f299649af871d5ca93b6f481"
+    "a66aa8c2cef8626c86aefb50ab087d3865a849d759fe88c5cc833c7128be36a9"
+    "b250724e106bad3dfda7019d173cd51d2d3d18f70575ebd8bb2ae0eb0460d356"
+    "f5afbf9addee8354cd403e078aeb42382aeeada73f74170025ac5a3e10c1c5df", 16)
+RSA_E = 65537
+
+# Fixed claims: no timestamps derived at generation time (exp pinned
+# far future) so regeneration is byte-stable.
+CLAIMS = {"iss": "https://example.com/", "sub": "golden",
+          "aud": ["client-id"], "iat": 1700000000, "nbf": 1700000000,
+          "exp": 4102444800}
+
+_SHA256_DIGESTINFO = bytes.fromhex(
+    "3031300d060960864801650304020105000420")
+
+
+def _b64u(raw: bytes) -> str:
+    from cap_tpu.jwt.jose import b64url_encode
+
+    return b64url_encode(raw)
+
+
+def _signing_input(alg: str, kid: str, claims=CLAIMS) -> str:
+    header = {"alg": alg, "typ": "JWT", "kid": kid}
+    return (_b64u(json.dumps(header, separators=(",", ":")).encode())
+            + "." +
+            _b64u(json.dumps(claims, separators=(",", ":")).encode()))
+
+
+def _der_int(v: int) -> bytes:
+    raw = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+    if raw[0] & 0x80:
+        raw = b"\x00" + raw
+    return b"\x02" + bytes([len(raw)]) + raw
+
+
+def _der_sig(r: int, s: int) -> bytes:
+    body = _der_int(r) + _der_int(s)
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def _ec_vectors():
+    """ES256 adversarial encodings; (jwk, vectors)."""
+    from cap_tpu.tpu.ec import curve, host_ecdsa_sign, scalar_mult
+
+    cp = curve("P-256")
+    qx, qy = scalar_mult(cp, EC_D, (cp.gx, cp.gy))
+    jwk = {"kty": "EC", "crv": "P-256", "kid": "sig-es",
+           "x": _b64u(qx.to_bytes(32, "big")),
+           "y": _b64u(qy.to_bytes(32, "big"))}
+
+    si = _signing_input("ES256", "sig-es")
+    digest = hashlib.sha256(si.encode()).digest()
+    e = int.from_bytes(digest, "big")
+    # Deterministic test nonce (test fixtures only — NEVER a pattern
+    # for production signing, where k must be unpredictable).
+    k = (int.from_bytes(hashlib.sha256(b"golden-es-k").digest(),
+                        "big") % (cp.n - 2)) + 1
+    r, s = host_ecdsa_sign("P-256", EC_D, e, k)
+
+    def tok(sig_bytes: bytes) -> str:
+        return si + "." + _b64u(sig_bytes)
+
+    raw = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    high_s = r.to_bytes(32, "big") + (cp.n - s).to_bytes(32, "big")
+    vectors = [
+        {"name": "es256-valid", "alg": "ES256", "token": tok(raw),
+         "verdict": "accept", "note": "control: well-formed raw r||s"},
+        {"name": "es256-high-s", "alg": "ES256", "token": tok(high_s),
+         "verdict": "accept",
+         "note": "s' = n - s: Go crypto/ecdsa (the reference's "
+                 "verifier) does NOT enforce low-S; parity means we "
+                 "accept it on every surface too"},
+        {"name": "es256-der-encoded", "alg": "ES256",
+         "token": tok(_der_sig(r, s)), "verdict": "reject",
+         "note": "valid DER of a valid (r,s) — JOSE mandates raw "
+                 "fixed-width r||s (RFC 7518 §3.4); length != 64"},
+        {"name": "es256-der-trailing-garbage", "alg": "ES256",
+         "token": tok(_der_sig(r, s) + b"\x00\x17"), "verdict": "reject",
+         "note": "DER with trailing bytes"},
+        {"name": "es256-sig-63-bytes", "alg": "ES256",
+         "token": tok(raw[:-1]), "verdict": "reject",
+         "note": "last byte truncated (leading-zero-strip analog)"},
+        {"name": "es256-sig-65-bytes", "alg": "ES256",
+         "token": tok(raw + b"\x00"), "verdict": "reject",
+         "note": "one trailing garbage byte"},
+        {"name": "es256-sig-empty", "alg": "ES256", "token": tok(b""),
+         "verdict": "reject", "note": "empty signature segment"},
+        {"name": "es256-r-zero", "alg": "ES256",
+         "token": tok(b"\x00" * 32 + s.to_bytes(32, "big")),
+         "verdict": "reject", "note": "r = 0 outside [1, n-1]"},
+        {"name": "es256-s-zero", "alg": "ES256",
+         "token": tok(r.to_bytes(32, "big") + b"\x00" * 32),
+         "verdict": "reject", "note": "s = 0 outside [1, n-1]"},
+        {"name": "es256-r-equals-n", "alg": "ES256",
+         "token": tok(cp.n.to_bytes(32, "big") + s.to_bytes(32, "big")),
+         "verdict": "reject", "note": "r = n outside [1, n-1]"},
+        {"name": "es256-tampered-payload", "alg": "ES256",
+         "token": _signing_input("ES256", "sig-es",
+                                 dict(CLAIMS, sub="evil"))
+         + "." + _b64u(raw),
+         "verdict": "reject", "note": "valid sig, different payload"},
+    ]
+    return jwk, vectors
+
+
+def _rsa_pkcs1v15_sign(msg: bytes, n: int, d: int, k: int = 256) -> bytes:
+    h = hashlib.sha256(msg).digest()
+    t = _SHA256_DIGESTINFO + h
+    em = b"\x00\x01" + b"\xff" * (k - len(t) - 3) + b"\x00" + t
+    return pow(int.from_bytes(em, "big"), d, n).to_bytes(k, "big")
+
+
+def _rsa_vectors():
+    n = RSA_P * RSA_Q
+    d = pow(RSA_E, -1, (RSA_P - 1) * (RSA_Q - 1))
+    jwk = {"kty": "RSA", "kid": "sig-rs",
+           "n": _b64u(n.to_bytes(256, "big")),
+           "e": _b64u(b"\x01\x00\x01")}
+
+    si = _signing_input("RS256", "sig-rs")
+    sig = _rsa_pkcs1v15_sign(si.encode(), n, d)
+
+    # Find a claims tweak whose signature integer has a LEADING ZERO
+    # byte at full width — the encoding a sloppy signer would strip.
+    stripped = None
+    for i in range(10000):
+        si2 = _signing_input("RS256", "sig-rs",
+                             dict(CLAIMS, jti=f"lz-{i:04d}"))
+        sig2 = _rsa_pkcs1v15_sign(si2.encode(), n, d)
+        if sig2[0] == 0:
+            stripped = (si2, sig2)
+            break
+    assert stripped is not None, "no leading-zero signature in range"
+    si2, sig2 = stripped
+
+    def tok(inp: str, sig_bytes: bytes) -> str:
+        return inp + "." + _b64u(sig_bytes)
+
+    vectors = [
+        {"name": "rs256-valid", "alg": "RS256", "token": tok(si, sig),
+         "verdict": "accept", "note": "control: 256-byte signature"},
+        {"name": "rs256-leading-zero-full-width", "alg": "RS256",
+         "token": tok(si2, sig2), "verdict": "accept",
+         "note": "control: signature whose top byte IS 0x00, at full "
+                 "256-byte width — must verify"},
+        {"name": "rs256-leading-zero-stripped", "alg": "RS256",
+         "token": tok(si2, sig2[1:]), "verdict": "reject",
+         "note": "same signature with the leading zero STRIPPED "
+                 "(255 bytes): Go crypto/rsa and OpenSSL both demand "
+                 "len(sig) == modulus size"},
+        {"name": "rs256-sig-zero-extended", "alg": "RS256",
+         "token": tok(si, b"\x00" + sig), "verdict": "reject",
+         "note": "257 bytes: zero-extended beyond the modulus size"},
+        {"name": "rs256-tampered-payload", "alg": "RS256",
+         "token": _signing_input("RS256", "sig-rs",
+                                 dict(CLAIMS, sub="evil"))
+         + "." + _b64u(sig),
+         "verdict": "reject", "note": "valid sig, different payload"},
+    ]
+    return jwk, vectors
+
+
+def write_sig_conformance(out_dir: str) -> str:
+    ec_jwk, ec_vecs = _ec_vectors()
+    rsa_jwk, rsa_vecs = _rsa_vectors()
+    doc = {
+        "comment": "Adversarial signature-encoding conformance "
+                   "vectors. Verdicts pin go-jose -> Go stdlib "
+                   "semantics; every cap_tpu verify surface must "
+                   "match them bit-for-bit. Keys are fixed TEST "
+                   "fixtures (never real credentials).",
+        "keys": {"keys": [ec_jwk, rsa_jwk]},
+        "vectors": ec_vecs + rsa_vecs,
+    }
+    path = os.path.join(out_dir, "sig_conformance.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
 def main():
     os.makedirs(OUT, exist_ok=True)
     s = _Sock()
@@ -65,6 +276,26 @@ def main():
     with open(os.path.join(OUT, "pong.bin"), "wb") as f:
         f.write(s.buf.getvalue())
 
+    # Checksummed frame pair (types 7/8) + STATS frames: separate
+    # golden files; the classic CVB1 files above stay byte-identical.
+    s = _Sock()
+    protocol.send_request(s, TOKENS, crc=True)
+    with open(os.path.join(OUT, "request_crc.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    protocol.send_response(s, RESULTS, crc=True)
+    with open(os.path.join(OUT, "response_crc.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    protocol.send_stats_request(s)
+    with open(os.path.join(OUT, "stats_request.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    protocol.send_stats_response(
+        s, {"pid": 7, "queued_tokens": 0, "inflight_batches": 1})
+    with open(os.path.join(OUT, "stats_response.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
     meta = {
         "tokens": TOKENS,
         "results": [
@@ -75,7 +306,9 @@ def main():
     }
     with open(os.path.join(OUT, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1, ensure_ascii=False)
-    print(f"golden vectors written to {OUT}")
+
+    sig_path = write_sig_conformance(OUT)
+    print(f"golden vectors written to {OUT} (+ {os.path.basename(sig_path)})")
 
 
 if __name__ == "__main__":
