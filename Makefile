@@ -26,7 +26,7 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench clean obs-smoke
 
 all: native
 
@@ -52,3 +52,10 @@ test-all: native
 
 golden-go:
 	python tools/gen_go_golden.py
+
+# Observability smoke: boot a 2-worker stub fleet, scrape /metrics +
+# /snapshot + /flight, fail on missing/NaN required gauges or a traced
+# request that reached no flight recorder. Stub workers only — no jax
+# import in the children, fits the tier-1 time budget.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/obs_smoke.py

@@ -200,6 +200,17 @@ def main() -> None:
             return
     telemetry.disable()
     all_counters = rec.counters()
+    # Stage attribution (the observability layer's per-stage p50/95/99
+    # from bounded histograms): prep, per-family dispatch, device sync,
+    # claims — the BENCH record now explains WHERE the time went, not
+    # just the headline rate.
+    stage_latency = {
+        name: {"count": int(s["count"]), "p50": round(s["p50"], 6),
+               "p95": round(s["p95"], 6), "p99": round(s["p99"], 6)}
+        for name, s in sorted(rec.summary().items())
+    }
+    pad_gauges = {k: round(v, 4) for k, v in sorted(rec.gauges().items())
+                  if k.startswith("device.")}
     h2d_bytes = all_counters.get("h2d.bytes", 0)
     # Fleet/serve health counters ride along in the BENCH record (the
     # retry/failover/stall story of the run, zero when nothing fired):
@@ -283,6 +294,12 @@ def main() -> None:
         # window (fleet.failovers, fleet.fallback_tokens, worker.*,
         # batcher.* — empty dict = clean run, nothing fired).
         "health_counters": health_counters,
+        # Per-stage attribution from the telemetry histograms: every
+        # span observed during the measured window, p50/p95/p99 in
+        # seconds, plus per-family padding/lane gauges — the perf
+        # trajectory carries its own breakdown now.
+        "telemetry": {"stage_latency": stage_latency,
+                      "device_gauges": pad_gauges},
         "bytes_per_token": round(bytes_per_token, 1),
         "link_implied_ceiling_vps": round(link_ceiling, 1)
         if link_ceiling else None,

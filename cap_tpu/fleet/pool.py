@@ -64,6 +64,7 @@ class WorkerHandle:
         self.placement = placement
         self.proc: Optional[subprocess.Popen] = None
         self.address: Optional[Tuple[str, int]] = None
+        self.obs_address: Optional[Tuple[str, int]] = None
         self.state = STARTING
         self.restarts = 0
         self.ping_failures = 0
@@ -134,6 +135,14 @@ class WorkerPool:
             return {h.worker_id: h.address for h in self._handles
                     if h.state == READY and h.address is not None}
 
+    def obs_endpoints(self) -> Dict[int, Tuple[str, int]]:
+        """worker_id → (host, port) of every READY worker's HTTP
+        observability server (/metrics, /snapshot, /flight) — what
+        ``tools/capstat.py`` scrapes."""
+        with self._lock:
+            return {h.worker_id: h.obs_address for h in self._handles
+                    if h.state == READY and h.obs_address is not None}
+
     def address(self, worker_id: int) -> Optional[Tuple[str, int]]:
         with self._lock:
             return self._handles[worker_id].address
@@ -187,6 +196,35 @@ class WorkerPool:
             for h in self._handles:
                 out.setdefault(h.worker_id, None)
         return out
+
+    def stats_merged(self) -> dict:
+        """Per-worker STATS plus an EXACT fleet aggregate.
+
+        The per-worker payloads carry mergeable telemetry snapshots
+        (bucket counts), so the aggregate's p50/p95/p99 are those of
+        one recorder that had observed every worker's samples — not a
+        lossy average of per-worker quantiles.
+        """
+        workers = self.stats()
+        merged = telemetry.merge_snapshots(
+            [(s or {}).get("snapshot") for s in workers.values()])
+        return {
+            "workers": workers,
+            "aggregate": {
+                "snapshot": merged,
+                "series": telemetry.summarize_snapshot(merged),
+                "counters": merged["counters"],
+                "gauges": merged["gauges"],
+                "queued_tokens": sum(
+                    (s or {}).get("queued_tokens", 0)
+                    for s in workers.values()),
+                "inflight_batches": sum(
+                    (s or {}).get("inflight_batches", 0)
+                    for s in workers.values()),
+                "restarts": {h.worker_id: h.restarts
+                             for h in self._handles},
+            },
+        }
 
     def restart(self, worker_id: int, graceful: bool = True) -> None:
         """Respawn one worker onto its device group.
@@ -248,6 +286,7 @@ class WorkerPool:
         its stdout so a chatty child can never block on a full pipe."""
         deadline = time.monotonic() + self._spawn_timeout
         port = None
+        obs_port = None
         try:
             while time.monotonic() < deadline:
                 line = proc.stdout.readline()
@@ -258,6 +297,8 @@ class WorkerPool:
                         k, _, v = field.partition("=")
                         if k == "port":
                             port = int(v)
+                        elif k == "obs":
+                            obs_port = int(v)
                     break
         except (OSError, ValueError):
             port = None
@@ -269,6 +310,8 @@ class WorkerPool:
                 telemetry.count("fleet.spawn_failures")
             else:
                 h.address = (self._host, port)
+                h.obs_address = ((self._host, obs_port)
+                                 if obs_port else None)
                 h.state = READY
                 telemetry.count("fleet.workers_started")
         # Drain any further output (worker stays quiet normally).
@@ -279,18 +322,30 @@ class WorkerPool:
             pass
 
     def _ping(self, addr: Tuple[str, int]) -> bool:
+        t0 = time.perf_counter()
         try:
             with socket.create_connection(
                     addr, timeout=self._ping_timeout) as s:
                 s.settimeout(self._ping_timeout)
                 protocol.send_ping(s)
                 ftype, _ = protocol.recv_frame(s)
-                return ftype == protocol.T_PONG
+                if ftype == protocol.T_PONG:
+                    # Health-ping round trip: the supervisor's view of
+                    # worker responsiveness (a climbing p99 here is the
+                    # early signal before hung_after trips).
+                    telemetry.observe("fleet.ping_s",
+                                      time.perf_counter() - t0)
+                    return True
+                return False
         except (OSError, protocol.ProtocolError):
             return False
 
     def _supervise_loop(self) -> None:
         while not self._closed.wait(self._ping_interval):
+            with self._lock:
+                telemetry.gauge(
+                    "fleet.workers_ready",
+                    sum(1 for h in self._handles if h.state == READY))
             for h in list(self._handles):
                 if self._closed.is_set():
                     return

@@ -83,12 +83,16 @@ class _Attempt:
             pass
         self.reader = protocol.FrameReader(self.sock)
 
-    def run(self, tokens: Sequence[str]) -> List[Any]:
-        protocol.send_request(self.sock, tokens, crc=True)
-        ftype, entries = self.reader.recv_frame()
-        if ftype != protocol.T_VERIFY_RESP_CRC:
+    def run(self, tokens: Sequence[str],
+            trace: Optional[str] = None) -> List[Any]:
+        protocol.send_request(self.sock, tokens, crc=True, trace=trace)
+        ftype, entries, echo = self.reader.recv_frame_ex()
+        want = (protocol.T_VERIFY_RESP_TRACE if trace is not None
+                else protocol.T_VERIFY_RESP_CRC)
+        if ftype != want or (trace is not None and echo != trace):
             raise protocol.ProtocolError(
-                f"expected checksummed response, got type {ftype}")
+                f"expected checksummed response type {want}, got type "
+                f"{ftype}")
         if len(entries) != len(tokens):
             raise protocol.ProtocolError(
                 f"response count {len(entries)} != request {len(tokens)}")
@@ -178,9 +182,14 @@ class FleetClient:
     def _on_success(self, ep: Endpoint) -> None:
         with self._lock:
             br = self._breakers.setdefault(ep, _Breaker())
+            if br.open_until > time.monotonic():
+                # Half-open probe succeeded: the breaker CLOSES (the
+                # transition capstat renders alongside the opens).
+                telemetry.count("fleet.breaker_closes")
             br.failures = 0
             br.open_until = 0.0
             br.backoff = 0.0
+            self._breaker_gauge_locked()
 
     def _on_failure(self, ep: Endpoint) -> None:
         telemetry.count("fleet.attempt_failures")
@@ -191,16 +200,36 @@ class FleetClient:
                 if br.open_until <= time.monotonic():
                     telemetry.count("fleet.breaker_opens")
                 br.open_until = time.monotonic() + self._breaker_reset_s
+            self._breaker_gauge_locked()
+
+    def _breaker_gauge_locked(self) -> None:
+        now = time.monotonic()
+        telemetry.gauge("fleet.breakers_open",
+                        sum(1 for b in self._breakers.values()
+                            if b.open_until > now))
 
     # -- verify ----------------------------------------------------------
 
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
         """Claims dict per verified token; RemoteVerifyError (or the
         fallback's per-token error) per rejected token. Raises only
-        :class:`FleetExhaustedError` (whole batch, no fallback)."""
+        :class:`FleetExhaustedError` (whole batch, no fallback).
+
+        When the caller holds a ``telemetry.trace()`` scope, the whole
+        submission is spanned (``client.submit``), every attempt /
+        hedge / backoff / fallback stage records a span against the
+        trace id, and the id crosses the wire in the traced CVB1
+        frame pair so the worker's stage spans join the same timeline.
+        """
         tokens = list(tokens)
         if not tokens:
             return []
+        with telemetry.span(telemetry.SPAN_CLIENT_SUBMIT):
+            return self._verify_batch_routed(
+                tokens, telemetry.current_trace())
+
+    def _verify_batch_routed(self, tokens: List[str],
+                             trace: Optional[str]) -> List[Any]:
         deadline = time.monotonic() + self._total_deadline
         tried_this_round: List[Endpoint] = []
         rounds = 0
@@ -216,7 +245,8 @@ class FleetClient:
                 telemetry.count("fleet.retry_rounds")
                 if time.monotonic() + sleep >= deadline:
                     break
-                time.sleep(sleep)
+                with telemetry.span(telemetry.SPAN_ROUTER_BACKOFF):
+                    time.sleep(sleep)
                 continue
             tried_this_round.append(ep)
             budget = min(self._attempt_timeout,
@@ -224,14 +254,16 @@ class FleetClient:
             if budget <= 0:
                 break
             try:
-                res = self._attempt_hedged(ep, tokens, budget,
-                                           tried_this_round)
-                self._on_success(ep)
-                return res
+                # Success credit happens INSIDE the attempt, to the
+                # endpoint that actually answered: crediting ``ep``
+                # here would reset a stalled primary's breaker on
+                # every hedge win, keeping it permanently half-dead.
+                return self._attempt_hedged(ep, tokens, budget,
+                                            tried_this_round, trace)
             except (OSError, protocol.ProtocolError):
                 self._on_failure(ep)
                 telemetry.count("fleet.failovers")
-        return self._terminal_fallback(tokens)
+        return self._terminal_fallback(tokens, trace)
 
     def verify_signature(self, token: str) -> Any:
         res = self.verify_batch([token])[0]
@@ -242,37 +274,54 @@ class FleetClient:
     # -- internals --------------------------------------------------------
 
     def _attempt_once(self, ep: Endpoint, tokens: Sequence[str],
-                      budget: float) -> List[Any]:
+                      budget: float,
+                      trace: Optional[str] = None,
+                      span_name: str = telemetry.SPAN_ROUTER_ATTEMPT
+                      ) -> List[Any]:
+        t0_wall = time.time()
+        t0 = time.perf_counter()
         at = _Attempt(ep, budget)
         try:
             at.sock.settimeout(budget)
-            return at.run(tokens)
+            return at.run(tokens, trace=trace)
         finally:
             at.close()
+            dur = time.perf_counter() - t0
+            telemetry.observe("router.attempt_s", dur)
+            if trace:
+                # Recorded explicitly: hedge attempts run on worker
+                # threads where the caller's context var doesn't flow.
+                telemetry.trace_span(trace, span_name, t0_wall, dur,
+                                     note=f"{ep[0]}:{ep[1]}")
 
     def _attempt_hedged(self, ep: Endpoint, tokens: Sequence[str],
-                        budget: float,
-                        tried: List[Endpoint]) -> List[Any]:
+                        budget: float, tried: List[Endpoint],
+                        trace: Optional[str] = None) -> List[Any]:
         """Primary attempt on ``ep``; if no answer after ``hedge_after``
         and a healthy peer exists, race a duplicate on the peer and
         take the first success (verify is deterministic → duplicate
         execution cannot change any verdict)."""
         hedge = self._hedge_after
         if hedge is None or hedge >= budget:
-            return self._attempt_once(ep, tokens, budget)
+            res = self._attempt_once(ep, tokens, budget, trace)
+            self._on_success(ep)
+            return res
 
         result_q: "List[Tuple[Endpoint, Any]]" = []
         done = threading.Condition()
         attempts: List[_Attempt] = []
 
-        def run_on(endpoint: Endpoint, timeout: float) -> None:
+        def run_on(endpoint: Endpoint, timeout: float,
+                   span_name: str = telemetry.SPAN_ROUTER_ATTEMPT) -> None:
             at = None
+            t0_wall = time.time()
+            t0a = time.perf_counter()
             try:
                 at = _Attempt(endpoint, timeout)
                 with done:
                     attempts.append(at)
                 at.sock.settimeout(timeout)
-                res = at.run(tokens)
+                res = at.run(tokens, trace=trace)
                 with done:
                     result_q.append((endpoint, res))
                     done.notify_all()
@@ -283,6 +332,13 @@ class FleetClient:
                 with done:
                     result_q.append((endpoint, e))
                     done.notify_all()
+            finally:
+                dur = time.perf_counter() - t0a
+                telemetry.observe("router.attempt_s", dur)
+                if trace:
+                    telemetry.trace_span(
+                        trace, span_name, t0_wall, dur,
+                        note=f"{endpoint[0]}:{endpoint[1]}")
 
         t0 = time.monotonic()
         threading.Thread(target=run_on, args=(ep, budget),
@@ -312,7 +368,8 @@ class FleetClient:
                             remaining = budget - elapsed
                             threading.Thread(
                                 target=run_on,
-                                args=(hedge_ep, remaining),
+                                args=(hedge_ep, remaining,
+                                      telemetry.SPAN_ROUTER_HEDGE),
                                 daemon=True,
                                 name="cap-tpu-fleet-hedge").start()
                             launched = 2
@@ -334,12 +391,16 @@ class FleetClient:
             for at in pending:
                 at.close()
 
-    def _terminal_fallback(self, tokens: List[str]) -> List[Any]:
+    def _terminal_fallback(self, tokens: List[str],
+                           trace: Optional[str] = None) -> List[Any]:
         if self._fallback is None:
             raise FleetExhaustedError()
         telemetry.count("fleet.fallback_batches")
         telemetry.count("fleet.fallback_tokens", len(tokens))
-        return self._fallback.verify_batch(tokens)
+        # Runs in-caller, so the trace context is still active: any
+        # engine spans inside the oracle attach to the same timeline.
+        with telemetry.span(telemetry.SPAN_ROUTER_FALLBACK):
+            return self._fallback.verify_batch(tokens)
 
     # -- observability ----------------------------------------------------
 
@@ -349,6 +410,19 @@ class FleetClient:
             return {ep: {"failures": br.failures,
                          "open_for_s": max(0.0, br.open_until - now)}
                     for ep, br in self._breakers.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Client-side observability bundle for ``tools/capstat.py``:
+        the process recorder's mergeable snapshot (router counters,
+        attempt latency histograms, breaker gauges) plus the live
+        per-endpoint breaker states keyed ``host:port``."""
+        rec = telemetry.active()
+        return {
+            "snapshot": rec.snapshot() if rec is not None else {},
+            "spans": rec.trace_spans() if rec is not None else [],
+            "breakers": {f"{ep[0]}:{ep[1]}": st
+                         for ep, st in self.breaker_states().items()},
+        }
 
     def close(self) -> None:
         pass                           # attempts own their sockets

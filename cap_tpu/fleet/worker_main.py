@@ -114,6 +114,9 @@ def main(argv=None) -> int:
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--max-batch", type=int, default=32768)
     ap.add_argument("--drain-deadline-s", type=float, default=30.0)
+    # Observability server (serve.obs): 0 = ephemeral port (default),
+    # -1 = disabled. The bound port is announced on the ready line.
+    ap.add_argument("--obs-port", type=int, default=0)
     args = ap.parse_args(argv)
 
     from .. import telemetry
@@ -124,11 +127,17 @@ def main(argv=None) -> int:
     worker = VerifyWorker(keyset, host=args.host, port=args.port,
                           target_batch=args.target_batch,
                           max_wait_ms=args.max_wait_ms,
-                          max_batch=args.max_batch)
+                          max_batch=args.max_batch,
+                          obs_port=(None if args.obs_port < 0
+                                    else args.obs_port))
     host, port = worker.address
+    obs = worker.obs_address
     # The ONE ready line the pool parses; flushed so it cannot sit in a
-    # stdio buffer while the pool's spawn timeout burns.
-    print(f"CAP_FLEET_READY port={port} pid={os.getpid()}", flush=True)
+    # stdio buffer while the pool's spawn timeout burns. Additive
+    # fields (obs=) ride the same k=v format the pool already skips
+    # when unknown.
+    print(f"CAP_FLEET_READY port={port} pid={os.getpid()}"
+          + (f" obs={obs[1]}" if obs is not None else ""), flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -60,6 +60,21 @@ def _pad_size(n: int, max_chunk: int) -> int:
     return min(size, max_chunk)
 
 
+def _pad_telemetry(family: str, m: int, pad: int) -> None:
+    """Per-family dispatch-lane accounting: how many device lanes each
+    chunk used (``pad``, the padded bucket size) and how many of them
+    were WASTE (padding rows verifying zeros). The fill-ratio
+    histogram plus the waste counter let the exposition surface show,
+    per family, how much device work the bucket rounding costs — the
+    per-stage occupancy attribution the FPGA/GPU engines in PAPERS.md
+    report, measured instead of assumed."""
+    telemetry.observe(f"device.{family}.lanes", pad)
+    telemetry.observe(f"device.{family}.fill_ratio", m / pad if pad else 0.0)
+    if pad > m:
+        telemetry.count(f"device.{family}.pad_waste_rows", pad - m)
+    telemetry.gauge(f"device.{family}.last_lanes", pad)
+
+
 def _pack_rsa_record(pb, table, kind: str, hash_name: str,
                      chunk: np.ndarray, crows: np.ndarray,
                      pad: int) -> np.ndarray:
@@ -921,6 +936,7 @@ class TPUBatchKeySet(KeySet):
                 m = len(chunk)
                 pad = _pad_size(m, chunk_n)
                 telemetry.count(f"device.{kind}.tokens", m)
+                _pad_telemetry(kind, m, pad)
                 with telemetry.span(f"dispatch.{kind}.{hash_name}"):
                     rec = _pack_rsa_record(pb, table, kind, hash_name,
                                            chunk, crows, pad)
@@ -972,6 +988,7 @@ class TPUBatchKeySet(KeySet):
             m = len(chunk)
             pad = _pad_size(m, chunk_n)
             telemetry.count("device.es.tokens", m)
+            _pad_telemetry("es", m, pad)
             with telemetry.span(f"dispatch.es.{crv}"):
                 rec = _pack_es_record(pb, table, chunk, crows,
                                       hash_len, pad)
@@ -1037,6 +1054,7 @@ class TPUBatchKeySet(KeySet):
                 key_idx = np.zeros(pad, np.int32)
                 key_idx[:m] = crows
                 telemetry.count(f"device.{kind}.tokens", m)
+                _pad_telemetry(kind, m, pad)
                 h2d = (sig_mat.nbytes + sig_lens.nbytes
                        + hash_mat.nbytes + key_idx.nbytes)
                 telemetry.count("h2d.bytes", h2d)
@@ -1086,6 +1104,7 @@ class TPUBatchKeySet(KeySet):
             key_idx = np.zeros(pad, np.int32)
             key_idx[:m] = crows
             telemetry.count("device.es.tokens", m)
+            _pad_telemetry("es", m, pad)
             h2d = (sig_mat.nbytes + sig_lens.nbytes + hash_mat.nbytes
                    + key_idx.nbytes)
             telemetry.count("h2d.bytes", h2d)
@@ -1129,6 +1148,7 @@ class TPUBatchKeySet(KeySet):
             msgs += [b""] * fill
             key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
             telemetry.count("device.ed.tokens", m)
+            _pad_telemetry("ed", m, pad)
             with telemetry.span("dispatch.ed25519"):
                 rec = tpued.ed_packed_records(table, sigs, msgs, key_idx)
                 telemetry.count("h2d.bytes", rec.nbytes)
@@ -1170,6 +1190,7 @@ class TPUBatchKeySet(KeySet):
             msgs += [b""] * fill
             key_idx = np.concatenate([crows, np.zeros(fill, np.int32)])
             telemetry.count("device.ed.tokens", m)
+            _pad_telemetry("ed", m, pad)
             h2d = (sum(len(x) for x in sigs)
                    + sum(len(x) for x in msgs) + key_idx.nbytes)
             telemetry.count("h2d.bytes", h2d)

@@ -1,0 +1,158 @@
+"""Observability exposition surface: /metrics, /snapshot, /flight.
+
+Every fleet worker (and anything else that wants one) can serve a tiny
+HTTP endpoint exposing the process's telemetry:
+
+- ``/metrics`` — Prometheus text format: counters (``_total``),
+  gauges, and histogram series as summaries (p50/p95/p99 quantile
+  labels + ``_sum``/``_count``), so any off-the-shelf scraper can
+  consume the fleet;
+- ``/snapshot`` — the MERGEABLE JSON snapshot
+  (:meth:`cap_tpu.telemetry.Recorder.snapshot` plus live extras such
+  as batcher depth): ``tools/capstat.py`` scrapes these and merges
+  them exactly (bucket counts add) rather than averaging quantiles;
+- ``/flight`` — the flight recorder: the N slowest recent TRACED
+  request timelines, each a list of span records, from which a
+  cross-process trace can be reassembled by joining on the 16-hex
+  trace id (``capstat.py --trace``);
+- ``/healthz`` — liveness.
+
+Redaction discipline: everything served here comes from the telemetry
+recorder, whose write boundary already rejects token-shaped names and
+scrubs notes (:func:`cap_tpu.telemetry.check_name`); the server adds
+no request-derived content of its own.
+
+The server is stdlib-only (``http.server`` on a daemon thread), binds
+127.0.0.1 by default, and costs nothing until scraped.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .. import telemetry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "cap_" + _NAME_RE.sub("_", name)
+
+
+def render_prometheus(snapshot: Dict[str, Any],
+                      extra_gauges: Optional[Dict[str, float]] = None
+                      ) -> str:
+    """Prometheus text exposition of a telemetry snapshot.
+
+    Counters → ``cap_<name>_total``; gauges (snapshot + extras) →
+    ``cap_<name>``; histogram series → summary: quantile-labelled
+    samples (computed from the log-scale buckets) plus _sum/_count.
+    """
+    lines = ["# TYPE cap_up gauge", "cap_up 1"]
+    for name, v in sorted((snapshot.get("counters") or {}).items()):
+        pn = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v}")
+    gauges = dict(snapshot.get("gauges") or {})
+    gauges.update(extra_gauges or {})
+    for name, v in sorted(gauges.items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {float(v):g}")
+    summaries = telemetry.summarize_snapshot(snapshot)
+    for name, s in sorted(summaries.items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q in ("0.5", "0.95", "0.99"):
+            key = "p" + str(int(float(q) * 100))
+            lines.append(f'{pn}{{quantile="{q}"}} {s[key]:.9g}')
+        lines.append(f"{pn}_sum {s['total']:.9g}")
+        lines.append(f"{pn}_count {int(s['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+class ObsServer:
+    """Serve the process's telemetry over HTTP (daemon thread).
+
+    extra: callable returning live numeric gauges to fold into every
+    scrape (the worker passes batcher depth/inflight); flight_n: how
+    many slowest timelines ``/flight`` returns.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 extra: Optional[Callable[[], Dict[str, float]]] = None,
+                 flight_n: int = 32):
+        self._extra = extra
+        self._flight_n = flight_n
+        obs = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):   # no stderr chatter
+                pass
+
+            def do_GET(self):               # noqa: N802 (stdlib API)
+                try:
+                    obs._respond(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="cap-tpu-obs")
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+    # -- handlers ---------------------------------------------------------
+
+    def _extras(self) -> Dict[str, float]:
+        try:
+            return dict(self._extra()) if self._extra is not None else {}
+        except Exception:  # noqa: BLE001 - a scrape must never 500 on it
+            return {}
+
+    def _respond(self, h: BaseHTTPRequestHandler) -> None:
+        rec = telemetry.active()
+        path = h.path.split("?", 1)[0]
+        if path == "/metrics":
+            snap = rec.snapshot() if rec is not None else {}
+            body = render_prometheus(snap, self._extras()).encode()
+            ctype = "text/plain; version=0.0.4"
+        elif path == "/snapshot":
+            body = json.dumps({
+                "snapshot": rec.snapshot() if rec is not None else {},
+                "extra": self._extras(),
+            }).encode()
+            ctype = "application/json"
+        elif path == "/flight":
+            entries = (rec.flight_slowest(self._flight_n)
+                       if rec is not None else [])
+            body = json.dumps({"slowest": entries}).encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body = b'{"ok": true}'
+            ctype = "application/json"
+        else:
+            h.send_response(404)
+            h.end_headers()
+            return
+        h.send_response(200)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)

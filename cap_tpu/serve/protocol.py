@@ -9,8 +9,14 @@ Frame layout (all integers little-endian):
     type    u8    1 = verify request, 2 = verify response, 3 = ping,
                   4 = pong, 5 = stats request, 6 = stats response,
                   7 = checksummed verify request,
-                  8 = checksummed verify response
+                  8 = checksummed verify response,
+                  9 = traced verify request,
+                  10 = traced verify response
     count   u32   number of entries
+    trace-context (types 9/10 only, between header and entries):
+      ctx_len u8   length of the trace-context field (1..64)
+      ctx     …    ctx_len bytes: the trace id, lowercase hex ASCII
+                   (16 chars as emitted by telemetry.new_trace_id)
     entries:
       request entry:   len u32, token bytes (UTF-8 compact JWS)
       response entry:  status u8 (0 = verified, 1 = rejected),
@@ -32,6 +38,19 @@ anywhere in either direction (status, lengths, payload) surfaces as
 clients (Go, native, VerifyClient default) keep the exact CVB1 bytes
 of types 1-4 — the golden vectors are unchanged.
 
+Types 9/10 are the TRACED variant of 7/8: same checksummed envelope
+plus one additive trace-context field between the header and the
+entries, so a request's 16-hex trace id crosses the process boundary
+and the worker's span records (batcher fill, device dispatch — see
+:mod:`cap_tpu.telemetry`) can be joined with the router's client-side
+spans into one cross-process timeline. A worker answers a traced
+request with a traced response echoing the same trace id. The field
+is validated AFTER the CRC matches (like status bytes) and must be
+lowercase-hex ASCII — it can never carry payload material. Frame
+types 1-8 are byte-identical to before this field existed
+(tests/test_conformance.py pins all of them against the committed
+golden vectors).
+
 Hardening stance: every length prefix is bound-checked BEFORE any
 allocation or read of entry bytes (a hostile or corrupt frame cannot
 make the parser allocate unbounded memory), and malformed values
@@ -52,7 +71,7 @@ import json
 import socket
 import struct
 import zlib
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 MAGIC = 0x31425643
 T_VERIFY_REQ = 1
@@ -63,12 +82,16 @@ T_STATS_REQ = 5
 T_STATS_RESP = 6
 T_VERIFY_REQ_CRC = 7
 T_VERIFY_RESP_CRC = 8
+T_VERIFY_REQ_TRACE = 9
+T_VERIFY_RESP_TRACE = 10
 
 _HDR = struct.Struct("<IBI")
 
 MAX_FRAME_ENTRIES = 1 << 20
 MAX_ENTRY_BYTES = 1 << 20
 MAX_FRAME_BYTES = 1 << 28        # aggregate cap: one frame ≤ 256 MiB
+MAX_TRACE_BYTES = 64             # trace-context field length bound
+_TRACE_HEX = frozenset(b"0123456789abcdef")
 
 
 class ProtocolError(Exception):
@@ -115,15 +138,30 @@ def _with_crc(parts: List[bytes]) -> List[bytes]:
     return parts
 
 
+def _trace_field(trace: str) -> bytes:
+    raw = trace.encode("ascii")
+    if not (0 < len(raw) <= MAX_TRACE_BYTES
+            and all(b in _TRACE_HEX for b in raw)):
+        raise MalformedFrameError(
+            f"invalid trace id ({len(raw)} bytes; must be 1..:"
+            f"{MAX_TRACE_BYTES} lowercase-hex chars)")
+    return bytes([len(raw)]) + raw
+
+
 def send_request(sock: socket.socket, tokens: Sequence[str],
-                 crc: bool = False) -> None:
-    ftype = T_VERIFY_REQ_CRC if crc else T_VERIFY_REQ
+                 crc: bool = False, trace: Optional[str] = None) -> None:
+    """trace: a telemetry trace id; selects the traced checksummed
+    frame (type 9) carrying the trace-context field."""
+    ftype = (T_VERIFY_REQ_TRACE if trace is not None
+             else T_VERIFY_REQ_CRC if crc else T_VERIFY_REQ)
     parts = [_HDR.pack(MAGIC, ftype, len(tokens))]
+    if trace is not None:
+        parts.append(_trace_field(trace))
     for t in tokens:
         raw = t.encode()
         parts.append(struct.pack("<I", len(raw)))
         parts.append(raw)
-    if crc:
+    if trace is not None or crc:
         _with_crc(parts)
     sock.sendall(b"".join(parts))
 
@@ -145,10 +183,15 @@ def _response_parts(ftype: int, results: Sequence[Any]) -> List[bytes]:
 
 
 def send_response(sock: socket.socket, results: Sequence[Any],
-                  crc: bool = False) -> None:
+                  crc: bool = False, trace: Optional[str] = None) -> None:
     """results: claims (dict, or the raw payload-JSON bytes the worker
-    verified — sent verbatim, zero re-serialization) or Exception."""
-    if crc:
+    verified — sent verbatim, zero re-serialization) or Exception.
+    trace: echo of the request's trace id (traced frame, type 10)."""
+    if trace is not None:
+        parts = _response_parts(T_VERIFY_RESP_TRACE, results)
+        parts.insert(1, _trace_field(trace))
+        _with_crc(parts)
+    elif crc:
         parts = _with_crc(_response_parts(T_VERIFY_RESP_CRC, results))
     else:
         parts = _response_parts(T_VERIFY_RESP, results)
@@ -185,17 +228,27 @@ def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
     (docs/PERF.md r5 serve projection); it stays for one-shot uses
     and as the simplest reference of the wire format.
     """
+    ftype, entries, _ = _parse_frame(lambda n: _recv_exact(sock, n))
+    return ftype, entries
+
+
+def recv_frame_ex(sock: socket.socket) -> Tuple[int, List[Any],
+                                                Optional[str]]:
+    """Like :func:`recv_frame`, also returning the trace id carried by
+    a traced frame (types 9/10; None for every other type)."""
     return _parse_frame(lambda n: _recv_exact(sock, n))
 
 
-def _parse_frame(take) -> Tuple[int, List[Any]]:
-    """Shared CVB1 frame parse over a ``take(n) -> bytes`` source.
+def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
+    """Shared CVB1 frame parse over a ``take(n) -> bytes`` source →
+    (type, entries, trace-id-or-None).
 
     Every length is validated BEFORE the corresponding ``take`` — the
     parser never allocates for an out-of-bounds prefix. Checksummed
-    frame types defer UTF-8 decoding and status validation until the
-    CRC trailer has matched, so a flipped byte anywhere in the frame
-    surfaces as :class:`FrameCorruptError`.
+    frame types defer UTF-8 decoding, status validation, and
+    trace-context validation until the CRC trailer has matched, so a
+    flipped byte anywhere in the frame surfaces as
+    :class:`FrameCorruptError`.
     """
     raw_take = take
     hdr = raw_take(_HDR.size)
@@ -204,7 +257,8 @@ def _parse_frame(take) -> Tuple[int, List[Any]]:
         raise MalformedFrameError(f"bad magic 0x{magic:08x}")
     if count > MAX_FRAME_ENTRIES:
         raise FrameTooLargeError(f"frame too large: {count} entries")
-    checksummed = ftype in (T_VERIFY_REQ_CRC, T_VERIFY_RESP_CRC)
+    checksummed = ftype in (T_VERIFY_REQ_CRC, T_VERIFY_RESP_CRC,
+                            T_VERIFY_REQ_TRACE, T_VERIFY_RESP_TRACE)
     if checksummed:
         crc_state = [zlib.crc32(hdr)]
 
@@ -213,18 +267,28 @@ def _parse_frame(take) -> Tuple[int, List[Any]]:
             crc_state[0] = zlib.crc32(b, crc_state[0])
             return b
 
+    trace_raw: Optional[bytes] = None
+    if ftype in (T_VERIFY_REQ_TRACE, T_VERIFY_RESP_TRACE):
+        (ctx_len,) = take(1)
+        if not 0 < ctx_len <= MAX_TRACE_BYTES:
+            raise MalformedFrameError(
+                f"trace-context length {ctx_len} outside 1..:"
+                f"{MAX_TRACE_BYTES}")
+        trace_raw = take(ctx_len)
+
     entries: List[Any] = []
     total = 0
     u32 = _LEN_U32.unpack
     bu32 = _LEN_BU32.unpack
-    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC):
+    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC, T_VERIFY_REQ_TRACE):
         for _ in range(count):
             (ln,) = u32(take(4))
             total += ln
             if ln > MAX_ENTRY_BYTES or total > MAX_FRAME_BYTES:
                 raise FrameTooLargeError(f"frame too large ({total} bytes)")
             entries.append(take(ln))
-    elif ftype in (T_VERIFY_RESP, T_VERIFY_RESP_CRC, T_STATS_RESP):
+    elif ftype in (T_VERIFY_RESP, T_VERIFY_RESP_CRC,
+                   T_VERIFY_RESP_TRACE, T_STATS_RESP):
         for _ in range(count):
             status, ln = bu32(take(5))
             if not checksummed and status not in (0, 1):
@@ -249,11 +313,18 @@ def _parse_frame(take) -> Tuple[int, List[Any]]:
         for e in entries:                   # deferred status validation
             if isinstance(e, tuple) and e[0] not in (0, 1):
                 raise MalformedFrameError(f"bad status byte {e[0]}")
-    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC):
+    trace: Optional[str] = None
+    if trace_raw is not None:
+        # Validated AFTER integrity, like status bytes: the field is a
+        # registered-charset identifier, never payload material.
+        if not all(b in _TRACE_HEX for b in trace_raw):
+            raise MalformedFrameError("trace-context not lowercase hex")
+        trace = trace_raw.decode("ascii")
+    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC, T_VERIFY_REQ_TRACE):
         # Token decode AFTER integrity: corruption inside a checksummed
         # frame can never masquerade as a different (valid) token.
         entries = [e.decode() for e in entries]
-    return ftype, entries
+    return ftype, entries, trace
 
 
 class FrameReader:
@@ -292,6 +363,12 @@ class FrameReader:
         return buf[off:off + n]
 
     def recv_frame(self) -> Tuple[int, List[Any]]:
+        ftype, entries, _ = self.recv_frame_ex()
+        return ftype, entries
+
+    def recv_frame_ex(self) -> Tuple[int, List[Any], Optional[str]]:
+        """(type, entries, trace-id-or-None) — the trace id is non-None
+        only for traced frames (types 9/10)."""
         out = _parse_frame(self._take)
         # Drop the consumed prefix so an idle connection never pins a
         # whole parsed frame (frames may be up to MAX_FRAME_BYTES).

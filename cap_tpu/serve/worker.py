@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 from typing import Optional, Tuple
 
 from .. import telemetry
@@ -49,7 +50,8 @@ class VerifyWorker:
     def __init__(self, keyset, host: str = "127.0.0.1", port: int = 0,
                  uds_path: Optional[str] = None,
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
-                 max_batch: int = 32768, raw_claims: bool = True):
+                 max_batch: int = 32768, raw_claims: bool = True,
+                 obs_port: Optional[int] = None):
         # Raw-claims passthrough: the response payload for a verified
         # token IS its claims JSON, and the signed payload bytes are
         # exactly that — building dicts only to re-serialize them
@@ -79,6 +81,17 @@ class VerifyWorker:
             self._addr = self._sock.getsockname()
         self._sock.listen(128)
         self._closed = False
+        # Observability surface (obs_port=None → off, 0 → ephemeral):
+        # Prometheus /metrics + mergeable /snapshot + /flight recorder
+        # (serve.obs). Extras are live batcher depth — present in every
+        # scrape even when the telemetry recorder is off.
+        self._obs = None
+        if obs_port is not None:
+            from .obs import ObsServer
+
+            self._obs = ObsServer(
+                host=host if uds_path is None else "127.0.0.1",
+                port=obs_port, extra=self._obs_gauges)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="cap-tpu-accept")
         self._accept_thread.start()
@@ -88,6 +101,17 @@ class VerifyWorker:
         """(host, port) for TCP, (path, 0) for UDS."""
         return self._addr
 
+    @property
+    def obs_address(self) -> Optional[Tuple[str, int]]:
+        """(host, port) of the HTTP observability server, if enabled."""
+        return self._obs.address if self._obs is not None else None
+
+    def _obs_gauges(self) -> dict:
+        d = self._batcher.depth()
+        return {"batcher.queued_tokens": d["queued_tokens"],
+                "batcher.inflight_batches": d["inflight_batches"],
+                "worker.pid": os.getpid()}
+
     def stats(self) -> dict:
         """Process-local load/health snapshot (the STATS op payload).
 
@@ -96,15 +120,22 @@ class VerifyWorker:
         and inflight come straight from the batcher either way.
         """
         rec = telemetry.active()
+        obs = self.obs_address
         return {
             "pid": os.getpid(),
             **self._batcher.depth(),
+            "obs_port": obs[1] if obs is not None else None,
             "counters": rec.counters() if rec is not None else {},
             "series": rec.summary() if rec is not None else {},
+            # Mergeable form: pool.stats_merged() adds bucket counts
+            # across workers for EXACT fleet-wide quantiles.
+            "snapshot": rec.snapshot() if rec is not None else {},
         }
 
     def close(self, deadline_s: float = 120.0) -> None:
         self._closed = True
+        if self._obs is not None:
+            self._obs.close()
         try:
             self._sock.close()
         except OSError:
@@ -158,7 +189,8 @@ class VerifyWorker:
         try:
             while True:
                 try:
-                    ftype, entries = reader.recv_frame()
+                    t_recv = time.time()
+                    ftype, entries, trace = reader.recv_frame_ex()
                 except (ConnectionError, OSError):
                     return
                 except (protocol.ProtocolError, UnicodeDecodeError):
@@ -168,21 +200,31 @@ class VerifyWorker:
                     telemetry.count("worker.protocol_errors")
                     return
                 if ftype == protocol.T_PING:
-                    respq.put(("pong", None))
+                    respq.put(("pong", None, None))
                     continue
                 if ftype == protocol.T_STATS_REQ:
-                    respq.put(("stats", None))
+                    respq.put(("stats", None, None))
                     continue
                 if ftype not in (protocol.T_VERIFY_REQ,
-                                 protocol.T_VERIFY_REQ_CRC):
+                                 protocol.T_VERIFY_REQ_CRC,
+                                 protocol.T_VERIFY_REQ_TRACE):
                     return  # protocol violation → drop the connection
                 telemetry.count("worker.requests")
                 telemetry.count("worker.tokens", len(entries))
-                # A checksummed request gets a checksummed response —
+                # A checksummed request gets a checksummed response, a
+                # traced one a traced response echoing its trace id —
                 # the fleet router's end-to-end integrity envelope.
+                if ftype == protocol.T_VERIFY_REQ_TRACE:
+                    pending = self._batcher.submit_nowait(entries,
+                                                          trace=trace)
+                    telemetry.trace_span(
+                        trace, telemetry.SPAN_WORKER_DEQUEUE, t_recv,
+                        time.time() - t_recv)
+                    respq.put(("batch_trace", pending, trace))
+                    continue
                 crc = ftype == protocol.T_VERIFY_REQ_CRC
                 respq.put(("batch_crc" if crc else "batch",
-                           self._batcher.submit_nowait(entries)))
+                           self._batcher.submit_nowait(entries), None))
         finally:
             respq.put(None)
             try:
@@ -198,7 +240,7 @@ class VerifyWorker:
                 return
             if broken:
                 continue              # discard; reader is winding down
-            kind, pending = item
+            kind, pending, trace = item
             try:
                 if kind == "pong":
                     protocol.send_pong(conn)
@@ -210,7 +252,8 @@ class VerifyWorker:
                 else:
                     pending.event.wait()
                     protocol.send_response(conn, pending.results,
-                                           crc=kind == "batch_crc")
+                                           crc=kind == "batch_crc",
+                                           trace=trace)
             except (ConnectionError, OSError):
                 # Connection broke mid-response: close it so the reader
                 # unblocks out of recv, then keep DRAINING until the

@@ -408,6 +408,142 @@ def test_four_surface_verdict_parity(es_pair, good_token):
 
 
 # ---------------------------------------------------------------------------
+# CVB1 wire golden vectors (byte-identical across protocol changes)
+# ---------------------------------------------------------------------------
+
+_TESTDATA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "clients", "go", "captpu", "testdata")
+
+
+def _golden(name: str) -> bytes:
+    with open(os.path.join(_TESTDATA, name), "rb") as f:
+        return f.read()
+
+
+class _CaptureSock:
+    def __init__(self):
+        self.chunks = []
+
+    def sendall(self, b):
+        self.chunks.append(bytes(b))
+
+    def value(self) -> bytes:
+        return b"".join(self.chunks)
+
+
+class TestWireGolden:
+    """The trace-context field (frame types 9/10) is ADDITIVE: every
+    plain frame type 1-8 must serialize byte-identically to the
+    committed golden vectors, forever. Regenerates each frame with
+    the exact inputs tools/gen_go_golden.py used and compares bytes
+    — a wire change that touches the old types fails here before it
+    can break a deployed Go/native client."""
+
+    TOKENS = ["eyJhbGciOiJSUzI1NiJ9.e30.c2ln", "a.b.c", ""]
+    TRACE_ID = "00112233aabbccdd"
+
+    def _results(self):
+        return [
+            {"iss": "https://example.com/", "aud": ["client-id"],
+             "n": 3},
+            InvalidSignatureError("no known key successfully "
+                                  "validated the token signature"),
+            {"sub": "alice", "unicode": "ü†✓"},
+        ]
+
+    def _regen(self):
+        from cap_tpu.serve import protocol
+
+        out = {}
+        for name, send in (
+            ("request.bin",
+             lambda s: protocol.send_request(s, self.TOKENS)),
+            ("response.bin",
+             lambda s: protocol.send_response(s, self._results())),
+            ("ping.bin", protocol.send_ping),
+            ("pong.bin", protocol.send_pong),
+            ("stats_request.bin", protocol.send_stats_request),
+            ("stats_response.bin",
+             lambda s: protocol.send_stats_response(
+                 s, {"pid": 7, "queued_tokens": 0,
+                     "inflight_batches": 1})),
+            ("request_crc.bin",
+             lambda s: protocol.send_request(s, self.TOKENS, crc=True)),
+            ("response_crc.bin",
+             lambda s: protocol.send_response(s, self._results(),
+                                              crc=True)),
+        ):
+            sock = _CaptureSock()
+            send(sock)
+            out[name] = sock.value()
+        return out
+
+    def test_plain_frames_1_to_8_byte_identical(self):
+        for name, blob in self._regen().items():
+            assert blob == _golden(name), \
+                f"{name} drifted from the committed golden bytes"
+
+    def test_trace_frames_match_golden(self):
+        from cap_tpu.serve import protocol
+
+        s = _CaptureSock()
+        protocol.send_request(s, self.TOKENS, trace=self.TRACE_ID)
+        assert s.value() == _golden("request_trace.bin")
+        s = _CaptureSock()
+        protocol.send_response(s, self._results(), trace=self.TRACE_ID)
+        assert s.value() == _golden("response_trace.bin")
+
+    def test_trace_frames_parse_back(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        for name, want_type in (
+                ("request_trace.bin", protocol.T_VERIFY_REQ_TRACE),
+                ("response_trace.bin", protocol.T_VERIFY_RESP_TRACE)):
+            buf = io.BytesIO(_golden(name))
+            ftype, entries, trace = protocol._parse_frame(buf.read)
+            assert ftype == want_type
+            assert trace == self.TRACE_ID
+            assert len(entries) == 3
+            assert buf.read() == b""       # trailer fully consumed
+        # request entries round-trip to the original tokens
+        buf = io.BytesIO(_golden("request_trace.bin"))
+        _, entries, _ = protocol._parse_frame(buf.read)
+        assert entries == self.TOKENS
+
+    def test_trace_frame_structure_is_additive(self):
+        """Type 9 == type 7 with the ctx field spliced in after the
+        header (and a recomputed trailer): byte-level proof the
+        change is additive."""
+        plain = _golden("request_crc.bin")
+        traced = _golden("request_trace.bin")
+        hdr = 9                                # <IBI
+        ctx = bytes([len(self.TRACE_ID)]) + self.TRACE_ID.encode()
+        # same body; type byte and trailer differ
+        assert traced[hdr + len(ctx):-4] == plain[hdr:-4]
+        assert traced[hdr:hdr + len(ctx)] == ctx
+        assert traced[4] == 9 and plain[4] == 7
+
+    def test_corrupt_trace_frame_detected(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        blob = bytearray(_golden("response_trace.bin"))
+        blob[14] ^= 0x01                       # a status-ish byte
+        with pytest.raises(protocol.ProtocolError):
+            protocol._parse_frame(io.BytesIO(bytes(blob)).read)
+
+    def test_meta_pins_trace_id(self):
+        with open(os.path.join(_TESTDATA, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["trace_id"] == self.TRACE_ID
+        assert meta["tokens"] == self.TOKENS
+
+
+# ---------------------------------------------------------------------------
 # Adversarial signature encodings (pinned golden vectors)
 # ---------------------------------------------------------------------------
 

@@ -296,6 +296,159 @@ def test_delayed_accepts_within_deadline(fleet):
         _assert_verdicts(tokens, res)
 
 
+# ---------------------------------------------------------------------------
+# cross-process tracing under faults (the observability acceptance bar)
+# ---------------------------------------------------------------------------
+
+# JWS-shaped stub tokens: the redaction sweep below must be able to
+# detect any leak of real-looking token material into telemetry.
+def _jws_tokens(prefix, n_ok=3):
+    toks = [f"eyJhbGciOiJSUzI1NiJ9.eyJzdWIiOiI{prefix}{i}In0.c2ln.ok"
+            for i in range(n_ok)]
+    toks.append(f"eyJhbGciOiJub25lIn0.eyJzdWIiOiI{prefix}In0.bad")
+    return toks
+
+
+def _no_payload_material(dumps, tokens):
+    frags = {"eyJ"}
+    for t in tokens:
+        frags.update(seg for seg in t.split(".") if len(seg) >= 8)
+    for frag in frags:
+        for i, d in enumerate(dumps):
+            assert frag not in d, \
+                f"payload material leaked into surface {i}"
+
+
+def _scrape_flights(pool):
+    """Every worker's /flight via its obs HTTP server."""
+    import json as _json
+    import urllib.request
+
+    out = {}
+    for wid, (host, port) in sorted(pool.obs_endpoints().items()):
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/flight", timeout=5) as r:
+            out[wid] = _json.load(r)["slowest"]
+    return out
+
+
+def test_traced_hedged_retry_reassembles_cross_process(fleet):
+    """A hedged-retry request under a stalled primary: the trace id
+    crosses the wire (CVB1 type 9/10), the surviving worker's flight
+    recorder holds the worker-side spans, and capstat reassembles the
+    full client → router → worker → batcher timeline. The breaker
+    transition shows up in capstat's fleet rendering. Zero payload
+    material anywhere."""
+    import json as _json
+
+    from tools import capstat
+
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        warm = _proxied_client(fleet, [p0, p1])
+        _assert_verdicts(["w.ok"], warm.verify_batch(["w.ok"]))
+        p0.stall()
+        # Long reset window: once the breaker opens it stays visibly
+        # open for the snapshot/rendering assertions below.
+        cl = _proxied_client(fleet, [p0, p1], breaker_threshold=2,
+                             breaker_reset_s=30.0)
+        tokens = _jws_tokens("hedge")
+        with telemetry.recording() as rec:
+            with telemetry.trace() as tid:
+                res = cl.verify_batch(tokens)
+            _assert_verdicts(tokens, res)
+            # Drive the stalled endpoint's breaker OPEN. The stalled
+            # primary's failure lands when its attempt socket times
+            # out (~attempt_timeout after each hedged batch), so keep
+            # offering batches until the transition is observed.
+            deadline = time.monotonic() + 30
+            while (rec.counters().get("fleet.breaker_opens", 0) < 1
+                   and time.monotonic() < deadline):
+                _assert_verdicts(["more.ok"],
+                                 cl.verify_batch(["more.ok"]))
+                time.sleep(0.2)
+            client_view = cl.snapshot()
+        c = rec.counters()
+        assert (c.get("fleet.hedges", 0) >= 1
+                or c.get("fleet.failovers", 0) >= 1)
+
+        # client-side spans of the traced request
+        names = {s["name"] for s in rec.trace_spans(tid)}
+        assert telemetry.SPAN_CLIENT_SUBMIT in names
+        assert telemetry.SPAN_ROUTER_ATTEMPT in names
+        if c.get("fleet.hedges", 0):
+            assert telemetry.SPAN_ROUTER_HEDGE in names
+
+        # worker-side spans: reassemble across every flight recorder
+        flights = _scrape_flights(fleet)
+        sources = [{"flight": fl} for fl in flights.values()]
+        sources.append({"spans": rec.trace_spans()})
+        spans = capstat.reassemble_trace(tid, sources)
+        got = {s["name"] for s in spans}
+        for stage in (telemetry.SPAN_CLIENT_SUBMIT,
+                      telemetry.SPAN_ROUTER_ATTEMPT,
+                      telemetry.SPAN_WORKER_DEQUEUE,
+                      telemetry.SPAN_BATCHER_FILL,
+                      telemetry.SPAN_BATCHER_FLUSH):
+            assert stage in got, f"stage {stage} missing from {got}"
+        timeline = capstat.render_trace(tid, spans)
+        assert tid in timeline
+
+        # capstat shows the breaker transition
+        assert c.get("fleet.breaker_opens", 0) >= 1
+        p0_ep = f"{p0.address[0]}:{p0.address[1]}"
+        assert client_view["breakers"][p0_ep]["open_for_s"] > 0
+        rendered = capstat.render_fleet({}, client_view)
+        assert "OPEN" in rendered and "breaker_opens=" in rendered
+
+        # redaction: nothing recorded carries payload material
+        _no_payload_material(
+            [timeline, rendered, _json.dumps(client_view),
+             _json.dumps(rec.trace_spans()),
+             _json.dumps(flights),
+             _json.dumps(rec.counters()), _json.dumps(rec.summary())],
+            tokens)
+
+
+def test_traced_terminal_fallback_full_timeline(fleet):
+    """Every worker stalled: the traced request's timeline must show
+    attempts on the (dead) fleet and the terminal CPU-oracle fallback
+    span — attribution for the 'at worst slow' contract."""
+    import json as _json
+
+    from tools import capstat
+
+    with ChaosProxy(lambda: fleet.address(0)) as p0, \
+            ChaosProxy(lambda: fleet.address(1)) as p1:
+        cl = _proxied_client(fleet, [p0, p1], attempt_timeout=1.0,
+                             total_deadline=10.0, max_rounds=2,
+                             hedge_after=None)
+        p0.stall()
+        p1.stall()
+        tokens = _jws_tokens("fb")
+        with telemetry.recording() as rec:
+            with telemetry.trace() as tid:
+                res = cl.verify_batch(tokens)
+        _assert_verdicts(tokens, res)
+        assert rec.counters().get("fleet.fallback_tokens", 0) == len(tokens)
+        spans = capstat.reassemble_trace(tid, [rec.trace_spans()])
+        names = [s["name"] for s in spans]
+        assert telemetry.SPAN_CLIENT_SUBMIT in names
+        assert names.count(telemetry.SPAN_ROUTER_ATTEMPT) >= 2  # both eps
+        assert telemetry.SPAN_ROUTER_FALLBACK in names
+        # the whole-request span covers the fallback span in time
+        sub = next(s for s in spans
+                   if s["name"] == telemetry.SPAN_CLIENT_SUBMIT)
+        fb = next(s for s in spans
+                  if s["name"] == telemetry.SPAN_ROUTER_FALLBACK)
+        assert sub["t0"] <= fb["t0"]
+        assert sub["dur"] >= fb["dur"]
+        _no_payload_material(
+            [capstat.render_trace(tid, spans),
+             _json.dumps(rec.trace_spans()),
+             _json.dumps(rec.summary())], tokens)
+
+
 def test_delayed_accepts_beyond_deadline_oracle(fleet):
     with ChaosProxy(lambda: fleet.address(0)) as p0, \
             ChaosProxy(lambda: fleet.address(1)) as p1:

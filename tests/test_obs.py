@@ -1,0 +1,245 @@
+"""Observability surface: obs HTTP server, capstat, redaction, traces.
+
+Tier-1, dependency-free: stub engines only (no crypto fixtures, no
+jax). Covers the exposition endpoints (/metrics Prometheus text,
+/snapshot mergeable JSON, /flight recorder), the capstat scraper /
+renderer / trace reassembler, and — the enforceable redaction
+satellite — a full traced verify (valid + malformed tokens) after
+which NO recorded metric name, gauge, span record, flight entry, or
+rendered output may contain token material (reference rule:
+/root/reference/oidc/config.go:20-31)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet import FleetClient
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.serve import obs as obs_mod
+from cap_tpu.serve.worker import VerifyWorker
+from tools import capstat
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _get(addr, path):
+    host, port = addr
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=5) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# prometheus rendering
+# ---------------------------------------------------------------------------
+
+def test_render_prometheus_counters_gauges_summaries():
+    rec = telemetry.Recorder()
+    rec.count("worker.requests", 3)
+    rec.gauge("fleet.breakers_open", 1)
+    for i in range(10):
+        rec.observe("batcher.batch_size", float(64 + i))
+    text = obs_mod.render_prometheus(rec.snapshot(),
+                                     {"batcher.queued_tokens": 5})
+    assert "cap_up 1" in text
+    assert "cap_worker_requests_total 3" in text
+    assert "cap_fleet_breakers_open 1" in text
+    assert "cap_batcher_queued_tokens 5" in text
+    assert 'cap_batcher_batch_size{quantile="0.5"}' in text
+    assert "cap_batcher_batch_size_count 10" in text
+    assert "cap_batcher_batch_size_sum" in text
+    # names sanitized for prometheus ('.' is illegal)
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert "." not in line.split("{")[0].split(" ")[0]
+
+
+def test_render_prometheus_empty_snapshot():
+    assert "cap_up 1" in obs_mod.render_prometheus({})
+
+
+# ---------------------------------------------------------------------------
+# obs server endpoints
+# ---------------------------------------------------------------------------
+
+def test_obs_server_endpoints():
+    srv = obs_mod.ObsServer(extra=lambda: {"batcher.queued_tokens": 0})
+    try:
+        with telemetry.recording() as rec:
+            rec.count("worker.requests")
+            rec.trace_span("ab12cd34ab12cd34", "batcher.fill", 1.0, 0.5)
+            rec.flight("ab12cd34ab12cd34", 0.5)
+            assert json.loads(_get(srv.address, "/healthz"))["ok"]
+            met = _get(srv.address, "/metrics")
+            assert "cap_worker_requests_total 1" in met
+            snap = json.loads(_get(srv.address, "/snapshot"))
+            assert snap["snapshot"]["counters"]["worker.requests"] == 1
+            assert snap["extra"]["batcher.queued_tokens"] == 0
+            fl = json.loads(_get(srv.address, "/flight"))
+            assert fl["slowest"][0]["trace"] == "ab12cd34ab12cd34"
+            assert fl["slowest"][0]["spans"][0]["name"] == "batcher.fill"
+        # telemetry off → still serves, just empty
+        met = _get(srv.address, "/metrics")
+        assert "cap_up 1" in met
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.address, "/nope")
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# traced verify through a real worker (in-process), full redaction
+# ---------------------------------------------------------------------------
+
+# JWS-shaped tokens: realistic base64url segments so any leak of
+# name/label/span/flight content is detectable by substring.
+VALID_TOK = ("eyJhbGciOiJSUzI1NiIsImtpZCI6InNlY3JldC1raWQifQ."
+             "eyJzdWIiOiJhbGljZUBleGFtcGxlLmNvbSJ9.c2lnbmF0dXJl.ok")
+BAD_TOK = ("eyJhbGciOiJub25lIn0."
+           "eyJzdWIiOiJtYWxsb3J5In0.Z2FyYmFnZQ")
+TRUNCATED_TOK = "eyJhbGciOiJSUzI1NiJ9"
+
+
+def _leak_fragments():
+    frags = {"eyJ"}
+    for t in (VALID_TOK, BAD_TOK, TRUNCATED_TOK):
+        frags.add(t)
+        for seg in t.split("."):
+            if len(seg) >= 8:
+                frags.add(seg)
+    return frags
+
+
+def test_traced_verify_records_no_token_material():
+    """Satellite: drive a FULL traced verify (valid + malformed) and
+    assert the redaction rule over EVERYTHING the observability layer
+    recorded or can render."""
+    worker = VerifyWorker(StubKeySet(), target_batch=4, max_wait_ms=1.0,
+                          obs_port=0)
+    try:
+        with telemetry.recording() as rec:
+            cl = FleetClient([worker.address], fallback=StubKeySet(),
+                             rr_seed=0)
+            with telemetry.trace() as tid:
+                out = cl.verify_batch([VALID_TOK, BAD_TOK, TRUNCATED_TOK])
+            assert out[0] == {"sub": VALID_TOK}
+            assert isinstance(out[1], Exception)
+            assert isinstance(out[2], Exception)
+            # every surface the layer can expose:
+            dumps = [
+                json.dumps(rec.counters()),
+                json.dumps(rec.gauges()),
+                json.dumps(rec.summary()),
+                json.dumps(rec.trace_spans()),
+                json.dumps(rec.flight_entries()),
+                json.dumps(rec.snapshot()),
+                json.dumps(cl.snapshot()),
+                _get(worker.obs_address, "/metrics"),
+                _get(worker.obs_address, "/snapshot"),
+                _get(worker.obs_address, "/flight"),
+                json.dumps(worker.stats()),
+            ]
+            for frag in _leak_fragments():
+                for i, d in enumerate(dumps):
+                    assert frag not in d, \
+                        f"token fragment leaked into surface {i}"
+            # and the trace DID flow: both sides of the timeline exist
+            names = {s["name"] for s in rec.trace_spans(tid)}
+            assert telemetry.SPAN_CLIENT_SUBMIT in names
+            assert telemetry.SPAN_ROUTER_ATTEMPT in names
+            assert telemetry.SPAN_WORKER_DEQUEUE in names
+            assert telemetry.SPAN_BATCHER_FILL in names
+            assert (telemetry.SPAN_BATCHER_FLUSH in names
+                    or telemetry.SPAN_BATCHER_DISPATCH in names)
+            flights = [e for e in rec.flight_entries()
+                       if e["trace"] == tid]
+            assert flights, "traced request missing from flight ring"
+    finally:
+        worker.close()
+
+
+# ---------------------------------------------------------------------------
+# capstat: scrape, render, reassemble
+# ---------------------------------------------------------------------------
+
+def test_capstat_scrape_render_and_reassemble():
+    worker = VerifyWorker(StubKeySet(), target_batch=4, max_wait_ms=1.0,
+                          obs_port=0)
+    try:
+        with telemetry.recording() as rec:
+            cl = FleetClient([worker.address], fallback=StubKeySet(),
+                             rr_seed=0)
+            with telemetry.trace() as tid:
+                cl.verify_batch(["a.ok", "b.ok"])
+            host, port = worker.obs_address
+            ep = f"{host}:{port}"
+            data = capstat.scrape(ep)
+            assert data["snapshot"]["counters"]["worker.requests"] >= 1
+            assert capstat.check_required({ep: data}) == []
+            rendered = capstat.render_fleet({ep: data}, cl.snapshot())
+            assert f"worker {ep}" in rendered
+            assert "fleet aggregate" in rendered
+            assert "router (client side)" in rendered
+            assert "eyJ" not in rendered
+            # cross-process reassembly: worker flight + client spans
+            spans = capstat.reassemble_trace(
+                tid, [data, {"spans": rec.trace_spans()}])
+            names = [s["name"] for s in spans]
+            assert telemetry.SPAN_CLIENT_SUBMIT in names
+            assert telemetry.SPAN_BATCHER_FILL in names
+            # ordered by wall-clock start
+            assert [s["t0"] for s in spans] == sorted(
+                s["t0"] for s in spans)
+            timeline = capstat.render_trace(tid, spans)
+            assert tid in timeline and "client.submit" in timeline
+    finally:
+        worker.close()
+
+
+def test_capstat_check_required_flags_gaps():
+    problems = capstat.check_required(
+        {"w0": {"extra": {"batcher.queued_tokens": float("nan")}}})
+    assert any("NaN" in p for p in problems)
+    assert any("missing" in p for p in problems)
+
+
+def test_observability_doc_pins_span_table():
+    """docs/OBSERVABILITY.md's registered span-name table and the
+    telemetry.SPAN_* constants are the same set — names cannot drift
+    in either direction without failing here."""
+    import os
+
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md")
+    with open(doc_path) as f:
+        doc = f.read()
+    for name in telemetry.SPAN_NAMES:
+        assert f"`{name}`" in doc, f"span {name} missing from doc table"
+    for const in ("SPAN_CLIENT_SUBMIT", "SPAN_ROUTER_HEDGE",
+                  "SPAN_BATCHER_FILL", "SPAN_WORKER_DEQUEUE"):
+        assert const in doc
+    # the engine prefix is documented too
+    assert telemetry.SPAN_ENGINE_PREFIX.rstrip(".") in doc
+
+
+def test_merge_preserves_fleet_totals():
+    a, b = telemetry.Recorder(), telemetry.Recorder()
+    a.count("worker.tokens", 10)
+    b.count("worker.tokens", 32)
+    for r, n in ((a, 100), (b, 50)):
+        for i in range(n):
+            r.observe("batcher.batch_size", float(i))
+    merged = telemetry.merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["counters"]["worker.tokens"] == 42
+    s = telemetry.summarize_snapshot(merged)["batcher.batch_size"]
+    assert s["count"] == 150
+    assert s["max"] == 99.0

@@ -11,9 +11,18 @@ import threading
 import pytest
 
 from cap_tpu import telemetry
-from cap_tpu import testing as captest
-from cap_tpu.jwt.jwk import JWK
-from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+try:
+    from cap_tpu import testing as captest
+    from cap_tpu.jwt.jwk import JWK
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+    _HAVE_CRYPTO = True
+except ModuleNotFoundError:          # crypto fixtures absent: the
+    captest = JWK = TPUBatchKeySet = None    # recorder tests still run
+    _HAVE_CRYPTO = False
+
+needs_crypto = pytest.mark.skipif(
+    not _HAVE_CRYPTO, reason="cryptography package not installed")
 
 
 @pytest.fixture(autouse=True)
@@ -88,6 +97,149 @@ def test_thread_safety():
     assert rec.summary()["v"]["count"] == 4000
 
 
+# ---------------------------------------------------------------------------
+# bounded metrics (the unbounded-_series footgun, fixed)
+# ---------------------------------------------------------------------------
+
+def test_memory_stays_bounded_after_1m_observations():
+    """The PR-2 Recorder kept EVERY observation in a list — a
+    long-running worker grew without bound. Now a series is a fixed
+    bucket array plus a capped reservoir: after 1M observations the
+    retained state is O(buckets), not O(observations)."""
+    rec = telemetry.Recorder()
+    for i in range(1_000_000):
+        rec.observe("hot", (i % 977) * 1e-5)
+    h = rec._series["hot"]
+    assert h.count == 1_000_000
+    assert h.raw is None                       # reservoir released
+    assert len(h.counts) == telemetry._N_BUCKETS
+    # total retained floats/ints for the series: buckets + moments,
+    # nowhere near the observation count.
+    assert len(h.counts) < 1000
+    # raw-sample surface reports empty rather than lying
+    assert rec.series("hot") == []
+    # quantiles still work, from the buckets (log-scale: ≤ ~9% error,
+    # uniform data over [0, 9.76e-3] → p50 ≈ 4.9e-3)
+    s = rec.summary()["hot"]
+    assert s["count"] == 1_000_000
+    assert 0.0035 < s["p50"] < 0.0065
+    assert s["max"] == pytest.approx(976e-5)
+
+
+def test_small_series_quantiles_stay_exact():
+    rec = telemetry.Recorder()
+    for i in range(100):
+        rec.observe("x", float(i))
+    # under the reservoir cap: exact, same as the PR-2 semantics
+    assert rec.summary()["x"]["p50"] == pytest.approx(50.0, abs=1)
+    assert rec.series("x") == [float(i) for i in range(100)]
+
+
+def test_gauges():
+    rec = telemetry.Recorder()
+    rec.gauge("depth", 7)
+    rec.gauge("depth", 3)
+    assert rec.gauges() == {"depth": 3.0}
+
+
+def test_snapshot_merge_is_exact():
+    """Fleet aggregation contract: merging two workers' snapshots
+    gives the same quantiles as one recorder that saw every sample
+    (bucket counts ADD; nothing is averaged)."""
+    a, b, ref = (telemetry.Recorder() for _ in range(3))
+    for i in range(5000):
+        v = 1e-4 * (1.3 ** (i % 30))
+        (a if i % 2 else b).observe("lat", v)
+        ref.observe("lat", v)
+        (a if i % 2 else b).count("n")
+        a.gauge("queued", 5)
+    merged = telemetry.merge_snapshots(
+        [a.snapshot(), b.snapshot(), None, {}])
+    summ = telemetry.summarize_snapshot(merged)["lat"]
+    # force the reference onto its buckets too (same resolution)
+    ref_h = ref._series["lat"]
+    ref_h.raw = None
+    for q, want in (("p50", ref_h.quantile(0.5)),
+                    ("p95", ref_h.quantile(0.95)),
+                    ("p99", ref_h.quantile(0.99))):
+        assert summ[q] == pytest.approx(want), q
+    assert summ["count"] == 5000
+    assert merged["counters"]["n"] == 5000
+    assert merged["gauges"]["queued"] == 5.0
+
+
+def test_metric_names_reject_token_material():
+    """Redaction at the WRITE boundary: a metric name that looks like
+    payload (JWS 'eyJ' prefix, whitespace, over-long) is refused."""
+    rec = telemetry.Recorder()
+    for bad in ("eyJhbGciOiJSUzI1NiJ9.e30.c2ln",
+                "lat " + "x" * 10,
+                "x" * 200):
+        with pytest.raises(ValueError, match="redaction"):
+            rec.count(bad)
+        with pytest.raises(ValueError, match="redaction"):
+            rec.observe(bad, 1.0)
+        with pytest.raises(ValueError, match="redaction"):
+            rec.gauge(bad, 1.0)
+    # notes are scrubbed, not raised (free-ish text)
+    assert telemetry.scrub_note("eyJabc") == "[redacted]"
+    assert telemetry.scrub_note("127.0.0.1:80") == "127.0.0.1:80"
+
+
+# ---------------------------------------------------------------------------
+# tracing + flight recorder
+# ---------------------------------------------------------------------------
+
+def test_trace_context_and_span_records():
+    with telemetry.recording() as rec:
+        assert telemetry.current_trace() is None
+        with telemetry.trace() as tid:
+            assert telemetry.valid_trace_id(tid) and len(tid) == 16
+            assert telemetry.current_trace() == tid
+            with telemetry.span("client.submit"):
+                pass
+        assert telemetry.current_trace() is None
+    spans = rec.trace_spans(tid)
+    assert [s["name"] for s in spans] == ["client.submit"]
+    assert spans[0]["dur"] >= 0.0
+    # the histogram observation happened too
+    assert rec.summary()["client.submit"]["count"] == 1
+
+
+def test_trace_scope_fans_out_to_batch_members():
+    rec = telemetry.Recorder()
+    with telemetry.recording(rec):
+        with telemetry.trace_scope(["aa00", "bb11"]):
+            with telemetry.span("batcher.dispatch"):
+                pass
+    assert len(rec.trace_spans("aa00")) == 1
+    assert len(rec.trace_spans("bb11")) == 1
+
+
+def test_flight_recorder_keeps_slowest_and_stays_bounded():
+    rec = telemetry.Recorder()
+    for i in range(1000):
+        tid = f"{i:016x}"
+        rec.trace_span(tid, "batcher.fill", float(i), 0.001)
+        rec.flight(tid, total_s=(i % 97) * 1e-3)
+    entries = rec.flight_entries()
+    assert len(entries) == telemetry.MAX_FLIGHT_ENTRIES
+    slowest = rec.flight_slowest(5)
+    assert len(slowest) == 5
+    assert all(e["total_s"] == 96e-3 for e in slowest[:1])
+    assert slowest[0]["total_s"] >= slowest[-1]["total_s"]
+
+
+def test_span_names_registered():
+    # the registered-constants table: every SPAN_* constant is in
+    # SPAN_NAMES, so docs and wire consumers can enumerate them
+    consts = {v for k, v in vars(telemetry).items()
+              if k.startswith("SPAN_") and isinstance(v, str)
+              and not k.endswith("_PREFIX")}
+    assert consts == set(telemetry.SPAN_NAMES)
+
+
+@needs_crypto
 def test_verify_batch_emits_stage_metrics():
     priv, pub = captest.generate_keys("RS256", rsa_bits=2048)
     ks = TPUBatchKeySet([JWK(pub, kid="k0")])

@@ -219,7 +219,9 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
             p.join(timeout=30)
         if errors:
             raise RuntimeError(f"fleet clients failed: {errors[:3]}")
-        stats = pool.stats()
+        merged = pool.stats_merged()
+        stats = merged["workers"]
+        agg = merged["aggregate"]
         served = {wid: (s or {}).get("counters", {}).get(
             "worker.tokens", 0) for wid, s in stats.items()}
     finally:
@@ -237,10 +239,28 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
         "per_worker_tokens": served,
         "placement": {w: list(d) for w, d in
                       pool.placement_map().items()},
+        # EXACT fleet-side stage attribution: the workers' mergeable
+        # histogram snapshots, bucket-added across the fleet (not an
+        # average of per-worker quantiles), plus respawn accounting.
+        "telemetry": {
+            "stage_latency": {
+                name: {"count": int(s["count"]),
+                       "p50": round(s["p50"], 6),
+                       "p95": round(s["p95"], 6),
+                       "p99": round(s["p99"], 6)}
+                for name, s in sorted(agg["series"].items())},
+            "counters": agg["counters"],
+            "respawns": agg["restarts"],
+        },
     }
 
 
 def fleet_main() -> None:
+    from cap_tpu import telemetry
+
+    # Parent-process recorder: pool supervision counters (respawns,
+    # crashes, ping latency) land here and ride into the BENCH JSON.
+    telemetry.enable()
     sizes = [int(s) for s in
              os.environ["CAP_SERVE_FLEET"].split(",") if s]
     keyset_spec = os.environ.get("CAP_SERVE_FLEET_KEYSET",
@@ -271,6 +291,13 @@ def fleet_main() -> None:
     smallest = min(points, key=lambda p: p["n_workers"])
     scaling = (round(best["throughput"] / smallest["throughput"], 3)
                if smallest["throughput"] else None)
+    rec = telemetry.active()
+    supervision = {
+        k: v for k, v in sorted(rec.counters().items())
+        if k.startswith("fleet.")
+    } if rec is not None else {}
+    ping = (rec.summary().get("fleet.ping_s") if rec is not None
+            else None)
     print(json.dumps({
         "metric": "serve_fleet_verifies_per_sec",
         "value": best["throughput"],
@@ -278,6 +305,10 @@ def fleet_main() -> None:
         "p99_request_latency_ms": best["p99_ms"],
         "fleet_scaling_vs_smallest": scaling,
         "placement_model": "single-owner-per-device",
+        # Pool-side supervision attribution for the whole sweep:
+        # respawn/crash/hung counters + health-ping latency quantiles.
+        "supervision_counters": supervision,
+        "ping_p99_s": round(ping["p99"], 6) if ping else None,
         "points": points,
     }))
 
@@ -289,11 +320,12 @@ def main() -> None:
         fleet_main()
         return
 
-    from cap_tpu import compile_cache
+    from cap_tpu import compile_cache, telemetry
     from cap_tpu._build import build_native
 
     build_native()
     compile_cache.enable()
+    telemetry.enable()               # stage attribution in the JSON
 
     n_clients = int(os.environ.get("CAP_SERVE_CLIENTS", 32))
     req_tokens = int(os.environ.get("CAP_SERVE_REQ_TOKENS", 64))
@@ -329,11 +361,20 @@ def main() -> None:
                   file=sys.stderr)
 
     best = max(points, key=lambda p: p["throughput"])
+    rec = telemetry.active()
+    stage_latency = {
+        name: {"count": int(s["count"]), "p50": round(s["p50"], 6),
+               "p95": round(s["p95"], 6), "p99": round(s["p99"], 6)}
+        for name, s in sorted(rec.summary().items())
+    } if rec is not None else {}
     print(json.dumps({
         "metric": "serve_verifies_per_sec",
         "value": best["throughput"],
         "unit": "verifies/sec",
         "p99_request_latency_ms": best["p99_ms"],
+        # Worker-side stage attribution accumulated over the sweep
+        # (batcher fill/dispatch/collect, per-family dispatch.*).
+        "telemetry": {"stage_latency": stage_latency},
         "points": points,
     }))
 

@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""capstat: live fleet observability — scrape, merge, render.
+
+Scrapes the HTTP observability surface every fleet worker serves
+(``cap_tpu.serve.obs``: ``/snapshot`` mergeable telemetry + live
+batcher gauges, ``/flight`` slowest traced request timelines) and
+renders the fleet in one screen:
+
+- per-endpoint AND exact fleet-aggregate p50/p95/p99 for every stage
+  histogram (verify_batch.total, batcher fill/dispatch/collect,
+  per-family ``dispatch.*`` …);
+- batcher depth / inflight / fill-ratio and per-family lane +
+  padding-waste gauges;
+- worker health counters (requests, tokens, protocol errors);
+- with ``--client FILE``: the router's client-side view — breaker
+  states and transitions (opens/closes), hedges, failovers, respawn
+  and fallback counters (write the file with
+  ``json.dump(fleet_client.snapshot(), f)``);
+- ``--trace ID``: reassemble ONE request's cross-process timeline by
+  joining the 16-hex trace id across every scraped flight recorder
+  (plus the client snapshot's spans), ordered by wall-clock start.
+
+Usage:
+    python tools/capstat.py HOST:OBSPORT [HOST:OBSPORT ...]
+    python tools/capstat.py --watch 2 HOST:OBSPORT ...
+    python tools/capstat.py --trace 33c8b42c35f4be9b HOST:OBSPORT ...
+    python tools/capstat.py --json HOST:OBSPORT ...
+
+Redaction: everything rendered comes from telemetry recorders, whose
+write boundary rejects token-shaped names and scrubs notes — capstat
+adds no payload-derived content and never sees tokens at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cap_tpu import telemetry  # noqa: E402
+
+# Stage series shown first, in pipeline order (everything else follows
+# alphabetically): the client → router → worker → batcher → device
+# attribution chain.
+STAGE_ORDER = [
+    telemetry.SPAN_CLIENT_SUBMIT,
+    "router.attempt_s",
+    telemetry.SPAN_ROUTER_BACKOFF,
+    telemetry.SPAN_ROUTER_FALLBACK,
+    telemetry.SPAN_WORKER_DEQUEUE,
+    telemetry.SPAN_BATCHER_FILL,
+    "batcher.fill_wait_s",
+    telemetry.SPAN_BATCHER_FLUSH,
+    telemetry.SPAN_BATCHER_DISPATCH,
+    telemetry.SPAN_BATCHER_COLLECT,
+    "verify_batch.total",
+]
+
+# Gauges a healthy scrape must carry (make obs-smoke fails without
+# them, and on NaN): the minimal live-fleet dashboard.
+REQUIRED_GAUGES = ["batcher.queued_tokens", "batcher.inflight_batches",
+                   "worker.pid"]
+
+
+def scrape(endpoint: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """One worker's /snapshot + /flight → {"snapshot", "extra",
+    "flight"}; endpoint is "host:port" of its obs server."""
+    host, _, port = endpoint.rpartition(":")
+    base = f"http://{host}:{int(port)}"
+    with urllib.request.urlopen(f"{base}/snapshot",
+                                timeout=timeout) as r:
+        snap = json.load(r)
+    with urllib.request.urlopen(f"{base}/flight", timeout=timeout) as r:
+        flight = json.load(r)
+    return {"snapshot": snap.get("snapshot") or {},
+            "extra": snap.get("extra") or {},
+            "flight": flight.get("slowest") or []}
+
+
+def reassemble_trace(trace_id: str,
+                     sources: Sequence[Dict[str, Any]]) -> List[dict]:
+    """Join one trace id across span sources into a single timeline.
+
+    Each source is either a scrape() result (its flight entries are
+    searched), a client snapshot ({"spans": [...]}), or a bare list of
+    span records. Returns spans sorted by wall-clock start."""
+    spans: List[dict] = []
+    for src in sources:
+        if isinstance(src, list):
+            cand = src
+        elif "flight" in src:
+            cand = [s for e in src["flight"]
+                    if e.get("trace") == trace_id
+                    for s in e.get("spans", [])]
+        else:
+            cand = src.get("spans", [])
+        spans.extend(s for s in cand if s.get("trace") == trace_id)
+    # Dedup (a span can appear in several flight entries of one ring).
+    seen = set()
+    out = []
+    for s in sorted(spans, key=lambda s: (s["t0"], s["name"])):
+        key = (s["name"], round(s["t0"], 6), round(s["dur"], 9))
+        if key not in seen:
+            seen.add(key)
+            out.append(s)
+    return out
+
+
+def render_trace(trace_id: str, spans: Sequence[dict]) -> str:
+    """ASCII timeline of one reassembled cross-process trace."""
+    if not spans:
+        return f"trace {trace_id}: no spans found"
+    t_base = min(s["t0"] for s in spans)
+    lines = [f"trace {trace_id}  ({len(spans)} spans)"]
+    for s in spans:
+        off_ms = (s["t0"] - t_base) * 1e3
+        note = f"  [{s['note']}]" if s.get("note") else ""
+        lines.append(f"  +{off_ms:9.3f}ms  {s['name']:<18} "
+                     f"{s['dur'] * 1e3:9.3f}ms{note}")
+    return "\n".join(lines)
+
+
+# Series that are NOT durations (tokens, ratios, lane counts): render
+# raw instead of milliseconds.
+_UNITLESS_SUFFIXES = ("_size", "_ratio", ".lanes", ".fill_ratio")
+
+
+def _series_rows(summary: Dict[str, Dict[str, float]]) -> List[str]:
+    names = [n for n in STAGE_ORDER if n in summary]
+    names += sorted(n for n in summary if n not in STAGE_ORDER)
+    rows = []
+    for n in names:
+        s = summary[n]
+        if n.endswith(_UNITLESS_SUFFIXES):
+            fmt = lambda v: f"{v:9.2f}"          # noqa: E731
+        else:
+            fmt = lambda v: f"{v * 1e3:9.3f}ms"  # noqa: E731
+        rows.append(f"  {n:<28} n={int(s['count']):>8}  "
+                    f"p50={fmt(s['p50'])}  "
+                    f"p95={fmt(s['p95'])}  "
+                    f"p99={fmt(s['p99'])}  "
+                    f"max={fmt(s['max'])}")
+    return rows
+
+
+def render_fleet(worker_data: Dict[str, Dict[str, Any]],
+                 client: Optional[Dict[str, Any]] = None) -> str:
+    """One screen: per-endpoint summaries, exact merged aggregate, and
+    (when a client snapshot is provided) breakers + routing health."""
+    lines: List[str] = []
+    snaps = []
+    for ep, data in sorted(worker_data.items()):
+        snap = data.get("snapshot") or {}
+        snaps.append(snap)
+        extra = data.get("extra") or {}
+        counters = snap.get("counters") or {}
+        lines.append(f"worker {ep}  pid={int(extra.get('worker.pid', 0))}"
+                     f"  queued={int(extra.get('batcher.queued_tokens', 0))}"
+                     f"  inflight={int(extra.get('batcher.inflight_batches', 0))}"
+                     f"  requests={counters.get('worker.requests', 0)}"
+                     f"  tokens={counters.get('worker.tokens', 0)}"
+                     f"  protocol_errors="
+                     f"{counters.get('worker.protocol_errors', 0)}")
+        lines.extend(_series_rows(telemetry.summarize_snapshot(snap)))
+        slowest = data.get("flight") or []
+        if slowest:
+            worst = slowest[0]
+            lines.append(f"  flight: {len(slowest)} traced, slowest "
+                         f"{worst['total_s'] * 1e3:.3f}ms "
+                         f"trace={worst['trace']}")
+    merged = telemetry.merge_snapshots(snaps)
+    lines.append("fleet aggregate (exact bucket merge)")
+    lines.extend(_series_rows(telemetry.summarize_snapshot(merged)))
+    agg_counters = merged.get("counters") or {}
+    for fam in ("rs", "ps", "es", "ed"):
+        waste = agg_counters.get(f"device.{fam}.pad_waste_rows")
+        toks = agg_counters.get(f"device.{fam}.tokens")
+        if toks:
+            lines.append(f"  device.{fam}: tokens={toks} "
+                         f"pad_waste_rows={waste or 0}")
+    if client is not None:
+        csnap = client.get("snapshot") or {}
+        c = csnap.get("counters") or {}
+        g = csnap.get("gauges") or {}
+        lines.append(
+            "router (client side)  "
+            f"hedges={c.get('fleet.hedges', 0)} "
+            f"hedge_wins={c.get('fleet.hedge_wins', 0)} "
+            f"failovers={c.get('fleet.failovers', 0)} "
+            f"breaker_opens={c.get('fleet.breaker_opens', 0)} "
+            f"breaker_closes={c.get('fleet.breaker_closes', 0)} "
+            f"fallback_tokens={c.get('fleet.fallback_tokens', 0)} "
+            f"respawns={c.get('fleet.respawns', 0)} "
+            f"breakers_open_now={int(g.get('fleet.breakers_open', 0))}")
+        for ep, st in sorted((client.get("breakers") or {}).items()):
+            state = ("OPEN" if st.get("open_for_s", 0) > 0 else
+                     "closed")
+            lines.append(f"  breaker {ep:<21} {state:<6} "
+                         f"failures={int(st.get('failures', 0))} "
+                         f"open_for_s={st.get('open_for_s', 0.0):.2f}")
+        lines.extend(_series_rows(telemetry.summarize_snapshot(csnap)))
+    return "\n".join(lines)
+
+
+def check_required(worker_data: Dict[str, Dict[str, Any]]) -> List[str]:
+    """Missing/NaN required gauges per endpoint (obs-smoke's check)."""
+    problems = []
+    for ep, data in sorted(worker_data.items()):
+        extra = data.get("extra") or {}
+        for name in REQUIRED_GAUGES:
+            v = extra.get(name)
+            if v is None:
+                problems.append(f"{ep}: missing gauge {name}")
+            elif v != v:                  # NaN
+                problems.append(f"{ep}: gauge {name} is NaN")
+    return problems
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="capstat", description="scrape + render fleet telemetry")
+    ap.add_argument("endpoints", nargs="+",
+                    help="worker obs endpoints (host:port)")
+    ap.add_argument("--client", metavar="FILE",
+                    help="JSON file with FleetClient.snapshot() for "
+                         "breaker/routing view")
+    ap.add_argument("--trace", metavar="ID",
+                    help="reassemble one trace id across the fleet")
+    ap.add_argument("--watch", type=float, metavar="SECONDS",
+                    help="re-scrape and re-render every N seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged scrape as JSON")
+    args = ap.parse_args(argv)
+
+    client = None
+    if args.client:
+        with open(args.client) as f:
+            client = json.load(f)
+
+    while True:
+        worker_data: Dict[str, Dict[str, Any]] = {}
+        for ep in args.endpoints:
+            try:
+                worker_data[ep] = scrape(ep)
+            except OSError as e:
+                worker_data[ep] = {"snapshot": {}, "extra": {},
+                                   "flight": [], "error": str(e)}
+        if args.trace:
+            sources: List[Any] = list(worker_data.values())
+            if client is not None:
+                sources.append({"spans": [
+                    s for s in (client.get("spans") or [])]})
+            spans = reassemble_trace(args.trace, sources)
+            print(render_trace(args.trace, spans))
+        elif args.json:
+            merged = telemetry.merge_snapshots(
+                [d.get("snapshot") for d in worker_data.values()])
+            print(json.dumps({
+                "workers": worker_data,
+                "aggregate": {
+                    "snapshot": merged,
+                    "series": telemetry.summarize_snapshot(merged)},
+            }, indent=1))
+        else:
+            print(render_fleet(worker_data, client))
+        if not args.watch:
+            break
+        time.sleep(args.watch)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

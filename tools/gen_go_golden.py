@@ -46,6 +46,11 @@ RESULTS = [
     {"sub": "alice", "unicode": "ü†✓"},
 ]
 
+# Pinned trace id for the traced frame pair (types 9/10): 16 lowercase
+# hex chars, exactly what telemetry.new_trace_id() emits. Fixed so
+# regeneration is byte-stable.
+TRACE_ID = "00112233aabbccdd"
+
 
 class _Sock:
     """Duck-typed socket capturing sendall output."""
@@ -296,8 +301,21 @@ def main():
     with open(os.path.join(OUT, "stats_response.bin"), "wb") as f:
         f.write(s.buf.getvalue())
 
+    # Traced frame pair (types 9/10): the checksummed envelope plus
+    # the additive trace-context field. Own golden files; every file
+    # above stays byte-identical (tests/test_conformance.py pins them).
+    s = _Sock()
+    protocol.send_request(s, TOKENS, trace=TRACE_ID)
+    with open(os.path.join(OUT, "request_trace.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    protocol.send_response(s, RESULTS, trace=TRACE_ID)
+    with open(os.path.join(OUT, "response_trace.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
     meta = {
         "tokens": TOKENS,
+        "trace_id": TRACE_ID,
         "results": [
             {"claims": r} if isinstance(r, dict) else
             {"error": f"{type(r).__name__}: {r}"}

@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""obs-smoke: boot a 2-worker stub fleet, scrape it, fail on gaps.
+
+The CI guard for the observability surface (``make obs-smoke``):
+
+1. spawn a 2-worker stub WorkerPool (no jax in the children — starts
+   in ~1 s) and drive a few traced verifies through a FleetClient;
+2. scrape every worker's /metrics (Prometheus text) and /snapshot;
+3. FAIL (exit 1) if any required gauge is missing or NaN, if the
+   Prometheus text lacks the required metric families, or if the
+   traced request produced no flight-recorder entry.
+
+Runs under JAX_PLATFORMS=cpu inside the tier-1 time budget (~10 s).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_PROM = [
+    "cap_up",
+    "cap_worker_pid",
+    "cap_batcher_queued_tokens",
+    "cap_batcher_inflight_batches",
+    "cap_worker_requests_total",
+    "cap_worker_tokens_total",
+    "cap_batcher_batch_size",       # summary (quantiles + _sum/_count)
+]
+
+
+def main() -> int:
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import FleetClient, WorkerPool
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from tools import capstat
+
+    failures = []
+    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.3)
+    try:
+        if not pool.wait_all_ready(30):
+            print("obs-smoke: fleet did not come up", file=sys.stderr)
+            return 1
+        telemetry.enable()
+        cl = FleetClient(pool, fallback=StubKeySet(), rr_seed=0)
+        with telemetry.trace() as tid:
+            for i in range(4):
+                out = cl.verify_batch([f"smoke-{i}.ok", f"smoke-{i}.bad"])
+                assert len(out) == 2
+        obs = pool.obs_endpoints()
+        if len(obs) != 2:
+            failures.append(f"expected 2 obs endpoints, got {obs}")
+        worker_data = {}
+        traced = False
+        for wid, (host, port) in sorted(obs.items()):
+            ep = f"{host}:{port}"
+            worker_data[ep] = capstat.scrape(ep)
+            text = urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5).read().decode()
+            for name in REQUIRED_PROM:
+                if f"\n{name}" not in "\n" + text:
+                    failures.append(f"worker {wid}: /metrics missing {name}")
+            if "nan" in text.lower():
+                failures.append(f"worker {wid}: NaN value in /metrics")
+            traced = traced or any(e.get("trace") == tid
+                                   for e in worker_data[ep]["flight"])
+        failures.extend(capstat.check_required(worker_data))
+        if not traced:
+            failures.append(
+                f"trace {tid} reached no worker flight recorder")
+        # The renderer must work over a live scrape (capstat's own
+        # smoke), and must contain the aggregate section.
+        rendered = capstat.render_fleet(worker_data, cl.snapshot())
+        if "fleet aggregate" not in rendered:
+            failures.append("capstat.render_fleet missing aggregate")
+    finally:
+        pool.close()
+    if failures:
+        for f in failures:
+            print(f"obs-smoke FAIL: {f}", file=sys.stderr)
+        return 1
+    print("obs-smoke OK: 2 workers scraped, required gauges present, "
+          f"trace {tid} landed in a flight recorder")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
