@@ -26,7 +26,7 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native test bench clean obs-smoke
+.PHONY: all native test bench clean obs-smoke bench-trend check
 
 all: native
 
@@ -59,3 +59,15 @@ golden-go:
 # import in the children, fits the tier-1 time budget.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/obs_smoke.py
+
+# Bench regression sentinel: selftest the detector (synthetic series +
+# a 15% regression injected into the real series must flag), then
+# check the committed BENCH_r*/MULTICHIP_r* trajectory — fails when
+# the latest round regresses any tracked metric >10% vs the best of
+# the last 3 rounds.
+bench-trend:
+	$(PYTHON) tools/bench_trend.py --selftest
+	$(PYTHON) tools/bench_trend.py
+
+# The default local CI gate: observability smoke + perf-trend sentinel.
+check: obs-smoke bench-trend

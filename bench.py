@@ -220,6 +220,21 @@ def main() -> None:
         k: v for k, v in sorted(all_counters.items())
         if k.startswith(("fleet.", "worker.", "batcher."))
     }
+    # Decision accounting + SLO evaluation (cap_tpu.obs): the record
+    # carries its own verdict/reason breakdown and objective status, so
+    # BENCH_r06+ is self-describing and tools/bench_trend.py can track
+    # these fields without re-running anything.
+    from cap_tpu.obs import decision as obs_decision
+    from cap_tpu.obs import slo as obs_slo
+
+    decision_counts = obs_decision.decision_counters(all_counters)
+    try:
+        slo_results = [
+            {"name": r["name"], "ok": r["ok"], "windows": r["windows"]}
+            for r in obs_slo.evaluate_once(rec.snapshot())
+        ]
+    except Exception as e:  # noqa: BLE001 - advisory field
+        slo_results = [{"error": repr(e)}]
 
     intervals = [b - a for a, b in zip(done_t, done_t[1:])]
     rates = [batch / dt for dt in intervals]
@@ -294,6 +309,11 @@ def main() -> None:
         # window (fleet.failovers, fleet.fallback_tokens, worker.*,
         # batcher.* — empty dict = clean run, nothing fired).
         "health_counters": health_counters,
+        # Reason-keyed decision counters and SLO objective status for
+        # the measured window (cap_tpu.obs): the record explains its
+        # own verdicts, and bench_trend.py enforces the fields exist.
+        "decisions": decision_counts,
+        "slo": slo_results,
         # Per-stage attribution from the telemetry histograms: every
         # span observed during the measured window, p50/p95/p99 in
         # seconds, plus per-family padding/lane gauges — the perf

@@ -52,6 +52,15 @@ class InvalidSignatureError(CapError):
     default_message = "invalid signature"
 
 
+class UnknownKeyIDError(InvalidSignatureError):
+    # Subclass of InvalidSignatureError so existing catch sites are
+    # unaffected; raised where a token's kid provably matches NO key in
+    # the set (key-rotation misses, stale caches) — a distinct
+    # rejection-reason class in telemetry (cap_tpu.obs.decision),
+    # because "unknown kid" pages differently than "forged signature".
+    default_message = "no key matches the token kid"
+
+
 class InvalidSubjectError(CapError):
     default_message = "invalid subject"
 

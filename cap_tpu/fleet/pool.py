@@ -27,16 +27,19 @@ Routing lives in :mod:`cap_tpu.fleet.router`, which consumes
 from __future__ import annotations
 
 import os
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import telemetry
 from ..errors import CapError
+from ..obs import postmortem as _postmortem
 from ..parallel.place import (
     WorkerPlacement,
     assert_single_owner,
@@ -68,6 +71,10 @@ class WorkerHandle:
         self.state = STARTING
         self.restarts = 0
         self.ping_failures = 0
+        # Latest collected crash/drain postmortem (obs.postmortem doc)
+        # and the checkpoint file the worker writes into.
+        self.postmortem: Optional[dict] = None
+        self.postmortem_path: Optional[str] = None
 
     @property
     def worker_id(self) -> int:
@@ -95,7 +102,9 @@ class WorkerPool:
                  ping_interval: float = 0.5, ping_timeout: float = 2.0,
                  hung_after: int = 3, max_restarts: int = 5,
                  spawn_timeout: float = 60.0, drain_grace: float = 5.0,
-                 env_extra: Optional[Dict[str, str]] = None):
+                 env_extra: Optional[Dict[str, str]] = None,
+                 postmortem_dir: Optional[str] = None,
+                 postmortem_interval: float = 1.0):
         if placements is None:
             placements = single_owner_placement(
                 n_workers, n_devices if n_devices is not None else n_workers,
@@ -117,6 +126,16 @@ class WorkerPool:
         self._spawn_timeout = spawn_timeout
         self._drain_grace = drain_grace
         self._env_extra = dict(env_extra or {})
+        # Crash postmortems are ON by default: workers checkpoint into
+        # per-slot files here; the pool collects a file once the death
+        # is CONFIRMED (so even kill -9 leaves a ≤interval-stale
+        # document). postmortem_dir=None → a pool-owned temp dir,
+        # removed in close(); an explicit dir is the caller's to keep.
+        self._pm_interval = postmortem_interval
+        self._pm_dir_owned = postmortem_dir is None
+        self._pm_dir = (tempfile.mkdtemp(prefix="cap-fleet-pm-")
+                        if postmortem_dir is None else postmortem_dir)
+        os.makedirs(self._pm_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self._handles = [WorkerHandle(p) for p in placements]
@@ -226,6 +245,37 @@ class WorkerPool:
             },
         }
 
+    def postmortem(self, worker_id: int) -> Optional[dict]:
+        """The latest postmortem collected for this slot (crash or
+        drain), or — when no death was confirmed yet — whatever the
+        LIVE worker last checkpointed (best-effort read)."""
+        with self._lock:
+            h = self._handles[worker_id]
+            doc, path = h.postmortem, h.postmortem_path
+        if doc is not None:
+            return doc
+        return _postmortem.read_postmortem(path) if path else None
+
+    def postmortem_path(self, worker_id: int) -> Optional[str]:
+        with self._lock:
+            return self._handles[worker_id].postmortem_path
+
+    def postmortems(self) -> Dict[int, Optional[dict]]:
+        return {h.worker_id: self.postmortem(h.worker_id)
+                for h in self._handles}
+
+    def _collect_postmortem(self, h: WorkerHandle) -> None:
+        """Read the dead worker's last checkpoint into the handle
+        (called only after the death is CONFIRMED, so the file cannot
+        be mid-replace — writes are atomic anyway)."""
+        if not h.postmortem_path:
+            return
+        doc = _postmortem.read_postmortem(h.postmortem_path)
+        if doc is not None:
+            with self._lock:
+                h.postmortem = doc
+            telemetry.count("fleet.postmortems_collected")
+
     def restart(self, worker_id: int, graceful: bool = True) -> None:
         """Respawn one worker onto its device group.
 
@@ -238,6 +288,7 @@ class WorkerPool:
             h = self._handles[worker_id]
             h.state = DRAINING
         self._reap(h, graceful=graceful)
+        self._collect_postmortem(h)
         with self._lock:
             if self._closed.is_set():
                 return
@@ -253,8 +304,13 @@ class WorkerPool:
         self._closed.set()
         for h in self._handles:
             self._reap(h, graceful=True)
+            self._collect_postmortem(h)
             with self._lock:
                 h.state = DEAD
+        if self._pm_dir_owned:
+            # The docs were collected onto the handles; the pool-owned
+            # checkpoint dir has served its purpose.
+            shutil.rmtree(self._pm_dir, ignore_errors=True)
 
     def __enter__(self):
         return self
@@ -265,7 +321,11 @@ class WorkerPool:
     # -- internals --------------------------------------------------------
 
     def _spawn(self, h: WorkerHandle) -> None:
-        env = {**os.environ, **h.placement.env(), **self._env_extra}
+        h.postmortem_path = os.path.join(
+            self._pm_dir, f"worker-{h.worker_id}.json")
+        env = {**os.environ, **h.placement.env(), **self._env_extra,
+               "CAP_FLEET_PM_PATH": h.postmortem_path,
+               "CAP_FLEET_PM_INTERVAL": str(self._pm_interval)}
         cmd = [sys.executable, "-m", "cap_tpu.fleet.worker_main",
                "--host", self._host, "--port", "0",
                "--keyset", self._spec, *self._worker_args]

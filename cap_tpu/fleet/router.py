@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 from .. import telemetry
 from ..errors import CapError
+from ..obs import decision as _decision
 from ..serve import protocol
 from ..serve.client import RemoteVerifyError
 
@@ -224,9 +225,17 @@ class FleetClient:
         tokens = list(tokens)
         if not tokens:
             return []
+        t0 = time.perf_counter()
         with telemetry.span(telemetry.SPAN_CLIENT_SUBMIT):
-            return self._verify_batch_routed(
+            out = self._verify_batch_routed(
                 tokens, telemetry.current_trace())
+        # Router-surface decision records: the verdicts the CALLER
+        # sees, whichever path produced them (worker, hedge peer, or
+        # the terminal oracle) — worker rejections arrive as
+        # RemoteVerifyError and classify back to the engine's reason.
+        _decision.record_batch("router", out, tokens=tokens,
+                               latency_s=time.perf_counter() - t0)
+        return out
 
     def _verify_batch_routed(self, tokens: List[str],
                              trace: Optional[str]) -> List[Any]:

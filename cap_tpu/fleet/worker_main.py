@@ -117,6 +117,15 @@ def main(argv=None) -> int:
     # Observability server (serve.obs): 0 = ephemeral port (default),
     # -1 = disabled. The bound port is announced on the ready line.
     ap.add_argument("--obs-port", type=int, default=0)
+    # Crash postmortems: checkpoint telemetry to this path on a timer
+    # and on SIGTERM drain, so the pool can collect a ≤interval-stale
+    # document even after kill -9. Empty = disabled. The pool passes
+    # the path via CAP_FLEET_PM_PATH (env wins over the default).
+    ap.add_argument("--postmortem-path",
+                    default=os.environ.get("CAP_FLEET_PM_PATH", ""))
+    ap.add_argument("--pm-interval", type=float,
+                    default=float(os.environ.get(
+                        "CAP_FLEET_PM_INTERVAL", "2.0")))
     args = ap.parse_args(argv)
 
     from .. import telemetry
@@ -130,6 +139,13 @@ def main(argv=None) -> int:
                           max_batch=args.max_batch,
                           obs_port=(None if args.obs_port < 0
                                     else args.obs_port))
+    pm = None
+    if args.postmortem_path:
+        from ..obs.postmortem import PostmortemWriter
+
+        pm = PostmortemWriter(args.postmortem_path,
+                              interval_s=args.pm_interval,
+                              stats_fn=worker.stats)
     host, port = worker.address
     obs = worker.obs_address
     # The ONE ready line the pool parses; flushed so it cannot sit in a
@@ -146,6 +162,10 @@ def main(argv=None) -> int:
     # Graceful drain: stop accepting, flush queued batches (bounded),
     # give the responder threads a beat to write the last frames out.
     worker.close(deadline_s=args.drain_deadline_s)
+    if pm is not None:
+        # Fresh final checkpoint AFTER the drain: the postmortem then
+        # reflects everything this process ever served.
+        pm.close("sigterm-drain")
     time.sleep(0.2)
     return 0
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..errors import (
@@ -27,11 +28,22 @@ from ..errors import (
     InvalidParameterError,
     InvalidSignatureError,
     NilParameterError,
+    UnknownKeyIDError,
 )
+from ..obs import decision as _decision
 from ..utils import http as _http
 from .jose import ParsedJWS, parse_jws
-from .jwk import JWK, parse_jwks
-from .verify import key_matches_alg, verify_parsed
+
+# The crypto-backed pieces (jwk parsing, signature verification) pull
+# in the ``cryptography`` package and are imported at CALL time: the
+# KeySet seam itself stays importable in crypto-less environments
+# (stub fleets, decision-layer tests), matching the lazy exports in
+# cap_tpu.jwt.__init__. Annotations are postponed (future import), so
+# the JWK name is only needed when type checkers look.
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .jwk import JWK
 
 
 class KeySet:
@@ -49,12 +61,17 @@ class KeySet:
         """Verify many tokens; returns one entry per token: either the
         claims dict or the exception that token failed with. Never raises
         for per-token failures."""
+        t0 = time.perf_counter()
         out: List[Any] = []
         for t in tokens:
             try:
                 out.append(self.verify_signature(t))
             except Exception as e:  # noqa: BLE001 - per-token error channel
                 out.append(e)
+        # CPU-oracle surface of the decision stream (the batched TPU
+        # engine overrides verify_batch and records surface "tpu").
+        _decision.record_batch("oracle", out, tokens=tokens,
+                               latency_s=time.perf_counter() - t0)
         return out
 
 
@@ -72,6 +89,8 @@ class StaticKeySet(KeySet):
         self._keys = list(public_keys)
 
     def verify_signature(self, token: str) -> Dict[str, Any]:
+        from .verify import verify_parsed
+
         parsed = parse_jws(token)
         last_err: Optional[Exception] = None
         for key in self._keys:
@@ -114,6 +133,8 @@ class JSONWebKeySet(KeySet):
             raise InvalidJWKSError(f"jwks is not valid JSON: {e}") from e
         if not isinstance(doc, dict):
             raise InvalidJWKSError("jwks is not a JSON object")
+        from .jwk import parse_jwks
+
         keys = parse_jwks(doc)
         with self._lock:
             self._keys = keys
@@ -129,7 +150,9 @@ class JSONWebKeySet(KeySet):
     # -- verification ------------------------------------------------------
 
     @staticmethod
-    def _candidates(keys: List[JWK], parsed: ParsedJWS) -> List[JWK]:
+    def _candidates(keys: "List[JWK]", parsed: ParsedJWS) -> "List[JWK]":
+        from .verify import key_matches_alg
+
         out = []
         for jwk in keys:
             if jwk.use not in (None, "", "sig"):
@@ -142,6 +165,8 @@ class JSONWebKeySet(KeySet):
         return out
 
     def verify_signature(self, token: str) -> Dict[str, Any]:
+        from .verify import verify_parsed
+
         parsed = parse_jws(token)
         keys = self.keys()
         candidates = self._candidates(keys, parsed)
@@ -157,12 +182,20 @@ class JSONWebKeySet(KeySet):
             # verification against cached candidates must NOT hit the
             # network — forged tokens would amplify into IdP fetches.
             keys = self.keys(refresh=True)
-            for jwk in self._candidates(keys, parsed):
+            refreshed = self._candidates(keys, parsed)
+            for jwk in refreshed:
                 try:
                     verify_parsed(parsed, jwk.key)
                     return parsed.claims()
                 except InvalidSignatureError as e:
                     last_err = e
+            if not refreshed and parsed.kid is not None:
+                # Even the freshly fetched set has no key for this kid:
+                # provably unknown (distinct reason class in telemetry —
+                # a rotation gap, not a forgery).
+                raise UnknownKeyIDError(
+                    "no key matches kid after refresh"
+                ) from last_err
         raise InvalidSignatureError(
             "failed to verify id token signature"
         ) from last_err

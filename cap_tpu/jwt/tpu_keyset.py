@@ -33,6 +33,7 @@ from ..errors import (
     MalformedTokenError,
     NilParameterError,
 )
+from ..obs import decision as _decision
 from . import algs
 from .jose import ParsedJWS, is_json_form, parse_jws
 from .jwk import JWK
@@ -803,6 +804,20 @@ class TPUBatchKeySet(KeySet):
                 if not isinstance(results[i], Exception):
                     results[i] = pay
         self._observe_wire(state)
+        # Device-surface decision records: families come straight from
+        # the prep arrays (no token re-parsing on the hot path).
+        if telemetry.active() is not None:
+            from ..runtime.native_binding import ALG_NAMES
+
+            fam_for = [_decision.family_for_alg(a) for a in ALG_NAMES]
+            alg_id = pb.alg_id
+            fams = [fam_for[int(alg_id[j])] if ok[j] else "unknown"
+                    for j in range(n)]
+            t_dispatch = state.get("t_dispatch")
+            _decision.record_batch(
+                "tpu", results, families=fams,
+                latency_s=(time.perf_counter() - t_dispatch
+                           if t_dispatch is not None else None))
         return results
 
     def _observe_wire(self, state: dict) -> None:
@@ -1273,6 +1288,10 @@ class TPUBatchKeySet(KeySet):
                 self._run_ec(kind[1], idxs, parsed_list, key_for, results)
             else:
                 self._run_ed(idxs, parsed_list, key_for, results)
+        if telemetry.active() is not None:
+            fams = [_decision.family_for_alg(p.alg) if p is not None
+                    else "unknown" for p in parsed_list]
+            _decision.record_batch("tpu", results, families=fams)
         return results
 
     # -- bucket runners ----------------------------------------------------

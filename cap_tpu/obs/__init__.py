@@ -1,0 +1,27 @@
+"""Decision-grade observability: verdict accounting, SLOs, postmortems.
+
+PR 3's telemetry layer made the fleet *mechanically* observable (stage
+spans, mergeable histograms, the /metrics scrape surface). This package
+answers the questions an auth service actually gets paged on:
+
+- :mod:`cap_tpu.obs.decision` — WHY tokens are rejected: every verify
+  on every surface (CPU oracle, TPU batch engine, serve worker, fleet
+  router) emits a bounded, redaction-enforced decision record into
+  reason-keyed mergeable counters plus a sampled ring;
+- :mod:`cap_tpu.obs.slo` — is the availability contract ("never
+  wrong, at worst slow") actually holding: declarative objectives
+  evaluated with multi-window burn rates (``capstat --slo``);
+- :mod:`cap_tpu.obs.postmortem` — what a worker looked like in the
+  seconds before it died: periodic crash-consistent checkpoints of the
+  telemetry state, collected by the pool on confirmed death and
+  rendered by ``capstat --postmortem``.
+
+Everything here is stdlib-only and rides the existing telemetry
+recorder — counters merge exactly through ``pool.stats_merged()`` and
+the CVB1 STATS/snapshot wire, with redaction enforced at the write
+boundary exactly like metric names (:func:`cap_tpu.telemetry.check_name`).
+"""
+
+from . import decision, postmortem, slo
+
+__all__ = ["decision", "postmortem", "slo"]

@@ -1,0 +1,365 @@
+"""Decision records: WHY every token was accepted or rejected.
+
+The error taxonomy in :mod:`cap_tpu.errors` (34 sentinel classes) is
+precise at the raise site and invisible in telemetry — an operator
+watching a rejection spike cannot tell expired from bad-signature from
+malformed. This module maps every exception a verify surface can
+produce onto a small, REGISTERED set of rejection-reason classes and
+folds each verdict into:
+
+- **reason-keyed mergeable counters** on the active telemetry
+  recorder (``decision.<surface>.accept``,
+  ``decision.<surface>.reject.<reason>``,
+  ``decision.<surface>.family.<family>``) — these ride the existing
+  STATS/snapshot wire and add exactly under
+  ``pool.stats_merged()`` / ``capstat``;
+- a **sampled decision ring** (bounded, 256 entries per recorder):
+  full records ``{surface, family, verdict, reason, lat, trace,
+  kid}`` for the first occurrence of every (surface, reason) pair and
+  a deterministic 1-in-16 sample after that. The worker obs server
+  exposes it at ``/decisions``.
+
+Four surfaces record: the CPU oracle (``KeySet.verify_batch``), the
+TPU batch engine (``TPUBatchKeySet``), the serve worker (per response
+batch), and the fleet router (``FleetClient.verify_batch``). A
+rejection increments the SAME reason class on every surface — the
+router sees worker rejections as ``RemoteVerifyError`` whose payload
+is ``"<ErrorClass>: <message>"`` (serve/protocol.py), and the
+classifier parses that head back to the class's reason, so
+cross-process parity is structural, not incidental.
+
+Redaction: reasons, families, and verdicts are registered enum
+strings; kids are HASHED (sha256, 12 hex chars) before they touch the
+recorder; trace ids are lowercase hex; latency is a bucket label.
+``_checked_entry`` enforces this at the write boundary (anything
+token-shaped raises), same stance as ``telemetry.check_name``.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from .. import telemetry
+
+# ---------------------------------------------------------------------------
+# rejection-reason classes (registered; docs/OBSERVABILITY.md pins this
+# table and tests pin the mapping's coverage of cap_tpu/errors.py)
+# ---------------------------------------------------------------------------
+
+REASON_MALFORMED = "malformed"            # unparseable / invalid structure
+REASON_NOT_SIGNED = "not_signed"          # empty/absent signature
+REASON_BAD_SIGNATURE = "bad_signature"    # signature check failed
+REASON_UNKNOWN_KID = "unknown_kid"        # kid matches no known key
+REASON_UNSUPPORTED_ALG = "unsupported_alg"
+REASON_EXPIRED = "expired"                # exp / auth_time / request age
+REASON_INVALID_CLAIMS = "invalid_claims"  # iss/aud/sub/nonce/azp/hashes
+REASON_JWKS_ERROR = "jwks_error"          # key material unavailable/bad
+REASON_OIDC_FLOW = "oidc_flow"            # RP flow violations
+REASON_TRANSPORT = "transport"            # wire/socket/protocol failure
+REASON_INTERNAL = "internal"              # anything else (bug bucket)
+
+REASON_CLASSES = frozenset({
+    REASON_MALFORMED, REASON_NOT_SIGNED, REASON_BAD_SIGNATURE,
+    REASON_UNKNOWN_KID, REASON_UNSUPPORTED_ALG, REASON_EXPIRED,
+    REASON_INVALID_CLAIMS, REASON_JWKS_ERROR, REASON_OIDC_FLOW,
+    REASON_TRANSPORT, REASON_INTERNAL,
+})
+
+# Exception CLASS NAME -> reason. Keyed by name (not type) so the
+# classifier needs no imports from the crypto-dependent modules and so
+# a wire-roundtripped error ("InvalidSignatureError: ...") classifies
+# identically to the in-process instance — the four-surface parity
+# contract. tests/test_obs_decision.py pins completeness over every
+# CapError subclass in cap_tpu/errors.py.
+REASON_FOR_ERROR: Dict[str, str] = {
+    # base (fallback for unmapped future subclasses via MRO walk)
+    "CapError": REASON_INTERNAL,
+    # structure / parameters
+    "InvalidParameterError": REASON_MALFORMED,
+    "NilParameterError": REASON_MALFORMED,
+    "MalformedTokenError": REASON_MALFORMED,
+    "TokenNotSignedError": REASON_NOT_SIGNED,
+    "UnsupportedAlgError": REASON_UNSUPPORTED_ALG,
+    # signature layer
+    "InvalidSignatureError": REASON_BAD_SIGNATURE,
+    "UnknownKeyIDError": REASON_UNKNOWN_KID,
+    "IDTokenVerificationFailedError": REASON_BAD_SIGNATURE,
+    # freshness
+    "ExpiredTokenError": REASON_EXPIRED,
+    "ExpiredRequestError": REASON_EXPIRED,
+    "ExpiredAuthTimeError": REASON_EXPIRED,
+    # claims validation
+    "InvalidIssuerError": REASON_INVALID_CLAIMS,
+    "InvalidSubjectError": REASON_INVALID_CLAIMS,
+    "InvalidAudienceError": REASON_INVALID_CLAIMS,
+    "InvalidNonceError": REASON_INVALID_CLAIMS,
+    "InvalidNotBeforeError": REASON_INVALID_CLAIMS,
+    "InvalidIssuedAtError": REASON_INVALID_CLAIMS,
+    "InvalidAuthorizedPartyError": REASON_INVALID_CLAIMS,
+    "InvalidAtHashError": REASON_INVALID_CLAIMS,
+    "InvalidCodeHashError": REASON_INVALID_CLAIMS,
+    "MissingClaimError": REASON_INVALID_CLAIMS,
+    # key material
+    "InvalidJWKSError": REASON_JWKS_ERROR,
+    "InvalidCACertError": REASON_JWKS_ERROR,
+    # OIDC relying-party flow
+    "InvalidResponseStateError": REASON_OIDC_FLOW,
+    "InvalidFlowError": REASON_OIDC_FLOW,
+    "UnsupportedChallengeMethodError": REASON_OIDC_FLOW,
+    "UnauthorizedRedirectURIError": REASON_OIDC_FLOW,
+    "LoginFailedError": REASON_OIDC_FLOW,
+    "UserInfoFailedError": REASON_OIDC_FLOW,
+    "MissingIDTokenError": REASON_OIDC_FLOW,
+    "MissingAccessTokenError": REASON_OIDC_FLOW,
+    "IDGeneratorFailedError": REASON_INTERNAL,
+    "NotFoundError": REASON_INTERNAL,
+    # serve/fleet transport layer
+    "ProtocolError": REASON_TRANSPORT,
+    "MalformedFrameError": REASON_TRANSPORT,
+    "FrameTooLargeError": REASON_TRANSPORT,
+    "FrameCorruptError": REASON_TRANSPORT,
+    "FleetExhaustedError": REASON_TRANSPORT,
+    "ConnectionError": REASON_TRANSPORT,
+    "TimeoutError": REASON_TRANSPORT,
+    "OSError": REASON_TRANSPORT,
+}
+
+
+def classify(err: BaseException) -> str:
+    """Map one rejection to its registered reason class.
+
+    ``RemoteVerifyError`` (a worker rejection crossing the CVB1 wire)
+    carries ``"<ErrorClass>: <message>"`` — the head is parsed back so
+    the router increments the SAME reason the worker's engine did.
+    Everything else walks the MRO by class name; unknown classes land
+    in ``internal`` (never raises — classification must not be able to
+    break a verify path).
+    """
+    if type(err).__name__ == "RemoteVerifyError":
+        head = str(err).split(":", 1)[0].strip()
+        return REASON_FOR_ERROR.get(head, REASON_INTERNAL)
+    for klass in type(err).__mro__:
+        reason = REASON_FOR_ERROR.get(klass.__name__)
+        if reason is not None:
+            return reason
+    return REASON_INTERNAL
+
+
+# ---------------------------------------------------------------------------
+# family + kid extraction (bounded, cached — hot-path safe)
+# ---------------------------------------------------------------------------
+
+FAMILIES = ("rs", "ps", "es", "ed", "other", "unknown")
+
+_FAMILY_FOR_ALG_PREFIX = {"RS": "rs", "PS": "ps", "ES": "es"}
+
+# JOSE headers repeat massively across a token stream (one IdP = a
+# handful of distinct headers), so (family, kid-hash) is cached by the
+# raw header segment. The cache holds header TEXT as keys in memory
+# only — nothing from it is ever recorded. Bounded: cleared at cap.
+_HDR_CACHE: Dict[str, tuple] = {}
+_HDR_CACHE_CAP = 1024
+_HDR_LOCK = threading.Lock()
+
+
+def family_for_alg(alg: Optional[str]) -> str:
+    if not alg:
+        return "unknown"
+    if alg == "EdDSA":
+        return "ed"
+    return _FAMILY_FOR_ALG_PREFIX.get(alg[:2], "other")
+
+
+def hash_kid(kid: Optional[str]) -> Optional[str]:
+    """12-hex one-way digest: correlates records without carrying the
+    kid itself (kids can embed tenant/issuer hints)."""
+    if not kid:
+        return None
+    return hashlib.sha256(str(kid).encode()).hexdigest()[:12]
+
+
+def _parse_header_segment(seg: str) -> tuple:
+    try:
+        pad = "=" * (-len(seg) % 4)
+        hdr = json.loads(base64.urlsafe_b64decode(seg + pad))
+        if not isinstance(hdr, dict):
+            return ("unknown", None)
+        return (family_for_alg(hdr.get("alg")), hash_kid(hdr.get("kid")))
+    except (ValueError, binascii.Error, UnicodeDecodeError):
+        return ("unknown", None)
+
+
+def token_family_kid(token: Any) -> tuple:
+    """(family, kid-hash-or-None) from a token's header segment.
+
+    O(1) per repeated header (cache hit); the parse itself is bounded
+    (header segment > 1024 chars -> "unknown" without decoding).
+    """
+    if not isinstance(token, str):
+        return ("unknown", None)
+    seg = token.split(".", 1)[0]
+    if not seg or len(seg) > 1024:
+        return ("unknown", None)
+    hit = _HDR_CACHE.get(seg)
+    if hit is not None:
+        return hit
+    out = _parse_header_segment(seg)
+    with _HDR_LOCK:
+        if len(_HDR_CACHE) >= _HDR_CACHE_CAP:
+            _HDR_CACHE.clear()
+        _HDR_CACHE[seg] = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# latency buckets
+# ---------------------------------------------------------------------------
+
+_LAT_BUCKETS = ((0.001, "lt1ms"), (0.010, "lt10ms"), (0.100, "lt100ms"),
+                (1.0, "lt1s"))
+
+
+def latency_bucket(latency_s: Optional[float]) -> str:
+    if latency_s is None:
+        return "na"
+    for bound, label in _LAT_BUCKETS:
+        if latency_s < bound:
+            return label
+    return "ge1s"
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+SURFACES = ("oracle", "tpu", "serve", "router")
+
+# Ring sampling: always the first record of a (surface, reason) pair,
+# then every RING_SAMPLE_EVERY-th decision on that key (deterministic —
+# derived from the counter value itself, no clock/randomness).
+RING_SAMPLE_EVERY = 16
+
+_MAX_FIELD_LEN = 64
+
+
+def _checked_entry(entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Write-boundary redaction: every string field must be a short
+    registered identifier — token-shaped or oversized values raise,
+    the same stance as :func:`telemetry.check_name`."""
+    for k, v in entry.items():
+        if isinstance(v, str) and ("eyJ" in v or len(v) > _MAX_FIELD_LEN
+                                   or any(ch.isspace() for ch in v)):
+            raise ValueError(
+                f"decision field {k!r} rejected by redaction rules")
+    return entry
+
+
+def record_batch(surface: str, results: Sequence[Any],
+                 tokens: Optional[Sequence[Any]] = None,
+                 families: Optional[Sequence[str]] = None,
+                 latency_s: Optional[float] = None,
+                 trace: Optional[str] = None) -> None:
+    """Fold one batch of per-token verdicts into decision telemetry.
+
+    results: the verify_batch contract — claims dict / raw payload
+    bytes per accept, Exception per reject. tokens OR families supply
+    the per-token family ("unknown" when neither is available, e.g.
+    stub engines). No-op (one attribute check) while telemetry is off.
+    """
+    rec = telemetry.active()
+    if rec is None or not results:
+        return
+    lat = latency_bucket(latency_s)
+    if trace is None:
+        trace = telemetry.current_trace()
+    for i, res in enumerate(results):
+        if families is not None:
+            fam, kid = families[i], None
+        elif tokens is not None:
+            fam, kid = token_family_kid(tokens[i])
+        else:
+            fam, kid = "unknown", None
+        if isinstance(res, BaseException):
+            verdict, reason = "reject", classify(res)
+            key = f"decision.{surface}.reject.{reason}"
+        else:
+            verdict, reason = "accept", None
+            key = f"decision.{surface}.accept"
+        n = rec.count(key)
+        rec.count(f"decision.{surface}.family.{fam}")
+        if n == 1 or n % RING_SAMPLE_EVERY == 0:
+            entry: Dict[str, Any] = {
+                "surface": surface, "family": fam, "verdict": verdict,
+                "lat": lat,
+            }
+            if reason is not None:
+                entry["reason"] = reason
+            if kid is not None:
+                entry["kid"] = kid
+            if trace is not None:
+                entry["trace"] = trace
+            rec.decision(_checked_entry(entry))
+
+
+def record_one(surface: str, result: Any, token: Optional[str] = None,
+               latency_s: Optional[float] = None,
+               trace: Optional[str] = None) -> None:
+    record_batch(surface, [result],
+                 tokens=None if token is None else [token],
+                 latency_s=latency_s, trace=trace)
+
+
+# ---------------------------------------------------------------------------
+# read side helpers (capstat / obs_smoke)
+# ---------------------------------------------------------------------------
+
+
+def decision_counters(counters: Dict[str, int]) -> Dict[str, int]:
+    """The ``decision.*`` subset of a counter map (snapshot or merged)."""
+    return {k: v for k, v in sorted(counters.items())
+            if k.startswith("decision.")}
+
+
+def surface_totals(counters: Dict[str, int]) -> Dict[str, Dict[str, int]]:
+    """Per-surface {accept, reject, reject.<reason>...} rollup from a
+    (merged) counter map — what capstat renders as the verdict table."""
+    out: Dict[str, Dict[str, int]] = {}
+    for k, v in counters.items():
+        if not k.startswith("decision."):
+            continue
+        parts = k.split(".")
+        if len(parts) < 3 or parts[2] == "family":
+            continue
+        surf = parts[1]
+        row = out.setdefault(surf, {"accept": 0, "reject": 0})
+        if parts[2] == "accept":
+            row["accept"] += int(v)
+        elif parts[2] == "reject" and len(parts) >= 4:
+            row["reject"] += int(v)
+            row[f"reject.{parts[3]}"] = row.get(f"reject.{parts[3]}", 0) \
+                + int(v)
+    return out
+
+
+def nonzero_check(counters: Dict[str, int],
+                  surfaces: Sequence[str]) -> List[str]:
+    """obs-smoke's gate: every listed surface must have counted BOTH an
+    accept and a reject for the driven mixed batch. Returns problem
+    strings (empty = healthy)."""
+    problems = []
+    rollup = surface_totals(counters)
+    for surf in surfaces:
+        row = rollup.get(surf)
+        if row is None:
+            problems.append(f"surface {surf}: no decision counters at all")
+            continue
+        if row["accept"] <= 0:
+            problems.append(f"surface {surf}: zero accept decisions")
+        if row["reject"] <= 0:
+            problems.append(f"surface {surf}: zero reject decisions")
+    return problems

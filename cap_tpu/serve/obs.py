@@ -15,7 +15,18 @@ HTTP endpoint exposing the process's telemetry:
   request timelines, each a list of span records, from which a
   cross-process trace can be reassembled by joining on the 16-hex
   trace id (``capstat.py --trace``);
+- ``/decisions`` — the sampled decision ring
+  (:mod:`cap_tpu.obs.decision`): full verdict records with reason
+  class, family, latency bucket, hashed kid;
 - ``/healthz`` — liveness.
+
+Stalled-scraper hardening: every connection runs on its own daemon
+handler thread with a SHORT socket timeout (``handler_timeout_s``,
+default 5 s) — a scraper that connects and never sends a request, or
+stops reading the response, times out and its thread exits instead of
+accumulating forever. The worker's serve loop never shares a thread
+with scrapes in the first place; the timeout bounds the obs server's
+own resource growth under a misbehaving collector (chaos-tested).
 
 Redaction discipline: everything served here comes from the telemetry
 recorder, whose write boundary already rejects token-shaped names and
@@ -85,20 +96,31 @@ class ObsServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  extra: Optional[Callable[[], Dict[str, float]]] = None,
-                 flight_n: int = 32):
+                 flight_n: int = 32, handler_timeout_s: float = 5.0):
         self._extra = extra
         self._flight_n = flight_n
         obs = self
 
         class _Handler(BaseHTTPRequestHandler):
+            # Socket timeout for the whole request/response exchange
+            # (stdlib applies it in setup()): a scraper that stalls —
+            # never sends the request line, or never drains the
+            # response — raises in ITS handler thread and the thread
+            # exits; other scrapes and the worker loop are unaffected.
+            timeout = handler_timeout_s
+
             def log_message(self, *args):   # no stderr chatter
                 pass
+
+            def handle_timeout(self):       # noqa: N802 (stdlib API)
+                self.close_connection = True
 
             def do_GET(self):               # noqa: N802 (stdlib API)
                 try:
                     obs._respond(self)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError, OSError):
+                    self.close_connection = True
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -143,6 +165,11 @@ class ObsServer:
             entries = (rec.flight_slowest(self._flight_n)
                        if rec is not None else [])
             body = json.dumps({"slowest": entries}).encode()
+            ctype = "application/json"
+        elif path == "/decisions":
+            body = json.dumps({
+                "decisions": rec.decisions() if rec is not None else [],
+            }).encode()
             ctype = "application/json"
         elif path == "/healthz":
             body = b'{"ok": true}'

@@ -16,6 +16,7 @@ import time
 from typing import Optional, Tuple
 
 from .. import telemetry
+from ..obs import decision as _decision
 from . import protocol
 from .batcher import AdaptiveBatcher
 
@@ -251,6 +252,13 @@ class VerifyWorker:
                     protocol.send_stats_response(conn, self.stats())
                 else:
                     pending.event.wait()
+                    # Serve-surface decision records: every verdict that
+                    # leaves this worker is accounted by reason class,
+                    # with the request's submit→respond latency bucket.
+                    _decision.record_batch(
+                        "serve", pending.results, tokens=pending.tokens,
+                        latency_s=time.monotonic() - pending.ts,
+                        trace=trace)
                     protocol.send_response(conn, pending.results,
                                            crc=kind == "batch_crc",
                                            trace=trace)

@@ -111,6 +111,8 @@ RESERVOIR_CAP = 256
 # Bounded trace storage: span records and completed-request timelines.
 MAX_TRACE_SPANS = 4096
 MAX_FLIGHT_ENTRIES = 256
+# Bounded decision ring: sampled verdict records (cap_tpu.obs.decision).
+MAX_DECISION_ENTRIES = 256
 
 
 class Histogram:
@@ -239,15 +241,20 @@ class Recorder:
         self._series: Dict[str, Histogram] = {}
         self._trace_spans: deque = deque(maxlen=MAX_TRACE_SPANS)
         self._flight: deque = deque(maxlen=MAX_FLIGHT_ENTRIES)
+        self._decisions: deque = deque(maxlen=MAX_DECISION_ENTRIES)
 
     # -- write side -------------------------------------------------------
 
-    def count(self, name: str, n: int = 1) -> None:
+    def count(self, name: str, n: int = 1) -> int:
+        """Increment and return the new value (the return value lets
+        deterministic samplers key off the count without re-reading
+        the whole counter map)."""
         with self._lock:
             if name in self._counters:
                 self._counters[name] += n
             else:
                 self._counters[check_name(name)] = n
+            return self._counters[name]
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
@@ -311,7 +318,17 @@ class Recorder:
                 entry["note"] = note
             self._flight.append(entry)
 
+    def decision(self, entry: Dict[str, Any]) -> None:
+        """Append one sampled decision record (bounded ring; entries
+        are built and redaction-checked by cap_tpu.obs.decision)."""
+        with self._lock:
+            self._decisions.append(dict(entry))
+
     # -- read side --------------------------------------------------------
+
+    def decisions(self) -> List[dict]:
+        with self._lock:
+            return [dict(d) for d in self._decisions]
 
     def counters(self) -> Dict[str, int]:
         with self._lock:
@@ -373,6 +390,7 @@ class Recorder:
             self._series.clear()
             self._trace_spans.clear()
             self._flight.clear()
+            self._decisions.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -523,10 +541,11 @@ def recording(recorder: Optional[Recorder] = None) -> Iterator[Recorder]:
         _recorder = prev
 
 
-def count(name: str, n: int = 1) -> None:
+def count(name: str, n: int = 1) -> Optional[int]:
     rec = _recorder
     if rec is not None:
-        rec.count(name, n)
+        return rec.count(name, n)
+    return None
 
 
 def gauge(name: str, value: float) -> None:

@@ -461,3 +461,167 @@ def test_delayed_accepts_beyond_deadline_oracle(fleet):
         with telemetry.recording() as rec:
             _assert_verdicts(tokens, cl.verify_batch(tokens))
         assert rec.counters().get("fleet.fallback_tokens", 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# crash postmortems: kill -9 leaves a readable file; SIGTERM drains fresh
+# ---------------------------------------------------------------------------
+
+def test_kill9_leaves_readable_postmortem(fleet, tmp_path):
+    """A kill -9'd worker leaves a postmortem at most one checkpoint
+    interval stale; the pool collects it on confirmed death and
+    ``capstat --postmortem`` renders it with the final flight ring."""
+    import json as _json
+
+    from cap_tpu.obs import postmortem as obs_postmortem
+    from tools import capstat
+
+    cl = FleetClient(fleet, fallback=StubKeySet(), rr_seed=0,
+                     attempt_timeout=2.0, total_deadline=30.0)
+    # Give worker 0 a traced history so its checkpoint carries a
+    # non-empty flight ring and decision counters.
+    with telemetry.recording():
+        for i in range(6):
+            with telemetry.trace():
+                _assert_verdicts([f"pm{i}.ok", f"pm{i}.bad"],
+                                 cl.verify_batch([f"pm{i}.ok",
+                                                  f"pm{i}.bad"]))
+    # Postmortems checkpoint every postmortem_interval (pool default
+    # 1.0 s): wait until a checkpoint after the traffic above exists.
+    victim = fleet.pid(0)
+    pm_path = fleet.postmortem_path(0)
+    assert pm_path, "pool did not assign a postmortem path"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        doc = obs_postmortem.read_postmortem(pm_path)
+        if doc and (doc.get("snapshot", {}).get("counters", {})
+                    .get("worker.requests", 0)) >= 1:
+            break
+        time.sleep(0.1)
+    kill9(victim)
+    # The pool confirms the death, collects the file, and respawns.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if fleet.state(0) == "ready" and fleet.pid(0) != victim:
+            break
+        time.sleep(0.1)
+    doc = fleet.postmortem(0)
+    assert doc is not None, "no postmortem collected after kill -9"
+    assert doc["pid"] == victim
+    counters = doc.get("snapshot", {}).get("counters", {})
+    assert counters.get("worker.requests", 0) >= 1
+    assert counters.get("decision.serve.accept", 0) >= 1
+    assert counters.get(
+        "decision.serve.reject.bad_signature", 0) >= 1
+    assert doc.get("flight"), "final flight ring missing"
+    # capstat renders the collected doc (write it like an operator
+    # saving the pool's copy).
+    f = tmp_path / "victim.json"
+    f.write_text(_json.dumps(doc))
+    assert capstat.main(["--postmortem", str(f)]) == 0
+    rendered = obs_postmortem.render_postmortem(doc)
+    assert "flight ring" in rendered
+    assert "decisions[serve]" in rendered
+
+
+def test_sigterm_drain_writes_fresh_postmortem(fleet):
+    """Graceful restart: the worker's SIGTERM handler writes a FINAL
+    checkpoint (reason sigterm-drain) after the drain completes."""
+    victim = fleet.pid(1)
+    fleet.restart(1, graceful=True)
+    doc = fleet.postmortem(1)
+    assert doc is not None
+    assert doc["pid"] == victim
+    assert doc["reason"] == "sigterm-drain"
+    # fresh: written within the drain window, not a stale checkpoint
+    assert time.time() - doc["t_write"] < 30
+
+
+# ---------------------------------------------------------------------------
+# stalled scraper: the obs server must not block the worker loop
+# ---------------------------------------------------------------------------
+
+def test_stalled_scraper_does_not_block_worker(fleet):
+    """A scraper that connects to a worker's obs server and goes
+    silent: verifies keep flowing, healthy scrapes keep answering,
+    and the stalled connection is eventually torn down by the
+    short-timeout handler."""
+    import socket as _socket
+    import urllib.request as _url
+
+    obs = fleet.obs_endpoints()
+    host, port = obs[0]
+    stalled = _socket.create_connection((host, port), timeout=5)
+    stalled.send(b"GET /snapshot")          # no CRLF: never a request
+    try:
+        cl = FleetClient(fleet, fallback=StubKeySet(), rr_seed=0)
+        tokens = [f"ss{i}.ok" for i in range(3)] + ["ss-bad"]
+        t0 = time.monotonic()
+        _assert_verdicts(tokens, cl.verify_batch(tokens))
+        assert time.monotonic() - t0 < 10.0
+        with _url.urlopen(f"http://{host}:{port}/healthz",
+                          timeout=5) as r:
+            assert r.status == 200
+        # The worker's obs handler (5 s timeout) closes the stalled
+        # connection instead of leaking its thread forever.
+        stalled.settimeout(10.0)
+        deadline = time.monotonic() + 10.0
+        closed = False
+        while time.monotonic() < deadline:
+            try:
+                if stalled.recv(4096) == b"":
+                    closed = True
+                    break
+            except (ConnectionError, _socket.timeout, OSError):
+                closed = True
+                break
+        assert closed, "stalled scraper held its connection forever"
+    finally:
+        stalled.close()
+
+
+# ---------------------------------------------------------------------------
+# redaction sweep: decision records + postmortem files carry no payload
+# ---------------------------------------------------------------------------
+
+def test_decision_and_postmortem_redaction_sweep(fleet):
+    """JWS-shaped tokens through the fleet: the decision counters,
+    the sampled decision rings (every worker's /decisions + the
+    router's), and the raw postmortem FILES on disk contain zero
+    token/payload material — the PR-3 scrub machinery enforced at the
+    new write boundaries."""
+    import json as _json
+    import urllib.request as _url
+
+    tokens = _jws_tokens("redact")
+    with telemetry.recording() as rec:
+        cl = FleetClient(fleet, fallback=StubKeySet(), rr_seed=0)
+        with telemetry.trace():
+            _assert_verdicts(tokens, cl.verify_batch(tokens))
+        router_ring = rec.decisions()
+        assert router_ring, "router decision ring empty"
+        router_counters = rec.counters()
+    assert router_counters.get("decision.router.accept", 0) >= 1
+
+    dumps = [_json.dumps(router_ring), _json.dumps(router_counters)]
+    for wid, (host, port) in sorted(fleet.obs_endpoints().items()):
+        with _url.urlopen(f"http://{host}:{port}/decisions",
+                          timeout=5) as r:
+            dumps.append(r.read().decode())
+    # Force a final checkpoint through the graceful path, then sweep
+    # the raw postmortem files exactly as they sit on disk.
+    paths = [fleet.postmortem_path(w) for w in (0, 1)]
+    fleet.restart(0, graceful=True)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if fleet.state(0) == "ready":
+            break
+        time.sleep(0.1)
+    for p in paths:
+        try:
+            with open(p) as f:
+                dumps.append(f.read())
+        except OSError:
+            pass
+    assert len(dumps) >= 5
+    _no_payload_material(dumps, tokens)

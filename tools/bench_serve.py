@@ -298,6 +298,28 @@ def fleet_main() -> None:
     } if rec is not None else {}
     ping = (rec.summary().get("fleet.ping_s") if rec is not None
             else None)
+    # Reason-keyed decision counters across the whole sweep (worker-
+    # side, summed from every point's exact merged snapshot) + the SLO
+    # objective status over those counters: the fleet BENCH record is
+    # self-describing from this round on (tools/bench_trend.py).
+    from cap_tpu.obs import decision as obs_decision
+    from cap_tpu.obs import slo as obs_slo
+
+    sweep_counters: dict = {}
+    for pt in points:
+        for k, v in (pt.get("telemetry", {}).get("counters")
+                     or {}).items():
+            sweep_counters[k] = sweep_counters.get(k, 0) + int(v)
+    if rec is not None:
+        for k, v in rec.counters().items():
+            sweep_counters[k] = sweep_counters.get(k, 0) + int(v)
+    try:
+        slo_results = [
+            {"name": r["name"], "ok": r["ok"], "windows": r["windows"]}
+            for r in obs_slo.evaluate_once({"counters": sweep_counters})
+        ]
+    except Exception as e:  # noqa: BLE001 - advisory field
+        slo_results = [{"error": repr(e)}]
     print(json.dumps({
         "metric": "serve_fleet_verifies_per_sec",
         "value": best["throughput"],
@@ -309,6 +331,8 @@ def fleet_main() -> None:
         # respawn/crash/hung counters + health-ping latency quantiles.
         "supervision_counters": supervision,
         "ping_p99_s": round(ping["p99"], 6) if ping else None,
+        "decisions": obs_decision.decision_counters(sweep_counters),
+        "slo": slo_results,
         "points": points,
     }))
 
@@ -367,6 +391,18 @@ def main() -> None:
                "p95": round(s["p95"], 6), "p99": round(s["p99"], 6)}
         for name, s in sorted(rec.summary().items())
     } if rec is not None else {}
+    from cap_tpu.obs import decision as obs_decision
+    from cap_tpu.obs import slo as obs_slo
+
+    counters = rec.counters() if rec is not None else {}
+    try:
+        slo_results = [
+            {"name": r["name"], "ok": r["ok"], "windows": r["windows"]}
+            for r in obs_slo.evaluate_once(
+                rec.snapshot() if rec is not None else {})
+        ]
+    except Exception as e:  # noqa: BLE001 - advisory field
+        slo_results = [{"error": repr(e)}]
     print(json.dumps({
         "metric": "serve_verifies_per_sec",
         "value": best["throughput"],
@@ -375,6 +411,9 @@ def main() -> None:
         # Worker-side stage attribution accumulated over the sweep
         # (batcher fill/dispatch/collect, per-family dispatch.*).
         "telemetry": {"stage_latency": stage_latency},
+        # Decision/SLO self-description (cap_tpu.obs), serve surface.
+        "decisions": obs_decision.decision_counters(counters),
+        "slo": slo_results,
         "points": points,
     }))
 

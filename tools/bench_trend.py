@@ -1,0 +1,285 @@
+#!/usr/bin/env python3
+"""bench-trend: the BENCH_r*.json regression sentinel.
+
+The perf trajectory lives in committed round records (BENCH_r01.json …,
+MULTICHIP_r01.json …) that, until now, only a human reading
+docs/PERF.md would compare. This tool parses the whole series and
+FAILS (exit 1) when the LATEST round regresses any tracked metric by
+more than ``THRESHOLD`` (10%) against the BEST of the up-to-3
+preceding rounds — best-of-3 because single rounds ride tunnel
+weather (BENCH_r03's headline dropped 38% on wire stalls alone and
+recovered; the best-of window absorbs that without absorbing a real
+regression).
+
+Tracked metrics (all higher-is-better; latency/wire fields are
+published weather, not tracked — see docs/PERF.md on stalls):
+
+- ``value``              — the honest end-to-end headline rate
+- ``value_peak``         — best pipelined interval
+- ``resident_mixed_vps`` — engine speed with records device-resident
+                           (weather-independent: THE regression signal)
+- ``serve_fleet``        — bench_serve fleet-mode value, when present
+
+MULTICHIP records are checked structurally: the latest round must
+still report ``ok`` (rc 0) on the same-or-larger device count.
+
+Also verifies the latest BENCH record is SELF-DESCRIBING per this
+round's contract: carries ``decisions`` (reason-keyed counters) and
+``slo`` (objective evaluation) once the record is from round ≥ 6 —
+earlier rounds predate the fields and are exempt.
+
+``--selftest`` exercises the detector on synthetic series (including
+an injected 15% regression over the real series) and exits nonzero if
+the detector misbehaves — wired before the real check in
+``make bench-trend`` so a broken sentinel cannot silently pass CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+THRESHOLD = 0.10          # >10% below best-of-window = regression
+WINDOW = 3                # best of the last 3 preceding rounds
+TRACKED = ("value", "value_peak", "resident_mixed_vps", "serve_fleet")
+# Rounds from this PR onward must embed decision/SLO fields.
+SELF_DESCRIBING_FROM_ROUND = 6
+
+
+def load_series(repo: str = REPO) -> List[Tuple[int, Dict[str, Any]]]:
+    """[(round, parsed-metric-dict)] for every BENCH_rNN.json, in
+    round order. Records whose bench errored (no parsed dict) carry
+    an empty dict — they participate as gaps, not as zeros."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        out.append((int(m.group(1)),
+                    parsed if isinstance(parsed, dict) else {}))
+    return sorted(out)
+
+
+def load_multichip(repo: str = REPO) -> List[Tuple[int, Dict[str, Any]]]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "MULTICHIP_r*.json"))):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                out.append((int(m.group(1)), json.load(f)))
+        except (OSError, ValueError):
+            continue
+    return sorted(out)
+
+
+def metric_value(parsed: Dict[str, Any], metric: str) -> Optional[float]:
+    if metric == "serve_fleet":
+        v = parsed.get("serve_fleet_value")
+    else:
+        v = parsed.get(metric)
+    if isinstance(v, (int, float)) and v > 0:
+        return float(v)
+    return None
+
+
+def check_series(series: List[Tuple[int, Dict[str, Any]]],
+                 threshold: float = THRESHOLD,
+                 window: int = WINDOW) -> List[str]:
+    """Regression findings for the LATEST round vs best-of-window.
+
+    A metric absent from the latest record is only a finding when a
+    previous round DID report it (a tracked number silently vanishing
+    is itself a regression signal); metrics absent everywhere are
+    skipped (older series predate them).
+    """
+    if len(series) < 2:
+        return []
+    latest_round, latest = series[-1]
+    prior = series[:-1][-window:]
+    findings = []
+    for metric in TRACKED:
+        best, best_round = None, None
+        for rnd, parsed in prior:
+            v = metric_value(parsed, metric)
+            if v is not None and (best is None or v > best):
+                best, best_round = v, rnd
+        if best is None:
+            continue
+        now = metric_value(latest, metric)
+        if now is None:
+            findings.append(
+                f"r{latest_round:02d}: tracked metric {metric!r} "
+                f"disappeared (best r{best_round:02d}={best:.1f})")
+            continue
+        drop = 1.0 - now / best
+        if drop > threshold:
+            weather = ""
+            if latest.get("stall_intervals"):
+                weather = (f"  [weather: {latest['stall_intervals']} "
+                           f"stall intervals, "
+                           f"{latest.get('stall_seconds', 0)}s — "
+                           "check resident_mixed_vps before blaming "
+                           "the engine]")
+            findings.append(
+                f"r{latest_round:02d}: {metric} = {now:.1f}, "
+                f"-{drop * 100:.1f}% vs best-of-last-{len(prior)} "
+                f"(r{best_round:02d}={best:.1f}, threshold "
+                f"{threshold * 100:.0f}%){weather}")
+    return findings
+
+
+def check_multichip(series: List[Tuple[int, Dict[str, Any]]]
+                    ) -> List[str]:
+    if not series:
+        return []
+    rnd, latest = series[-1]
+    findings = []
+    if latest.get("skipped"):
+        return []
+    if not latest.get("ok", False) or latest.get("rc", 1) != 0:
+        findings.append(f"MULTICHIP r{rnd:02d}: not ok "
+                        f"(rc={latest.get('rc')})")
+    prev_devs = [d.get("n_devices", 0) for _, d in series[:-1]
+                 if not d.get("skipped")]
+    if prev_devs and latest.get("n_devices", 0) < max(prev_devs):
+        findings.append(
+            f"MULTICHIP r{rnd:02d}: device count shrank "
+            f"({latest.get('n_devices')} < {max(prev_devs)})")
+    return findings
+
+
+def check_self_describing(series: List[Tuple[int, Dict[str, Any]]]
+                          ) -> List[str]:
+    """Round ≥ SELF_DESCRIBING_FROM_ROUND records must carry the
+    decision/SLO embedding (bench.py writes them from this PR on)."""
+    if not series:
+        return []
+    rnd, latest = series[-1]
+    if rnd < SELF_DESCRIBING_FROM_ROUND or not latest:
+        return []
+    findings = []
+    for field in ("decisions", "slo"):
+        if field not in latest:
+            findings.append(
+                f"r{rnd:02d}: BENCH record is not self-describing — "
+                f"missing {field!r} (bench.py must embed it)")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# selftest: the detector must detect
+# ---------------------------------------------------------------------------
+
+
+def _synthetic(values: List[Optional[float]]
+               ) -> List[Tuple[int, Dict[str, Any]]]:
+    return [(i + 1, {} if v is None else {"value": v})
+            for i, v in enumerate(values)]
+
+
+def selftest(repo: str = REPO) -> List[str]:
+    problems = []
+
+    # 1. flat series: clean
+    if check_series(_synthetic([100.0, 101.0, 99.0, 100.0])):
+        problems.append("flat synthetic series flagged")
+    # 2. 16% drop vs best-of-3: must flag
+    if not check_series(_synthetic([100.0, 95.0, 98.0, 84.0])):
+        problems.append("16% synthetic regression NOT flagged")
+    # 3. drop >10% vs best but window slid past the peak: best-of-3
+    #    looks at the last 3 only, so an old peak cannot page forever
+    if check_series(_synthetic([200.0, 100.0, 100.0, 100.0, 95.0])):
+        problems.append("stale-peak comparison leaked past the window")
+    # 4. metric disappearing: must flag
+    gone = _synthetic([100.0, 100.0])
+    gone.append((3, {"value_peak": 5.0}))
+    if not any("disappeared" in f for f in check_series(gone)):
+        problems.append("vanished tracked metric NOT flagged")
+    # 5. the REAL series with a 15% regression injected into a copy of
+    #    the newest record: must flag (the acceptance-bar case)
+    real = load_series(repo)
+    if len(real) >= 2:
+        injected = copy.deepcopy(real)
+        rnd, parsed = injected[-1]
+        bumped = dict(parsed)
+        for metric in TRACKED:
+            v = metric_value(parsed, metric)
+            if v is not None:
+                bumped[metric if metric != "serve_fleet"
+                       else "serve_fleet_value"] = v * 0.85
+        injected[-1] = (rnd, bumped)
+        if not check_series(injected):
+            problems.append(
+                "15% regression injected into the real series NOT "
+                "flagged")
+        # 6. and the real series itself must evaluate (clean or not,
+        #    deterministically — no exceptions)
+        check_series(real)
+    else:
+        problems.append("real BENCH series too short to self-test")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_trend",
+        description="flag >10% regressions in the BENCH_r*.json series")
+    ap.add_argument("--selftest", action="store_true",
+                    help="exercise the detector on synthetic series")
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--threshold", type=float, default=THRESHOLD)
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        problems = selftest(args.repo)
+        if problems:
+            for p in problems:
+                print(f"bench-trend SELFTEST FAIL: {p}",
+                      file=sys.stderr)
+            return 1
+        print("bench-trend selftest OK: detector flags synthetic and "
+              "injected regressions, passes flat series")
+        return 0
+
+    series = load_series(args.repo)
+    if not series:
+        print("bench-trend: no BENCH_r*.json series found",
+              file=sys.stderr)
+        return 1
+    findings = (check_series(series, threshold=args.threshold)
+                + check_multichip(load_multichip(args.repo))
+                + check_self_describing(series))
+    rounds = ", ".join(f"r{r:02d}" for r, _ in series)
+    if findings:
+        for f in findings:
+            print(f"bench-trend REGRESSION: {f}", file=sys.stderr)
+        return 1
+    latest_round, latest = series[-1]
+    vals = {m: metric_value(latest, m) for m in TRACKED}
+    print(f"bench-trend OK: {rounds}; r{latest_round:02d} tracked "
+          + " ".join(f"{m}={v:.0f}" for m, v in vals.items()
+                     if v is not None)
+          + f"; no metric >{args.threshold * 100:.0f}% below "
+            f"best-of-last-{WINDOW}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,12 +18,24 @@ renders the fleet in one screen:
   ``json.dump(fleet_client.snapshot(), f)``);
 - ``--trace ID``: reassemble ONE request's cross-process timeline by
   joining the 16-hex trace id across every scraped flight recorder
-  (plus the client snapshot's spans), ordered by wall-clock start.
+  (plus the client snapshot's spans), ordered by wall-clock start;
+- decision records: per-surface accept/reject-by-reason rollups
+  (``cap_tpu.obs.decision``) from the merged counters;
+- ``--slo`` (rules file via ``--slo-rules``): evaluate SLO burn-rate
+  rules (``cap_tpu.obs.slo`` syntax; defaults when no file) against
+  the merged fleet counters — **exits 2 on any breach**, so cron
+  probes and CI can page on contract burn;
+- ``--postmortem FILE``: render a collected crash postmortem
+  (``cap_tpu.obs.postmortem``) — final flight ring, stage quantiles,
+  decision counters, queue depth at death.
 
 Usage:
     python tools/capstat.py HOST:OBSPORT [HOST:OBSPORT ...]
     python tools/capstat.py --watch 2 HOST:OBSPORT ...
     python tools/capstat.py --trace 33c8b42c35f4be9b HOST:OBSPORT ...
+    python tools/capstat.py --slo HOST:OBSPORT ...
+    python tools/capstat.py --slo-rules slo.rules HOST:OBSPORT ...
+    python tools/capstat.py --postmortem worker-0.json
     python tools/capstat.py --json HOST:OBSPORT ...
 
 Redaction: everything rendered comes from telemetry recorders, whose
@@ -44,6 +56,9 @@ from typing import Any, Dict, List, Optional, Sequence
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cap_tpu import telemetry  # noqa: E402
+from cap_tpu.obs import decision as obs_decision  # noqa: E402
+from cap_tpu.obs import postmortem as obs_postmortem  # noqa: E402
+from cap_tpu.obs import slo as obs_slo  # noqa: E402
 
 # Stage series shown first, in pipeline order (everything else follows
 # alphabetically): the client → router → worker → batcher → device
@@ -178,6 +193,7 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
     lines.append("fleet aggregate (exact bucket merge)")
     lines.extend(_series_rows(telemetry.summarize_snapshot(merged)))
     agg_counters = merged.get("counters") or {}
+    lines.extend(_decision_rows(agg_counters))
     for fam in ("rs", "ps", "es", "ed"):
         waste = agg_counters.get(f"device.{fam}.pad_waste_rows")
         toks = agg_counters.get(f"device.{fam}.tokens")
@@ -208,6 +224,47 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
     return "\n".join(lines)
 
 
+def _decision_rows(counters: Dict[str, Any]) -> List[str]:
+    """Per-surface verdict/reason rollup lines (empty when no decision
+    counters were recorded)."""
+    rows = []
+    for surf, row in sorted(obs_decision.surface_totals(counters).items()):
+        reasons = "  ".join(
+            f"{k.split('.', 1)[1]}={v}" for k, v in sorted(row.items())
+            if k.startswith("reject."))
+        rows.append(f"  decisions[{surf}]: accept={row['accept']} "
+                    f"reject={row['reject']}"
+                    + (f"  ({reasons})" if reasons else ""))
+    return rows
+
+
+def merged_snapshot(worker_data: Dict[str, Dict[str, Any]],
+                    client: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """One merged snapshot over every scraped worker plus (optionally)
+    the client-side snapshot — what the SLO engine evaluates."""
+    snaps = [d.get("snapshot") for d in worker_data.values()]
+    if client is not None:
+        snaps.append(client.get("snapshot"))
+    return telemetry.merge_snapshots(snaps)
+
+
+def run_slo(worker_data: Dict[str, Dict[str, Any]],
+            client: Optional[Dict[str, Any]],
+            rules_file: Optional[str]) -> tuple:
+    """(rendered table, breach?) for the --slo path. A rules file that
+    fails to parse raises SLOError — an unevaluable SLO config is a
+    failure, not a silent pass."""
+    if rules_file:
+        with open(rules_file) as f:
+            rules = obs_slo.parse_rules(f.read())
+    else:
+        rules = obs_slo.default_rules()
+    results = obs_slo.evaluate_once(
+        merged_snapshot(worker_data, client), rules)
+    return obs_slo.format_results(results), obs_slo.any_breach(results)
+
+
 def check_required(worker_data: Dict[str, Dict[str, Any]]) -> List[str]:
     """Missing/NaN required gauges per endpoint (obs-smoke's check)."""
     problems = []
@@ -225,24 +282,48 @@ def check_required(worker_data: Dict[str, Dict[str, Any]]) -> List[str]:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="capstat", description="scrape + render fleet telemetry")
-    ap.add_argument("endpoints", nargs="+",
-                    help="worker obs endpoints (host:port)")
+    ap.add_argument("endpoints", nargs="*",
+                    help="worker obs endpoints (host:port); not "
+                         "needed with --postmortem")
     ap.add_argument("--client", metavar="FILE",
                     help="JSON file with FleetClient.snapshot() for "
                          "breaker/routing view")
     ap.add_argument("--trace", metavar="ID",
                     help="reassemble one trace id across the fleet")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate SLO rules (the default set, or "
+                         "--slo-rules FILE) against the merged fleet; "
+                         "exit 2 on breach")
+    ap.add_argument("--slo-rules", metavar="FILE",
+                    help="rules file for --slo (cap_tpu.obs.slo "
+                         "syntax); implies --slo")
+    ap.add_argument("--postmortem", metavar="FILE",
+                    help="render a collected crash postmortem file "
+                         "(no endpoints scraped)")
     ap.add_argument("--watch", type=float, metavar="SECONDS",
                     help="re-scrape and re-render every N seconds")
     ap.add_argument("--json", action="store_true",
                     help="print the merged scrape as JSON")
     args = ap.parse_args(argv)
 
+    if args.postmortem:
+        doc = obs_postmortem.read_postmortem(args.postmortem)
+        if doc is None:
+            print(f"capstat: cannot read postmortem "
+                  f"{args.postmortem}", file=sys.stderr)
+            return 1
+        print(obs_postmortem.render_postmortem(doc))
+        return 0
+
+    if not args.endpoints:
+        ap.error("endpoints are required unless --postmortem is used")
+
     client = None
     if args.client:
         with open(args.client) as f:
             client = json.load(f)
 
+    breached = False
     while True:
         worker_data: Dict[str, Dict[str, Any]] = {}
         for ep in args.endpoints:
@@ -269,11 +350,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             }, indent=1))
         else:
             print(render_fleet(worker_data, client))
+        if args.slo or args.slo_rules:
+            table, breach = run_slo(worker_data, client,
+                                    args.slo_rules)
+            print(table)
+            breached = breached or breach
         if not args.watch:
             break
         time.sleep(args.watch)
         print()
-    return 0
+    return 2 if breached else 0
 
 
 if __name__ == "__main__":

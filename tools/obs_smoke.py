@@ -4,11 +4,18 @@
 The CI guard for the observability surface (``make obs-smoke``):
 
 1. spawn a 2-worker stub WorkerPool (no jax in the children — starts
-   in ~1 s) and drive a few traced verifies through a FleetClient;
+   in ~1 s) and drive a few traced MIXED (verified + rejected)
+   batches through a FleetClient;
 2. scrape every worker's /metrics (Prometheus text) and /snapshot;
 3. FAIL (exit 1) if any required gauge is missing or NaN, if the
    Prometheus text lacks the required metric families, or if the
-   traced request produced no flight-recorder entry.
+   traced request produced no flight-recorder entry;
+4. FAIL if any exercised surface (serve worker, fleet router) reports
+   ZERO decision counters — accept AND reject must both have counted
+   for the mixed batch (cap_tpu.obs.decision);
+5. FAIL if the SLO engine cannot evaluate the default rules over the
+   live fleet's merged counters, or if the wrong-verdict objective is
+   breached.
 
 Runs under JAX_PLATFORMS=cpu inside the tier-1 time budget (~10 s).
 """
@@ -76,6 +83,37 @@ def main() -> int:
         rendered = capstat.render_fleet(worker_data, cl.snapshot())
         if "fleet aggregate" not in rendered:
             failures.append("capstat.render_fleet missing aggregate")
+
+        # Decision counters: the mixed batches above were half .ok /
+        # half rejected, so BOTH verdicts must have counted on every
+        # exercised surface — workers (merged scrape) and the router
+        # (this process's recorder).
+        from cap_tpu.obs import decision as obs_decision
+        from cap_tpu.obs import slo as obs_slo
+
+        worker_counters = telemetry.merge_snapshots(
+            [d["snapshot"] for d in worker_data.values()]
+        ).get("counters") or {}
+        failures.extend(obs_decision.nonzero_check(worker_counters,
+                                                   ["serve"]))
+        router_counters = telemetry.active().snapshot()["counters"]
+        failures.extend(obs_decision.nonzero_check(router_counters,
+                                                   ["router"]))
+
+        # SLO engine over the LIVE fleet: an evaluation error (not a
+        # breach — a crash/parse failure) is a smoke failure; so is a
+        # wrong-verdict breach, which can only mean corrupted verdict
+        # accounting in a clean stub run.
+        try:
+            merged_all = telemetry.merge_snapshots(
+                [d["snapshot"] for d in worker_data.values()]
+                + [telemetry.active().snapshot()])
+            slo_results = obs_slo.evaluate_once(merged_all)
+            for r in slo_results:
+                if r["name"] == "wrong_verdicts" and not r["ok"]:
+                    failures.append(f"SLO breach in clean run: {r}")
+        except Exception as e:  # noqa: BLE001 - the gate itself
+            failures.append(f"SLO engine evaluation error: {e!r}")
     finally:
         pool.close()
     if failures:
@@ -83,7 +121,9 @@ def main() -> int:
             print(f"obs-smoke FAIL: {f}", file=sys.stderr)
         return 1
     print("obs-smoke OK: 2 workers scraped, required gauges present, "
-          f"trace {tid} landed in a flight recorder")
+          f"trace {tid} landed in a flight recorder, decision "
+          "counters nonzero on serve+router, SLO engine evaluated "
+          "clean")
     return 0
 
 
