@@ -26,7 +26,7 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native test bench clean obs-smoke bench-trend check
+.PHONY: all native test bench clean obs-smoke keyplane-smoke bench-trend check
 
 all: native
 
@@ -60,6 +60,13 @@ golden-go:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/obs_smoke.py
 
+# Keyplane smoke: boot a 2-worker stub fleet, push 3 key epochs while
+# mixed traffic flows, fail on missed convergence, any wrong verdict,
+# a stale keyplane.epoch gauge, or an SLO breach (rotation lag /
+# push-failure rate ride the default rules).
+keyplane-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/keyplane_smoke.py
+
 # Bench regression sentinel: selftest the detector (synthetic series +
 # a 15% regression injected into the real series must flag), then
 # check the committed BENCH_r*/MULTICHIP_r* trajectory — fails when
@@ -69,5 +76,6 @@ bench-trend:
 	$(PYTHON) tools/bench_trend.py --selftest
 	$(PYTHON) tools/bench_trend.py
 
-# The default local CI gate: observability smoke + perf-trend sentinel.
-check: obs-smoke bench-trend
+# The default local CI gate: observability smoke + keyplane rotation
+# smoke + perf-trend sentinel.
+check: obs-smoke keyplane-smoke bench-trend

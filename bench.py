@@ -86,6 +86,61 @@ def _resident_mixed_vps(ks, tokens):
     return resident_slope_vps(n, fns, details=True)
 
 
+def _rotation_fields(ks, jwks, tokens) -> dict:
+    """CAP_BENCH_ROTATE=1: measure hot-rotation cost on the LIVE keyset.
+
+    Three measurements, embedded under ``rotate`` in the BENCH json so
+    tools/bench_trend.py can track rotation cost across rounds:
+
+    - ``swap_s``: wall time of ``swap_keys`` to a same-keys/new-kids
+      JWKS with a grace window (table build + atomic install);
+    - the GRACE window holding: a batch signed under the retired kids
+      right after the swap — rejects and CPU-fallback tokens must both
+      be 0 (retired kids still resolve on the device path);
+    - the ``unknown_kid`` burst WITHOUT grace: the same batch after a
+      zero-grace swap — every retired-kid token falls off the device
+      path onto the CPU oracle (kid is a routing hint, not an
+      enforcement, so verdicts stay correct; the cost is the fallback
+      burst and its wall time).
+    """
+    from cap_tpu import telemetry
+    from cap_tpu.jwt.jwk import JWK
+
+    rotated = [JWK(j.key, kid=(j.kid + "-r2") if j.kid else None,
+                   alg=j.alg, use=j.use) for j in jwks]
+    sample = tokens[:4096]
+    base_epoch = ks.key_epoch
+    t0 = time.perf_counter()
+    ks.swap_keys(rotated, grace_s=300.0)
+    swap_s = time.perf_counter() - t0
+    with telemetry.recording() as rec:
+        t0 = time.perf_counter()
+        out = ks.verify_batch(sample)
+        grace_verify_s = time.perf_counter() - t0
+        grace_fallback = rec.counters().get("cpu_fallback.tokens", 0)
+    grace_rejects = sum(1 for r in out if isinstance(r, Exception))
+    ks.swap_keys(rotated, grace_s=0.0)
+    with telemetry.recording() as rec:
+        t0 = time.perf_counter()
+        out = ks.verify_batch(sample)
+        burst_verify_s = time.perf_counter() - t0
+        burst_fallback = rec.counters().get("cpu_fallback.tokens", 0)
+    burst_rejects = sum(1 for r in out if isinstance(r, Exception))
+    # Restore the original tables so nothing later measures rotated
+    # state (epochs only move forward).
+    ks.swap_keys(jwks, epoch=base_epoch + 3, grace_s=0.0)
+    return {"rotate": {
+        "sample": len(sample),
+        "swap_s": round(swap_s, 4),
+        "grace_window_rejects": grace_rejects,
+        "grace_fallback_tokens": int(grace_fallback),
+        "grace_verify_s": round(grace_verify_s, 4),
+        "unknown_kid_fallback_tokens": int(burst_fallback),
+        "unknown_kid_rejects": burst_rejects,
+        "unknown_kid_verify_s": round(burst_verify_s, 4),
+    }}
+
+
 def _probe_wire_mbps() -> float:
     """Raw sustained H2D bandwidth right now (16 MB u8, best of 2)."""
     import jax
@@ -276,6 +331,14 @@ def main() -> None:
                            "mesh_devices": mesh_n,
                            "mesh_error": repr(e)}
 
+    rotate_fields = {}
+    if os.environ.get("CAP_BENCH_ROTATE") == "1":
+        try:
+            rotate_fields = _rotation_fields(ks, jwks, tokens)
+        except Exception as e:  # noqa: BLE001 - advisory field
+            print(f"rotation bench failed: {e!r}", file=sys.stderr)
+            rotate_fields = {"rotate": {"error": repr(e)}}
+
     print(f"sign={sign_s:.1f}s window={window} "
           f"rates={[round(r) for r in rates]} "
           f"interval_s p50={slats[len(slats) // 2]:.3f} p99={p99:.3f} "
@@ -334,6 +397,9 @@ def main() -> None:
         # CAP_BENCH_MESH=N only: the same resident mix under shard_map
         # (resident_mesh_vps, per-record sorted per-device shard rows).
         **mesh_fields,
+        # CAP_BENCH_ROTATE=1 only: hot-rotation cost (swap latency,
+        # grace-window integrity, unknown-kid fallback burst).
+        **rotate_fields,
     }))
 
 

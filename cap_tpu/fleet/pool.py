@@ -71,6 +71,10 @@ class WorkerHandle:
         self.state = STARTING
         self.restarts = 0
         self.ping_failures = 0
+        # Last key epoch this worker ACKED (or announced on its ready
+        # line); the supervisor re-pushes until it matches the pool's
+        # current distribution — convergence after crash/kill -9.
+        self.key_epoch: Optional[int] = None
         # Latest collected crash/drain postmortem (obs.postmortem doc)
         # and the checkpoint file the worker writes into.
         self.postmortem: Optional[dict] = None
@@ -104,7 +108,8 @@ class WorkerPool:
                  spawn_timeout: float = 60.0, drain_grace: float = 5.0,
                  env_extra: Optional[Dict[str, str]] = None,
                  postmortem_dir: Optional[str] = None,
-                 postmortem_interval: float = 1.0):
+                 postmortem_interval: float = 1.0,
+                 keys_push_timeout: float = 30.0):
         if placements is None:
             placements = single_owner_placement(
                 n_workers, n_devices if n_devices is not None else n_workers,
@@ -136,6 +141,12 @@ class WorkerPool:
         self._pm_dir = (tempfile.mkdtemp(prefix="cap-fleet-pm-")
                         if postmortem_dir is None else postmortem_dir)
         os.makedirs(self._pm_dir, exist_ok=True)
+        # Keyplane distribution state: the epoch+JWKS the fleet should
+        # converge on. Set BEFORE the first worker is contacted in
+        # push_keys, so a crash mid-push leaves the supervisor enough
+        # to finish the rotation on the respawned worker.
+        self._keys_push_timeout = keys_push_timeout
+        self._keys_current: Optional[Tuple[int, dict]] = None
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self._handles = [WorkerHandle(p) for p in placements]
@@ -242,8 +253,101 @@ class WorkerPool:
                     for s in workers.values()),
                 "restarts": {h.worker_id: h.restarts
                              for h in self._handles},
+                "key_epochs": self.key_epochs(),
+                "epoch_skew": self.epoch_skew(),
             },
         }
+
+    # -- keyplane distribution --------------------------------------------
+
+    def push_keys(self, jwks_doc: dict,
+                  epoch: Optional[int] = None) -> Dict[int, Optional[int]]:
+        """Push one key epoch to every READY worker; returns
+        worker_id → acked epoch (None: push failed — the supervisor
+        keeps re-pushing until the worker converges or dies).
+
+        The distribution target is recorded BEFORE any worker is
+        contacted: a worker killed mid-push converges after respawn
+        (the ready-path re-push), and a worker that missed its frame
+        converges on the next supervisor sweep. ``epoch`` defaults to
+        the previous push's epoch + 1.
+        """
+        with self._lock:
+            if epoch is None:
+                epoch = (self._keys_current[0] + 1
+                         if self._keys_current else 1)
+            epoch = int(epoch)
+            self._keys_current = (epoch, jwks_doc)
+            targets = [h for h in self._handles
+                       if h.state == READY and h.address is not None]
+        telemetry.count("keyplane.pushes")
+        telemetry.gauge("keyplane.epoch", epoch)
+        t0 = time.perf_counter()
+        out: Dict[int, Optional[int]] = {}
+        for h in targets:
+            out[h.worker_id] = self._push_keys_to(h, jwks_doc, epoch)
+        if out and all(v == epoch for v in out.values()):
+            # Rotation propagation lag: push start → last ack. The
+            # default SLO rules bound its p99 (docs/KEYPLANE.md).
+            telemetry.observe("keyplane.propagate_s",
+                              time.perf_counter() - t0)
+        with self._lock:
+            for h in self._handles:
+                out.setdefault(h.worker_id, h.key_epoch
+                               if h.key_epoch == epoch else None)
+        return out
+
+    def _push_keys_to(self, h: WorkerHandle, jwks_doc: dict,
+                      epoch: int) -> Optional[int]:
+        """One KEYS push/ack exchange on a fresh connection."""
+        with self._lock:
+            addr = h.address if h.state == READY else None
+        if addr is None:
+            return None
+        telemetry.count("keyplane.push_attempts")
+        try:
+            with socket.create_connection(
+                    addr, timeout=self._ping_timeout) as s:
+                # Table builds on real keysets take longer than a
+                # ping: the exchange gets its own (generous) deadline.
+                s.settimeout(self._keys_push_timeout)
+                protocol.send_keys_push(s, jwks_doc, epoch)
+                ftype, entries = protocol.FrameReader(s).recv_frame()
+        except (OSError, protocol.ProtocolError):
+            telemetry.count("keyplane.push_failures")
+            return None
+        if (ftype != protocol.T_KEYS_ACK or not entries
+                or entries[0][0] != 0):
+            telemetry.count("keyplane.push_failures")
+            return None
+        import json as _json
+
+        try:
+            got = int(_json.loads(entries[0][1]).get("epoch"))
+        except (ValueError, TypeError):
+            telemetry.count("keyplane.push_failures")
+            return None
+        with self._lock:
+            h.key_epoch = got
+        return got
+
+    def key_epochs(self) -> Dict[int, Optional[int]]:
+        """worker_id → last known key epoch (ready line or KEYS ack)."""
+        with self._lock:
+            return {h.worker_id: h.key_epoch for h in self._handles}
+
+    def keys_epoch(self) -> Optional[int]:
+        """The epoch the fleet is converging on (None: never pushed)."""
+        with self._lock:
+            return self._keys_current[0] if self._keys_current else None
+
+    def epoch_skew(self) -> int:
+        """Spread between the newest and oldest known worker epoch —
+        0 when the fleet is converged (what the router surfaces)."""
+        epochs = [e for e in self.key_epochs().values() if e is not None]
+        if not epochs:
+            return 0
+        return max(epochs) - min(epochs)
 
     def postmortem(self, worker_id: int) -> Optional[dict]:
         """The latest postmortem collected for this slot (crash or
@@ -347,6 +451,7 @@ class WorkerPool:
         deadline = time.monotonic() + self._spawn_timeout
         port = None
         obs_port = None
+        epoch = None
         try:
             while time.monotonic() < deadline:
                 line = proc.stdout.readline()
@@ -359,6 +464,8 @@ class WorkerPool:
                             port = int(v)
                         elif k == "obs":
                             obs_port = int(v)
+                        elif k == "epoch":
+                            epoch = int(v)
                     break
         except (OSError, ValueError):
             port = None
@@ -372,8 +479,16 @@ class WorkerPool:
                 h.address = (self._host, port)
                 h.obs_address = ((self._host, obs_port)
                                  if obs_port else None)
+                h.key_epoch = epoch
                 h.state = READY
                 telemetry.count("fleet.workers_started")
+            keys_current = self._keys_current
+        if port is not None and keys_current is not None \
+                and epoch != keys_current[0]:
+            # A (re)spawned worker boots on its own key material:
+            # converge it onto the fleet's current epoch immediately —
+            # the kill -9-mid-push recovery path.
+            self._push_keys_to(h, keys_current[1], keys_current[0])
         # Drain any further output (worker stays quiet normally).
         try:
             for _ in proc.stdout:
@@ -424,6 +539,15 @@ class WorkerPool:
                     if self._ping(addr):
                         with self._lock:
                             h.ping_failures = 0
+                            keys_current = self._keys_current
+                            stale = (keys_current is not None
+                                     and h.key_epoch != keys_current[0])
+                        if stale:
+                            # Missed or failed push (worker restarted
+                            # mid-rotation, transient socket error):
+                            # keep re-pushing until the ack matches.
+                            self._push_keys_to(h, keys_current[1],
+                                               keys_current[0])
                     else:
                         with self._lock:
                             h.ping_failures += 1

@@ -134,7 +134,10 @@ class FleetClient:
                  hedge_after: Optional[float] = None,
                  rr_seed: Optional[int] = None):
         if hasattr(endpoints, "endpoints"):       # a WorkerPool
+            self._pool = endpoints
             endpoints = endpoints.endpoints
+        else:
+            self._pool = None
         self._endpoints_src = endpoints
         self._fallback = fallback
         self._attempt_timeout = attempt_timeout
@@ -420,18 +423,35 @@ class FleetClient:
                          "open_for_s": max(0.0, br.open_until - now)}
                     for ep, br in self._breakers.items()}
 
+    def key_epoch_skew(self) -> Optional[int]:
+        """Key-epoch spread across the pool's workers (0 = converged,
+        None when this client routes to bare endpoints): a sustained
+        nonzero value means part of the fleet is verifying against
+        retired key material — rotation propagation is stuck."""
+        if self._pool is None or not hasattr(self._pool, "epoch_skew"):
+            return None
+        return self._pool.epoch_skew()
+
     def snapshot(self) -> Dict[str, Any]:
         """Client-side observability bundle for ``tools/capstat.py``:
         the process recorder's mergeable snapshot (router counters,
         attempt latency histograms, breaker gauges) plus the live
-        per-endpoint breaker states keyed ``host:port``."""
+        per-endpoint breaker states keyed ``host:port`` and — when the
+        client is pool-backed — the fleet's key-epoch map and skew."""
         rec = telemetry.active()
-        return {
+        out = {
             "snapshot": rec.snapshot() if rec is not None else {},
             "spans": rec.trace_spans() if rec is not None else [],
             "breakers": {f"{ep[0]}:{ep[1]}": st
                          for ep, st in self.breaker_states().items()},
         }
+        skew = self.key_epoch_skew()
+        if skew is not None:
+            out["key_epochs"] = {str(k): v for k, v in
+                                 self._pool.key_epochs().items()}
+            out["epoch_skew"] = skew
+            telemetry.gauge("keyplane.epoch_skew", skew)
+        return out
 
     def close(self) -> None:
         pass                           # attempts own their sockets

@@ -24,6 +24,21 @@ Keyset specs (``--keyset``):
 - ``jwks:<path>`` — a real ``TPUBatchKeySet`` over the JWKS JSON file
   at ``<path>`` (imports jax + the crypto stack; the placement env
   decides which devices the backend sees).
+- ``jwks-url:<url>`` — boot straight from a REMOTE JWKS via the
+  keyplane: a ``KeyPlaneKeySet`` fetches the document, builds the
+  device tables, and keeps them fresh (jittered periodic refresh +
+  singleflight unknown-kid refresh; env knobs
+  ``CAP_KEYPLANE_REFRESH_S`` / ``CAP_KEYPLANE_GRACE_S``). Hot key
+  rotation without a worker restart — see docs/KEYPLANE.md.
+- ``oidc:<issuer>`` — same, with the JWKS URL resolved through OIDC
+  discovery (issuer-equality enforced).
+
+Every keyset kind accepts the fleet's KEYS pushes (CVB1 type 11):
+``swap_keys`` swaps the live tables and the ready line / STATS /
+``/snapshot`` all report ``key_epoch`` so the pool can verify epoch
+convergence. The stub records the epoch without changing verdicts —
+rotation must never alter a stub fleet's ground truth, which is
+exactly what the rotation chaos tests assert.
 """
 
 from __future__ import annotations
@@ -47,6 +62,15 @@ class StubKeySet:
     def __init__(self, batch_ms: float = 0.0, token_us: float = 0.0):
         self._batch_s = batch_ms / 1e3
         self._token_s = token_us / 1e6
+        self.key_epoch = 0
+
+    def swap_keys(self, jwks, epoch=None, grace_s: float = 0.0) -> int:
+        """Keyplane hook: record the pushed epoch. Verdicts stay
+        suffix-determined — a rotation must not change the fleet
+        tests' ground truth (that WOULD be a wrong verdict)."""
+        self.key_epoch = (self.key_epoch + 1 if epoch is None
+                          else int(epoch))
+        return self.key_epoch
 
     def verify_batch(self, tokens):
         from ..errors import InvalidSignatureError
@@ -85,6 +109,16 @@ def make_keyset(spec: str):
         with open(spec[len("jwks:"):], "r") as f:
             doc = json.load(f)
         return TPUBatchKeySet(parse_jwks(doc))
+    if spec.startswith("jwks-url:") or spec.startswith("oidc:"):
+        _configure_devices()
+        from ..keyplane import source_for_spec
+        from ..keyplane.plane import KeyPlaneKeySet
+
+        return KeyPlaneKeySet(
+            source_for_spec(spec),
+            interval_s=float(os.environ.get(
+                "CAP_KEYPLANE_REFRESH_S", "300")),
+            grace_s=float(os.environ.get("CAP_KEYPLANE_GRACE_S", "30")))
     raise ValueError(f"unknown keyset spec {spec!r}")
 
 
@@ -148,12 +182,15 @@ def main(argv=None) -> int:
                               stats_fn=worker.stats)
     host, port = worker.address
     obs = worker.obs_address
+    epoch = worker.key_epoch
     # The ONE ready line the pool parses; flushed so it cannot sit in a
     # stdio buffer while the pool's spawn timeout burns. Additive
-    # fields (obs=) ride the same k=v format the pool already skips
-    # when unknown.
+    # fields (obs=, epoch=) ride the same k=v format the pool already
+    # skips when unknown.
     print(f"CAP_FLEET_READY port={port} pid={os.getpid()}"
-          + (f" obs={obs[1]}" if obs is not None else ""), flush=True)
+          + (f" obs={obs[1]}" if obs is not None else "")
+          + (f" epoch={epoch}" if epoch is not None else ""),
+          flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from .. import telemetry
 from ..errors import (
     InvalidJWKSError,
     InvalidParameterError,
@@ -111,15 +112,25 @@ class JSONWebKeySet(KeySet):
     the token's kid triggers one refetch (key-rotation handling), the
     same observable behavior as the coreos RemoteKeySet the reference
     wraps. Thread-safe.
+
+    ``refresh_cooldown_s``: minimum interval between MISS-triggered
+    refetches. Repeated unknown-kid lookups inside the window raise
+    without touching the network — attacker tokens carrying random
+    kids must not amplify 1:1 into IdP fetches (DoS guard). The
+    initial cache fill and explicit ``keys(refresh=True)`` calls are
+    not throttled.
     """
 
-    def __init__(self, jwks_url: str, jwks_ca_pem: Optional[str] = None):
+    def __init__(self, jwks_url: str, jwks_ca_pem: Optional[str] = None,
+                 refresh_cooldown_s: float = 10.0):
         if not jwks_url:
             raise NilParameterError("jwks_url is required")
         self._url = jwks_url
         self._ssl_ctx = _http.ssl_context_for_ca(jwks_ca_pem)
         self._lock = threading.Lock()
         self._keys: Optional[List[JWK]] = None
+        self._refresh_cooldown = refresh_cooldown_s
+        self._last_miss_refresh = float("-inf")
 
     # -- key cache ---------------------------------------------------------
 
@@ -181,6 +192,23 @@ class JSONWebKeySet(KeySet):
             # kid cache miss only → one refetch (key rotation). A failed
             # verification against cached candidates must NOT hit the
             # network — forged tokens would amplify into IdP fetches.
+            now = time.monotonic()
+            with self._lock:
+                cooled = (now - self._last_miss_refresh
+                          < self._refresh_cooldown)
+                if not cooled:
+                    # Stamp BEFORE the fetch: a slow or failing IdP
+                    # must also respect the cooldown, or every
+                    # unknown-kid token blocks on a doomed fetch.
+                    self._last_miss_refresh = now
+            if cooled:
+                telemetry.count("jwks.refresh_suppressed")
+                if parsed.kid is not None:
+                    raise UnknownKeyIDError(
+                        "no key matches kid (refresh cooldown active)"
+                    ) from last_err
+                raise InvalidSignatureError(
+                    "failed to verify id token signature") from last_err
             keys = self.keys(refresh=True)
             refreshed = self._candidates(keys, parsed)
             for jwk in refreshed:

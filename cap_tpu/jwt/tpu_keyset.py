@@ -400,6 +400,112 @@ def resident_slope_vps(n: int, fns, reps: int = 4,
     return vps
 
 
+class _KeyTables(object):
+    """One epoch's immutable key-table set: JWKs partitioned into
+    per-family device tables plus the kid-routing maps.
+
+    Everything a batch needs to resolve kids and dispatch lives here,
+    built ONCE and never mutated — ``TPUBatchKeySet.swap_keys``
+    installs a fresh instance atomically, so an in-flight batch that
+    captured the previous instance finishes entirely on its epoch.
+    """
+
+    __slots__ = ("epoch", "jwks", "by_kid", "kids", "rsa_tables",
+                 "n_rsa_keys", "ec_tables", "ed_table", "rsa_rows",
+                 "ec_rows", "ed_rows", "kid_rsa_row", "kid_ec_row",
+                 "kid_ed_row", "ec_keys", "ed_keys")
+
+    def __init__(self, jwks: Sequence[JWK], epoch: int = 0):
+        from cryptography.hazmat.primitives.asymmetric import (
+            ec,
+            ed25519,
+            rsa,
+        )
+
+        self.epoch = int(epoch)
+        self.jwks = list(jwks)
+        # Partition keys into family tables; remember each JWK's slot.
+        # RSA keys additionally split into SIZE CLASSES (one table per
+        # limb width): a mixed 2048/4096 JWKS must not pad every
+        # token's wire record to the widest key (the round-1 config-②
+        # cliff). Rows encode as class*_RSA_CLS_STRIDE + row.
+        from ..tpu.limbs import nlimbs_for_bits
+
+        rsa_classes: List[list] = []      # per class: [(n, e), ...]
+        rsa_class_need: List[int] = []    # per class: limb width
+        self.rsa_rows: Dict[int, int] = {}
+        self.ec_keys: Dict[str, list] = {}
+        self.ec_rows: Dict[str, Dict[int, int]] = {}
+        self.ed_keys, self.ed_rows = [], {}
+        for i, jwk in enumerate(self.jwks):
+            key = jwk.key
+            if isinstance(key, rsa.RSAPublicKey):
+                nums = key.public_numbers()
+                need = nlimbs_for_bits(nums.n.bit_length())
+                try:
+                    cls = rsa_class_need.index(need)
+                except ValueError:
+                    cls = len(rsa_classes)
+                    rsa_classes.append([])
+                    rsa_class_need.append(need)
+                self.rsa_rows[i] = (cls * _RSA_CLS_STRIDE
+                                    + len(rsa_classes[cls]))
+                rsa_classes[cls].append((nums.n, nums.e))
+            elif isinstance(key, ec.EllipticCurvePublicKey):
+                crv = {"secp256r1": "P-256", "secp384r1": "P-384",
+                       "secp521r1": "P-521"}[key.curve.name]
+                rows = self.ec_rows.setdefault(crv, {})
+                rows[i] = len(self.ec_keys.setdefault(crv, []))
+                self.ec_keys[crv].append(key)
+            elif isinstance(key, ed25519.Ed25519PublicKey):
+                self.ed_rows[i] = len(self.ed_keys)
+                self.ed_keys.append(key)
+
+        self.rsa_tables: List[Any] = []
+        if rsa_classes:
+            from ..tpu.rsa import RSAKeyTable
+            self.rsa_tables = [RSAKeyTable(nums) for nums in rsa_classes]
+        self.n_rsa_keys = sum(len(c) for c in rsa_classes)
+        self.ec_tables: Dict[str, Any] = {}
+        for crv, keys in self.ec_keys.items():
+            try:
+                from ..tpu.ec import ECKeyTable
+                self.ec_tables[crv] = ECKeyTable(crv, keys)
+            except ImportError:
+                pass  # EC engine not built yet → CPU fallback
+        self.ed_table = None
+        if self.ed_keys:
+            try:
+                from ..tpu.ed25519 import Ed25519KeyTable
+                self.ed_table = Ed25519KeyTable(self.ed_keys)
+            except ImportError:
+                pass
+
+        self.by_kid: Dict[str, List[int]] = {}
+        for i, jwk in enumerate(self.jwks):
+            if jwk.kid:
+                self.by_kid.setdefault(jwk.kid, []).append(i)
+        self.kids = frozenset(self.by_kid)
+
+        # kid → family table row, for kids resolving to exactly one key
+        # (ambiguous kids take the trial-verify slow path)
+        self.kid_rsa_row: Dict[str, int] = {}
+        self.kid_ec_row: Dict[str, Dict[str, int]] = {c: {} for c in
+                                                      self.ec_rows}
+        self.kid_ed_row: Dict[str, int] = {}
+        for kid, idxs in self.by_kid.items():
+            if len(idxs) != 1:
+                continue
+            i = idxs[0]
+            if i in self.rsa_rows:
+                self.kid_rsa_row[kid] = self.rsa_rows[i]
+            for crv, rows in self.ec_rows.items():
+                if i in rows:
+                    self.kid_ec_row[crv][kid] = rows[i]
+            if i in self.ed_rows:
+                self.kid_ed_row[kid] = self.ed_rows[i]
+
+
 class TPUBatchKeySet(KeySet):
     """KeySet whose batch path runs on the TPU verify engine.
 
@@ -417,13 +523,15 @@ class TPUBatchKeySet(KeySet):
     ``"affine"``, or None for the global default
     (``cap_tpu.tpu.ec.ladder_mode``, env CAP_TPU_EC_LADDER). Verdicts
     are bit-exact either way; see docs/PERF.md for the A/B.
+
+    ``epoch``: the key-material version this initial table set
+    represents (the keyplane's counter); :meth:`swap_keys` installs
+    later epochs without restarting anything — see docs/KEYPLANE.md.
     """
 
     def __init__(self, jwks: Sequence[JWK], max_chunk: int = 32768,
                  cpu_fallback: bool = True, mesh=None,
-                 ec_ladder: Optional[str] = None):
-        from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
-
+                 ec_ladder: Optional[str] = None, epoch: int = 0):
         if not jwks:
             raise NilParameterError("at least one key is required")
         if ec_ladder is not None:
@@ -431,7 +539,6 @@ class TPUBatchKeySet(KeySet):
 
             resolve_ladder(ec_ladder)     # raises on unknown modes
         self._ec_ladder = ec_ladder
-        self._jwks = list(jwks)
         self._max_chunk = max_chunk
         self._cpu_fallback = cpu_fallback
         self._mesh = mesh
@@ -445,110 +552,172 @@ class TPUBatchKeySet(KeySet):
         self._last_collect_t: Optional[float] = None
         self._chunk_budget_s = float(os.environ.get(
             "CAP_TPU_CHUNK_BUDGET_MS", "250")) / 1e3
+        import threading
 
-        # Partition keys into family tables; remember each JWK's slot.
-        # RSA keys additionally split into SIZE CLASSES (one table per
-        # limb width): a mixed 2048/4096 JWKS must not pad every
-        # token's wire record to the widest key (the round-1 config-②
-        # cliff). Rows encode as class*_RSA_CLS_STRIDE + row.
-        from ..tpu.limbs import nlimbs_for_bits
+        self._swap_lock = threading.Lock()
+        self._tables = _KeyTables(jwks, epoch=epoch)
 
-        rsa_classes: List[list] = []      # per class: [(n, e), ...]
-        rsa_class_need: List[int] = []    # per class: limb width
-        self._rsa_rows: Dict[int, int] = {}
-        self._ec_keys: Dict[str, list] = {}
-        self._ec_rows: Dict[str, Dict[int, int]] = {}
-        self._ed_keys, self._ed_rows = [], {}
-        for i, jwk in enumerate(self._jwks):
-            key = jwk.key
-            if isinstance(key, rsa.RSAPublicKey):
-                nums = key.public_numbers()
-                need = nlimbs_for_bits(nums.n.bit_length())
-                try:
-                    cls = rsa_class_need.index(need)
-                except ValueError:
-                    cls = len(rsa_classes)
-                    rsa_classes.append([])
-                    rsa_class_need.append(need)
-                self._rsa_rows[i] = (cls * _RSA_CLS_STRIDE
-                                     + len(rsa_classes[cls]))
-                rsa_classes[cls].append((nums.n, nums.e))
-            elif isinstance(key, ec.EllipticCurvePublicKey):
-                crv = {"secp256r1": "P-256", "secp384r1": "P-384",
-                       "secp521r1": "P-521"}[key.curve.name]
-                rows = self._ec_rows.setdefault(crv, {})
-                rows[i] = len(self._ec_keys.setdefault(crv, []))
-                self._ec_keys[crv].append(key)
-            elif isinstance(key, ed25519.Ed25519PublicKey):
-                self._ed_rows[i] = len(self._ed_keys)
-                self._ed_keys.append(key)
+    # -- epoch-versioned key tables (keyplane hot swap) --------------------
 
-        self._rsa_tables: List[Any] = []
-        if rsa_classes:
-            from ..tpu.rsa import RSAKeyTable
-            self._rsa_tables = [RSAKeyTable(nums) for nums in rsa_classes]
-        self._n_rsa_keys = sum(len(c) for c in rsa_classes)
-        self._ec_tables: Dict[str, Any] = {}
-        for crv, keys in self._ec_keys.items():
-            try:
-                from ..tpu.ec import ECKeyTable
-                self._ec_tables[crv] = ECKeyTable(crv, keys)
-            except ImportError:
-                pass  # EC engine not built yet → CPU fallback
-        self._ed_table = None
-        if self._ed_keys:
-            try:
-                from ..tpu.ed25519 import Ed25519KeyTable
-                self._ed_table = Ed25519KeyTable(self._ed_keys)
-            except ImportError:
-                pass
+    @property
+    def key_epoch(self) -> int:
+        """Epoch of the tables NEW batches dispatch against."""
+        return self._tables.epoch
 
-        self._by_kid: Dict[str, List[int]] = {}
-        for i, jwk in enumerate(self._jwks):
-            if jwk.kid:
-                self._by_kid.setdefault(jwk.kid, []).append(i)
+    def swap_keys(self, jwks, epoch: Optional[int] = None,
+                  grace_s: float = 30.0) -> int:
+        """Hot-swap the key tables to a new epoch; returns the epoch.
 
-        # kid → family table row, for kids resolving to exactly one key
-        # (ambiguous kids take the trial-verify slow path)
-        self._kid_rsa_row: Dict[str, int] = {}
-        self._kid_ec_row: Dict[str, Dict[str, int]] = {c: {} for c in
-                                                       self._ec_rows}
-        self._kid_ed_row: Dict[str, int] = {}
-        for kid, idxs in self._by_kid.items():
-            if len(idxs) != 1:
-                continue
-            i = idxs[0]
-            if i in self._rsa_rows:
-                self._kid_rsa_row[kid] = self._rsa_rows[i]
-            for crv, rows in self._ec_rows.items():
-                if i in rows:
-                    self._kid_ec_row[crv][kid] = rows[i]
-            if i in self._ed_rows:
-                self._kid_ed_row[kid] = self._ed_rows[i]
+        ``jwks``: a JWKS document (dict — parsed via
+        :func:`cap_tpu.jwt.jwk.parse_jwks`) or a sequence of
+        :class:`JWK`. ``epoch``: the keyplane's version for this
+        material (default: current + 1).
+
+        Semantics:
+
+        - the replacement tables are built OFF the serving path (in
+          the caller's thread — refresher/push threads, never a verify
+          thread) and installed with one atomic reference swap;
+        - batches already dispatched keep the table set they captured
+          and finish entirely on their epoch;
+        - for ``grace_s`` seconds, kids that exist only in the OLD
+          epoch still resolve (the installed set is the new JWKS plus
+          the retired-kid keys), so tokens signed moments before the
+          rotation don't flap to unknown-kid rejects; after the grace
+          window a pure new-epoch table set is built in the background
+          and takes over.
+        """
+        if isinstance(jwks, dict):
+            from .jwk import parse_jwks
+
+            jwks = parse_jwks(jwks)
+        jwks = list(jwks)
+        if not jwks:
+            raise NilParameterError("at least one key is required")
+        import threading
+
+        t0 = time.perf_counter()
+        with self._swap_lock:
+            old = self._tables
+            new_epoch = old.epoch + 1 if epoch is None else int(epoch)
+            new_kids = {j.kid for j in jwks if j.kid}
+            retained = ([j for j in old.jwks
+                         if j.kid and j.kid not in new_kids]
+                        if grace_s > 0 else [])
+            with telemetry.span(telemetry.SPAN_KEYPLANE_SWAP):
+                self._tables = _KeyTables(jwks + retained,
+                                          epoch=new_epoch)
+        if retained:
+            telemetry.count("keyplane.grace_kids", len(retained))
+            timer = threading.Timer(
+                grace_s, self._retire_grace, args=(jwks, new_epoch))
+            timer.daemon = True
+            timer.start()
+        telemetry.count("keyplane.swaps")
+        telemetry.observe("keyplane.swap_s", time.perf_counter() - t0)
+        telemetry.gauge("keyplane.epoch", new_epoch)
+        return new_epoch
+
+    def _retire_grace(self, jwks, epoch: int) -> None:
+        """Grace expiry: install the pure new-epoch tables (background
+        thread — the build never runs on a verify path). A newer swap
+        having landed meanwhile makes this a no-op."""
+        try:
+            pure = _KeyTables(jwks, epoch=epoch)
+        except Exception:  # noqa: BLE001 - keep serving graced tables
+            telemetry.count("keyplane.grace_retire_errors")
+            return
+        with self._swap_lock:
+            if self._tables.epoch == epoch:
+                self._tables = pure
+                telemetry.count("keyplane.grace_retired")
+
+    # Compatibility delegates: the pre-keyplane attribute names, used
+    # by resident_dispatchers/bench/tests, read the CURRENT epoch.
+    @property
+    def _jwks(self):
+        return self._tables.jwks
+
+    @property
+    def _by_kid(self):
+        return self._tables.by_kid
+
+    @property
+    def _rsa_tables(self):
+        return self._tables.rsa_tables
+
+    @property
+    def _n_rsa_keys(self):
+        return self._tables.n_rsa_keys
+
+    @property
+    def _ec_tables(self):
+        return self._tables.ec_tables
+
+    @property
+    def _ed_table(self):
+        return self._tables.ed_table
+
+    @property
+    def _rsa_rows(self):
+        return self._tables.rsa_rows
+
+    @property
+    def _ec_rows(self):
+        return self._tables.ec_rows
+
+    @property
+    def _ed_rows(self):
+        return self._tables.ed_rows
+
+    @property
+    def _kid_rsa_row(self):
+        return self._tables.kid_rsa_row
+
+    @property
+    def _kid_ec_row(self):
+        return self._tables.kid_ec_row
+
+    @property
+    def _kid_ed_row(self):
+        return self._tables.kid_ed_row
+
+    @property
+    def _ec_keys(self):
+        return self._tables.ec_keys
+
+    @property
+    def _ed_keys(self):
+        return self._tables.ed_keys
 
     # -- single-token path (CPU oracle) -----------------------------------
 
-    def _candidate_indices(self, parsed: ParsedJWS) -> List[int]:
-        if parsed.kid is not None and parsed.kid in self._by_kid:
-            pool = self._by_kid[parsed.kid]
+    def _candidate_indices(self, parsed: ParsedJWS,
+                           tables: Optional[_KeyTables] = None
+                           ) -> List[int]:
+        t = self._tables if tables is None else tables
+        if parsed.kid is not None and parsed.kid in t.by_kid:
+            pool = t.by_kid[parsed.kid]
         else:
-            pool = range(len(self._jwks))
+            pool = range(len(t.jwks))
         return [i for i in pool
-                if key_matches_alg(self._jwks[i].key, parsed.alg)]
+                if key_matches_alg(t.jwks[i].key, parsed.alg)]
 
     def verify_signature(self, token: str) -> Dict[str, Any]:
         return self._verify_parsed_trial(parse_jws(token))
 
     # -- batch path --------------------------------------------------------
 
-    def _verify_parsed_trial(self, parsed: ParsedJWS):
+    def _verify_parsed_trial(self, parsed: ParsedJWS,
+                             tables: Optional[_KeyTables] = None):
         """Trial-verify one parsed token against the candidate keys —
         the single-token verdict logic, shared by verify_signature and
         the batch path's non-compactable JSON-form fallback."""
+        t = self._tables if tables is None else tables
         last: Optional[Exception] = None
-        for i in self._candidate_indices(parsed):
+        for i in self._candidate_indices(parsed, t):
             try:
-                verify_parsed(parsed, self._jwks[i].key)
+                verify_parsed(parsed, t.jwks[i].key)
                 return parsed.claims()
             except InvalidSignatureError as e:
                 last = e
@@ -648,6 +817,10 @@ class TPUBatchKeySet(KeySet):
         """Phase 1: prep, bucket, pack, and queue ALL device work."""
         from ..runtime.native_binding import ALG_NAMES, prepare_batch_arrays
 
+        # Epoch capture: ONE immutable table set serves this whole
+        # batch (dispatch, collect, slow-path trials) — a swap_keys
+        # landing mid-batch changes only batches dispatched after it.
+        tables = self._tables
         # Wire-estimate span starts HERE: transfers drain while later
         # chunks are still being packed, so measuring from dispatch END
         # would overestimate the link (the sync would block briefly on
@@ -672,7 +845,7 @@ class TPUBatchKeySet(KeySet):
                 results[i] = sp
             else:
                 try:
-                    results[i] = self._verify_parsed_trial(sp)
+                    results[i] = self._verify_parsed_trial(sp, tables)
                     special_payloads[i] = sp.payload
                 except Exception as e:  # noqa: BLE001 - per-token
                     results[i] = e
@@ -702,7 +875,7 @@ class TPUBatchKeySet(KeySet):
         def run_rs(alg_name: str, idx: np.ndarray) -> None:
             self._run_rsa_packed("rs", _RS[alg_name], idx, pb,
                                  packed_parts, packed_meta, pending,
-                                 slow, results, stats)
+                                 slow, results, stats, tables)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
             # Every PS* family rides the packed single-transfer path
@@ -711,23 +884,23 @@ class TPUBatchKeySet(KeySet):
             # tpu/sha512.py) — no EM bytes return to the host.
             self._run_rsa_packed("ps", _PS[alg_name], idx, pb,
                                  packed_parts, packed_meta,
-                                 pending, slow, results, stats)
+                                 pending, slow, results, stats, tables)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
             self._run_ec_packed(alg_name, idx, pb, packed_parts,
                                 packed_meta, pending, slow, results,
-                                stats)
+                                stats, tables)
 
         def run_ed(alg_name: str, idx: np.ndarray) -> None:
             self._run_ed_packed(idx, pb, packed_parts, packed_meta,
-                                pending, slow, results, stats)
+                                pending, slow, results, stats, tables)
 
         for a, crv in _ES.items():
-            if crv in self._ec_tables:
+            if crv in tables.ec_tables:
                 run_family(a, run_es)
-        if self._ed_table is not None:
+        if tables.ed_table is not None:
             run_family(algs.EdDSA, run_ed)
-        if self._rsa_tables:
+        if tables.rsa_tables:
             for a in _RS:
                 run_family(a, run_rs)
             for a in _PS:
@@ -736,7 +909,7 @@ class TPUBatchKeySet(KeySet):
         return dict(pb=pb, n=n, ok=ok, results=results, slow=slow,
                     pending=pending, packed_parts=packed_parts,
                     packed_meta=packed_meta, stats=stats,
-                    t_dispatch=t_dispatch,
+                    t_dispatch=t_dispatch, tables=tables,
                     special_payloads=special_payloads)
 
     def _collect_batch(self, state: dict) -> List[Any]:
@@ -792,7 +965,8 @@ class TPUBatchKeySet(KeySet):
             telemetry.count("cpu_fallback.tokens", len(slow_set))
             with telemetry.span("cpu_fallback"):
                 for j in sorted(slow_set):
-                    out = self._verify_one_parsed(pb.parsed(j))
+                    out = self._verify_one_parsed(pb.parsed(j),
+                                                  state.get("tables"))
                     if raw and not isinstance(out, Exception):
                         # the oracle built the dict from these bytes
                         out = pb.payload_bytes(j)
@@ -919,11 +1093,13 @@ class TPUBatchKeySet(KeySet):
                         packed_meta: List[tuple],
                         pending: List[tuple],
                         slow: List[int], results: List[Any],
-                        stats: dict) -> None:
+                        stats: dict,
+                        tables: Optional[_KeyTables] = None) -> None:
         from ..tpu import rsa as tpursa
 
-        rows = pb.kid_rows(idx, self._kid_rsa_row)
-        if self._n_rsa_keys == 1:
+        t = self._tables if tables is None else tables
+        rows = pb.kid_rows(idx, t.kid_rsa_row)
+        if t.n_rsa_keys == 1:
             rows = np.where(rows == -1, 0, rows)
         fast = rows >= 0
         slow.extend(int(i) for i in idx[~fast])
@@ -932,7 +1108,7 @@ class TPUBatchKeySet(KeySet):
         if len(idx) == 0:
             return
         h_len = tpursa.HASH_LEN[hash_name]
-        for cls, table in enumerate(self._rsa_tables):
+        for cls, table in enumerate(t.rsa_tables):
             sel = (rows // _RSA_CLS_STRIDE) == cls
             if not sel.any():
                 continue
@@ -940,7 +1116,8 @@ class TPUBatchKeySet(KeySet):
             cls_rows = rows[sel] % _RSA_CLS_STRIDE
             if len(table.n_ints) > 255:    # kid row must fit a u8
                 self._run_rsa_arrays(kind, hash_name, cls_idx, pb,
-                                     pending, slow, stats, cls=cls)
+                                     pending, slow, stats, cls=cls,
+                                     tables=t)
                 continue
             width = 2 * table.k
             chunk_n = self._chunk_tokens(width + h_len
@@ -975,17 +1152,19 @@ class TPUBatchKeySet(KeySet):
                        packed_meta: List[tuple],
                        pending: List[tuple],
                        slow: List[int], results: List[Any],
-                       stats: dict) -> None:
+                       stats: dict,
+                       tables: Optional[_KeyTables] = None) -> None:
         from ..tpu import ec as tpuec
         from ..tpu.rsa import HASH_LEN
 
+        t = self._tables if tables is None else tables
         crv = _ES[alg]
-        table = self._ec_tables[crv]
+        table = t.ec_tables[crv]
         if len(table.keys) > 255:
             return self._run_ec_arrays(alg, idx, pb, pending, slow,
-                                       stats)
+                                       stats, tables=t)
         hash_len = HASH_LEN[algs.HASH_FOR_ALG[alg]]
-        rows = pb.kid_rows(idx, self._kid_ec_row[crv])
+        rows = pb.kid_rows(idx, t.kid_ec_row[crv])
         if len(table.keys) == 1:
             rows = np.where(rows == -1, 0, rows)
         fast = rows >= 0
@@ -1031,11 +1210,13 @@ class TPUBatchKeySet(KeySet):
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
                         pb, pending: List[tuple],
                         slow: List[int], stats: dict,
-                        cls: Optional[int] = None) -> None:
+                        cls: Optional[int] = None,
+                        tables: Optional[_KeyTables] = None) -> None:
         from ..tpu import rsa as tpursa
 
-        rows = pb.kid_rows(idx, self._kid_rsa_row)
-        if self._n_rsa_keys == 1:
+        t = self._tables if tables is None else tables
+        rows = pb.kid_rows(idx, t.kid_rsa_row)
+        if t.n_rsa_keys == 1:
             # single-key family: kid-less tokens have exactly one
             # candidate — dispatch them to the device (row 0), matching
             # the object path's single-candidate routing
@@ -1046,7 +1227,7 @@ class TPUBatchKeySet(KeySet):
         rows = rows[fast].astype(np.int32)
         if len(idx) == 0:
             return
-        for c, table in enumerate(self._rsa_tables):
+        for c, table in enumerate(t.rsa_tables):
             if cls is not None and c != cls:
                 continue
             sel = (rows // _RSA_CLS_STRIDE) == c
@@ -1087,14 +1268,16 @@ class TPUBatchKeySet(KeySet):
 
     def _run_ec_arrays(self, alg: str, idx: np.ndarray, pb,
                        pending: List[tuple], slow: List[int],
-                       stats: dict) -> None:
+                       stats: dict,
+                       tables: Optional[_KeyTables] = None) -> None:
         from ..tpu import ec as tpuec
         from ..tpu.rsa import HASH_LEN
 
+        t = self._tables if tables is None else tables
         crv = _ES[alg]
-        table = self._ec_tables[crv]
+        table = t.ec_tables[crv]
         hash_len = HASH_LEN[algs.HASH_FOR_ALG[alg]]
-        rows = pb.kid_rows(idx, self._kid_ec_row[crv])
+        rows = pb.kid_rows(idx, t.kid_ec_row[crv])
         if len(table.keys) == 1:
             # kid-less tokens have exactly one candidate on this curve
             rows = np.where(rows == -1, 0, rows)
@@ -1135,13 +1318,16 @@ class TPUBatchKeySet(KeySet):
                        packed_meta: List[tuple],
                        pending: List[tuple],
                        slow: List[int], results: List[Any],
-                       stats: dict) -> None:
+                       stats: dict,
+                       tables: Optional[_KeyTables] = None) -> None:
         from ..tpu import ed25519 as tpued
 
-        table = self._ed_table
+        t = self._tables if tables is None else tables
+        table = t.ed_table
         if len(table.keys) > 255:
-            return self._run_ed_arrays(idx, pb, pending, slow, stats)
-        rows = pb.kid_rows(idx, self._kid_ed_row)
+            return self._run_ed_arrays(idx, pb, pending, slow, stats,
+                                       tables=t)
+        rows = pb.kid_rows(idx, t.kid_ed_row)
         if len(table.keys) == 1:
             rows = np.where(rows == -1, 0, rows)
         fast = rows >= 0
@@ -1179,11 +1365,13 @@ class TPUBatchKeySet(KeySet):
 
     def _run_ed_arrays(self, idx: np.ndarray, pb,
                        pending: List[tuple], slow: List[int],
-                       stats: dict) -> None:
+                       stats: dict,
+                       tables: Optional[_KeyTables] = None) -> None:
         from ..tpu import ed25519 as tpued
 
-        table = self._ed_table
-        rows = pb.kid_rows(idx, self._kid_ed_row)
+        t = self._tables if tables is None else tables
+        table = t.ed_table
+        rows = pb.kid_rows(idx, t.kid_ed_row)
         if len(table.keys) == 1:
             # kid-less tokens have exactly one EdDSA candidate
             rows = np.where(rows == -1, 0, rows)
@@ -1215,16 +1403,18 @@ class TPUBatchKeySet(KeySet):
                     table, sigs, msgs, key_idx)
             pending.append((chunk, m, fin))
 
-    def _verify_one_parsed(self, p) -> Any:
+    def _verify_one_parsed(self, p,
+                           tables: Optional[_KeyTables] = None) -> Any:
         """CPU trial verification of one parsed token (slow path)."""
+        t = self._tables if tables is None else tables
         if not self._cpu_fallback:
             return InvalidParameterError(
                 "token cannot be dispatched to the device engine and "
                 "CPU fallback is disabled")
         last: Optional[Exception] = None
-        for i in self._candidate_indices(p):
+        for i in self._candidate_indices(p, t):
             try:
-                verify_parsed(p, self._jwks[i].key)
+                verify_parsed(p, t.jwks[i].key)
                 try:
                     return p.claims()
                 except MalformedTokenError as e:
@@ -1238,6 +1428,7 @@ class TPUBatchKeySet(KeySet):
 
     def _verify_batch_objects(self, tokens: Sequence[str]) -> List[Any]:
         n = len(tokens)
+        tables = self._tables        # one epoch serves this batch
         results: List[Any] = [None] * n
         parsed_list: List[Optional[ParsedJWS]] = [None] * n
         key_for: List[Optional[int]] = [None] * n
@@ -1252,7 +1443,7 @@ class TPUBatchKeySet(KeySet):
                 results[j] = p
                 continue
             parsed_list[j] = p
-            cands = self._candidate_indices(p)
+            cands = self._candidate_indices(p, tables)
             if len(cands) == 1:
                 key_for[j] = cands[0]
             elif not cands:
@@ -1267,27 +1458,29 @@ class TPUBatchKeySet(KeySet):
                 continue
             if key_for[j] is None:
                 buckets.setdefault(("cpu",), []).append(j)
-            elif p.alg in _RS and self._rsa_tables:
+            elif p.alg in _RS and tables.rsa_tables:
                 buckets.setdefault(("rs", _RS[p.alg]), []).append(j)
-            elif p.alg in _PS and self._rsa_tables:
+            elif p.alg in _PS and tables.rsa_tables:
                 buckets.setdefault(("ps", _PS[p.alg]), []).append(j)
-            elif p.alg in _ES and _ES[p.alg] in self._ec_tables:
+            elif p.alg in _ES and _ES[p.alg] in tables.ec_tables:
                 buckets.setdefault(("es", p.alg), []).append(j)
-            elif p.alg == algs.EdDSA and self._ed_table is not None:
+            elif p.alg == algs.EdDSA and tables.ed_table is not None:
                 buckets.setdefault(("ed",), []).append(j)
             else:
                 buckets.setdefault(("cpu",), []).append(j)
 
         for kind, idxs in buckets.items():
             if kind[0] == "cpu":
-                self._run_cpu(idxs, parsed_list, results)
+                self._run_cpu(idxs, parsed_list, results, tables)
             elif kind[0] in ("rs", "ps"):
                 self._run_rsa(kind[0], kind[1], idxs, parsed_list,
-                              key_for, results)
+                              key_for, results, tables)
             elif kind[0] == "es":
-                self._run_ec(kind[1], idxs, parsed_list, key_for, results)
+                self._run_ec(kind[1], idxs, parsed_list, key_for,
+                             results, tables)
             else:
-                self._run_ed(idxs, parsed_list, key_for, results)
+                self._run_ed(idxs, parsed_list, key_for, results,
+                             tables)
         if telemetry.active() is not None:
             fams = [_decision.family_for_alg(p.alg) if p is not None
                     else "unknown" for p in parsed_list]
@@ -1308,7 +1501,8 @@ class TPUBatchKeySet(KeySet):
                     "no known key successfully validated the token signature"
                 )
 
-    def _run_cpu(self, idxs, parsed_list, results):
+    def _run_cpu(self, idxs, parsed_list, results, tables=None):
+        t = self._tables if tables is None else tables
         if not self._cpu_fallback:
             for j in idxs:
                 results[j] = InvalidParameterError(
@@ -1320,9 +1514,9 @@ class TPUBatchKeySet(KeySet):
             p = parsed_list[j]
             last: Optional[Exception] = None
             done = False
-            for i in self._candidate_indices(p):
+            for i in self._candidate_indices(p, t):
                 try:
-                    verify_parsed(p, self._jwks[i].key)
+                    verify_parsed(p, t.jwks[i].key)
                     results[j] = p.claims()
                     done = True
                     break
@@ -1349,21 +1543,23 @@ class TPUBatchKeySet(KeySet):
                        hashlib.new(hash_name, p.signing_input).digest())
         return out
 
-    def _run_rsa(self, kind, hash_name, idxs, parsed_list, key_for, results):
+    def _run_rsa(self, kind, hash_name, idxs, parsed_list, key_for,
+                 results, tables=None):
         from ..tpu import rsa as tpursa
 
+        t = self._tables if tables is None else tables
         by_cls: Dict[int, List[int]] = {}
         for j in idxs:
             by_cls.setdefault(
-                self._rsa_rows[key_for[j]] // _RSA_CLS_STRIDE, []).append(j)
+                t.rsa_rows[key_for[j]] // _RSA_CLS_STRIDE, []).append(j)
         for cls, cidxs in sorted(by_cls.items()):
-            table = self._rsa_tables[cls]
+            table = t.rsa_tables[cls]
             for lo in range(0, len(cidxs), self._max_chunk):
                 chunk = cidxs[lo: lo + self._max_chunk]
                 pad = _pad_size(len(chunk), self._max_chunk)
                 sigs = [parsed_list[j].signature for j in chunk]
                 hashes_ = self._hashes(chunk, parsed_list, hash_name)
-                rows = [self._rsa_rows[key_for[j]] % _RSA_CLS_STRIDE
+                rows = [t.rsa_rows[key_for[j]] % _RSA_CLS_STRIDE
                         for j in chunk]
                 fill = pad - len(chunk)
                 sigs += [b""] * fill
@@ -1378,19 +1574,21 @@ class TPUBatchKeySet(KeySet):
                 self._finish(chunk, parsed_list, ok[: len(chunk)],
                              results)
 
-    def _run_ec(self, alg, idxs, parsed_list, key_for, results):
+    def _run_ec(self, alg, idxs, parsed_list, key_for, results,
+                tables=None):
         from ..tpu import ec as tpuec
         from ..tpu.rsa import HASH_LEN
 
+        t = self._tables if tables is None else tables
         crv = _ES[alg]
-        table = self._ec_tables[crv]
+        table = t.ec_tables[crv]
         hash_name = algs.HASH_FOR_ALG[alg]
         for lo in range(0, len(idxs), self._max_chunk):
             chunk = idxs[lo: lo + self._max_chunk]
             pad = _pad_size(len(chunk), self._max_chunk)
             sigs = [parsed_list[j].signature for j in chunk]
             hashes_ = self._hashes(chunk, parsed_list, hash_name)
-            rows = [self._ec_rows[crv][key_for[j]] for j in chunk]
+            rows = [t.ec_rows[crv][key_for[j]] for j in chunk]
             fill = pad - len(chunk)
             sigs += [b"\x00" * (2 * table.coord_bytes)] * fill
             hashes_ += [b"\x00" * HASH_LEN[hash_name]] * fill
@@ -1398,16 +1596,18 @@ class TPUBatchKeySet(KeySet):
             ok = tpuec.verify_ecdsa_batch(table, sigs, hashes_, key_idx)
             self._finish(chunk, parsed_list, ok[: len(chunk)], results)
 
-    def _run_ed(self, idxs, parsed_list, key_for, results):
+    def _run_ed(self, idxs, parsed_list, key_for, results,
+                tables=None):
         from ..tpu import ed25519 as tpued
 
-        table = self._ed_table
+        t = self._tables if tables is None else tables
+        table = t.ed_table
         for lo in range(0, len(idxs), self._max_chunk):
             chunk = idxs[lo: lo + self._max_chunk]
             pad = _pad_size(len(chunk), self._max_chunk)
             sigs = [parsed_list[j].signature for j in chunk]
             msgs = [parsed_list[j].signing_input for j in chunk]
-            rows = [self._ed_rows[key_for[j]] for j in chunk]
+            rows = [t.ed_rows[key_for[j]] for j in chunk]
             fill = pad - len(chunk)
             sigs += [b"\x00" * 64] * fill
             msgs += [b""] * fill
@@ -1468,9 +1668,15 @@ class TPURemoteKeySet(KeySet):
                 self._last_refresh = time.monotonic()
             jwks = self._remote.keys(refresh=refresh)
             kids = {j.kid for j in jwks if j.kid}
-            if self._ks is None or kids != self._kids:
+            if self._ks is None:
                 self._ks = TPUBatchKeySet(jwks, max_chunk=self._max_chunk,
                                           mesh=self._mesh)
+                self._kids = kids
+            elif kids != self._kids:
+                # Hot swap (keyplane epoch bump) instead of a from-
+                # scratch keyset: in-flight batches finish on their
+                # tables, and the wire-rate EWMA survives the rotation.
+                self._ks.swap_keys(jwks)
                 self._kids = kids
             return self._ks
 

@@ -1,0 +1,51 @@
+"""Keyplane: epoch-versioned JWKS distribution with hot key rotation.
+
+The key-distribution control plane behind BASELINE config 4
+("NewJSONWebKeySet with rotating kids") at fleet scale:
+
+- :mod:`cap_tpu.keyplane.source` — where key material comes from
+  (static file, remote JWKS URL, OIDC discovery);
+- :mod:`cap_tpu.keyplane.refresher` — epoch-versioned snapshots with
+  jittered periodic refresh, singleflight on-miss refresh under a
+  cooldown, and a TTL'd negative-kid cache;
+- :mod:`cap_tpu.keyplane.plane` — :class:`KeyPlaneKeySet`, the
+  rotation-aware device keyset a fleet worker serves from;
+- fleet propagation rides the CVB1 KEYS frame pair (types 11/12,
+  :mod:`cap_tpu.serve.protocol`) pushed by
+  :meth:`cap_tpu.fleet.pool.WorkerPool.push_keys`.
+
+See docs/KEYPLANE.md for the epoch model, the grace window, and the
+wire format.
+"""
+
+from .refresher import Refresher, Snapshot
+from .source import (
+    KeySource,
+    OIDCDiscoverySource,
+    RemoteJWKSSource,
+    StaticFileSource,
+    canonical_digest,
+    source_for_spec,
+)
+
+__all__ = [
+    "KeySource",
+    "StaticFileSource",
+    "RemoteJWKSSource",
+    "OIDCDiscoverySource",
+    "canonical_digest",
+    "source_for_spec",
+    "Refresher",
+    "Snapshot",
+    "KeyPlaneKeySet",
+]
+
+
+def __getattr__(name):
+    # KeyPlaneKeySet pulls in the jwt stack on use, not on package
+    # import (same lazy-export discipline as cap_tpu.jwt).
+    if name == "KeyPlaneKeySet":
+        from .plane import KeyPlaneKeySet
+
+        return KeyPlaneKeySet
+    raise AttributeError(name)

@@ -58,6 +58,10 @@ wrong_verdicts   counter decision.wrong_verdicts max 0
 protocol_errors  ratio worker.protocol_errors / worker.requests max 0.01
 oracle_fallback  ratio fleet.fallback_tokens / worker.tokens max 0.05
 hedge_rate       ratio fleet.hedges / worker.requests max 0.25
+# Keyplane: a rotation must reach every worker fast (push start →
+# last ack; docs/KEYPLANE.md) and pushes must not be flaking.
+rotation_lag     quantile keyplane.propagate_s p99 max 5
+push_failures    ratio keyplane.push_failures / keyplane.push_attempts max 0.5
 """
 
 

@@ -11,7 +11,9 @@ Frame layout (all integers little-endian):
                   7 = checksummed verify request,
                   8 = checksummed verify response,
                   9 = traced verify request,
-                  10 = traced verify response
+                  10 = traced verify response,
+                  11 = keys push (keyplane),
+                  12 = keys ack (keyplane)
     count   u32   number of entries
     trace-context (types 9/10 only, between header and entries):
       ctx_len u8   length of the trace-context field (1..64)
@@ -37,6 +39,23 @@ anywhere in either direction (status, lengths, payload) surfaces as
 :class:`FrameCorruptError` instead of a silently wrong verdict. Plain
 clients (Go, native, VerifyClient default) keep the exact CVB1 bytes
 of types 1-4 — the golden vectors are unchanged.
+
+Types 11/12 are the keyplane's distribution pair, ADDITIVE like 9/10
+(types 1-10 keep their exact bytes — the golden vectors pin them):
+
+- **KEYS push (11)**: checksummed, exactly ONE request-shaped entry
+  whose payload is the key-distribution JSON
+  ``{"epoch": <int>, "jwks": {"keys": [...]}}`` — canonical form
+  (sorted keys, compact separators) so identical snapshots serialize
+  identically. Public key material only (a JWKS by definition);
+  redaction discipline for tokens/claims is untouched.
+- **KEYS ack (12)**: checksummed, exactly ONE response-shaped entry:
+  status 0 + ``{"epoch": <int>}`` when the worker swapped its tables
+  onto the pushed epoch, status 1 + an error string (class name +
+  message, never key material) when it could not.
+
+A corrupt push must never install half a key set — the CRC check runs
+before the payload is even decoded, same stance as types 7-10.
 
 Types 9/10 are the TRACED variant of 7/8: same checksummed envelope
 plus one additive trace-context field between the header and the
@@ -71,7 +90,7 @@ import json
 import socket
 import struct
 import zlib
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 MAGIC = 0x31425643
 T_VERIFY_REQ = 1
@@ -84,6 +103,8 @@ T_VERIFY_REQ_CRC = 7
 T_VERIFY_RESP_CRC = 8
 T_VERIFY_REQ_TRACE = 9
 T_VERIFY_RESP_TRACE = 10
+T_KEYS_PUSH = 11
+T_KEYS_ACK = 12
 
 _HDR = struct.Struct("<IBI")
 
@@ -217,6 +238,40 @@ def send_stats_response(sock: socket.socket, stats: Any) -> None:
                  + struct.pack("<BI", 0, len(payload)) + payload)
 
 
+def keys_payload(jwks_doc: Dict[str, Any], epoch: int) -> bytes:
+    """Canonical KEYS-push payload bytes: sorted keys + compact
+    separators, so one snapshot has one wire encoding (golden vectors
+    and dedup both rely on it)."""
+    return json.dumps({"epoch": int(epoch), "jwks": jwks_doc},
+                      separators=(",", ":"), sort_keys=True).encode()
+
+
+def send_keys_push(sock: socket.socket, jwks_doc: Dict[str, Any],
+                   epoch: int) -> None:
+    """Checksummed KEYS push (type 11): one entry, the epoch+JWKS JSON."""
+    payload = keys_payload(jwks_doc, epoch)
+    if len(payload) > MAX_ENTRY_BYTES:
+        raise FrameTooLargeError(
+            f"keys payload {len(payload)} bytes exceeds entry bound")
+    parts = [_HDR.pack(MAGIC, T_KEYS_PUSH, 1),
+             _LEN_U32.pack(len(payload)), payload]
+    sock.sendall(b"".join(_with_crc(parts)))
+
+
+def send_keys_ack(sock: socket.socket, epoch: Optional[int] = None,
+                  error: Optional[str] = None) -> None:
+    """Checksummed KEYS ack (type 12): status 0 + {"epoch": N} on a
+    successful swap, status 1 + error string otherwise."""
+    if error is None:
+        status, payload = 0, json.dumps(
+            {"epoch": int(epoch or 0)}, separators=(",", ":")).encode()
+    else:
+        status, payload = 1, error.encode()
+    parts = [_HDR.pack(MAGIC, T_KEYS_ACK, 1),
+             _LEN_BU32.pack(status, len(payload)), payload]
+    sock.sendall(b"".join(_with_crc(parts)))
+
+
 def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
     """Read one frame → (type, entries), exact reads (no buffering).
 
@@ -258,7 +313,12 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
     if count > MAX_FRAME_ENTRIES:
         raise FrameTooLargeError(f"frame too large: {count} entries")
     checksummed = ftype in (T_VERIFY_REQ_CRC, T_VERIFY_RESP_CRC,
-                            T_VERIFY_REQ_TRACE, T_VERIFY_RESP_TRACE)
+                            T_VERIFY_REQ_TRACE, T_VERIFY_RESP_TRACE,
+                            T_KEYS_PUSH, T_KEYS_ACK)
+    if ftype in (T_KEYS_PUSH, T_KEYS_ACK) and count != 1:
+        raise MalformedFrameError(
+            f"type-{ftype} keys frame must carry exactly one entry, "
+            f"got {count}")
     if checksummed:
         crc_state = [zlib.crc32(hdr)]
 
@@ -280,7 +340,8 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
     total = 0
     u32 = _LEN_U32.unpack
     bu32 = _LEN_BU32.unpack
-    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC, T_VERIFY_REQ_TRACE):
+    if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC, T_VERIFY_REQ_TRACE,
+                 T_KEYS_PUSH):
         for _ in range(count):
             (ln,) = u32(take(4))
             total += ln
@@ -288,7 +349,7 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
                 raise FrameTooLargeError(f"frame too large ({total} bytes)")
             entries.append(take(ln))
     elif ftype in (T_VERIFY_RESP, T_VERIFY_RESP_CRC,
-                   T_VERIFY_RESP_TRACE, T_STATS_RESP):
+                   T_VERIFY_RESP_TRACE, T_STATS_RESP, T_KEYS_ACK):
         for _ in range(count):
             status, ln = bu32(take(5))
             if not checksummed and status not in (0, 1):

@@ -53,6 +53,10 @@ class VerifyWorker:
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
                  max_batch: int = 32768, raw_claims: bool = True,
                  obs_port: Optional[int] = None):
+        # The unwrapped engine: keyplane operations (KEYS pushes,
+        # epoch reporting) address it directly, whatever raw-claims
+        # wrapper the batcher ends up routed through.
+        self._engine = keyset
         # Raw-claims passthrough: the response payload for a verified
         # token IS its claims JSON, and the signed payload bytes are
         # exactly that — building dicts only to re-serialize them
@@ -107,11 +111,36 @@ class VerifyWorker:
         """(host, port) of the HTTP observability server, if enabled."""
         return self._obs.address if self._obs is not None else None
 
+    @property
+    def key_epoch(self):
+        """The engine's key-table epoch (None: not epoch-versioned)."""
+        return getattr(self._engine, "key_epoch", None)
+
+    def apply_keys(self, jwks_doc: dict, epoch) -> int:
+        """Apply one keyplane KEYS push; returns the installed epoch.
+
+        Raises when the engine is not swap-capable or the document is
+        unusable — the caller acks with the error, never half-applies.
+        """
+        swap = getattr(self._engine, "swap_keys", None)
+        if swap is None:
+            raise TypeError(
+                f"{type(self._engine).__name__} does not support hot "
+                "key rotation")
+        got = swap(jwks_doc, epoch=epoch)
+        telemetry.count("worker.keys_pushes")
+        telemetry.gauge("keyplane.epoch", got)
+        return got
+
     def _obs_gauges(self) -> dict:
         d = self._batcher.depth()
-        return {"batcher.queued_tokens": d["queued_tokens"],
-                "batcher.inflight_batches": d["inflight_batches"],
-                "worker.pid": os.getpid()}
+        out = {"batcher.queued_tokens": d["queued_tokens"],
+               "batcher.inflight_batches": d["inflight_batches"],
+               "worker.pid": os.getpid()}
+        epoch = self.key_epoch
+        if epoch is not None:
+            out["keyplane.epoch"] = float(epoch)
+        return out
 
     def stats(self) -> dict:
         """Process-local load/health snapshot (the STATS op payload).
@@ -125,6 +154,7 @@ class VerifyWorker:
         return {
             "pid": os.getpid(),
             **self._batcher.depth(),
+            "key_epoch": self.key_epoch,
             "obs_port": obs[1] if obs is not None else None,
             "counters": rec.counters() if rec is not None else {},
             "series": rec.summary() if rec is not None else {},
@@ -206,6 +236,25 @@ class VerifyWorker:
                 if ftype == protocol.T_STATS_REQ:
                     respq.put(("stats", None, None))
                     continue
+                if ftype == protocol.T_KEYS_PUSH:
+                    # Applied HERE, in the reader thread (the pool
+                    # pushes on a dedicated connection): the table
+                    # build blocks only this connection, and by frame
+                    # order every verify request read AFTER the push
+                    # dispatches on the new epoch. The ack rides the
+                    # responder queue so in-order delivery holds.
+                    import json as _json
+
+                    try:
+                        doc = _json.loads(entries[0])
+                        got = self.apply_keys(doc.get("jwks") or {},
+                                              doc.get("epoch"))
+                        respq.put(("keys_ack", got, None))
+                    except Exception as e:  # noqa: BLE001 - acked
+                        telemetry.count("worker.keys_push_errors")
+                        respq.put(("keys_err",
+                                   f"{type(e).__name__}: {e}", None))
+                    continue
                 if ftype not in (protocol.T_VERIFY_REQ,
                                  protocol.T_VERIFY_REQ_CRC,
                                  protocol.T_VERIFY_REQ_TRACE):
@@ -245,6 +294,10 @@ class VerifyWorker:
             try:
                 if kind == "pong":
                     protocol.send_pong(conn)
+                elif kind == "keys_ack":
+                    protocol.send_keys_ack(conn, epoch=pending)
+                elif kind == "keys_err":
+                    protocol.send_keys_ack(conn, error=pending)
                 elif kind == "stats":
                     # Snapshot at RESPOND time (in-order with verifies
                     # on this connection, so a stats probe sent after a

@@ -66,13 +66,14 @@ SPAN_BATCHER_FILL = "batcher.fill"          # batcher admit -> flush start
 SPAN_BATCHER_FLUSH = "batcher.flush"        # sync verify_batch call
 SPAN_BATCHER_DISPATCH = "batcher.dispatch"  # async dispatch (prep+H2D)
 SPAN_BATCHER_COLLECT = "batcher.collect"    # async device drain
+SPAN_KEYPLANE_SWAP = "keyplane.swap"        # key-table build + hot swap
 SPAN_ENGINE_PREFIX = "dispatch."            # dispatch.<family>.<detail>
 
 SPAN_NAMES = frozenset({
     SPAN_CLIENT_SUBMIT, SPAN_ROUTER_ATTEMPT, SPAN_ROUTER_HEDGE,
     SPAN_ROUTER_BACKOFF, SPAN_ROUTER_FALLBACK, SPAN_WORKER_DEQUEUE,
     SPAN_BATCHER_FILL, SPAN_BATCHER_FLUSH, SPAN_BATCHER_DISPATCH,
-    SPAN_BATCHER_COLLECT,
+    SPAN_BATCHER_COLLECT, SPAN_KEYPLANE_SWAP,
 })
 
 # ---------------------------------------------------------------------------

@@ -798,3 +798,166 @@ def test_decision_counters_swept_on_all_surfaces(decision_parity,
             (k, v) for k, v in rollup.items()
             if k.startswith("reject.")))
     assert len(set(rollups.values())) == 1, rollups
+
+
+# ---------------------------------------------------------------------------
+# keyplane KEYS frames (types 11/12): additive golden vectors
+# ---------------------------------------------------------------------------
+
+class TestKeysWireGolden:
+    """The KEYS frame pair is ADDITIVE exactly like the traced pair:
+    its own golden files, while types 1-10 stay pinned byte-identical
+    by TestWireGolden above. Fixture values mirror
+    tools/gen_go_golden.py exactly."""
+
+    KEYS_EPOCH = 3
+    KEYS_JWKS = {"keys": [
+        {"kty": "RSA", "kid": "rot-2024-a", "n": "AQAB", "e": "AQAB"},
+        {"kty": "EC", "kid": "rot-2024-b", "crv": "P-256",
+         "x": "AQAB", "y": "AQAB"},
+    ]}
+
+    def test_keys_frames_match_golden(self):
+        from cap_tpu.serve import protocol
+
+        s = _CaptureSock()
+        protocol.send_keys_push(s, self.KEYS_JWKS, self.KEYS_EPOCH)
+        assert s.value() == _golden("keys_push.bin"), \
+            "keys_push.bin drifted from the committed golden bytes"
+        s = _CaptureSock()
+        protocol.send_keys_ack(s, epoch=self.KEYS_EPOCH)
+        assert s.value() == _golden("keys_ack.bin"), \
+            "keys_ack.bin drifted from the committed golden bytes"
+
+    def test_keys_frames_parse_back(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        buf = io.BytesIO(_golden("keys_push.bin"))
+        ftype, entries, trace = protocol._parse_frame(buf.read)
+        assert ftype == protocol.T_KEYS_PUSH and trace is None
+        assert buf.read() == b""           # trailer fully consumed
+        doc = json.loads(entries[0])
+        assert doc["epoch"] == self.KEYS_EPOCH
+        assert doc["jwks"]["keys"][0]["kid"] == "rot-2024-a"
+
+        buf = io.BytesIO(_golden("keys_ack.bin"))
+        ftype, entries, _ = protocol._parse_frame(buf.read)
+        assert ftype == protocol.T_KEYS_ACK
+        assert entries[0][0] == 0
+        assert json.loads(entries[0][1]) == {"epoch": self.KEYS_EPOCH}
+
+    def test_corrupt_keys_frame_detected(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        blob = bytearray(_golden("keys_push.bin"))
+        blob[20] ^= 0x01
+        with pytest.raises(protocol.ProtocolError):
+            protocol._parse_frame(io.BytesIO(bytes(blob)).read)
+
+    def test_meta_pins_keys_fixture(self):
+        with open(os.path.join(_TESTDATA, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["keys_epoch"] == self.KEYS_EPOCH
+        assert meta["keys_jwks"] == self.KEYS_JWKS
+
+
+# ---------------------------------------------------------------------------
+# rotation parity: the sig-conformance vectors across an epoch swap
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rotation_parity(sig_golden):
+    """The conformance vectors through a CPU ``JSONWebKeySet`` (the
+    reference's remote-JWKS behavior) and a ``TPUBatchKeySet`` BEFORE
+    and AFTER a keyplane epoch swap (same keys re-kidded, grace window
+    on — the realistic rotation where freshly-signed old-kid tokens
+    are still in flight)."""
+    if not _HAVE_CRYPTO:
+        pytest.skip("cryptography package not installed")
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from cap_tpu.jwt.jwk import JWK
+    from cap_tpu.jwt.keyset import JSONWebKeySet
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+    body = json.dumps(sig_golden["keys"]).encode()
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/jwks"
+    tokens = [v["token"] for v in sig_golden["vectors"]]
+    try:
+        cpu_ks = JSONWebKeySet(url)
+        cpu = []
+        for t in tokens:
+            try:
+                cpu.append(cpu_ks.verify_signature(t))
+            except Exception as e:  # noqa: BLE001 - verdict channel
+                cpu.append(e)
+        jwks = parse_jwks(sig_golden["keys"])
+        ks = TPUBatchKeySet(jwks)
+        pre = ks.verify_batch(tokens)
+        rotated = [JWK(j.key, kid=j.kid + "-r2", alg=j.alg, use=j.use)
+                   for j in jwks]
+        epoch = ks.swap_keys(rotated, grace_s=300.0)
+        post = ks.verify_batch(tokens)
+    finally:
+        server.shutdown()
+    return {"cpu": cpu, "pre": pre, "post": post, "epoch": epoch,
+            "keyset": ks}
+
+
+@needs_crypto
+@pytest.mark.parametrize("vec_name", _SIG_VECTOR_NAMES)
+def test_rotation_parity_per_vector(rotation_parity, sig_golden,
+                                    vec_name):
+    """Satellite pin: verdict AND decision-reason class match between
+    the CPU JSONWebKeySet and the keyplane-rotated TPUBatchKeySet on
+    both sides of the epoch swap."""
+    from cap_tpu.obs import decision as obs_decision
+
+    i = next(idx for idx, v in enumerate(sig_golden["vectors"])
+             if v["name"] == vec_name)
+    want_accept = sig_golden["vectors"][i]["verdict"] == "accept"
+
+    def verdict(r):
+        if isinstance(r, Exception):
+            return ("reject", obs_decision.classify(r))
+        return ("accept", None)
+
+    cpu = verdict(rotation_parity["cpu"][i])
+    pre = verdict(rotation_parity["pre"][i])
+    post = verdict(rotation_parity["post"][i])
+    assert cpu == pre, \
+        f"{vec_name}: CPU {cpu} != device pre-swap {pre}"
+    assert pre == post, \
+        f"{vec_name}: verdict flapped across the epoch swap: " \
+        f"{pre} -> {post}"
+    assert (cpu[0] == "accept") == want_accept
+
+
+@needs_crypto
+def test_rotation_parity_epoch_advanced(rotation_parity):
+    """The sweep really crossed an epoch boundary, and the retired
+    kids resolved through the grace window (no verdict depended on a
+    stale-kid reject)."""
+    assert rotation_parity["epoch"] == 1
+    ks = rotation_parity["keyset"]
+    assert ks.key_epoch == 1
+    assert "sig-es" in ks._tables.kids        # grace retains old kids
+    assert "sig-es-r2" in ks._tables.kids

@@ -1,0 +1,553 @@
+"""Keyplane unit layer: sources, refresher, cooldowns, KEYS frames.
+
+Everything here is crypto-free (sources and the refresher operate on
+raw JWKS documents; JSONWebKeySet's cooldown is exercised through
+stubbed jwk/verify modules so the DoS guard is enforced in every
+environment). The crypto-backed swap tests for ``TPUBatchKeySet`` are
+gated like the rest of the classic suites.
+"""
+
+import io
+import json
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from cap_tpu import keyplane, telemetry
+from cap_tpu.errors import (
+    InvalidIssuerError,
+    InvalidJWKSError,
+    UnknownKeyIDError,
+)
+from cap_tpu.keyplane import (
+    OIDCDiscoverySource,
+    RemoteJWKSSource,
+    Refresher,
+    StaticFileSource,
+    canonical_digest,
+    source_for_spec,
+)
+from cap_tpu.serve import protocol
+from cap_tpu.utils import http as caphttp
+
+try:
+    import cryptography  # noqa: F401
+
+    _HAVE_CRYPTO = True
+except ImportError:
+    _HAVE_CRYPTO = False
+
+needs_crypto = pytest.mark.skipif(
+    not _HAVE_CRYPTO, reason="cryptography package not installed")
+
+
+def _jwks(*kids):
+    return {"keys": [{"kty": "RSA", "kid": k, "n": "AQAB", "e": "AQAB"}
+                     for k in kids]}
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+def test_source_for_spec_kinds(tmp_path):
+    p = tmp_path / "jwks.json"
+    p.write_text(json.dumps(_jwks("a")))
+    assert isinstance(source_for_spec(f"jwks:{p}"), StaticFileSource)
+    assert isinstance(source_for_spec("jwks-url:http://x/jwks"),
+                      RemoteJWKSSource)
+    assert isinstance(source_for_spec("oidc:https://idp.example"),
+                      OIDCDiscoverySource)
+    with pytest.raises(ValueError, match="unknown key source"):
+        source_for_spec("nope:x")
+
+
+def test_file_source_fetch_and_change_detection(tmp_path):
+    p = tmp_path / "jwks.json"
+    p.write_text(json.dumps(_jwks("a")))
+    src = StaticFileSource(str(p))
+    doc1, dig1 = src.fetch()
+    assert {k["kid"] for k in doc1["keys"]} == {"a"}
+    # Whitespace-only rewrite: same canonical digest (not a rotation).
+    p.write_text(json.dumps(_jwks("a"), indent=3))
+    _, dig2 = src.fetch()
+    assert dig2 == dig1
+    p.write_text(json.dumps(_jwks("b")))
+    _, dig3 = src.fetch()
+    assert dig3 != dig1
+
+
+def test_file_source_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("not json")
+    with pytest.raises(InvalidJWKSError):
+        StaticFileSource(str(p)).fetch()
+    p.write_text(json.dumps({"nokeys": True}))
+    with pytest.raises(InvalidJWKSError, match="no 'keys'"):
+        StaticFileSource(str(p)).fetch()
+
+
+class _CountingJWKSHandler:
+    """Tiny HTTP handler serving a JWKS with an ETag; counts hits and
+    answers If-None-Match with 304."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler
+
+        state = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if not self.path.endswith("/jwks"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                state.hits += 1
+                body = json.dumps(state.doc).encode()
+                etag = f'"{canonical_digest(state.doc)[:16]}"'
+                if state.etags and \
+                        self.headers.get("If-None-Match") == etag:
+                    state.hits_304 += 1
+                    self.send_response(304)
+                    self.send_header("ETag", etag)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                if state.etags:
+                    self.send_header("ETag", etag)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.handler = H
+        self.doc = _jwks("a")
+        self.etags = True
+        self.hits = 0
+        self.hits_304 = 0
+
+
+@pytest.fixture
+def jwks_http():
+    from http.server import ThreadingHTTPServer
+
+    state = _CountingJWKSHandler()
+    server = ThreadingHTTPServer(("127.0.0.1", 0), state.handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/jwks"
+    yield state, url
+    server.shutdown()
+
+
+def test_http_get_conditional_etag_reuses_body(jwks_http):
+    state, url = jwks_http
+    s1, b1, h1 = caphttp.get(url, conditional=True)
+    assert s1 == 200 and json.loads(b1)["keys"]
+    s2, b2, h2 = caphttp.get(url, conditional=True)
+    assert (s2, b2) == (200, b1)
+    assert h2.get("x-cap-conditional") == "revalidated"
+    assert state.hits_304 == 1          # second hit was a 304
+    # Plain (non-conditional) get never sends the validator.
+    s3, b3, h3 = caphttp.get(url)
+    assert s3 == 200 and "x-cap-conditional" not in h3
+
+
+def test_remote_source_free_refresh_on_unchanged(jwks_http):
+    state, url = jwks_http
+    src = RemoteJWKSSource(url)
+    doc1, dig1 = src.fetch()
+    _, dig2 = src.fetch()               # 304 → same digest, no body
+    assert dig2 == dig1
+    assert state.hits_304 >= 1
+    state.doc = _jwks("a", "b")         # rotate at the IdP
+    doc3, dig3 = src.fetch()
+    assert dig3 != dig1
+    assert {k["kid"] for k in doc3["keys"]} == {"a", "b"}
+
+
+def test_remote_source_error_statuses(jwks_http):
+    _, url = jwks_http
+    src = RemoteJWKSSource(url + "-missing")
+    with pytest.raises(InvalidJWKSError, match="status 404"):
+        src.fetch()
+
+
+@pytest.fixture
+def oidc_http():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    state = types.SimpleNamespace(issuer=None, doc=_jwks("a"),
+                                  wrong_issuer=False)
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.endswith("openid-configuration"):
+                body = json.dumps({
+                    "issuer": state.issuer + ("-evil" if
+                                              state.wrong_issuer else ""),
+                    "jwks_uri": state.issuer + "/jwks",
+                }).encode()
+            else:
+                body = json.dumps(state.doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    state.issuer = f"http://127.0.0.1:{server.server_address[1]}"
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield state
+    server.shutdown()
+
+
+def test_oidc_source_discovers_jwks_uri(oidc_http):
+    src = OIDCDiscoverySource(oidc_http.issuer)
+    doc, _ = src.fetch()
+    assert {k["kid"] for k in doc["keys"]} == {"a"}
+
+
+def test_oidc_source_issuer_mismatch_rejected(oidc_http):
+    oidc_http.wrong_issuer = True
+    with pytest.raises(InvalidIssuerError):
+        OIDCDiscoverySource(oidc_http.issuer).fetch()
+
+
+# ---------------------------------------------------------------------------
+# refresher: epochs, singleflight, cooldown, negative cache
+# ---------------------------------------------------------------------------
+
+class _FakeSource(keyplane.KeySource):
+    def __init__(self, doc, delay_s=0.0):
+        self.doc = doc
+        self.delay_s = delay_s
+        self.fetches = 0
+        self.fail = False
+        self.description = "fake"
+
+    def fetch(self):
+        self.fetches += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise InvalidJWKSError("fake: down")
+        return self.doc, canonical_digest(self.doc)
+
+
+def test_refresher_epoch_bumps_only_on_change():
+    src = _FakeSource(_jwks("a"))
+    applied = []
+    r = Refresher(src, apply=applied.append, miss_cooldown_s=0.0)
+    snap1 = r.refresh()
+    assert snap1.epoch == 1 and snap1.kids == {"a"}
+    snap2 = r.refresh()                 # unchanged → same epoch
+    assert snap2.epoch == 1
+    src.doc = _jwks("a", "b")
+    snap3 = r.refresh()
+    assert snap3.epoch == 2 and snap3.kids == {"a", "b"}
+    assert [s.epoch for s in applied] == [1, 2]
+
+
+def test_refresher_failed_fetch_keeps_previous_snapshot():
+    src = _FakeSource(_jwks("a"))
+    r = Refresher(src)
+    r.refresh()
+    src.fail = True
+    with pytest.raises(InvalidJWKSError):
+        r.refresh()
+    assert r.epoch == 1 and r.snapshot.kids == {"a"}
+
+
+def test_refresher_singleflight_coalesces_concurrent_callers():
+    src = _FakeSource(_jwks("a"), delay_s=0.2)
+    r = Refresher(src)
+    snaps = []
+
+    def go():
+        snaps.append(r.refresh())
+
+    threads = [threading.Thread(target=go) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert src.fetches == 1             # one leader, seven followers
+    assert all(s is not None and s.epoch == 1 for s in snaps)
+
+
+def test_on_miss_cooldown_and_negative_kid_ttl():
+    src = _FakeSource(_jwks("a"))
+    r = Refresher(src, miss_cooldown_s=0.15, negative_ttl_s=0.3)
+    r.refresh()
+    assert src.fetches == 1
+    # Miss on an unknown kid → one refresh; kid still absent → negative.
+    assert r.on_miss("ghost") is not None
+    assert src.fetches == 2
+    # Negative cache answers instantly, even after the cooldown lapses.
+    time.sleep(0.2)
+    assert r.on_miss("ghost") is None
+    assert src.fetches == 2
+    # A DIFFERENT kid inside the cooldown window is suppressed too.
+    assert r.on_miss("other") is None or src.fetches == 3
+    # After the negative TTL, the kid is probe-able again.
+    time.sleep(0.35)
+    fetches_before = src.fetches
+    assert r.on_miss("ghost") is not None
+    assert src.fetches == fetches_before + 1
+    # A rotation that ADDS the kid clears its negative entry (wait out
+    # the TTL stamped by the refetch above, plus the miss cooldown).
+    src.doc = _jwks("a", "ghost")
+    time.sleep(0.35)
+    snap = r.on_miss("ghost")
+    assert snap is not None and "ghost" in snap.kids
+
+
+def test_refresher_background_polling():
+    src = _FakeSource(_jwks("a"))
+    r = Refresher(src, interval_s=0.1, jitter=0.0)
+    r.refresh()
+    r.start()
+    try:
+        deadline = time.monotonic() + 5
+        while src.fetches < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert src.fetches >= 3, "periodic refresh did not run"
+        assert r.epoch == 1             # unchanged doc → stable epoch
+    finally:
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# JSONWebKeySet refresh cooldown (the one-line DoS guard, satellite 1)
+# ---------------------------------------------------------------------------
+
+def _compact_token(kid):
+    from cap_tpu.jwt.jose import b64url_encode
+
+    h = b64url_encode(json.dumps(
+        {"alg": "RS256", "kid": kid}).encode())
+    p = b64url_encode(json.dumps({"sub": "x"}).encode())
+    return f"{h}.{p}.{b64url_encode(b'sig')}"
+
+
+@pytest.fixture
+def stubbed_jwt(monkeypatch):
+    """Stub the crypto-backed jwk/verify modules so the keyset's
+    cooldown logic runs identically with or without ``cryptography``
+    (the cooldown is transport behavior, not signature math)."""
+    jwk_mod = types.ModuleType("cap_tpu.jwt.jwk")
+
+    class _J:
+        def __init__(self, kid):
+            self.kid, self.use, self.key = kid, "sig", object()
+
+    jwk_mod.parse_jwks = lambda doc: [
+        _J(k.get("kid")) for k in doc.get("keys", [])]
+    verify_mod = types.ModuleType("cap_tpu.jwt.verify")
+    verify_mod.key_matches_alg = lambda key, alg: True
+    verify_mod.verify_parsed = lambda parsed, key: None  # accept
+    monkeypatch.setitem(sys.modules, "cap_tpu.jwt.jwk", jwk_mod)
+    monkeypatch.setitem(sys.modules, "cap_tpu.jwt.verify", verify_mod)
+
+
+def test_jwks_unknown_kid_refetch_respects_cooldown(jwks_http,
+                                                    stubbed_jwt):
+    from cap_tpu.jwt.keyset import JSONWebKeySet
+
+    state, url = jwks_http
+    state.etags = False                 # count full fetches only
+    ks = JSONWebKeySet(url, refresh_cooldown_s=30.0)
+    assert ks.verify_signature(_compact_token("a"))["sub"] == "x"
+    hits_warm = state.hits              # cache fill
+    # First unknown kid: ONE refetch, then a provably-unknown verdict.
+    with pytest.raises(UnknownKeyIDError):
+        ks.verify_signature(_compact_token("ghost"))
+    assert state.hits == hits_warm + 1
+    # Hammering unknown kids inside the cooldown: ZERO further fetches.
+    with telemetry.recording() as rec:
+        for i in range(5):
+            with pytest.raises(UnknownKeyIDError, match="cooldown"):
+                ks.verify_signature(_compact_token(f"ghost-{i}"))
+    assert state.hits == hits_warm + 1, "cooldown did not hold"
+    assert rec.counters().get("jwks.refresh_suppressed", 0) == 5
+    # Known kids are untouched by the cooldown.
+    assert ks.verify_signature(_compact_token("a"))["sub"] == "x"
+
+
+def test_jwks_cooldown_expiry_allows_refetch(jwks_http, stubbed_jwt):
+    from cap_tpu.jwt.keyset import JSONWebKeySet
+
+    state, url = jwks_http
+    state.etags = False
+    ks = JSONWebKeySet(url, refresh_cooldown_s=0.1)
+    with pytest.raises(UnknownKeyIDError):
+        ks.verify_signature(_compact_token("ghost"))
+    hits = state.hits
+    time.sleep(0.15)
+    # Rotation landed at the IdP; the next miss may now refetch.
+    state.doc = _jwks("a", "ghost")
+    assert ks.verify_signature(_compact_token("ghost"))["sub"] == "x"
+    assert state.hits == hits + 1
+
+
+# ---------------------------------------------------------------------------
+# KEYS wire frames (types 11/12)
+# ---------------------------------------------------------------------------
+
+class _Capture:
+    def __init__(self):
+        self.buf = io.BytesIO()
+
+    def sendall(self, b):
+        self.buf.write(b)
+
+
+def test_keys_frames_roundtrip():
+    s = _Capture()
+    protocol.send_keys_push(s, _jwks("a", "b"), 7)
+    ftype, entries, trace = protocol._parse_frame(
+        io.BytesIO(s.buf.getvalue()).read)
+    assert ftype == protocol.T_KEYS_PUSH and trace is None
+    doc = json.loads(entries[0])
+    assert doc["epoch"] == 7
+    assert {k["kid"] for k in doc["jwks"]["keys"]} == {"a", "b"}
+
+    s = _Capture()
+    protocol.send_keys_ack(s, epoch=7)
+    ftype, entries, _ = protocol._parse_frame(
+        io.BytesIO(s.buf.getvalue()).read)
+    assert ftype == protocol.T_KEYS_ACK
+    assert entries[0][0] == 0
+    assert json.loads(entries[0][1]) == {"epoch": 7}
+
+    s = _Capture()
+    protocol.send_keys_ack(s, error="TypeError: no swap")
+    _, entries, _ = protocol._parse_frame(
+        io.BytesIO(s.buf.getvalue()).read)
+    assert entries[0] == (1, b"TypeError: no swap")
+
+
+def test_keys_frame_corruption_detected():
+    s = _Capture()
+    protocol.send_keys_push(s, _jwks("a"), 1)
+    blob = bytearray(s.buf.getvalue())
+    blob[len(blob) // 2] ^= 0x01
+    with pytest.raises(protocol.FrameCorruptError):
+        protocol._parse_frame(io.BytesIO(bytes(blob)).read)
+
+
+def test_keys_frame_requires_exactly_one_entry():
+    import struct
+
+    hdr = struct.pack("<IBI", protocol.MAGIC, protocol.T_KEYS_PUSH, 2)
+    with pytest.raises(protocol.MalformedFrameError, match="exactly one"):
+        protocol._parse_frame(io.BytesIO(hdr).read)
+
+
+def test_keys_payload_is_canonical():
+    a = protocol.keys_payload({"keys": [{"kid": "a", "kty": "RSA"}]}, 1)
+    b = protocol.keys_payload({"keys": [{"kty": "RSA", "kid": "a"}]}, 1)
+    assert a == b                       # key order never changes bytes
+
+
+# ---------------------------------------------------------------------------
+# TPUBatchKeySet.swap_keys (crypto-gated: real tables, real verdicts)
+# ---------------------------------------------------------------------------
+
+@needs_crypto
+class TestSwapKeys:
+    @pytest.fixture(scope="class")
+    def fixtures(self):
+        from cap_tpu import testing as captest
+        from cap_tpu.jwt.jwk import JWK
+
+        es_priv, es_pub = captest.generate_keys("ES256")
+        es2_priv, es2_pub = captest.generate_keys("ES256")
+        return {
+            "old": [JWK(es_pub, kid="old-1")],
+            "new": [JWK(es2_pub, kid="new-1")],
+            "tok_old": captest.sign_jwt(es_priv, "ES256",
+                                        captest.default_claims(),
+                                        kid="old-1"),
+            "tok_new": captest.sign_jwt(es2_priv, "ES256",
+                                        captest.default_claims(),
+                                        kid="new-1"),
+        }
+
+    def test_swap_bumps_epoch_and_serves_new_keys(self, fixtures):
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet(fixtures["old"])
+        assert ks.key_epoch == 0
+        assert not isinstance(
+            ks.verify_batch([fixtures["tok_old"]])[0], Exception)
+        got = ks.swap_keys(fixtures["new"], grace_s=30.0)
+        assert got == 1 and ks.key_epoch == 1
+        assert not isinstance(
+            ks.verify_batch([fixtures["tok_new"]])[0], Exception)
+
+    def test_grace_window_resolves_retired_kids(self, fixtures):
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet(fixtures["old"])
+        ks.swap_keys(fixtures["new"], grace_s=30.0)
+        # Tokens signed under the just-retired kid still verify.
+        res = ks.verify_batch([fixtures["tok_old"], fixtures["tok_new"]])
+        assert not isinstance(res[0], Exception), \
+            "retired kid flapped to reject inside the grace window"
+        assert not isinstance(res[1], Exception)
+
+    def test_grace_expiry_retires_old_kids(self, fixtures):
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet(fixtures["old"])
+        ks.swap_keys(fixtures["new"], grace_s=0.2)
+        deadline = time.monotonic() + 10
+        while "old-1" in ks._tables.kids and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "old-1" not in ks._tables.kids, "grace never retired"
+        assert isinstance(
+            ks.verify_batch([fixtures["tok_old"]])[0], Exception)
+        assert not isinstance(
+            ks.verify_batch([fixtures["tok_new"]])[0], Exception)
+
+    def test_zero_grace_drops_old_kids_immediately(self, fixtures):
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet(fixtures["old"])
+        ks.swap_keys(fixtures["new"], grace_s=0.0)
+        assert isinstance(
+            ks.verify_batch([fixtures["tok_old"]])[0], Exception)
+
+    def test_swap_accepts_jwks_document(self, fixtures):
+        from cap_tpu.jwt.jwk import serialize_public_key
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet(fixtures["old"])
+        doc = {"keys": [serialize_public_key(fixtures["new"][0].key,
+                                             kid="new-1")]}
+        ks.swap_keys(doc, epoch=9)
+        assert ks.key_epoch == 9
+        assert not isinstance(
+            ks.verify_batch([fixtures["tok_new"]])[0], Exception)
+
+    def test_swap_records_telemetry(self, fixtures):
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+
+        ks = TPUBatchKeySet(fixtures["old"])
+        with telemetry.recording() as rec:
+            ks.swap_keys(fixtures["new"])
+            assert rec.counters().get("keyplane.swaps") == 1
+            assert rec.gauges().get("keyplane.epoch") == 1
+            assert telemetry.SPAN_KEYPLANE_SWAP in rec.summary()

@@ -1,0 +1,311 @@
+"""Keyplane fleet propagation: KEYS pushes, convergence, chaos.
+
+Stub workers (no jax in the children), so the suite is tier-1-cheap.
+Ground truth is the stub rule — tokens ending ``.ok`` verify — which a
+rotation must NEVER change: the acceptance bar is live rotation under
+sustained load with zero wrong verdicts, zero lost submissions, and
+every worker on the new epoch within two refresh intervals, including
+a kill -9 landing mid-push.
+"""
+
+import json
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet import FleetClient, WorkerPool
+from cap_tpu.fleet.chaos import kill9
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.serve import protocol
+from cap_tpu.serve.worker import VerifyWorker
+
+HARD_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"keyplane fleet test exceeded hard {HARD_TIMEOUT_S}s "
+            "timeout")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _jwks(*kids):
+    return {"keys": [{"kty": "RSA", "kid": k, "n": "AQAB", "e": "AQAB"}
+                     for k in kids]}
+
+
+def _wait_epochs(pool, epoch, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(e == epoch for e in pool.key_epochs().values()):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+@pytest.fixture
+def pool():
+    p = WorkerPool(2, keyset_spec="stub", ping_interval=0.2,
+                   max_restarts=10)
+    assert p.wait_all_ready(30), "fleet did not come up"
+    yield p
+    p.close()
+
+
+# ---------------------------------------------------------------------------
+# propagation basics
+# ---------------------------------------------------------------------------
+
+def test_ready_line_announces_epoch(pool):
+    # Stub workers boot on epoch 0 and the pool learns it from the
+    # ready line before any push happens.
+    assert pool.key_epochs() == {0: 0, 1: 0}
+    assert pool.epoch_skew() == 0
+    assert pool.keys_epoch() is None
+
+
+def test_push_keys_reaches_every_worker(pool):
+    acks = pool.push_keys(_jwks("k-1"))
+    assert acks == {0: 1, 1: 1}
+    assert pool.key_epochs() == {0: 1, 1: 1}
+    assert pool.keys_epoch() == 1
+    # Epochs auto-increment per push; explicit epochs are honored.
+    assert set(pool.push_keys(_jwks("k-2")).values()) == {2}
+    assert set(pool.push_keys(_jwks("k-3"), epoch=10).values()) == {10}
+    # Workers report the epoch over STATS and the obs scrape.
+    stats = pool.stats()
+    assert {s["key_epoch"] for s in stats.values()} == {10}
+    agg = pool.stats_merged()["aggregate"]
+    assert agg["key_epochs"] == {0: 10, 1: 10}
+    assert agg["epoch_skew"] == 0
+
+
+def test_push_records_propagation_telemetry(pool):
+    with telemetry.recording() as rec:
+        pool.push_keys(_jwks("t-1"))
+        assert rec.counters().get("keyplane.pushes") == 1
+        assert rec.counters().get("keyplane.push_attempts") == 2
+        assert "keyplane.propagate_s" in rec.summary()
+        assert rec.gauges().get("keyplane.epoch") == 1
+
+
+def test_worker_obs_scrape_carries_epoch(pool):
+    pool.push_keys(_jwks("o-1"), epoch=4)
+    import sys
+    sys.path.insert(0, ".")
+    from tools import capstat
+
+    data = {}
+    for wid, (host, port) in sorted(pool.obs_endpoints().items()):
+        data[f"{host}:{port}"] = capstat.scrape(f"{host}:{port}")
+    for ep, d in data.items():
+        assert d["extra"].get("keyplane.epoch") == 4.0, (ep, d["extra"])
+    # capstat renders the per-worker epoch.
+    rendered = capstat.render_fleet(data)
+    assert "epoch=4" in rendered
+
+
+def test_router_surfaces_epoch_skew(pool):
+    cl = FleetClient(pool, fallback=StubKeySet())
+    pool.push_keys(_jwks("s-1"))
+    snap = cl.snapshot()
+    assert snap["epoch_skew"] == 0
+    assert snap["key_epochs"] == {"0": 1, "1": 1}
+    # Manufacture skew: mark one worker stale.
+    with pool._lock:
+        pool._handles[1].key_epoch = 0
+    assert cl.key_epoch_skew() == 1
+    # Endpoint-list clients have no pool → no skew view.
+    cl2 = FleetClient(list(pool.endpoints().values()))
+    assert cl2.key_epoch_skew() is None
+    assert "epoch_skew" not in cl2.snapshot()
+
+
+def test_verifies_on_connection_after_push_see_new_epoch(pool):
+    # Frame order on one connection: a verify request sent AFTER a
+    # KEYS push is answered by a worker already on the new epoch.
+    addr = pool.endpoints()[0]
+    with socket.create_connection(addr, timeout=10) as s:
+        s.settimeout(10)
+        protocol.send_keys_push(s, _jwks("c-1"), 6)
+        protocol.send_request(s, ["after.ok"], crc=True)
+        reader = protocol.FrameReader(s)
+        ftype, entries = reader.recv_frame()
+        assert ftype == protocol.T_KEYS_ACK
+        assert json.loads(entries[0][1]) == {"epoch": 6}
+        ftype, entries = reader.recv_frame()
+        assert ftype == protocol.T_VERIFY_RESP_CRC
+        assert entries[0][0] == 0
+    assert pool.stats()[0]["key_epoch"] == 6
+
+
+# ---------------------------------------------------------------------------
+# non-swappable engines ack an error, never a half-applied state
+# ---------------------------------------------------------------------------
+
+class _NoSwapKeySet:
+    def verify_batch(self, tokens):
+        return [{"sub": t} for t in tokens]
+
+
+def test_push_to_non_swappable_keyset_acks_error():
+    w = VerifyWorker(_NoSwapKeySet(), target_batch=4, max_wait_ms=1.0,
+                     obs_port=None)
+    try:
+        with socket.create_connection(w.address, timeout=10) as s:
+            s.settimeout(10)
+            protocol.send_keys_push(s, _jwks("x"), 1)
+            ftype, entries = protocol.FrameReader(s).recv_frame()
+        assert ftype == protocol.T_KEYS_ACK
+        status, payload = entries[0]
+        assert status == 1
+        assert b"hot key rotation" in payload
+        assert w.key_epoch is None
+    finally:
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: rotation under sustained load, kill -9 mid-push
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_rotation_under_load_zero_wrong_verdicts():
+    """Live rotation while traffic flows: every verdict stays correct,
+    nothing is lost, and the fleet converges on each pushed epoch."""
+    pool = WorkerPool(2, keyset_spec="stub:batch_ms=20",
+                      ping_interval=0.2, max_restarts=20,
+                      max_wait_ms=1.0)
+    try:
+        assert pool.wait_all_ready(30)
+        cl = FleetClient(pool, fallback=StubKeySet(),
+                         attempt_timeout=2.0, total_deadline=30.0,
+                         rr_seed=0)
+        stop = threading.Event()
+        failures = []
+        done = []
+
+        def driver(d):
+            i = 0
+            while not stop.is_set():
+                toks = [f"d{d}-{i}-{j}.ok" for j in range(3)] + \
+                    [f"d{d}-{i}-bad"]
+                try:
+                    res = cl.verify_batch(toks)
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"driver {d}: {e!r}")
+                    return
+                if len(res) != len(toks):
+                    failures.append(f"driver {d}: lost submissions")
+                    return
+                for t, r in zip(toks, res):
+                    ok = not isinstance(r, Exception)
+                    if ok != t.endswith(".ok") or \
+                            (ok and r != {"sub": t}):
+                        failures.append(
+                            f"driver {d}: WRONG verdict for {t!r}")
+                        return
+                done.append(len(toks))
+                i += 1
+
+        threads = [threading.Thread(target=driver, args=(d,))
+                   for d in range(4)]
+        for t in threads:
+            t.start()
+        # Three live rotations while the drivers hammer the fleet.
+        for epoch in (1, 2, 3):
+            time.sleep(0.3)
+            pool.push_keys(_jwks(f"rot-{epoch}"), epoch=epoch)
+            assert _wait_epochs(pool, epoch, timeout=15), \
+                f"fleet did not converge on epoch {epoch}"
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "driver wedged"
+        assert not failures, failures
+        assert sum(done) > 0
+    finally:
+        pool.close()
+
+
+@pytest.mark.chaos
+def test_kill9_mid_push_converges_on_respawn():
+    """SIGKILL one worker exactly while a rotation is being pushed:
+    the respawned process must converge on the pushed epoch (ready-
+    line re-push + supervisor sweep), with verdicts correct
+    throughout."""
+    pool = WorkerPool(2, keyset_spec="stub:batch_ms=20",
+                      ping_interval=0.2, max_restarts=20,
+                      max_wait_ms=1.0)
+    try:
+        assert pool.wait_all_ready(30)
+        cl = FleetClient(pool, fallback=StubKeySet(),
+                         attempt_timeout=2.0, total_deadline=30.0,
+                         rr_seed=0)
+        victim = pool.pid(0)
+        pushed = threading.Event()
+
+        def killer():
+            # Land the SIGKILL in the middle of the push fan-out.
+            pushed.wait(timeout=10)
+            kill9(victim)
+
+        t = threading.Thread(target=killer)
+        t.start()
+        pushed.set()
+        acks = pool.push_keys(_jwks("mid-push"), epoch=5)
+        t.join(timeout=10)
+        # The killed worker may or may not have acked; the SURVIVOR
+        # must have, and the pool's distribution target is epoch 5.
+        assert pool.keys_epoch() == 5
+        assert 5 in acks.values()
+        # Convergence: the respawn path re-pushes epoch 5. Two refresh
+        # (supervisor ping) intervals after the respawn is the budget;
+        # respawn itself takes a few seconds on this host.
+        assert _wait_epochs(pool, 5, timeout=60), \
+            f"no convergence after kill -9 mid-push: {pool.key_epochs()}"
+        assert pool.pid(0) != victim
+        assert pool.epoch_skew() == 0
+        # Traffic still produces only correct verdicts.
+        res = cl.verify_batch(["post.ok", "post.bad"])
+        assert res[0] == {"sub": "post.ok"}
+        assert isinstance(res[1], Exception)
+    finally:
+        pool.close()
+
+
+@pytest.mark.chaos
+def test_supervisor_repushes_after_transient_push_failure():
+    """A worker that misses a push (its serve socket was briefly
+    unreachable) is converged by the supervisor sweep, not left
+    skewed forever."""
+    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.2,
+                      max_restarts=10)
+    try:
+        assert pool.wait_all_ready(30)
+        pool.push_keys(_jwks("r-1"), epoch=3)
+        assert _wait_epochs(pool, 3, timeout=15)
+        # Simulate a missed push: forget worker 1's ack so the pool
+        # believes it is stale (epoch tracking is pool-side state).
+        with pool._lock:
+            pool._handles[1].key_epoch = 0
+        assert pool.epoch_skew() == 3
+        # The supervisor notices the stale epoch on its next sweep and
+        # re-pushes the CURRENT distribution.
+        assert _wait_epochs(pool, 3, timeout=15), pool.key_epochs()
+    finally:
+        pool.close()

@@ -175,8 +175,11 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
         snaps.append(snap)
         extra = data.get("extra") or {}
         counters = snap.get("counters") or {}
+        epoch = extra.get("keyplane.epoch")
         lines.append(f"worker {ep}  pid={int(extra.get('worker.pid', 0))}"
-                     f"  queued={int(extra.get('batcher.queued_tokens', 0))}"
+                     + (f"  epoch={int(epoch)}" if epoch is not None
+                        else "")
+                     + f"  queued={int(extra.get('batcher.queued_tokens', 0))}"
                      f"  inflight={int(extra.get('batcher.inflight_batches', 0))}"
                      f"  requests={counters.get('worker.requests', 0)}"
                      f"  tokens={counters.get('worker.tokens', 0)}"
@@ -214,6 +217,14 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
             f"fallback_tokens={c.get('fleet.fallback_tokens', 0)} "
             f"respawns={c.get('fleet.respawns', 0)} "
             f"breakers_open_now={int(g.get('fleet.breakers_open', 0))}")
+        if client.get("epoch_skew") is not None:
+            eps = "  ".join(f"w{k}={v}" for k, v in
+                            sorted((client.get("key_epochs")
+                                    or {}).items()))
+            state = ("CONVERGED" if client["epoch_skew"] == 0
+                     else f"SKEW={client['epoch_skew']}")
+            lines.append(f"  key epochs: {state}"
+                         + (f"  ({eps})" if eps else ""))
         for ep, st in sorted((client.get("breakers") or {}).items()):
             state = ("OPEN" if st.get("open_for_s", 0) > 0 else
                      "closed")

@@ -51,6 +51,17 @@ RESULTS = [
 # regeneration is byte-stable.
 TRACE_ID = "00112233aabbccdd"
 
+# Pinned keyplane distribution fixture for the KEYS frame pair (types
+# 11/12): a shape-only JWKS (no real key material needed on the wire
+# layer) and a fixed epoch. send_keys_push canonicalizes the JSON
+# (sorted keys, compact separators), so regeneration is byte-stable.
+KEYS_EPOCH = 3
+KEYS_JWKS = {"keys": [
+    {"kty": "RSA", "kid": "rot-2024-a", "n": "AQAB", "e": "AQAB"},
+    {"kty": "EC", "kid": "rot-2024-b", "crv": "P-256",
+     "x": "AQAB", "y": "AQAB"},
+]}
+
 
 class _Sock:
     """Duck-typed socket capturing sendall output."""
@@ -313,9 +324,22 @@ def main():
     with open(os.path.join(OUT, "response_trace.bin"), "wb") as f:
         f.write(s.buf.getvalue())
 
+    # Keyplane KEYS frame pair (types 11/12): additive like the traced
+    # pair — everything written above stays byte-identical.
+    s = _Sock()
+    protocol.send_keys_push(s, KEYS_JWKS, KEYS_EPOCH)
+    with open(os.path.join(OUT, "keys_push.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    protocol.send_keys_ack(s, epoch=KEYS_EPOCH)
+    with open(os.path.join(OUT, "keys_ack.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
     meta = {
         "tokens": TOKENS,
         "trace_id": TRACE_ID,
+        "keys_epoch": KEYS_EPOCH,
+        "keys_jwks": KEYS_JWKS,
         "results": [
             {"claims": r} if isinstance(r, dict) else
             {"error": f"{type(r).__name__}: {r}"}
