@@ -26,7 +26,7 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native test bench clean obs-smoke keyplane-smoke bench-trend check
+.PHONY: all native test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat check
 
 all: native
 
@@ -76,6 +76,12 @@ bench-trend:
 	$(PYTHON) tools/bench_trend.py --selftest
 	$(PYTHON) tools/bench_trend.py
 
+# ML-DSA known-answer gate: the pinned FIPS 204 KATs through all four
+# verify surfaces (oracle / TPU both paths / serve / router) plus a
+# randomized engine-vs-oracle parity selftest. Dependency-free.
+mldsa-kat:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/mldsa_kat.py
+
 # The default local CI gate: observability smoke + keyplane rotation
-# smoke + perf-trend sentinel.
-check: obs-smoke keyplane-smoke bench-trend
+# smoke + perf-trend sentinel + post-quantum KAT gate.
+check: obs-smoke keyplane-smoke bench-trend mldsa-kat

@@ -86,6 +86,50 @@ def _resident_mixed_vps(ks, tokens):
     return resident_slope_vps(n, fns, details=True)
 
 
+def _resident_mldsa44_vps(n_tokens: int):
+    """Post-quantum engine number: ML-DSA-44 verifies/sec with the
+    decoded lanes (z/c/hints + key tables) device-resident.
+
+    Same slope methodology as ``resident_mixed_vps`` (shared
+    ``resident_slope_vps`` implementation, accept-sum integrity via
+    on-device w1-lane comparison against the pure-int oracle — see
+    resident_dispatchers). Fixtures come from the in-repo
+    deterministic FIPS 204 signer: 2 AKP keys, ``n_tokens`` unique
+    tokens (CAP_BENCH_MLDSA, default 256 — signing is host-side
+    numpy, ~40 ms/token, and stays off the timed path).
+    """
+    import json as _json
+
+    from cap_tpu.jwt.jose import b64url_encode
+    from cap_tpu.jwt.jwk import parse_jwks, serialize_public_key
+    from cap_tpu.jwt.tpu_keyset import (
+        TPUBatchKeySet,
+        resident_dispatchers,
+        resident_slope_vps,
+    )
+    from cap_tpu.tpu import mldsa
+
+    privs, jwk_dicts = [], []
+    for s in (51, 52):
+        priv, pub = mldsa.keygen("ML-DSA-44", bytes([s]) * 32)
+        privs.append(priv)
+        jwk_dicts.append(serialize_public_key(pub, kid=f"bench-pq{s}"))
+    tokens = []
+    for i in range(n_tokens):
+        header = {"alg": "ML-DSA-44", "kid": f"bench-pq{51 + i % 2}"}
+        h = b64url_encode(_json.dumps(
+            header, separators=(",", ":")).encode())
+        p = b64url_encode(_json.dumps(
+            {"sub": f"pq-{i}", "jti": f"t{i}"},
+            separators=(",", ":")).encode())
+        si = (h + "." + p).encode()
+        tokens.append(h + "." + p + "."
+                      + b64url_encode(privs[i % 2].sign(si)))
+    ks = TPUBatchKeySet(parse_jwks({"keys": jwk_dicts}))
+    n, fns = resident_dispatchers(ks, tokens)
+    return resident_slope_vps(n, fns, details=True)
+
+
 def _rotation_fields(ks, jwks, tokens) -> dict:
     """CAP_BENCH_ROTATE=1: measure hot-rotation cost on the LIVE keyset.
 
@@ -321,6 +365,15 @@ def main() -> None:
         print(f"resident_mixed_vps failed: {e!r}", file=sys.stderr)
         resident, resident_trials = None, []
 
+    mldsa_n = int(os.environ.get("CAP_BENCH_MLDSA", "256") or 0)
+    mldsa_vps, mldsa_trials = None, []
+    if mldsa_n:
+        try:
+            mldsa_vps, mldsa_trials = _resident_mldsa44_vps(mldsa_n)
+        except Exception as e:  # noqa: BLE001 - advisory metric
+            print(f"resident_mldsa44_vps failed: {e!r}",
+                  file=sys.stderr)
+
     mesh_fields = {}
     if mesh_n:
         try:
@@ -394,6 +447,13 @@ def main() -> None:
         # resident_trials_vps (slower trials ate a tunnel stall).
         "resident_mixed_vps": round(resident, 1) if resident else None,
         "resident_trials_vps": [round(v, 1) for v in resident_trials],
+        # Post-quantum engine rate (ML-DSA-44 resident lanes; same
+        # slope/min-of-3 semantics and weather caveats as the mixed
+        # number — tools/bench_trend.py tracks it from round 11 on).
+        "resident_mldsa44_vps": round(mldsa_vps, 1) if mldsa_vps
+        else None,
+        "resident_mldsa44_trials_vps": [round(v, 1)
+                                        for v in mldsa_trials],
         # CAP_BENCH_MESH=N only: the same resident mix under shard_map
         # (resident_mesh_vps, per-record sorted per-device shard rows).
         **mesh_fields,

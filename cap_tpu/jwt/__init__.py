@@ -12,6 +12,7 @@ Public surface mirrors jwt/keyset.go + jwt/jwt.go + jwt/algs.go:
 from .algs import (
     Alg,
     RS256, RS384, RS512, ES256, ES384, ES512, PS256, PS384, PS512, EdDSA,
+    MLDSA44, MLDSA65, MLDSA87, MLDSA_ALGORITHMS,
     SUPPORTED_ALGORITHMS,
     supported_signing_algorithm,
 )
@@ -33,7 +34,9 @@ _CRYPTO_EXPORTS = {
 
 __all__ = [
     "Alg", "RS256", "RS384", "RS512", "ES256", "ES384", "ES512",
-    "PS256", "PS384", "PS512", "EdDSA", "SUPPORTED_ALGORITHMS",
+    "PS256", "PS384", "PS512", "EdDSA",
+    "MLDSA44", "MLDSA65", "MLDSA87", "MLDSA_ALGORITHMS",
+    "SUPPORTED_ALGORITHMS",
     "supported_signing_algorithm",
     "ParsedJWS", "parse_compact", "parse_json", "parse_jws",
     "json_to_compact", "parse_public_key_pem",

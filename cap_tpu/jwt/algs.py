@@ -1,8 +1,10 @@
 """JOSE asymmetric signing algorithm registry (RFC 7518 §3.1).
 
-Parity with jwt/algs.go:6-46: the same ten asymmetric algorithms are
-supported and anything else (including ``none`` and the HMAC family) is
-rejected.
+Parity with jwt/algs.go:6-46: the same ten classical asymmetric
+algorithms are supported, plus the post-quantum ML-DSA family (FIPS
+204) under the JOSE names registered by draft-ietf-cose-dilithium
+(``ML-DSA-44``/``-65``/``-87``); anything else (including ``none`` and
+the HMAC family) is rejected.
 """
 
 from __future__ import annotations
@@ -24,14 +26,25 @@ PS384: Alg = "PS384"  # RSASSA-PSS using SHA-384 and MGF1-SHA-384
 PS512: Alg = "PS512"  # RSASSA-PSS using SHA-512 and MGF1-SHA-512
 EdDSA: Alg = "EdDSA"  # Ed25519 using SHA-512
 
+# Post-quantum lattice family (FIPS 204 final; JOSE names per
+# draft-ietf-cose-dilithium). The whole message is absorbed by
+# SHAKE256 inside the scheme, so these carry no HASH_FOR_ALG entry —
+# there is no detached SHA-2 prehash step.
+MLDSA44: Alg = "ML-DSA-44"  # ML-DSA-44 (NIST category 2)
+MLDSA65: Alg = "ML-DSA-65"  # ML-DSA-65 (NIST category 3)
+MLDSA87: Alg = "ML-DSA-87"  # ML-DSA-87 (NIST category 5)
+
+MLDSA_ALGORITHMS = frozenset({MLDSA44, MLDSA65, MLDSA87})
+
 SUPPORTED_ALGORITHMS = frozenset({
     RS256, RS384, RS512,
     ES256, ES384, ES512,
     PS256, PS384, PS512,
     EdDSA,
-})
+}) | MLDSA_ALGORITHMS
 
-# Hash function name (hashlib) per algorithm.
+# Hash function name (hashlib) per algorithm (prehash families only:
+# ML-DSA hashes internally via SHAKE and is deliberately absent).
 HASH_FOR_ALG = {
     RS256: "sha256", RS384: "sha384", RS512: "sha512",
     ES256: "sha256", ES384: "sha384", ES512: "sha512",

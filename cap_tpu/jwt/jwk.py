@@ -2,13 +2,24 @@
 
 The reference gets this from coreos go-oidc's RemoteKeySet; here it is
 implemented directly: RSA (kty=RSA: n,e), EC (kty=EC: crv,x,y on
-P-256/P-384/P-521), and OKP Ed25519 (kty=OKP, crv=Ed25519: x).
+P-256/P-384/P-521), OKP Ed25519 (kty=OKP, crv=Ed25519: x), and the
+post-quantum ML-DSA family (kty=AKP: alg, pub — the Algorithm Key
+Pair type from draft-ietf-cose-dilithium / draft-ietf-jose-pqc).
 
 ``x5c`` certificate chains (RFC 7517 §4.7) are accepted the way the
 go-jose JSONWebKey the reference wraps accepts them (jwt/keyset.go:
 109-122): a key whose material arrives only as a certificate chain
 takes its public key from the first certificate's SPKI, and a key
 carrying BOTH parameters and a chain must have them agree.
+
+Dependency posture: the ``cryptography`` package is imported at CALL
+time, per key type. AKP keys never need it (the ML-DSA stack is
+dependency-free), and EC keys fall back to the pure-integer
+``HostECPublicKey`` (with an explicit on-curve check) where the
+OpenSSL stack is absent — that is what lets the full ES256→ML-DSA
+hybrid-migration path run on crypto-less hosts. RSA/OKP keys and x5c
+chains still require ``cryptography`` and surface its ImportError at
+first use, matching the package's lazy-export stance.
 """
 
 from __future__ import annotations
@@ -17,18 +28,38 @@ import base64
 import binascii
 from typing import Any, Dict, List, Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
-
 from ..errors import InvalidJWKSError
 from .jose import b64url_decode, b64url_encode
 
 _CURVES = {
-    "P-256": (ec.SECP256R1, 32),
-    "P-384": (ec.SECP384R1, 48),
-    "P-521": (ec.SECP521R1, 66),
+    "P-256": ("secp256r1", 32),
+    "P-384": ("secp384r1", 48),
+    "P-521": ("secp521r1", 66),
 }
-_CURVE_NAME_FOR_KEY = {"secp256r1": "P-256", "secp384r1": "P-384", "secp521r1": "P-521"}
+_CURVE_NAME_FOR_KEY = {"secp256r1": "P-256", "secp384r1": "P-384",
+                       "secp521r1": "P-521"}
+
+# SEC 2 curve b constants for the dependency-free on-curve check
+# (a = -3 on every NIST curve). tests/test_mldsa.py pins each base
+# point against these, so a transcription error cannot survive CI.
+_CURVE_B = {
+    "P-256": 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,  # noqa: E501
+    "P-384": 0xB3312FA7E23EE7E4988E056BE3F82D19181D9C6EFE8141120314088F5013875AC656398D8A2ED19D2A85C8EDD3EC2AEF,  # noqa: E501
+    "P-521": 0x0051953EB9618E1C9A1F929A21A0B68540EEA2DA725B99B315F3B8B489918EF109E156193951EC7E937B1652C0BD3BB1BF073573DF883D2C34F1EF451FD46B503F00,  # noqa: E501
+}
+
+
+def _crypto():
+    """The cryptography key-type modules, or None when unavailable."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric import (
+            ec,
+            ed25519,
+            rsa,
+        )
+    except ImportError:
+        return None
+    return ec, ed25519, rsa
 
 
 class JWK:
@@ -65,6 +96,9 @@ def _x5c_public_key(data: Dict[str, Any]):
     x5c = data.get("x5c")
     if x5c is None:
         return None
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
+
     if not isinstance(x5c, list) or not x5c or not all(
             isinstance(c, str) for c in x5c):
         raise InvalidJWKSError("jwk x5c must be a non-empty string array")
@@ -87,6 +121,8 @@ def _x5c_public_key(data: Dict[str, Any]):
 def _keys_equal(a, b) -> bool:
     if type(a) is not type(b):
         return False
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
     if isinstance(a, ed25519.Ed25519PublicKey):
         from cryptography.hazmat.primitives.serialization import (
             Encoding, PublicFormat,
@@ -96,12 +132,63 @@ def _keys_equal(a, b) -> bool:
     return a.public_numbers() == b.public_numbers()
 
 
+def _parse_ec_host(data: Dict[str, Any], crv: str):
+    """EC parse without the OpenSSL stack: pure-integer key with an
+    explicit on-curve check (cryptography validates the same thing in
+    its constructor — the rejection surface must not silently widen
+    when the dependency is absent)."""
+    from ..tpu.ec import _CURVE_INTS, HostECPublicKey
+
+    x = _b64_uint(data, "x")
+    y = _b64_uint(data, "y")
+    p = _CURVE_INTS[crv]["p"]
+    if not (0 <= x < p and 0 <= y < p) or \
+            (y * y - (x * x * x - 3 * x + _CURVE_B[crv])) % p != 0:
+        raise InvalidJWKSError(
+            f"invalid EC jwk: point is not on curve {crv}")
+    return HostECPublicKey(crv, x, y)
+
+
+def _parse_akp(data: Dict[str, Any]):
+    """kty=AKP (ML-DSA): the parameter set rides the REQUIRED alg
+    member and the public key is the FIPS 204 pk encoding in ``pub``
+    (draft-ietf-cose-dilithium JOSE serialization)."""
+    from ..tpu.mldsa import MLDSA_ALGS, MLDSAPublicKey
+
+    alg = data.get("alg")
+    if alg not in MLDSA_ALGS:
+        raise InvalidJWKSError(
+            f"AKP jwk requires alg in {sorted(MLDSA_ALGS)}, got {alg!r}")
+    raw = data.get("pub")
+    if not isinstance(raw, str):
+        raise InvalidJWKSError("AKP jwk missing field 'pub'")
+    try:
+        key = MLDSAPublicKey(alg, b64url_decode(raw))
+    except ValueError as err:
+        raise InvalidJWKSError(f"invalid AKP jwk: {err}") from err
+    return key
+
+
 def parse_jwk(data: Dict[str, Any]) -> JWK:
     """Parse one JWK dict into a JWK with a usable public key."""
     kty = data.get("kty")
-    cert_key = _x5c_public_key(data)
+    if kty == "AKP":
+        # Post-quantum path first: never touches the OpenSSL stack.
+        key = _parse_akp(data)
+        kid = data.get("kid") if isinstance(data.get("kid"), str) else None
+        alg = data.get("alg") if isinstance(data.get("alg"), str) else None
+        use = data.get("use") if isinstance(data.get("use"), str) else None
+        return JWK(key, kid=kid, alg=alg, use=use)
+
+    crypto = _crypto()
+    cert_key = _x5c_public_key(data) if (data.get("x5c") is not None
+                                         or crypto is not None) else None
     key = None
     if kty == "RSA":
+        if crypto is None:
+            raise ImportError(
+                "parsing RSA JWKs requires the 'cryptography' package")
+        ec, ed25519, rsa = crypto
         # presence-gated, not type-gated: a MALFORMED n/e must reject
         # (as go-jose does), never silently defer to the x5c key
         if "n" in data or "e" in data or cert_key is None:
@@ -117,7 +204,19 @@ def parse_jwk(data: Dict[str, Any]) -> JWK:
         if "x" in data or "y" in data or cert_key is None:
             if crv not in _CURVES:
                 raise InvalidJWKSError(f"unsupported EC curve {crv!r}")
-            curve_cls, _ = _CURVES[crv]
+            if crypto is None:
+                return JWK(
+                    _parse_ec_host(data, crv),
+                    kid=data.get("kid") if isinstance(data.get("kid"),
+                                                      str) else None,
+                    alg=data.get("alg") if isinstance(data.get("alg"),
+                                                      str) else None,
+                    use=data.get("use") if isinstance(data.get("use"),
+                                                      str) else None)
+            ec, ed25519, rsa = crypto
+            curve_cls = {"secp256r1": ec.SECP256R1,
+                         "secp384r1": ec.SECP384R1,
+                         "secp521r1": ec.SECP521R1}[_CURVES[crv][0]]
             x = _b64_uint(data, "x")
             y = _b64_uint(data, "y")
             try:
@@ -127,10 +226,19 @@ def parse_jwk(data: Dict[str, Any]) -> JWK:
                 raise InvalidJWKSError(f"invalid EC jwk: {err}") from err
         elif crv is not None and crv not in _CURVES:
             raise InvalidJWKSError(f"unsupported EC curve {crv!r}")
+        if crypto is None:
+            raise ImportError(
+                "parsing x5c-only EC JWKs requires the 'cryptography' "
+                "package")
+        ec, ed25519, rsa = crypto
         expected_type = ec.EllipticCurvePublicKey
     elif kty == "OKP":
         if data.get("crv") != "Ed25519":
             raise InvalidJWKSError(f"unsupported OKP curve {data.get('crv')!r}")
+        if crypto is None:
+            raise ImportError(
+                "parsing OKP JWKs requires the 'cryptography' package")
+        ec, ed25519, rsa = crypto
         if "x" in data or cert_key is None:
             raw = data.get("x")
             if not isinstance(raw, str):
@@ -146,6 +254,7 @@ def parse_jwk(data: Dict[str, Any]) -> JWK:
         raise InvalidJWKSError(f"unsupported jwk kty {kty!r}")
 
     if cert_key is not None:
+        ec, ed25519, rsa = crypto
         if not isinstance(cert_key, expected_type):
             raise InvalidJWKSError(
                 "x5c certificate key type does not match jwk kty")
@@ -196,6 +305,21 @@ def serialize_public_key(key, kid: Optional[str] = None,
         out["kid"] = kid
     if alg:
         out["alg"] = alg
+    pset = getattr(key, "parameter_set", None)
+    if pset is not None:                       # MLDSAPublicKey → AKP
+        out.update({"kty": "AKP", "alg": pset,
+                    "pub": b64url_encode(key.pk)})
+        return out
+    crv_host = getattr(key, "curve_name", None)
+    if crv_host is not None:                   # HostECPublicKey → EC
+        nums = key.public_numbers()
+        size = _CURVES[crv_host][1]
+        out.update({"kty": "EC", "crv": crv_host,
+                    "x": _uint_b64(nums.x, size),
+                    "y": _uint_b64(nums.y, size)})
+        return out
+    from cryptography.hazmat.primitives.asymmetric import ec, ed25519, rsa
+
     if isinstance(key, rsa.RSAPublicKey):
         nums = key.public_numbers()
         out.update({"kty": "RSA", "n": _uint_b64(nums.n), "e": _uint_b64(nums.e)})

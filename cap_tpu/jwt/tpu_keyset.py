@@ -45,6 +45,7 @@ _PS = {algs.PS256: "sha256", algs.PS384: "sha384", algs.PS512: "sha512"}
 _ES = {algs.ES256: "P-256", algs.ES384: "P-384", algs.ES512: "P-521"}
 
 _MIN_BUCKET = 128
+N_COEFF = 256                 # ML-DSA ring degree (FIPS 204)
 
 # RSA key-table rows encode as class * _RSA_CLS_STRIDE + row. The
 # stride must exceed any realistic per-class key count: with a 256
@@ -59,6 +60,22 @@ def _pad_size(n: int, max_chunk: int) -> int:
     while size < n:
         size *= 2
     return min(size, max_chunk)
+
+
+def _mldsa_alg_indices(pb, ok: np.ndarray, name: str) -> np.ndarray:
+    """Token indices whose protected alg is the ML-DSA set ``name``.
+
+    The native prep only interns the ten classical alg names
+    (``ALG_NAMES``); everything else carries ``alg_id == -1`` plus the
+    raw alg bytes — so the ML-DSA bucket match is a vectorized compare
+    against ``alg_raw``, no per-token Python parsing.
+    """
+    nb = np.frombuffer(name.encode(), np.uint8)
+    cand = ok & (pb.alg_id == -1) & (pb.alg_len == len(nb))
+    if not cand.any():
+        return np.zeros(0, np.int64)
+    match = (pb.alg_raw[:, : len(nb)] == nb).all(axis=1)
+    return np.nonzero(cand & match)[0]
 
 
 def _pad_telemetry(family: str, m: int, pad: int) -> None:
@@ -316,6 +333,59 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
 
         fns.append((len(idx), fn))
 
+    for pset in sorted(getattr(ks._tables, "mldsa_tables", {})):
+        from ..tpu import mldsa as tpumldsa
+
+        table = ks._tables.mldsa_tables[pset]
+        idx = _mldsa_alg_indices(pb, pb.status == 0, pset)
+        if len(idx) == 0:
+            continue
+        rows = pb.kid_rows(idx, ks._kid_mldsa_row[pset])
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        if (rows < 0).any():
+            raise InvalidParameterError(
+                f"{pset}: tokens with unknown kid")
+        covered[idx] = True
+        pad = _pad_size(len(idx), ks._max_chunk)
+        if len(idx) > pad:
+            raise InvalidParameterError("bucket exceeds max_chunk")
+        sigs = [pb.signature(int(j)) for j in idx]
+        msgs = [pb.signing_input(int(j)) for j in idx]
+        prep = tpumldsa._PreppedChunk(table, sigs, msgs,
+                                      rows.astype(np.int32), pad)
+        if not prep.valid[: len(idx)].all():
+            raise InvalidParameterError(
+                f"{pset}: resident bench tokens must decode cleanly")
+        # The accept bit needs the host-side μ/c̃ SHAKE compare, which
+        # must stay OFF the timed path — so the resident program
+        # instead matches the engine's w1 lanes against the pure-int
+        # oracle's, per token, ON DEVICE. Oracle-accept is asserted
+        # here once; a broken engine then mismatches lanes and the
+        # slope harness's accept-sum check fails exactly as for the
+        # classical families.
+        expected = tpumldsa.host_w1(table, prep).astype(np.uint8)
+        ok_host = prep.finalize(table, expected)
+        if not ok_host[: len(idx)].all():
+            raise InvalidParameterError(
+                f"{pset}: resident bench tokens must all verify")
+        live = np.zeros(pad, np.uint8)
+        live[: len(idx)] = 1
+        zd = dev_put(prep.z)
+        cd = dev_put(prep.c)
+        hd = dev_put(prep.h)
+        kd = dev_put(prep.key_idx)
+        ed = dev_put(expected)
+        md = dev_put(live)
+
+        def fn(zd=zd, cd=cd, hd=hd, kd=kd, ed=ed, md=md, table=table,
+               tpumldsa=tpumldsa):
+            w1 = tpumldsa.w1_resident(table, zd, cd, hd, kd)
+            eq = jnp.all(w1 == ed, axis=(1, 2)) & (md != 0)
+            return jnp.sum(eq.astype(jnp.int32))
+
+        fns.append((len(idx), fn))
+
     if not covered.all():
         raise InvalidParameterError(
             "tokens outside the packed families: "
@@ -413,14 +483,24 @@ class _KeyTables(object):
     __slots__ = ("epoch", "jwks", "by_kid", "kids", "rsa_tables",
                  "n_rsa_keys", "ec_tables", "ed_table", "rsa_rows",
                  "ec_rows", "ed_rows", "kid_rsa_row", "kid_ec_row",
-                 "kid_ed_row", "ec_keys", "ed_keys")
+                 "kid_ed_row", "ec_keys", "ed_keys", "mldsa_keys",
+                 "mldsa_rows", "mldsa_tables", "kid_mldsa_row")
 
     def __init__(self, jwks: Sequence[JWK], epoch: int = 0):
-        from cryptography.hazmat.primitives.asymmetric import (
-            ec,
-            ed25519,
-            rsa,
-        )
+        # The OpenSSL-backed key types need the ``cryptography``
+        # package; ML-DSA (AKP) keys and HostECPublicKey-backed EC
+        # keys are dependency-free, so the partition duck-types those
+        # FIRST and only isinstance-checks the crypto classes when
+        # the package exists — an ML-DSA/host-EC keyset builds (and
+        # hot-swaps) on crypto-less hosts.
+        try:
+            from cryptography.hazmat.primitives.asymmetric import (
+                ec,
+                ed25519,
+                rsa,
+            )
+        except ImportError:
+            ec = ed25519 = rsa = None
 
         self.epoch = int(epoch)
         self.jwks = list(jwks)
@@ -437,9 +517,23 @@ class _KeyTables(object):
         self.ec_keys: Dict[str, list] = {}
         self.ec_rows: Dict[str, Dict[int, int]] = {}
         self.ed_keys, self.ed_rows = [], {}
+        # ML-DSA: one table per parameter set (alg name = set name),
+        # mirroring the per-curve EC layout.
+        self.mldsa_keys: Dict[str, list] = {}
+        self.mldsa_rows: Dict[str, Dict[int, int]] = {}
         for i, jwk in enumerate(self.jwks):
             key = jwk.key
-            if isinstance(key, rsa.RSAPublicKey):
+            pset = getattr(key, "parameter_set", None)
+            host_crv = getattr(key, "curve_name", None)
+            if pset is not None:                 # MLDSAPublicKey
+                rows = self.mldsa_rows.setdefault(pset, {})
+                rows[i] = len(self.mldsa_keys.setdefault(pset, []))
+                self.mldsa_keys[pset].append(key)
+            elif host_crv is not None:           # HostECPublicKey
+                rows = self.ec_rows.setdefault(host_crv, {})
+                rows[i] = len(self.ec_keys.setdefault(host_crv, []))
+                self.ec_keys[host_crv].append(key)
+            elif rsa is not None and isinstance(key, rsa.RSAPublicKey):
                 nums = key.public_numbers()
                 need = nlimbs_for_bits(nums.n.bit_length())
                 try:
@@ -451,13 +545,15 @@ class _KeyTables(object):
                 self.rsa_rows[i] = (cls * _RSA_CLS_STRIDE
                                     + len(rsa_classes[cls]))
                 rsa_classes[cls].append((nums.n, nums.e))
-            elif isinstance(key, ec.EllipticCurvePublicKey):
+            elif ec is not None and isinstance(
+                    key, ec.EllipticCurvePublicKey):
                 crv = {"secp256r1": "P-256", "secp384r1": "P-384",
                        "secp521r1": "P-521"}[key.curve.name]
                 rows = self.ec_rows.setdefault(crv, {})
                 rows[i] = len(self.ec_keys.setdefault(crv, []))
                 self.ec_keys[crv].append(key)
-            elif isinstance(key, ed25519.Ed25519PublicKey):
+            elif ed25519 is not None and isinstance(
+                    key, ed25519.Ed25519PublicKey):
                 self.ed_rows[i] = len(self.ed_keys)
                 self.ed_keys.append(key)
 
@@ -480,6 +576,13 @@ class _KeyTables(object):
                 self.ed_table = Ed25519KeyTable(self.ed_keys)
             except ImportError:
                 pass
+        self.mldsa_tables: Dict[str, Any] = {}
+        for pset, keys in self.mldsa_keys.items():
+            try:
+                from ..tpu.mldsa import MLDSAKeyTable
+                self.mldsa_tables[pset] = MLDSAKeyTable(pset, keys)
+            except ImportError:
+                pass  # ML-DSA engine unavailable → CPU oracle
 
         self.by_kid: Dict[str, List[int]] = {}
         for i, jwk in enumerate(self.jwks):
@@ -493,6 +596,8 @@ class _KeyTables(object):
         self.kid_ec_row: Dict[str, Dict[str, int]] = {c: {} for c in
                                                       self.ec_rows}
         self.kid_ed_row: Dict[str, int] = {}
+        self.kid_mldsa_row: Dict[str, Dict[str, int]] = {
+            p: {} for p in self.mldsa_rows}
         for kid, idxs in self.by_kid.items():
             if len(idxs) != 1:
                 continue
@@ -504,6 +609,9 @@ class _KeyTables(object):
                     self.kid_ec_row[crv][kid] = rows[i]
             if i in self.ed_rows:
                 self.kid_ed_row[kid] = self.ed_rows[i]
+            for pset, rows in self.mldsa_rows.items():
+                if i in rows:
+                    self.kid_mldsa_row[pset][kid] = rows[i]
 
 
 class TPUBatchKeySet(KeySet):
@@ -689,6 +797,14 @@ class TPUBatchKeySet(KeySet):
     @property
     def _ed_keys(self):
         return self._tables.ed_keys
+
+    @property
+    def _mldsa_tables(self):
+        return self._tables.mldsa_tables
+
+    @property
+    def _kid_mldsa_row(self):
+        return self._tables.kid_mldsa_row
 
     # -- single-token path (CPU oracle) -----------------------------------
 
@@ -895,6 +1011,14 @@ class TPUBatchKeySet(KeySet):
             self._run_ed_packed(idx, pb, packed_parts, packed_meta,
                                 pending, slow, results, stats, tables)
 
+        # ML-DSA first: the deepest device program (NTT network) goes
+        # on the wire before the cheaper families, so its device time
+        # overlaps their packing + transfers.
+        for pset in sorted(tables.mldsa_tables):
+            idx = _mldsa_alg_indices(pb, ok, pset)
+            if len(idx):
+                self._run_mldsa_packed(pset, idx, pb, pending, slow,
+                                       stats, tables)
         for a, crv in _ES.items():
             if crv in tables.ec_tables:
                 run_family(a, run_es)
@@ -985,8 +1109,17 @@ class TPUBatchKeySet(KeySet):
 
             fam_for = [_decision.family_for_alg(a) for a in ALG_NAMES]
             alg_id = pb.alg_id
-            fams = [fam_for[int(alg_id[j])] if ok[j] else "unknown"
-                    for j in range(n)]
+
+            def fam(j: int) -> str:
+                if not ok[j]:
+                    return "unknown"
+                aid = int(alg_id[j])
+                if aid >= 0:
+                    return fam_for[aid]
+                # non-interned algs (ML-DSA et al.) carry raw bytes
+                return _decision.family_for_alg(pb.alg(j))
+
+            fams = [fam(j) for j in range(n)]
             t_dispatch = state.get("t_dispatch")
             _decision.record_batch(
                 "tpu", results, families=fams,
@@ -1206,6 +1339,55 @@ class TPUBatchKeySet(KeySet):
                 self._finish_arrays(chunk, okv, pb, results)
 
             packed_meta.append(([pad, pad], consume))
+
+    def _run_mldsa_packed(self, pset: str, idx: np.ndarray, pb,
+                          pending: List[tuple],
+                          slow: List[int], stats: dict,
+                          tables: Optional[_KeyTables] = None) -> None:
+        """One ML-DSA parameter set through the two-phase device path.
+
+        Host work per token (signature decode + range/hint gates, μ
+        SHAKE, SampleInBall) happens at dispatch; the NTT network is
+        queued on the device; the verdict closure finishes with the
+        w1Encode + μ/c̃ hash compare when the batch-wide sync drains.
+        Tokens whose kid cannot be routed fall to the CPU oracle —
+        which for ML-DSA is the same pure-int ``py_verify`` math, so
+        verdict parity is structural.
+        """
+        from ..tpu import mldsa as tpumldsa
+
+        t = self._tables if tables is None else tables
+        table = t.mldsa_tables[pset]
+        p = table.params
+        rows = pb.kid_rows(idx, t.kid_mldsa_row[pset])
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        # Per-token device bytes: z lanes (l·256 u32) + c lanes
+        # (256 u32) + hint lanes (k·256 u8) + the key row.
+        bpt = (p.l + 1) * N_COEFF * 4 + p.k * N_COEFF + 4
+        chunk_n = self._chunk_tokens(max(1, bpt // 2))
+        for lo in range(0, len(idx), chunk_n):
+            chunk = idx[lo: lo + chunk_n]
+            crows = rows[lo: lo + chunk_n]
+            m = len(chunk)
+            pad = _pad_size(m, chunk_n)
+            sigs = [pb.signature(int(j)) for j in chunk]
+            msgs = [pb.signing_input(int(j)) for j in chunk]
+            telemetry.count("device.mldsa.tokens", m)
+            _pad_telemetry("mldsa", m, pad)
+            h2d = pad * bpt
+            telemetry.count("h2d.bytes", h2d)
+            stats["h2d"] += h2d
+            with telemetry.span(f"dispatch.mldsa.{pset}"):
+                fin = tpumldsa.verify_mldsa_pending(
+                    table, sigs, msgs, crows, pad=pad, mesh=self._mesh)
+            pending.append((chunk, m, fin))
 
     def _run_rsa_arrays(self, kind: str, hash_name: str, idx: np.ndarray,
                         pb, pending: List[tuple],
@@ -1466,6 +1648,8 @@ class TPUBatchKeySet(KeySet):
                 buckets.setdefault(("es", p.alg), []).append(j)
             elif p.alg == algs.EdDSA and tables.ed_table is not None:
                 buckets.setdefault(("ed",), []).append(j)
+            elif p.alg in tables.mldsa_tables:
+                buckets.setdefault(("mldsa", p.alg), []).append(j)
             else:
                 buckets.setdefault(("cpu",), []).append(j)
 
@@ -1478,6 +1662,9 @@ class TPUBatchKeySet(KeySet):
             elif kind[0] == "es":
                 self._run_ec(kind[1], idxs, parsed_list, key_for,
                              results, tables)
+            elif kind[0] == "mldsa":
+                self._run_mldsa(kind[1], idxs, parsed_list, key_for,
+                                results, tables)
             else:
                 self._run_ed(idxs, parsed_list, key_for, results,
                              tables)
@@ -1594,6 +1781,30 @@ class TPUBatchKeySet(KeySet):
             hashes_ += [b"\x00" * HASH_LEN[hash_name]] * fill
             key_idx = np.asarray(rows + [0] * fill, np.int32)
             ok = tpuec.verify_ecdsa_batch(table, sigs, hashes_, key_idx)
+            self._finish(chunk, parsed_list, ok[: len(chunk)], results)
+
+    def _run_mldsa(self, alg, idxs, parsed_list, key_for, results,
+                   tables=None):
+        from ..tpu import mldsa as tpumldsa
+
+        t = self._tables if tables is None else tables
+        table = t.mldsa_tables[alg]
+        p = table.params
+        chunk_n = self._chunk_tokens(
+            max(1, ((p.l + 1) * N_COEFF * 4 + p.k * N_COEFF + 4) // 2))
+        for lo in range(0, len(idxs), chunk_n):
+            chunk = idxs[lo: lo + chunk_n]
+            pad = _pad_size(len(chunk), chunk_n)
+            sigs = [parsed_list[j].signature for j in chunk]
+            msgs = [parsed_list[j].signing_input for j in chunk]
+            rows = [t.mldsa_rows[alg][key_for[j]] for j in chunk]
+            telemetry.count("device.mldsa.tokens", len(chunk))
+            _pad_telemetry("mldsa", len(chunk), pad)
+            with telemetry.span(f"dispatch.mldsa.{alg}"):
+                ok = tpumldsa.verify_mldsa_pending(
+                    table, sigs, msgs,
+                    np.asarray(rows, np.int32), pad=pad,
+                    mesh=self._mesh)()
             self._finish(chunk, parsed_list, ok[: len(chunk)], results)
 
     def _run_ed(self, idxs, parsed_list, key_for, results,

@@ -1,28 +1,27 @@
-"""Single-token signature verification on CPU via the ``cryptography`` package.
+"""Single-token signature verification on CPU.
 
-This is the correctness oracle and default execution path — the analog of
-the reference's go-jose → Go stdlib crypto pipeline
+This is the correctness oracle and default execution path — the analog
+of the reference's go-jose → Go stdlib crypto pipeline
 (jwt/keyset.go:126-139,154-173 → crypto/{rsa,ecdsa,ed25519}). The TPU
 batch engine (cap_tpu/tpu) must match it bit-for-bit, on failures as
 well as successes.
+
+Dependency posture: the classical families (RS*/PS*/ES*/EdDSA over
+OpenSSL-backed keys) import the ``cryptography`` package at call time.
+The ML-DSA family and ``HostECPublicKey``-backed ES* keys verify on
+pure-integer host oracles (``tpu.mldsa.py_verify``,
+``tpu.ec._py_verify_one``'s math) and therefore work on crypto-less
+hosts — the availability contract the crypto-free KAT sweeps and the
+hybrid-migration chaos suite rely on.
 """
 
 from __future__ import annotations
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
-from cryptography.hazmat.primitives.asymmetric.utils import encode_dss_signature
+import hashlib
 
 from ..errors import InvalidSignatureError, UnsupportedAlgError
 from . import algs
 from .jose import ParsedJWS
-
-_HASHES = {
-    "sha256": hashes.SHA256,
-    "sha384": hashes.SHA384,
-    "sha512": hashes.SHA512,
-}
 
 # ES* algorithms pin both the curve and the raw signature coordinate size
 # (RFC 7518 §3.4): ES256→P-256/32B, ES384→P-384/48B, ES512→P-521/66B.
@@ -31,14 +30,33 @@ _EC_CURVE_FOR_ALG = {
     algs.ES384: ("secp384r1", 48),
     algs.ES512: ("secp521r1", 66),
 }
+_EC_JOSE_CRV_FOR_ALG = {
+    algs.ES256: "P-256", algs.ES384: "P-384", algs.ES512: "P-521",
+}
 
 
 def _hash_cls(alg: str):
-    return _HASHES[algs.HASH_FOR_ALG[alg]]
+    from cryptography.hazmat.primitives import hashes
+
+    return {"sha256": hashes.SHA256, "sha384": hashes.SHA384,
+            "sha512": hashes.SHA512}[algs.HASH_FOR_ALG[alg]]
 
 
 def key_matches_alg(key, alg: str) -> bool:
     """Whether the key type is usable with the given JOSE alg."""
+    if alg in algs.MLDSA_ALGORITHMS:
+        return getattr(key, "parameter_set", None) == alg
+    host_crv = getattr(key, "curve_name", None)
+    if host_crv is not None:                  # HostECPublicKey
+        return _EC_JOSE_CRV_FOR_ALG.get(alg) == host_crv
+    try:
+        from cryptography.hazmat.primitives.asymmetric import (
+            ec,
+            ed25519,
+            rsa,
+        )
+    except ImportError:
+        return False
     if alg in (algs.RS256, algs.RS384, algs.RS512,
                algs.PS256, algs.PS384, algs.PS512):
         return isinstance(key, rsa.RSAPublicKey)
@@ -52,6 +70,24 @@ def key_matches_alg(key, alg: str) -> bool:
     return False
 
 
+def _verify_host_ec(parsed: ParsedJWS, key) -> None:
+    """Pure-integer ECDSA for HostECPublicKey (SEC1 §4.1.4) — the same
+    acceptance rule as Go crypto/ecdsa and OpenSSL."""
+    from ..tpu.ec import curve, py_ecdsa_verify
+
+    _, coord = _EC_CURVE_FOR_ALG[parsed.alg]
+    sig = parsed.signature
+    if len(sig) != 2 * coord:
+        raise InvalidSignatureError(
+            f"bad ECDSA signature length {len(sig)} for {parsed.alg}")
+    digest = hashlib.new(algs.HASH_FOR_ALG[parsed.alg],
+                         parsed.signing_input).digest()
+    cp = curve(key.curve_name)
+    nums = key.public_numbers()
+    if not py_ecdsa_verify(cp, nums.x, nums.y, sig, digest):
+        raise InvalidSignatureError("signature verification failed")
+
+
 def verify_parsed(parsed: ParsedJWS, key) -> None:
     """Verify ``parsed.signature`` over ``parsed.signing_input`` with ``key``.
 
@@ -63,6 +99,24 @@ def verify_parsed(parsed: ParsedJWS, key) -> None:
         raise UnsupportedAlgError(f"unsupported signing algorithm {alg!r}")
     if not key_matches_alg(key, alg):
         raise InvalidSignatureError(f"key type does not match alg {alg}")
+
+    if alg in algs.MLDSA_ALGORITHMS:
+        from ..tpu.mldsa import py_verify
+
+        # py_verify subsumes every encoding rule (length, hint
+        # validity, z range) — all rejects are signature-layer rejects,
+        # matching the raw-r||s gates of the ES* branch below.
+        if not py_verify(key, parsed.signature, parsed.signing_input):
+            raise InvalidSignatureError("signature verification failed")
+        return
+    if getattr(key, "curve_name", None) is not None:
+        return _verify_host_ec(parsed, key)
+
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ec, padding
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature,
+    )
 
     try:
         if alg in (algs.RS256, algs.RS384, algs.RS512):

@@ -154,9 +154,16 @@ def classify(err: BaseException) -> str:
 # family + kid extraction (bounded, cached — hot-path safe)
 # ---------------------------------------------------------------------------
 
-FAMILIES = ("rs", "ps", "es", "ed", "other", "unknown")
+FAMILIES = ("rs", "ps", "es", "ed", "mldsa44", "mldsa65", "mldsa87",
+            "other", "unknown")
 
 _FAMILY_FOR_ALG_PREFIX = {"RS": "rs", "PS": "ps", "ES": "es"}
+
+# Post-quantum family: one registered family per parameter set so a
+# hybrid-migration rollout can watch ES256 traffic drain and ML-DSA
+# traffic ramp as separate counter series (docs/KEYPLANE.md).
+_MLDSA_FAMILY = {"ML-DSA-44": "mldsa44", "ML-DSA-65": "mldsa65",
+                 "ML-DSA-87": "mldsa87"}
 
 # JOSE headers repeat massively across a token stream (one IdP = a
 # handful of distinct headers), so (family, kid-hash) is cached by the
@@ -172,6 +179,9 @@ def family_for_alg(alg: Optional[str]) -> str:
         return "unknown"
     if alg == "EdDSA":
         return "ed"
+    fam = _MLDSA_FAMILY.get(alg)
+    if fam is not None:
+        return fam
     return _FAMILY_FOR_ALG_PREFIX.get(alg[:2], "other")
 
 

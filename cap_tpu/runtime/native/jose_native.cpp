@@ -22,6 +22,7 @@
 
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>  // SHA-NI path (sha2 namespace below)
+#include <cpuid.h>      // feature probe fallback for gcc < 11
 #endif
 
 // ---------------------------------------------------------------------------
@@ -133,10 +134,23 @@ static void (*sha256_compress)(uint32_t[8], const uint8_t*) =
 
 __attribute__((constructor)) static void sha256_pick_impl() {
 #if defined(__x86_64__) || defined(__i386__)
+#if defined(__clang__) || (defined(__GNUC__) && __GNUC__ >= 11)
   if (__builtin_cpu_supports("sha") &&
       __builtin_cpu_supports("sse4.1") &&
       __builtin_cpu_supports("ssse3"))
     sha256_compress = sha256_compress_ni;
+#else
+  // gcc < 11 rejects "sha" as a __builtin_cpu_supports feature name
+  // (the whole translation unit failed to compile, silently killing
+  // the native runtime on those toolchains): probe CPUID leaf 7
+  // directly — EBX bit 29 is the SHA-extensions flag.
+  unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+  if (__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx) &&
+      (ebx & (1u << 29)) &&
+      __builtin_cpu_supports("sse4.1") &&
+      __builtin_cpu_supports("ssse3"))
+    sha256_compress = sha256_compress_ni;
+#endif
 #endif
 }
 

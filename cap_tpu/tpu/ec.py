@@ -858,16 +858,16 @@ def host_ecdsa_sign(crv: str, d: int, e: int, k: int) -> Tuple[int, int]:
     return r, s
 
 
-def _py_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,
-                   digest: bytes) -> bool:
+def py_ecdsa_verify(cp: CurveParams, qx: int, qy: int, sig_raw: bytes,
+                    digest: bytes) -> bool:
     """Pure-integer ECDSA verify (SEC1 §4.1.4), dependency-free.
 
-    The oracle of last resort when the ``cryptography`` package is
-    absent: same acceptance rule as Go crypto/ecdsa and OpenSSL —
-    range checks 1 <= r, s < n, left-bits hash truncation, accept iff
-    (u1·G + u2·Q).x ≡ r (mod n).
+    Same acceptance rule as Go crypto/ecdsa and OpenSSL — range checks
+    1 <= r, s < n, left-bits hash truncation, accept iff
+    (u1·G + u2·Q).x ≡ r (mod n). The oracle behind both the
+    degenerate-lane re-verification and the crypto-less
+    ``HostECPublicKey`` verify path in jwt/verify.py.
     """
-    cp = table.curve
     cb = cp.coord_bytes
     r = int.from_bytes(sig_raw[:cb], "big")
     s = int.from_bytes(sig_raw[cb:], "big")
@@ -877,15 +877,22 @@ def _py_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,
     excess = 8 * len(digest) - cp.nbits
     if excess > 0:
         e >>= excess
-    nums = table.keys[row].public_numbers()
     w = pow(s, -1, cp.n)
     u1 = (e * w) % cp.n
     u2 = (r * w) % cp.n
     R = cp.affine_add(scalar_mult(cp, u1, (cp.gx, cp.gy)),
-                      scalar_mult(cp, u2, (nums.x, nums.y)))
+                      scalar_mult(cp, u2, (qx, qy)))
     if R is None:
         return False
     return R[0] % cp.n == r
+
+
+def _py_verify_one(table: ECKeyTable, row: int, sig_raw: bytes,
+                   digest: bytes) -> bool:
+    """Table-row wrapper over :func:`py_ecdsa_verify` (the oracle of
+    last resort when the ``cryptography`` package is absent)."""
+    nums = table.keys[row].public_numbers()
+    return py_ecdsa_verify(table.curve, nums.x, nums.y, sig_raw, digest)
 
 
 def verify_ecdsa_batch(table: ECKeyTable, sigs: Sequence[bytes],

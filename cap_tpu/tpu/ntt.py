@@ -1,0 +1,249 @@
+"""Number-theoretic transform over Z_q, q = 8380417 (FIPS 204 §7.5).
+
+The ML-DSA verify hot loop is NTT-dominated (PAPERS.md: the GPU
+Dilithium engine spends ~70% of verify in NTT + pointwise ring mults),
+and the 256-point transform over the Dilithium prime maps exactly onto
+the repo's batch-lane shape: one token = a handful of degree-255
+polynomials, a batch = a [B, ·, 256] integer lane array, and every
+butterfly stage is one vectorized multiply-add sweep across all lanes
+at once.
+
+Arithmetic strategy (TPU-safe):
+
+- coefficients ride in **uint32 lanes** in canonical form [0, q);
+- products use **Montgomery reduction with R = 2^32**, built from
+  16-bit limb multiplies so nothing ever needs an int64 (TPUs have no
+  64-bit integer units; XLA:CPU lowers the same graph to scalar ops);
+- the twiddle tables are stored in Montgomery form (ζ·R mod q), so
+  ``mont_mul(zeta_mont, x)`` yields the PLAIN product ζ·x mod q —
+  data stays in the plain domain through the whole transform and no
+  global domain conversion is ever needed. Pointwise key-table mults
+  use the same trick: tables are uploaded in Montgomery form once
+  (key material is long-lived), per-token data stays plain;
+- the inverse transform folds the 256⁻¹ scaling into one final
+  Montgomery multiply by (256⁻¹·R mod q).
+
+The stage loops are unrolled host-side (8 fixed stages), each stage a
+reshape + one batched butterfly over [..., blocks, 2, len] — XLA sees
+a short static program per batch shape, the same compile-once shape
+discipline as the RSA/EC engines.
+
+``ntt_ref``/``intt_ref`` are the numpy int64 host references (exact
+integer arithmetic, no Montgomery) — the pure-int oracle in
+``mldsa.py`` runs on them, and the parity tests pin the uint32 device
+graph against them butterfly-for-butterfly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# jax is imported INSIDE the device kernels: the numpy references and
+# the twiddle tables at the bottom serve the pure-int host oracle in
+# ``mldsa.py``, which must stay importable (and cheap) on hosts that
+# never touch the accelerator — the same lazy-jax stance as the jwt
+# package's lazy TPUBatchKeySet export.
+
+Q = 8380417                       # 2^23 - 2^13 + 1
+N = 256
+ZETA = 1753                       # primitive 512th root of unity mod q
+MONT_BITS = 32
+MONT_R = (1 << MONT_BITS) % Q
+# -q^{-1} mod 2^32 for unsigned REDC: p + (p·NQINV mod 2^32)·q ≡ 0 (mod 2^32)
+NQINV = (-pow(Q, -1, 1 << MONT_BITS)) % (1 << MONT_BITS)
+INV256 = pow(N, -1, Q)
+
+
+def _bitrev8(x: int) -> int:
+    r = 0
+    for _ in range(8):
+        r = (r << 1) | (x & 1)
+        x >>= 1
+    return r
+
+
+# zetas[k] = ζ^bitrev8(k) mod q, consumed in index order by the
+# standard Cooley-Tukey schedule (zetas[0] is never referenced).
+ZETAS = np.array([pow(ZETA, _bitrev8(k), Q) for k in range(N)], np.int64)
+ZETAS_MONT = ((ZETAS << MONT_BITS) % Q).astype(np.uint32)
+NEG_ZETAS_MONT = (((Q - ZETAS) << MONT_BITS) % Q).astype(np.uint32)
+INV256_MONT = np.uint32((INV256 << MONT_BITS) % Q)
+
+_Q32 = np.uint32(Q)
+_NQINV32 = np.uint32(NQINV)
+_MASK16 = np.uint32(0xFFFF)
+
+
+# ---------------------------------------------------------------------------
+# uint32 Montgomery arithmetic (no 64-bit integers anywhere)
+# ---------------------------------------------------------------------------
+
+def _mulhi32(a, b):
+    """High 32 bits of the 64-bit product of two uint32 arrays,
+    computed from 16-bit limbs (every partial product stays < 2^32)."""
+    a0 = a & _MASK16
+    a1 = a >> 16
+    b0 = b & _MASK16
+    b1 = b >> 16
+    t = a0 * b0
+    u = a1 * b0 + (t >> 16)           # ≤ (2^16-1)^2 + (2^16-1) < 2^32
+    v = a0 * b1 + (u & _MASK16)
+    return a1 * b1 + (u >> 16) + (v >> 16)
+
+
+def mont_mul(a, b):
+    """Montgomery product a·b·R⁻¹ mod q for uint32 lanes in [0, q).
+
+    With one operand pre-multiplied by R (twiddles, key tables) this
+    is the PLAIN modular product of the other operand — the only way
+    the engine ever multiplies. Result is canonical [0, q).
+    """
+    import jax.numpy as jnp
+
+    lo = a * b                        # wraps mod 2^32 (uint32 lanes)
+    hi = _mulhi32(a, b)
+    m = lo * _NQINV32                 # mod 2^32
+    mq_hi = _mulhi32(m, _Q32)
+    # lo + low32(m·q) ≡ 0 (mod 2^32): the carry out is 1 iff lo != 0.
+    t = hi + mq_hi + (lo != 0).astype(jnp.uint32)
+    return jnp.where(t >= _Q32, t - _Q32, t)
+
+
+def add_q(a, b):
+    import jax.numpy as jnp
+
+    t = a + b
+    return jnp.where(t >= _Q32, t - _Q32, t)
+
+
+def sub_q(a, b):
+    import jax.numpy as jnp
+
+    return jnp.where(a >= b, a - b, a + _Q32 - b)
+
+
+# ---------------------------------------------------------------------------
+# batched NTT / inverse NTT (last axis = 256 coefficients)
+# ---------------------------------------------------------------------------
+
+def ntt(x):
+    """Forward NTT, plain domain in → plain domain out (CRYSTALS
+    bit-reversed frequency order). x: uint32 [..., 256] in [0, q)."""
+    import jax.numpy as jnp
+
+    shape = x.shape
+    lead = shape[:-1]
+    for s in range(8):                # len = 128 >> s
+        ln = 128 >> s
+        nblk = N // (2 * ln)
+        z = jnp.asarray(ZETAS_MONT[nblk: 2 * nblk])       # [nblk]
+        v = x.reshape(lead + (nblk, 2, ln))
+        lo_, hi_ = v[..., 0, :], v[..., 1, :]
+        t = mont_mul(z[..., :, None], hi_)
+        x = jnp.stack([add_q(lo_, t), sub_q(lo_, t)],
+                      axis=-2).reshape(shape)
+    return x
+
+
+def intt(x):
+    """Inverse NTT (Gentleman-Sande), including the 256⁻¹ scaling.
+    Plain domain in/out; exact inverse of :func:`ntt`."""
+    import jax.numpy as jnp
+
+    shape = x.shape
+    lead = shape[:-1]
+    for s in range(8):                # len = 1 << s
+        ln = 1 << s
+        nblk = N // (2 * ln)
+        # k decrements from 2·nblk-1 down to nblk as blocks advance.
+        z = jnp.asarray(NEG_ZETAS_MONT[nblk: 2 * nblk][::-1].copy())
+        v = x.reshape(lead + (nblk, 2, ln))
+        lo_, hi_ = v[..., 0, :], v[..., 1, :]
+        t = lo_
+        lo_ = add_q(t, hi_)
+        hi_ = mont_mul(z[..., :, None], sub_q(t, hi_))
+        x = jnp.stack([lo_, hi_], axis=-2).reshape(shape)
+    return mont_mul(jnp.asarray(INV256_MONT), x)
+
+
+# ---------------------------------------------------------------------------
+# Decompose / UseHint lanes (FIPS 204 §7.4) — per-parameter-set γ2
+# ---------------------------------------------------------------------------
+
+def use_hint(h, r, gamma2: int):
+    """Vectorized UseHint: w1 lanes from hint bits + raw w lanes.
+
+    h: uint32/uint8 [..., 256] in {0,1}; r: uint32 [..., 256] in
+    [0, q); gamma2: 95232 (ML-DSA-44) or 261888 (65/87), a static
+    Python int so each parameter set compiles its own graph.
+    Returns uint32 w1 in [0, m) with m = (q-1)/(2γ2).
+    """
+    import jax.numpy as jnp
+
+    two_g2 = np.uint32(2 * gamma2)
+    g2 = np.uint32(gamma2)
+    m = np.uint32((Q - 1) // (2 * gamma2))
+    rm = r % two_g2
+    is_neg = rm > g2                  # centered r0 < 0
+    r_sub_r0 = r - rm + jnp.where(is_neg, two_g2, np.uint32(0))
+    special = r_sub_r0 == np.uint32(Q - 1)    # r1 wraps to 0, r0 -= 1
+    r1 = jnp.where(special, np.uint32(0), r_sub_r0 // two_g2)
+    r0_pos = (~special) & (~is_neg) & (rm > 0)
+    h = h.astype(jnp.uint32)
+    bumped = jnp.where(r0_pos, r1 + np.uint32(1), r1 + m - np.uint32(1)) % m
+    return jnp.where(h != 0, bumped, r1)
+
+
+# ---------------------------------------------------------------------------
+# numpy int64 host reference (exact arithmetic; the oracle's transform)
+# ---------------------------------------------------------------------------
+
+def ntt_ref(x: np.ndarray) -> np.ndarray:
+    """Forward NTT on int64 numpy lanes [..., 256], values [0, q)."""
+    a = np.asarray(x, np.int64).copy()
+    k = 0
+    ln = 128
+    while ln >= 1:
+        for start in range(0, N, 2 * ln):
+            k += 1
+            z = int(ZETAS[k])
+            t = (z * a[..., start + ln: start + 2 * ln]) % Q
+            a[..., start + ln: start + 2 * ln] = \
+                (a[..., start: start + ln] - t) % Q
+            a[..., start: start + ln] = \
+                (a[..., start: start + ln] + t) % Q
+        ln //= 2
+    return a
+
+
+def intt_ref(x: np.ndarray) -> np.ndarray:
+    """Inverse NTT on int64 numpy lanes; exact inverse of ntt_ref."""
+    a = np.asarray(x, np.int64).copy()
+    k = N
+    ln = 1
+    while ln < N:
+        for start in range(0, N, 2 * ln):
+            k -= 1
+            z = Q - int(ZETAS[k])
+            t = a[..., start: start + ln].copy()
+            a[..., start: start + ln] = \
+                (t + a[..., start + ln: start + 2 * ln]) % Q
+            a[..., start + ln: start + 2 * ln] = \
+                (z * (t - a[..., start + ln: start + 2 * ln])) % Q
+        ln *= 2
+    return (a * INV256) % Q
+
+
+def use_hint_ref(h: np.ndarray, r: np.ndarray, gamma2: int) -> np.ndarray:
+    """numpy reference of :func:`use_hint` (same special-case rules)."""
+    r = np.asarray(r, np.int64)
+    two_g2 = 2 * gamma2
+    m = (Q - 1) // two_g2
+    rm = r % two_g2
+    is_neg = rm > gamma2
+    r_sub_r0 = r - rm + np.where(is_neg, two_g2, 0)
+    special = r_sub_r0 == Q - 1
+    r1 = np.where(special, 0, r_sub_r0 // two_g2)
+    r0_pos = (~special) & (~is_neg) & (rm > 0)
+    bumped = np.where(r0_pos, r1 + 1, r1 + m - 1) % m
+    return np.where(np.asarray(h) != 0, bumped, r1)

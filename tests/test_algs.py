@@ -5,14 +5,19 @@ from cap_tpu.jwt import algs
 from cap_tpu.jwt.algs import supported_signing_algorithm
 
 
-def test_all_ten_supported():
-    # The same ten asymmetric algorithms as the reference (jwt/algs.go:6-22).
+def test_registry_pinned():
+    # The reference's ten asymmetric algorithms (jwt/algs.go:6-22)
+    # plus the post-quantum ML-DSA family (FIPS 204, docs/PQC.md) —
+    # and NOTHING else.
     assert algs.SUPPORTED_ALGORITHMS == {
         "RS256", "RS384", "RS512",
         "ES256", "ES384", "ES512",
         "PS256", "PS384", "PS512",
         "EdDSA",
+        "ML-DSA-44", "ML-DSA-65", "ML-DSA-87",
     }
+    assert algs.MLDSA_ALGORITHMS == {"ML-DSA-44", "ML-DSA-65",
+                                     "ML-DSA-87"}
     supported_signing_algorithm(*algs.SUPPORTED_ALGORITHMS)
 
 

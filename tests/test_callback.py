@@ -14,6 +14,11 @@ from wsgiref.simple_server import WSGIServer, make_server
 
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 from cap_tpu.errors import ExpiredRequestError, NotFoundError
 from cap_tpu.oidc import Config, Provider, Request
 from cap_tpu.oidc.callback import (

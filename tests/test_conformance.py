@@ -662,6 +662,117 @@ class TestSigEncodingGolden:
                 f"device engine verdict for {v['name']}: {bool(ok)}"
 
 
+class TestMLDSAEncodingGolden:
+    """Adversarial ML-DSA encoding vectors (pinned in
+    sig_conformance.json): truncated/extended signatures, a
+    bit-flipped c̃, an out-of-range z coefficient, hint-count
+    overflow, nonzero hint padding. Dependency-free like the ES*/RS*
+    engine suite — AND swept across all four verify surfaces right
+    here, because the AKP/ML-DSA stack never needs ``cryptography``:
+    verdicts and decision reason classes (``bad_signature`` vs
+    ``malformed``) must agree everywhere."""
+
+    @pytest.fixture(scope="class")
+    def pq_vectors(self, sig_golden):
+        vecs = [v for v in sig_golden["vectors"]
+                if v["alg"].startswith("ML-DSA")]
+        assert vecs, "ML-DSA vectors missing from sig_conformance.json"
+        return vecs
+
+    @pytest.fixture(scope="class")
+    def pq_jwks(self, sig_golden):
+        from cap_tpu.jwt.jwk import parse_jwk
+
+        return [parse_jwk(k) for k in sig_golden["keys"]["keys"]
+                if k.get("kty") == "AKP"]
+
+    def test_vector_inventory(self, pq_vectors):
+        names = {v["name"] for v in pq_vectors}
+        for required in ("mldsa44-valid", "mldsa44-sig-truncated",
+                         "mldsa44-ctilde-bitflip",
+                         "mldsa44-z-out-of-range",
+                         "mldsa44-hint-count-overflow",
+                         "mldsa44-hint-padding-nonzero",
+                         "mldsa44-sig-extended"):
+            assert required in names, required
+        verdicts = {v["name"]: v["verdict"] for v in pq_vectors}
+        assert verdicts["mldsa44-valid"] == "accept"
+
+    def test_oracle_matches_pinned_verdicts(self, pq_vectors, pq_jwks):
+        from cap_tpu.jwt.jose import b64url_decode
+        from cap_tpu.tpu import mldsa
+
+        key = pq_jwks[0].key
+        for v in pq_vectors:
+            h, p, s = v["token"].split(".")
+            got = mldsa.py_verify(key, b64url_decode(s),
+                                  (h + "." + p).encode())
+            assert got == (v["verdict"] == "accept"), v["name"]
+
+    def test_engine_matches_pinned_verdicts(self, pq_vectors, pq_jwks):
+        import numpy as np
+
+        from cap_tpu.jwt.jose import b64url_decode
+        from cap_tpu.tpu import mldsa
+
+        key = pq_jwks[0].key
+        table = mldsa.MLDSAKeyTable(key.parameter_set, [key])
+        sigs, msgs = [], []
+        for v in pq_vectors:
+            h, p, s = v["token"].split(".")
+            sigs.append(b64url_decode(s))
+            msgs.append((h + "." + p).encode())
+        got = mldsa.verify_mldsa_batch(
+            table, sigs, msgs, np.zeros(len(sigs), np.int32))
+        for v, ok in zip(pq_vectors, got):
+            assert bool(ok) == (v["verdict"] == "accept"), v["name"]
+
+    def test_reject_reason_class_parity_four_surfaces(self, pq_vectors,
+                                                      pq_jwks):
+        from cap_tpu.fleet import FleetClient
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+        from cap_tpu.obs import decision as obs_decision
+        from cap_tpu.serve.client import VerifyClient
+        from cap_tpu.serve.worker import VerifyWorker
+
+        # keyset.py's StaticKeySet is importable without cryptography
+        # (lazy exports) — the module-top alias is crypto-gated, so
+        # import it directly for this crypto-free sweep.
+        from cap_tpu.jwt.keyset import StaticKeySet as _SKS
+
+        tokens = [v["token"] for v in pq_vectors]
+        out = {}
+        out["oracle"] = _SKS([j.key for j in pq_jwks]).verify_batch(
+            tokens)
+        ks = TPUBatchKeySet(pq_jwks)
+        out["tpu"] = ks.verify_batch(tokens)
+        out["tpu_objects"] = ks._verify_batch_objects(tokens)
+        w = VerifyWorker(TPUBatchKeySet(pq_jwks), target_batch=8,
+                         max_wait_ms=5.0)
+        try:
+            host, port = w.address
+            with VerifyClient(host, port, timeout=600.0) as c:
+                out["serve"] = c.verify_batch(tokens)
+            out["router"] = FleetClient([(host, port)],
+                                        rr_seed=0).verify_batch(tokens)
+        finally:
+            w.close()
+
+        for i, v in enumerate(pq_vectors):
+            per_surface = {}
+            for surf, results in out.items():
+                r = results[i]
+                if isinstance(r, Exception):
+                    per_surface[surf] = ("reject",
+                                         obs_decision.classify(r))
+                else:
+                    per_surface[surf] = ("accept", None)
+            assert len(set(per_surface.values())) == 1, \
+                f"{v['name']}: {per_surface}"
+            assert (per_surface["tpu"][0] == "accept") == \
+                (v["verdict"] == "accept"), v["name"]
+
+
 @needs_crypto
 def test_sig_encoding_four_surface_parity(sig_golden):
     """Golden vectors through the full stack: CPU oracle, TPU batch,

@@ -13,6 +13,11 @@ import json
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.asymmetric import ec as cec
 from cryptography.hazmat.primitives.asymmetric.utils import (

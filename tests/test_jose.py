@@ -1,5 +1,10 @@
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 from cap_tpu.errors import MalformedTokenError, TokenNotSignedError
 from cap_tpu.jwt.jose import b64url_decode, b64url_encode, parse_compact
 from cap_tpu import testing as captest

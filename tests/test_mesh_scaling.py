@@ -15,6 +15,11 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 import jax
 
 from cap_tpu import testing as captest

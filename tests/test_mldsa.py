@@ -1,0 +1,291 @@
+"""ML-DSA (FIPS 204) core: NTT parity, encodings, sign/verify
+roundtrips, JWK plumbing, and the engine-vs-oracle bit-exactness
+sweep (≥1k batched verifies per parameter set — the acceptance bar).
+
+Everything here is dependency-free (no ``cryptography``): the host
+oracle is pure numpy int64, the device engine is the uint32 Montgomery
+JAX graph, and fixtures come from the deterministic in-repo signer.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from cap_tpu.errors import InvalidJWKSError, InvalidSignatureError
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.jose import b64url_encode
+from cap_tpu.jwt.jwk import _CURVE_B, parse_jwk, serialize_public_key
+from cap_tpu.jwt.verify import key_matches_alg, verify_parsed
+from cap_tpu.tpu import mldsa as M
+from cap_tpu.tpu import ntt as NTT
+
+RNG = np.random.default_rng(0xCAB)
+
+
+# ---------------------------------------------------------------------------
+# NTT layer
+# ---------------------------------------------------------------------------
+
+def test_ntt_ref_roundtrip():
+    a = RNG.integers(0, NTT.Q, (5, 256), dtype=np.int64)
+    assert (NTT.intt_ref(NTT.ntt_ref(a)) == a).all()
+
+
+def test_ntt_ref_negacyclic_product():
+    """Pointwise NTT-domain products ARE negacyclic convolution in
+    Z_q[x]/(x^256+1) — the algebra the whole verify equation rides."""
+    a = RNG.integers(0, NTT.Q, 256, dtype=np.int64)
+    b = RNG.integers(0, NTT.Q, 256, dtype=np.int64)
+    c = np.zeros(256, object)
+    for i in range(256):
+        ai = int(a[i])
+        for k in range(256):
+            j = (k - i) % 256
+            term = ai * int(b[j])
+            c[k] = (c[k] + (term if i <= k else -term)) % NTT.Q
+    via_ntt = NTT.intt_ref((NTT.ntt_ref(a) * NTT.ntt_ref(b)) % NTT.Q)
+    assert (c.astype(np.int64) == via_ntt).all()
+
+
+def test_device_ntt_matches_ref():
+    import jax.numpy as jnp
+
+    a = RNG.integers(0, NTT.Q, (4, 256), dtype=np.int64)
+    dev = np.asarray(NTT.ntt(jnp.asarray(a.astype(np.uint32))))
+    assert (dev.astype(np.int64) == NTT.ntt_ref(a)).all()
+    back = np.asarray(NTT.intt(NTT.ntt(jnp.asarray(a.astype(np.uint32)))))
+    assert (back.astype(np.int64) == a).all()
+
+
+def test_mont_mul_is_plain_product_with_mont_operand():
+    import jax.numpy as jnp
+
+    x = RNG.integers(0, NTT.Q, 512, dtype=np.int64)
+    y = RNG.integers(0, NTT.Q, 512, dtype=np.int64)
+    ym = (y << NTT.MONT_BITS) % NTT.Q
+    got = np.asarray(NTT.mont_mul(jnp.asarray(x.astype(np.uint32)),
+                                  jnp.asarray(ym.astype(np.uint32))))
+    assert (got.astype(np.int64) == (x * y) % NTT.Q).all()
+
+
+@pytest.mark.parametrize("gamma2", [95232, 261888])
+def test_use_hint_device_matches_ref(gamma2):
+    import jax.numpy as jnp
+
+    r = RNG.integers(0, NTT.Q, (3, 256), dtype=np.int64)
+    # Force the q-1 wrap special case into the sweep.
+    r[0, 0] = NTT.Q - 1
+    r[0, 1] = 0
+    h = RNG.integers(0, 2, (3, 256), dtype=np.int64)
+    ref = NTT.use_hint_ref(h, r, gamma2)
+    dev = np.asarray(NTT.use_hint(jnp.asarray(h.astype(np.uint32)),
+                                  jnp.asarray(r.astype(np.uint32)),
+                                  gamma2))
+    assert (ref == dev.astype(np.int64)).all()
+    m = (NTT.Q - 1) // (2 * gamma2)
+    assert ref.max() < m
+
+
+# ---------------------------------------------------------------------------
+# encodings
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bits", [4, 6, 10, 13, 18, 20])
+def test_bitpack_roundtrip(bits):
+    arr = RNG.integers(0, 1 << bits, (3, 256), dtype=np.int64)
+    packed = M.bitpack(arr, bits)
+    assert packed.shape[-1] == 256 * bits // 8
+    assert (M.bitunpack(packed, bits, 256) == arr).all()
+
+
+@pytest.mark.parametrize("pset", sorted(M.PARAMS))
+def test_hint_roundtrip_and_malformed_rejects(pset):
+    p = M.PARAMS[pset]
+    h = np.zeros((p.k, 256), np.uint8)
+    # a plausible sparse hint pattern
+    for i in range(p.k):
+        h[i, RNG.choice(256, size=5, replace=False)] = 1
+    enc = M.hint_bit_pack(h, p)
+    assert len(enc) == p.omega + p.k
+    dec = M.hint_bit_unpack(enc, p)
+    assert (dec == h).all()
+    # count overflow: per-poly cumulative index above omega
+    bad = bytearray(enc)
+    bad[p.omega + p.k - 1] = p.omega + 1
+    assert M.hint_bit_unpack(bytes(bad), p) is None
+    # non-increasing cumulative index
+    if p.k >= 2:
+        bad = bytearray(enc)
+        bad[p.omega] = p.omega       # first poly claims everything
+        assert M.hint_bit_unpack(bytes(bad), p) is None
+    # nonzero padding after the last used index
+    bad = bytearray(enc)
+    bad[p.omega - 1] = 200 if bad[p.omega - 1] == 0 else 0
+    assert M.hint_bit_unpack(bytes(bad), p) is None
+
+
+# ---------------------------------------------------------------------------
+# sign / verify roundtrips (host oracle)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pset", sorted(M.PARAMS))
+def test_sign_verify_roundtrip(pset):
+    p = M.PARAMS[pset]
+    priv, pub = M.keygen(pset, bytes([1]) * 32)
+    assert len(pub.pk) == p.pk_size
+    sig = priv.sign(b"roundtrip")
+    assert len(sig) == p.sig_size
+    assert M.py_verify(pub, sig, b"roundtrip")
+    assert not M.py_verify(pub, sig, b"roundtriq")
+    assert not M.py_verify(pub, sig[:-1], b"roundtrip")
+    assert not M.py_verify(pub, sig + b"\x00", b"roundtrip")
+    flip = bytearray(sig)
+    flip[3] ^= 0x10
+    assert not M.py_verify(pub, bytes(flip), b"roundtrip")
+    # deterministic: same seed -> same key, same sig
+    priv2, pub2 = M.keygen(pset, bytes([1]) * 32)
+    assert pub2.pk == pub.pk
+    assert priv2.sign(b"roundtrip") == sig
+    # different key rejects
+    _, pub3 = M.keygen(pset, bytes([2]) * 32)
+    assert not M.py_verify(pub3, sig, b"roundtrip")
+
+
+def test_out_of_range_z_rejected():
+    pset = "ML-DSA-44"
+    p = M.PARAMS[pset]
+    priv, pub = M.keygen(pset, bytes([3]) * 32)
+    sig = bytearray(priv.sign(b"msg"))
+    # encoded slot 0 -> z0 = gamma1 (>= gamma1 - beta): norm gate
+    sig[p.lam // 4: p.lam // 4 + 3] = b"\x00\x00\x00"
+    assert M.py_verify(pub, bytes(sig), b"msg") is False
+
+
+# ---------------------------------------------------------------------------
+# JWK / verify plumbing
+# ---------------------------------------------------------------------------
+
+def test_akp_jwk_roundtrip_and_negatives():
+    _, pub = M.keygen("ML-DSA-44", bytes([4]) * 32)
+    jwk_dict = serialize_public_key(pub, kid="pq")
+    assert jwk_dict["kty"] == "AKP"
+    assert jwk_dict["alg"] == "ML-DSA-44"
+    jwk = parse_jwk(jwk_dict)
+    assert jwk.key.pk == pub.pk
+    with pytest.raises(InvalidJWKSError):
+        parse_jwk({"kty": "AKP", "alg": "ML-DSA-99", "pub": "AQAB"})
+    with pytest.raises(InvalidJWKSError):
+        parse_jwk({"kty": "AKP", "alg": "ML-DSA-44"})
+    with pytest.raises(InvalidJWKSError):
+        parse_jwk({"kty": "AKP", "alg": "ML-DSA-44", "pub": "AQAB"})
+
+
+def test_key_matches_alg_mldsa():
+    _, pub44 = M.keygen("ML-DSA-44", bytes([5]) * 32)
+    assert key_matches_alg(pub44, algs.MLDSA44)
+    assert not key_matches_alg(pub44, algs.MLDSA65)
+    assert not key_matches_alg(pub44, algs.ES256)
+    assert algs.MLDSA44 in algs.SUPPORTED_ALGORITHMS
+    assert algs.MLDSA44 not in algs.HASH_FOR_ALG
+
+
+def test_verify_parsed_mldsa():
+    from cap_tpu.jwt.jose import parse_jws
+
+    priv, pub = M.keygen("ML-DSA-44", bytes([6]) * 32)
+    h = b64url_encode(json.dumps({"alg": "ML-DSA-44"}).encode())
+    pl = b64url_encode(json.dumps({"sub": "x"}).encode())
+    si = (h + "." + pl).encode()
+    tok = h + "." + pl + "." + b64url_encode(priv.sign(si))
+    parsed = parse_jws(tok)
+    verify_parsed(parsed, pub)          # must not raise
+    bad = parse_jws(tok[:-6] + ("AAAAAA" if not tok.endswith("AAAAAA")
+                                else "BBBBBB"))
+    with pytest.raises(InvalidSignatureError):
+        verify_parsed(bad, pub)
+
+
+def test_curve_b_constants_match_base_points():
+    """Pin the dependency-free on-curve check's b constants: every
+    curve's standard base point must satisfy y² = x³ - 3x + b."""
+    from cap_tpu.tpu.ec import _CURVE_INTS
+
+    for crv, b in _CURVE_B.items():
+        c = _CURVE_INTS[crv]
+        p, gx, gy = c["p"], c["gx"], c["gy"]
+        assert (gy * gy - (gx * gx * gx - 3 * gx + b)) % p == 0, crv
+
+
+def test_decision_family_for_mldsa():
+    from cap_tpu.obs import decision
+
+    assert decision.family_for_alg("ML-DSA-44") == "mldsa44"
+    assert decision.family_for_alg("ML-DSA-65") == "mldsa65"
+    assert decision.family_for_alg("ML-DSA-87") == "mldsa87"
+    for fam in ("mldsa44", "mldsa65", "mldsa87"):
+        assert fam in decision.FAMILIES
+
+
+# ---------------------------------------------------------------------------
+# engine vs oracle: bit-exact parity on ≥1k batched verifies per set
+# ---------------------------------------------------------------------------
+
+def _mutate(sig: bytes, msg: bytes, i: int, p):
+    """Deterministic per-index mutation mix: valid, tampered sig
+    bytes, truncations, hint corruption, tampered message."""
+    mode = i % 8
+    if mode in (0, 1, 2):                 # 3/8 valid
+        return sig, msg
+    if mode == 3:                          # c~ flip
+        b = bytearray(sig)
+        b[i % (p.lam // 4)] ^= 1 << (i % 8)
+        return bytes(b), msg
+    if mode == 4:                          # z flip
+        b = bytearray(sig)
+        b[p.lam // 4 + (i * 7) % (p.l * 32 * p.z_bits)] ^= 0x20
+        return bytes(b), msg
+    if mode == 5:                          # wrong length
+        return (sig[:-1] if i % 2 else sig + b"\x00"), msg
+    if mode == 6:                          # hint section corruption
+        b = bytearray(sig)
+        b[-(1 + i % p.k)] ^= 0xFF
+        return bytes(b), msg
+    return sig, msg + b"!"                 # tampered message
+
+
+@pytest.mark.parametrize("pset", sorted(M.PARAMS))
+def test_engine_oracle_parity_1k(pset):
+    """≥1k batched verifies per parameter set, mixed valid/adversarial,
+    TWO keys in the table: the device engine's verdicts must equal the
+    pure-int host oracle's bit-for-bit (the ROADMAP acceptance bar)."""
+    p = M.PARAMS[pset]
+    privs, pubs = [], []
+    for s in (20, 21):
+        pr, pu = M.keygen(pset, bytes([s]) * 32)
+        privs.append(pr)
+        pubs.append(pu)
+    table = M.MLDSAKeyTable(pset, pubs)
+
+    base = []
+    for i in range(16):
+        msg = f"parity-{pset}-{i}".encode()
+        base.append((privs[i % 2].sign(msg), msg, i % 2))
+
+    n = 1024
+    sigs, msgs, rows = [], [], []
+    for i in range(n):
+        sig, msg, row = base[i % len(base)]
+        sig, msg = _mutate(sig, msg, i // len(base) + i, p)
+        sigs.append(sig)
+        msgs.append(msg)
+        rows.append(row)
+
+    got = M.verify_mldsa_batch(table, sigs, msgs,
+                               np.asarray(rows, np.int32))
+    want = np.array([M.py_verify(pubs[rows[i]], sigs[i], msgs[i])
+                     for i in range(n)])
+    mism = np.nonzero(got[:n] != want)[0]
+    assert len(mism) == 0, f"verdict mismatch at {mism[:10]}"
+    assert 0 < int(want.sum()) < n      # the sweep mixed both verdicts

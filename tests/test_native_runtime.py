@@ -11,6 +11,11 @@ import json
 
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 from cap_tpu import testing as captest
 from cap_tpu.jwt import algs
 from cap_tpu.jwt.jose import b64url_encode, parse_compact

@@ -4,6 +4,11 @@ from urllib.parse import parse_qs, urlparse
 
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 from cap_tpu.errors import (
     ExpiredAuthTimeError,
     ExpiredTokenError,

@@ -12,6 +12,11 @@ import hashlib
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 import jax
 import jax.numpy as jnp
 

@@ -10,6 +10,11 @@ path. Small key counts/batches keep CPU compile time bounded.
 
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 
 @pytest.fixture(autouse=True)
 def _force_rns(monkeypatch):

@@ -6,6 +6,11 @@ import urllib.request
 
 import pytest
 
+pytest.importorskip("cryptography", reason=(
+    "module-wide fixtures need the cryptography package: "
+    "clean skip instead of a collection ERROR on crypto-less hosts"))
+
+
 from cap_tpu.errors import InvalidJWKSError
 from cap_tpu.jwt import JSONWebKeySet, StaticKeySet
 from cap_tpu.oidc.testing import TestProvider

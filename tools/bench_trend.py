@@ -19,6 +19,8 @@ published weather, not tracked — see docs/PERF.md on stalls):
 - ``resident_mixed_vps`` — engine speed with records device-resident
                            (weather-independent: THE regression signal)
 - ``serve_fleet``        — bench_serve fleet-mode value, when present
+- ``resident_mldsa44_vps`` — post-quantum engine rate (ML-DSA-44
+                           resident lanes), tracked from round 11 on
 
 MULTICHIP records are checked structurally: the latest round must
 still report ``ok`` (rc 0) on the same-or-larger device count.
@@ -49,7 +51,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 THRESHOLD = 0.10          # >10% below best-of-window = regression
 WINDOW = 3                # best of the last 3 preceding rounds
-TRACKED = ("value", "value_peak", "resident_mixed_vps", "serve_fleet")
+TRACKED = ("value", "value_peak", "resident_mixed_vps", "serve_fleet",
+           "resident_mldsa44_vps")
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 

@@ -201,6 +201,80 @@ def _rsa_pkcs1v15_sign(msg: bytes, n: int, d: int, k: int = 256) -> bytes:
     return pow(int.from_bytes(em, "big"), d, n).to_bytes(k, "big")
 
 
+def _mldsa_vectors():
+    """ML-DSA-44 adversarial ENCODING vectors; (jwk, vectors).
+
+    The post-quantum analog of the ES*/RS* encoding suite: every
+    vector is a structurally-valid JWS whose reject (when expected)
+    comes from the FIPS 204 signature layer — wrong length, bit-
+    flipped c̃, an out-of-range z coefficient, a hint-count overflow,
+    nonzero hint padding. Keys come from a PINNED keygen seed and the
+    signer is deterministic (rnd = 0³²), so regeneration is
+    byte-stable, exactly like the classical fixtures above.
+    """
+    from cap_tpu.jwt.jwk import serialize_public_key
+    from cap_tpu.tpu import mldsa
+
+    p = mldsa.PARAMS["ML-DSA-44"]
+    priv, pub = mldsa.keygen("ML-DSA-44", bytes(range(32)))
+    jwk = serialize_public_key(pub, kid="sig-pq")
+
+    si = _signing_input("ML-DSA-44", "sig-pq")
+    sig = priv.sign(si.encode())
+
+    def tok(sig_bytes: bytes) -> str:
+        return si + "." + _b64u(sig_bytes)
+
+    # Out-of-range z: overwrite the first packed z slot with encoded
+    # value 0 → z₀ = γ1, which fails the ‖z‖∞ < γ1 − β verify gate.
+    z_lo = p.lam // 4
+    z_oor = bytearray(sig)
+    z_oor[z_lo: z_lo + 3] = b"\x00\x00\x00"
+    # Hint-count overflow: the per-poly cumulative index byte must
+    # never exceed ω; HintBitUnpack returns ⊥ (FIPS 204 Alg 21).
+    h_overflow = bytearray(sig)
+    h_overflow[-1] = p.omega + 1
+    # Nonzero hint padding: bytes past the last used index must be 0.
+    h_pad = bytearray(sig)
+    h_pad[p.lam // 4 + p.l * 32 * p.z_bits + p.omega - 1] = \
+        0 if h_pad[p.lam // 4 + p.l * 32 * p.z_bits + p.omega - 1] \
+        else 200
+    flipped = bytearray(sig)
+    flipped[0] ^= 0x01
+
+    vectors = [
+        {"name": "mldsa44-valid", "alg": "ML-DSA-44", "token": tok(sig),
+         "verdict": "accept",
+         "note": "control: well-formed FIPS 204 signature"},
+        {"name": "mldsa44-sig-truncated", "alg": "ML-DSA-44",
+         "token": tok(sig[:-1]), "verdict": "reject",
+         "note": "last byte truncated: length != 2420"},
+        {"name": "mldsa44-sig-extended", "alg": "ML-DSA-44",
+         "token": tok(sig + b"\x00"), "verdict": "reject",
+         "note": "one trailing zero byte: length != 2420"},
+        {"name": "mldsa44-ctilde-bitflip", "alg": "ML-DSA-44",
+         "token": tok(bytes(flipped)), "verdict": "reject",
+         "note": "one bit of c~ flipped: the final hash compare fails"},
+        {"name": "mldsa44-z-out-of-range", "alg": "ML-DSA-44",
+         "token": tok(bytes(z_oor)), "verdict": "reject",
+         "note": "first z slot rewritten to encoded 0 -> z = gamma1, "
+                 "outside the ||z|| < gamma1 - beta verify gate"},
+        {"name": "mldsa44-hint-count-overflow", "alg": "ML-DSA-44",
+         "token": tok(bytes(h_overflow)), "verdict": "reject",
+         "note": "cumulative hint index > omega: HintBitUnpack "
+                 "returns bottom"},
+        {"name": "mldsa44-hint-padding-nonzero", "alg": "ML-DSA-44",
+         "token": tok(bytes(h_pad)), "verdict": "reject",
+         "note": "nonzero byte in the unused hint padding region"},
+        {"name": "mldsa44-tampered-payload", "alg": "ML-DSA-44",
+         "token": _signing_input("ML-DSA-44", "sig-pq",
+                                 dict(CLAIMS, sub="evil"))
+         + "." + _b64u(sig),
+         "verdict": "reject", "note": "valid sig, different payload"},
+    ]
+    return jwk, vectors
+
+
 def _rsa_vectors():
     n = RSA_P * RSA_Q
     d = pow(RSA_E, -1, (RSA_P - 1) * (RSA_Q - 1))
@@ -254,14 +328,17 @@ def _rsa_vectors():
 def write_sig_conformance(out_dir: str) -> str:
     ec_jwk, ec_vecs = _ec_vectors()
     rsa_jwk, rsa_vecs = _rsa_vectors()
+    pq_jwk, pq_vecs = _mldsa_vectors()
     doc = {
         "comment": "Adversarial signature-encoding conformance "
                    "vectors. Verdicts pin go-jose -> Go stdlib "
-                   "semantics; every cap_tpu verify surface must "
-                   "match them bit-for-bit. Keys are fixed TEST "
-                   "fixtures (never real credentials).",
-        "keys": {"keys": [ec_jwk, rsa_jwk]},
-        "vectors": ec_vecs + rsa_vecs,
+                   "semantics (classical families) and FIPS 204 "
+                   "decode/verify gates (ML-DSA); every cap_tpu "
+                   "verify surface must match them bit-for-bit. "
+                   "Keys are fixed TEST fixtures (never real "
+                   "credentials).",
+        "keys": {"keys": [ec_jwk, rsa_jwk, pq_jwk]},
+        "vectors": ec_vecs + rsa_vecs + pq_vecs,
     }
     path = os.path.join(out_dir, "sig_conformance.json")
     with open(path, "w") as f:
