@@ -26,17 +26,32 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat check
+.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat check
 
 all: native
 
 native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 
-$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp
-	$(CXX) $(CXXFLAGS) -o $@ $<
+$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp $(NATIVE_DIR)/serve_native.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $^
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
+
+# Force-rebuild the native runtime + client from source (gcc<11 CPUID
+# fallback included — the SHA-NI probe that silently killed the whole
+# .so in r11 compiles everywhere now) and fail LOUDLY if the serve
+# chain's symbols don't resolve. tests/test_serve_native.py runs the
+# same check as a tier-1 test so the native chain can't die silently.
+native-build:
+	rm -f $(NATIVE_SO) $(CLIENT_SO)
+	$(MAKE) $(NATIVE_SO) $(CLIENT_SO)
+	$(PYTHON) -c "import ctypes; lib = ctypes.CDLL('$(NATIVE_SO)'); \
+	  [getattr(lib, s) for s in ('cap_prepare_batch', 'cap_serve_create', \
+	   'cap_serve_add_conn', 'cap_serve_drain', 'cap_serve_post_results', \
+	   'cap_serve_probe_frame', 'cap_bench_drive')]; \
+	  ctypes.CDLL('$(CLIENT_SO)').cap_client_connect; \
+	  print('native-build: all serve-native symbols resolve')"
 
 test: native
 	python -m pytest tests/ -x -q

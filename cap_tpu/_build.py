@@ -29,25 +29,32 @@ _FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
 # checkout shared across CPython minor versions load a mismatched ABI.
 EXT_NAME = "_capclaims" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
 
-# (source, output, needs_python_headers) — paths relative to cap_tpu/.
+# (sources, output, needs_python_headers) — paths relative to
+# cap_tpu/. libcapruntime.so is built from TWO translation units:
+# jose_native.cpp (batch JOSE prep) and serve_native.cpp (the GIL-free
+# serve chain) — one .so, so the serve binding and the prep binding
+# load the same library.
 _TARGETS = [
-    (os.path.join("runtime", "native", "jose_native.cpp"),
+    ((os.path.join("runtime", "native", "jose_native.cpp"),
+      os.path.join("runtime", "native", "serve_native.cpp")),
      os.path.join("runtime", "native", "libcapruntime.so"), False),
-    (os.path.join("serve", "native", "client_native.cpp"),
+    ((os.path.join("serve", "native", "client_native.cpp"),),
      os.path.join("serve", "native", "libcapclient.so"), False),
-    (os.path.join("runtime", "native", "claims_ext.cpp"),
+    ((os.path.join("runtime", "native", "claims_ext.cpp"),),
      os.path.join("runtime", "native", EXT_NAME), True),
 ]
 
 
-def _build_one(src: str, out: str, py_headers: bool,
-               timeout: float) -> None:
-    src = os.path.join(_PKG, src)
+def _build_one(sources, out: str, py_headers: bool,
+               timeout: float, force: bool = False) -> None:
+    srcs = [os.path.join(_PKG, s) for s in sources]
+    srcs = [s for s in srcs if os.path.exists(s)]
     out = os.path.join(_PKG, out)
-    if not os.path.exists(src):
+    if not srcs:
         return
-    if os.path.exists(out) and \
-            os.path.getmtime(out) >= os.path.getmtime(src):
+    if not force and os.path.exists(out) and \
+            os.path.getmtime(out) >= max(os.path.getmtime(s)
+                                         for s in srcs):
         return
     cmd = ["g++", *_FLAGS]
     # -march=native when the compiler supports it (portable fallback
@@ -55,7 +62,7 @@ def _build_one(src: str, out: str, py_headers: bool,
     cmd.append("-march=native")
     if py_headers:
         cmd.append("-I" + sysconfig.get_paths()["include"])
-    cmd += ["-o", out, src]
+    cmd += ["-o", out, *srcs]
     res = subprocess.run(cmd, capture_output=True, timeout=timeout,
                          check=False)
     if res.returncode != 0 and "-march=native" in cmd:
@@ -64,15 +71,20 @@ def _build_one(src: str, out: str, py_headers: bool,
                        check=False)
 
 
-def build_native(timeout: float = 180.0) -> None:
-    """Compile any missing/stale native library once, best-effort."""
+def build_native(timeout: float = 180.0, force: bool = False) -> None:
+    """Compile any missing/stale native library once, best-effort.
+
+    ``force=True`` rebuilds every target unconditionally (``make
+    native-build`` / the build-health tier-1 test) and bypasses the
+    once-per-process latch so a later call still works.
+    """
     global _done
     with _lock:
-        if _done:
+        if _done and not force:
             return
         _done = True
-        for src, out, py_headers in _TARGETS:
+        for srcs, out, py_headers in _TARGETS:
             try:
-                _build_one(src, out, py_headers, timeout)
+                _build_one(srcs, out, py_headers, timeout, force=force)
             except Exception:  # noqa: BLE001 - fallbacks handle absence
                 pass

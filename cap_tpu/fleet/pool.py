@@ -75,6 +75,9 @@ class WorkerHandle:
         # line); the supervisor re-pushes until it matches the pool's
         # current distribution — convergence after crash/kill -9.
         self.key_epoch: Optional[int] = None
+        # Which serve chain the worker announced on its ready line
+        # ("native" / "python"; None before the first ready line).
+        self.serve_chain: Optional[str] = None
         # Latest collected crash/drain postmortem (obs.postmortem doc)
         # and the checkpoint file the worker writes into.
         self.postmortem: Optional[dict] = None
@@ -336,6 +339,13 @@ class WorkerPool:
         with self._lock:
             return {h.worker_id: h.key_epoch for h in self._handles}
 
+    def serve_chains(self) -> Dict[int, Optional[str]]:
+        """worker_id → serve chain from the ready line ("native" /
+        "python"; None while a worker is still starting) — how
+        bench_serve/capstat see which chain each worker runs."""
+        with self._lock:
+            return {h.worker_id: h.serve_chain for h in self._handles}
+
     def keys_epoch(self) -> Optional[int]:
         """The epoch the fleet is converging on (None: never pushed)."""
         with self._lock:
@@ -452,6 +462,7 @@ class WorkerPool:
         port = None
         obs_port = None
         epoch = None
+        serve_chain = None
         try:
             while time.monotonic() < deadline:
                 line = proc.stdout.readline()
@@ -466,6 +477,8 @@ class WorkerPool:
                             obs_port = int(v)
                         elif k == "epoch":
                             epoch = int(v)
+                        elif k == "serve_chain":
+                            serve_chain = v
                     break
         except (OSError, ValueError):
             port = None
@@ -480,6 +493,7 @@ class WorkerPool:
                 h.obs_address = ((self._host, obs_port)
                                  if obs_port else None)
                 h.key_epoch = epoch
+                h.serve_chain = serve_chain
                 h.state = READY
                 telemetry.count("fleet.workers_started")
             keys_current = self._keys_current

@@ -59,9 +59,22 @@ class StubKeySet:
     failover peer, fallback) is comparable bit-for-bit.
     """
 
-    def __init__(self, batch_ms: float = 0.0, token_us: float = 0.0):
+    def __init__(self, batch_ms: float = 0.0, token_us: float = 0.0,
+                 pipeline: float = 0.0, raw: float = 0.0):
         self._batch_s = batch_ms / 1e3
         self._token_s = token_us / 1e6
+        # raw=1: serve the raw-claims interface real engines expose
+        # (verify_batch_raw → payload BYTES per verified token), so a
+        # bench against the stub exercises the same zero-reserialize
+        # response path as a TPUBatchKeySet. Verdicts stay
+        # suffix-determined either way.
+        self._raw = bool(raw)
+        # pipeline=1: expose verify_batch_async so the batcher runs
+        # its 2-deep pipeline against the stub — the simulated device
+        # occupancy of batch k+1 then overlaps batch k's drain, the
+        # way a real device's H2D/compute overlap does. Opt-in: the
+        # chaos suite's timing assumptions stay on the sync path.
+        self._pipeline = bool(pipeline)
         self.key_epoch = 0
 
     def swap_keys(self, jwks, epoch=None, grace_s: float = 0.0) -> int:
@@ -72,18 +85,53 @@ class StubKeySet:
                           else int(epoch))
         return self.key_epoch
 
-    def verify_batch(self, tokens):
+    def _results(self, tokens):
         from ..errors import InvalidSignatureError
 
-        sleep_s = self._batch_s + self._token_s * len(tokens)
-        if sleep_s > 0.0:
-            time.sleep(sleep_s)      # models device occupancy (no GIL)
+        if self._raw:
+            reject = InvalidSignatureError(
+                "no known key successfully validated the token signature")
+            ok = b'{"sub":"stub"}'
+            return [ok if t.endswith(".ok") else reject for t in tokens]
         return [
             {"sub": t} if t.endswith(".ok")
             else InvalidSignatureError(
                 "no known key successfully validated the token signature")
             for t in tokens
         ]
+
+
+    def verify_batch(self, tokens):
+        sleep_s = self._batch_s + self._token_s * len(tokens)
+        if sleep_s > 0.0:
+            time.sleep(sleep_s)      # models device occupancy (no GIL)
+        return self._results(tokens)
+
+    def __getattr__(self, name):
+        # Mode-dependent interface: verify_batch_async exists only in
+        # pipeline mode (the batcher's hasattr probe picks the right
+        # dispatch path) and verify_batch_raw only in raw mode (the
+        # worker's raw-claims wrapper probes it the same way).
+        # (__dict__ lookup: __getattr__ must not recurse during
+        # unpickling, before __init__ has run.)
+        if name == "verify_batch_async" and self.__dict__.get("_pipeline"):
+            return self._verify_batch_async
+        if name == "verify_batch_raw" and self.__dict__.get("_raw"):
+            return self.verify_batch
+        raise AttributeError(name)
+
+    def _verify_batch_async(self, tokens):
+        done_at = time.monotonic() + self._batch_s \
+            + self._token_s * len(tokens)
+        results = self._results(tokens)
+
+        def collect():
+            remaining = done_at - time.monotonic()
+            if remaining > 0.0:
+                time.sleep(remaining)   # occupancy overlaps next prep
+            return results
+
+        return collect
 
 
 def make_keyset(spec: str):
@@ -95,7 +143,7 @@ def make_keyset(spec: str):
                 if not kv:
                     continue
                 k, _, v = kv.partition("=")
-                if k not in ("batch_ms", "token_us"):
+                if k not in ("batch_ms", "token_us", "pipeline", "raw"):
                     raise ValueError(f"unknown stub option {k!r}")
                 kwargs[k] = float(v)
         return StubKeySet(**kwargs)
@@ -151,6 +199,13 @@ def main(argv=None) -> int:
     # Observability server (serve.obs): 0 = ephemeral port (default),
     # -1 = disabled. The bound port is announced on the ready line.
     ap.add_argument("--obs-port", type=int, default=0)
+    # Serve chain: "native" (C++ frame I/O + lock-free ring), "python"
+    # (reader/responder threads), or "auto" — CAP_SERVE_NATIVE=1 in
+    # the environment selects native, anything else python. A native
+    # request falls back to python when the library is unbuildable;
+    # the ready line's serve_chain= field reports what actually runs.
+    ap.add_argument("--serve-chain", default="auto",
+                    choices=["auto", "native", "python"])
     # Crash postmortems: checkpoint telemetry to this path on a timer
     # and on SIGTERM drain, so the pool can collect a ≤interval-stale
     # document even after kill -9. Empty = disabled. The pool passes
@@ -165,14 +220,22 @@ def main(argv=None) -> int:
     from .. import telemetry
     from ..serve.worker import VerifyWorker
 
-    telemetry.enable()               # STATS op serves real numbers
+    # CAP_FLEET_TELEMETRY=0: run with the observability layer OFF
+    # (decision accounting is the serve path's main per-token Python
+    # cost once the native chain is on — PERF.md §Round 12 quantifies
+    # the tradeoff; the STATS op then serves structural fields only).
+    if os.environ.get("CAP_FLEET_TELEMETRY", "1") != "0":
+        telemetry.enable()           # STATS op serves real numbers
     keyset = make_keyset(args.keyset)
+    serve_native = (None if args.serve_chain == "auto"
+                    else args.serve_chain == "native")
     worker = VerifyWorker(keyset, host=args.host, port=args.port,
                           target_batch=args.target_batch,
                           max_wait_ms=args.max_wait_ms,
                           max_batch=args.max_batch,
                           obs_port=(None if args.obs_port < 0
-                                    else args.obs_port))
+                                    else args.obs_port),
+                          serve_native=serve_native)
     pm = None
     if args.postmortem_path:
         from ..obs.postmortem import PostmortemWriter
@@ -189,7 +252,8 @@ def main(argv=None) -> int:
     # skips when unknown.
     print(f"CAP_FLEET_READY port={port} pid={os.getpid()}"
           + (f" obs={obs[1]}" if obs is not None else "")
-          + (f" epoch={epoch}" if epoch is not None else ""),
+          + (f" epoch={epoch}" if epoch is not None else "")
+          + f" serve_chain={worker.serve_chain}",
           flush=True)
 
     stop = threading.Event()

@@ -42,6 +42,7 @@ import binascii
 import hashlib
 import json
 import threading
+from collections import Counter
 from typing import Any, Dict, List, Optional, Sequence
 
 from .. import telemetry
@@ -170,7 +171,7 @@ _MLDSA_FAMILY = {"ML-DSA-44": "mldsa44", "ML-DSA-65": "mldsa65",
 # raw header segment. The cache holds header TEXT as keys in memory
 # only — nothing from it is ever recorded. Bounded: cleared at cap.
 _HDR_CACHE: Dict[str, tuple] = {}
-_HDR_CACHE_CAP = 1024
+_HDR_CACHE_CAP = 4096
 _HDR_LOCK = threading.Lock()
 
 
@@ -204,16 +205,9 @@ def _parse_header_segment(seg: str) -> tuple:
         return ("unknown", None)
 
 
-def token_family_kid(token: Any) -> tuple:
-    """(family, kid-hash-or-None) from a token's header segment.
-
-    O(1) per repeated header (cache hit); the parse itself is bounded
-    (header segment > 1024 chars -> "unknown" without decoding).
-    """
-    if not isinstance(token, str):
-        return ("unknown", None)
-    seg = token.split(".", 1)[0]
-    if not seg or len(seg) > 1024:
+def _seg_family_kid(seg: Any) -> tuple:
+    """(family, kid-hash-or-None) for one header SEGMENT (cached)."""
+    if not isinstance(seg, str) or not seg or len(seg) > 1024:
         return ("unknown", None)
     hit = _HDR_CACHE.get(seg)
     if hit is not None:
@@ -224,6 +218,17 @@ def token_family_kid(token: Any) -> tuple:
             _HDR_CACHE.clear()
         _HDR_CACHE[seg] = out
     return out
+
+
+def token_family_kid(token: Any) -> tuple:
+    """(family, kid-hash-or-None) from a token's header segment.
+
+    O(1) per repeated header (cache hit); the parse itself is bounded
+    (header segment > 1024 chars -> "unknown" without decoding).
+    """
+    if not isinstance(token, str):
+        return ("unknown", None)
+    return _seg_family_kid(token.split(".", 1)[0])
 
 
 # ---------------------------------------------------------------------------
@@ -287,22 +292,74 @@ def record_batch(surface: str, results: Sequence[Any],
     lat = latency_bucket(latency_s)
     if trace is None:
         trace = telemetry.current_trace()
-    for i, res in enumerate(results):
-        if families is not None:
-            fam, kid = families[i], None
-        elif tokens is not None:
-            fam, kid = token_family_kid(tokens[i])
-        else:
-            fam, kid = "unknown", None
-        if isinstance(res, BaseException):
-            verdict, reason = "reject", classify(res)
-            key = f"decision.{surface}.reject.{reason}"
-        else:
-            verdict, reason = "accept", None
-            key = f"decision.{surface}.accept"
-        n = rec.count(key)
-        rec.count(f"decision.{surface}.family.{fam}")
-        if n == 1 or n % RING_SAMPLE_EVERY == 0:
+
+    # AGGREGATED exact path (the serve hot loop calls this once per
+    # drained chunk): one pass groups indices by decision key, family
+    # counts come from a C-speed Counter over header segments, every
+    # counter increments ONCE per group — the counters and the ring
+    # SAMPLE POSITIONS are identical to k single-token walks (sampled
+    # counts are c == 1 or c % RING_SAMPLE_EVERY == 0 over the same
+    # post-increment sequence, attributed to the same token).
+    reject_groups: Dict[str, List[int]] = {}
+    if any(isinstance(r, BaseException) for r in results):
+        accept_idx: Any = []
+        for i, res in enumerate(results):
+            if isinstance(res, BaseException):
+                reject_groups.setdefault(classify(res), []).append(i)
+            else:
+                accept_idx.append(i)
+    else:
+        # all-accept fast path (the raw-claims serve hot loop): no
+        # index list materialized — sampling indexes a range
+        accept_idx = range(len(results))
+
+    if families is not None:
+        fam_counts = Counter(families)
+
+        def fam_kid(i: int) -> tuple:
+            return (families[i], None)
+    elif tokens is not None:
+        try:
+            segs: List[Any] = [t.split(".", 1)[0] for t in tokens]
+        except AttributeError:      # non-str tokens: guarded walk
+            segs = [t.split(".", 1)[0] if isinstance(t, str) else None
+                    for t in tokens]
+        seg_counts = Counter(segs)
+        seg_fk = {seg: _seg_family_kid(seg) for seg in seg_counts}
+        fam_counts = Counter()
+        for seg, k in seg_counts.items():
+            fam_counts[seg_fk[seg][0]] += k
+
+        def fam_kid(i: int) -> tuple:
+            return seg_fk[segs[i]]
+    else:
+        fam_counts = Counter({"unknown": len(results)})
+
+        def fam_kid(i: int) -> tuple:
+            return ("unknown", None)
+
+    increments = {f"decision.{surface}.family.{fam}": k
+                  for fam, k in fam_counts.items()}
+    accept_key = f"decision.{surface}.accept"
+    if accept_idx:
+        increments[accept_key] = len(accept_idx)
+    for reason, idxs in reject_groups.items():
+        increments[f"decision.{surface}.reject.{reason}"] = len(idxs)
+    # one lock round for the whole chunk's counters
+    post = rec.count_many(increments)
+
+    def bulk(key: str, idxs, verdict: str,
+             reason: Optional[str]) -> None:
+        k = len(idxs)
+        after = post[key]
+        start = after - k
+        sampled = [1] if start == 0 else []
+        m = (start // RING_SAMPLE_EVERY + 1) * RING_SAMPLE_EVERY
+        while m <= after:
+            sampled.append(m)
+            m += RING_SAMPLE_EVERY
+        for c in sampled:
+            fam, kid = fam_kid(idxs[c - start - 1])
             entry: Dict[str, Any] = {
                 "surface": surface, "family": fam, "verdict": verdict,
                 "lat": lat,
@@ -314,6 +371,12 @@ def record_batch(surface: str, results: Sequence[Any],
             if trace is not None:
                 entry["trace"] = trace
             rec.decision(_checked_entry(entry))
+
+    if accept_idx:
+        bulk(f"decision.{surface}.accept", accept_idx, "accept", None)
+    for reason, idxs in reject_groups.items():
+        bulk(f"decision.{surface}.reject.{reason}", idxs, "reject",
+             reason)
 
 
 def record_one(surface: str, result: Any, token: Optional[str] = None,

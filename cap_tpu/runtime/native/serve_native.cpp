@@ -1,0 +1,1009 @@
+// serve_native — GIL-free CVB1 serve chain for cap_tpu.
+//
+// The per-token half of the serve hot path (ROADMAP open item #1):
+// frame read/validate/decode and response encode/write run in
+// dedicated C++ threads, feeding the Python batcher through a bounded
+// lock-free MPSC ring (tokens in, verdicts out). Python touches only
+// whole BATCHES — one drain call pulls every queued request's tokens
+// into flat buffers, one post call hands a batch of verdicts back —
+// so the interpreter's serial cost per token is a couple of memcpy'd
+// slices instead of a frame parse, a queue hop, and a struct.pack.
+//
+// Contract: the frame parser here must reject EXACTLY the same
+// malformed / oversize / corrupt frames as serve/protocol.py
+// _parse_frame, with the same error classes (status codes below map
+// 1:1 onto MalformedFrameError / FrameTooLargeError /
+// FrameCorruptError / UnicodeDecodeError — the parity sweep in
+// tests/test_serve_native.py pins this over the malformed corpus).
+//
+// Threading model (one handle per worker):
+//   - one reader thread per connection: buffered recv → parse →
+//     validate → Req records pushed into the MPSC ring (Vyukov
+//     bounded queue; producers lock-free on the fast path, blocking
+//     only when the ring or the token watermark is full, which is the
+//     backpressure that ends up in the client's TCP window);
+//   - one writer thread per connection: sends responses strictly in
+//     request order (seq assigned at read time), holding out-of-order
+//     completions in a map — CVB1 has no request ids, order IS the
+//     correlation;
+//   - pings are answered natively (pong enqueued at the ping's seq);
+//     stats/keys frames ride the ring as control records so Python
+//     handles them IN ORDER with the verifies around them.
+//
+// Built into libcapruntime.so alongside jose_native.cpp (one TU each,
+// same .so — see Makefile `native` / cap_tpu/_build.py).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace serve_native {
+
+// ---------------------------------------------------------------------------
+// CVB1 wire constants — mirror serve/protocol.py exactly.
+// ---------------------------------------------------------------------------
+
+static const uint32_t MAGIC = 0x31425643;  // "CVB1"
+enum {
+  T_VERIFY_REQ = 1,
+  T_VERIFY_RESP = 2,
+  T_PING = 3,
+  T_PONG = 4,
+  T_STATS_REQ = 5,
+  T_STATS_RESP = 6,
+  T_VERIFY_REQ_CRC = 7,
+  T_VERIFY_RESP_CRC = 8,
+  T_VERIFY_REQ_TRACE = 9,
+  T_VERIFY_RESP_TRACE = 10,
+  T_KEYS_PUSH = 11,
+  T_KEYS_ACK = 12,
+};
+static const int64_t MAX_FRAME_ENTRIES = 1 << 20;
+static const int64_t MAX_ENTRY_BYTES = 1 << 20;
+static const int64_t MAX_FRAME_BYTES = 1 << 28;
+static const int32_t MAX_TRACE_BYTES = 64;
+
+// Parse status codes: the shared error-class contract with
+// serve/protocol.py (serve/native_serve.py maps them back to the
+// exact Python exception classes).
+enum {
+  PF_OK = 0,
+  PF_MALFORMED = 1,   // MalformedFrameError
+  PF_TOOLARGE = 2,    // FrameTooLargeError
+  PF_CORRUPT = 3,     // FrameCorruptError
+  PF_INCOMPLETE = 4,  // need more bytes (stream: keep reading)
+  PF_UTF8 = 5,        // UnicodeDecodeError (token not valid UTF-8)
+};
+
+// ---------------------------------------------------------------------------
+// zlib-compatible CRC-32 (IEEE reflected, poly 0xEDB88320).
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static bool crc_init = []() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (0xEDB88320u ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+  return true;
+}();
+
+static inline uint32_t crc32_update(uint32_t crc, const uint8_t* p,
+                                    size_t n) {
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    crc = crc_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// strict UTF-8 validation (CPython's decoder rules: no overlongs, no
+// surrogates, max U+10FFFF) — tokens cross into Python as str.
+// ---------------------------------------------------------------------------
+
+static bool utf8_valid(const uint8_t* s, int64_t n) {
+  int64_t i = 0;
+  while (i < n) {
+    uint8_t c = s[i];
+    if (c < 0x80) { i++; continue; }
+    if (c < 0xC2) return false;
+    if (c < 0xE0) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      i += 2; continue;
+    }
+    if (c < 0xF0) {
+      if (i + 2 >= n) return false;
+      uint8_t lo = (c == 0xE0) ? 0xA0 : 0x80;
+      uint8_t hi = (c == 0xED) ? 0x9F : 0xBF;
+      if (s[i + 1] < lo || s[i + 1] > hi || (s[i + 2] & 0xC0) != 0x80)
+        return false;
+      i += 3; continue;
+    }
+    if (c < 0xF5) {
+      if (i + 3 >= n) return false;
+      uint8_t lo = (c == 0xF0) ? 0x90 : 0x80;
+      uint8_t hi = (c == 0xF4) ? 0x8F : 0xBF;
+      if (s[i + 1] < lo || s[i + 1] > hi ||
+          (s[i + 2] & 0xC0) != 0x80 || (s[i + 3] & 0xC0) != 0x80)
+        return false;
+      i += 4; continue;
+    }
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// frame parse over a byte buffer — check-for-check identical to
+// protocol._parse_frame: every length validated BEFORE the bytes are
+// touched, CRC checked before deferred status/trace/UTF-8 validation.
+// ---------------------------------------------------------------------------
+
+struct EntryRef {
+  int64_t off;
+  int64_t len;
+  uint8_t status;  // response-shaped entries only
+};
+
+struct Parsed {
+  uint8_t ftype = 0;
+  uint32_t count = 0;
+  int64_t trace_off = 0;
+  int32_t trace_len = 0;  // 0 = no trace field
+  std::vector<EntryRef> entries;
+  int64_t consumed = 0;
+};
+
+static int parse_frame(const uint8_t* b, int64_t n, Parsed& out) {
+  if (n < 9) return PF_INCOMPLETE;
+  uint32_t magic, count;
+  std::memcpy(&magic, b, 4);
+  uint8_t ftype = b[4];
+  std::memcpy(&count, b + 5, 4);
+  if (magic != MAGIC) return PF_MALFORMED;
+  if ((int64_t)count > MAX_FRAME_ENTRIES) return PF_TOOLARGE;
+  bool checksummed =
+      ftype == T_VERIFY_REQ_CRC || ftype == T_VERIFY_RESP_CRC ||
+      ftype == T_VERIFY_REQ_TRACE || ftype == T_VERIFY_RESP_TRACE ||
+      ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK;
+  if ((ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK) && count != 1)
+    return PF_MALFORMED;
+  int64_t pos = 9;
+  out.trace_off = 0;
+  out.trace_len = 0;
+  if (ftype == T_VERIFY_REQ_TRACE || ftype == T_VERIFY_RESP_TRACE) {
+    if (pos + 1 > n) return PF_INCOMPLETE;
+    uint8_t ctx_len = b[pos];
+    if (ctx_len == 0 || ctx_len > MAX_TRACE_BYTES) return PF_MALFORMED;
+    if (pos + 1 + ctx_len > n) return PF_INCOMPLETE;
+    out.trace_off = pos + 1;
+    out.trace_len = ctx_len;
+    pos += 1 + ctx_len;
+  }
+  out.ftype = ftype;
+  out.count = count;
+  out.entries.clear();
+  bool req_shape = ftype == T_VERIFY_REQ || ftype == T_VERIFY_REQ_CRC ||
+                   ftype == T_VERIFY_REQ_TRACE || ftype == T_KEYS_PUSH;
+  bool resp_shape = ftype == T_VERIFY_RESP || ftype == T_VERIFY_RESP_CRC ||
+                    ftype == T_VERIFY_RESP_TRACE || ftype == T_STATS_RESP ||
+                    ftype == T_KEYS_ACK;
+  int64_t total = 0;
+  if (req_shape) {
+    out.entries.reserve(count < 4096 ? count : 4096);
+    for (uint32_t i = 0; i < count; i++) {
+      if (pos + 4 > n) return PF_INCOMPLETE;
+      uint32_t ln;
+      std::memcpy(&ln, b + pos, 4);
+      pos += 4;
+      total += (int64_t)ln;
+      if ((int64_t)ln > MAX_ENTRY_BYTES || total > MAX_FRAME_BYTES)
+        return PF_TOOLARGE;
+      if (pos + (int64_t)ln > n) return PF_INCOMPLETE;
+      out.entries.push_back({pos, (int64_t)ln, 0});
+      pos += ln;
+    }
+  } else if (resp_shape) {
+    out.entries.reserve(count < 4096 ? count : 4096);
+    for (uint32_t i = 0; i < count; i++) {
+      if (pos + 5 > n) return PF_INCOMPLETE;
+      uint8_t st = b[pos];
+      uint32_t ln;
+      std::memcpy(&ln, b + pos + 1, 4);
+      pos += 5;
+      if (!checksummed && st > 1) return PF_MALFORMED;
+      total += (int64_t)ln;
+      if ((int64_t)ln > MAX_ENTRY_BYTES || total > MAX_FRAME_BYTES)
+        return PF_TOOLARGE;
+      if (pos + (int64_t)ln > n) return PF_INCOMPLETE;
+      out.entries.push_back({pos, (int64_t)ln, st});
+      pos += ln;
+    }
+  } else if (ftype == T_PING || ftype == T_PONG || ftype == T_STATS_REQ) {
+    if (count) return PF_MALFORMED;
+  } else {
+    return PF_MALFORMED;
+  }
+  if (checksummed) {
+    if (pos + 4 > n) return PF_INCOMPLETE;
+    uint32_t want;
+    std::memcpy(&want, b + pos, 4);
+    uint32_t got = crc32_update(0, b, (size_t)pos);
+    pos += 4;
+    if (want != got) return PF_CORRUPT;
+    // deferred status validation, exactly like the Python parser
+    if (resp_shape)
+      for (const auto& e : out.entries)
+        if (e.status > 1) return PF_MALFORMED;
+  }
+  if (out.trace_len) {
+    for (int32_t i = 0; i < out.trace_len; i++) {
+      uint8_t c = b[out.trace_off + i];
+      if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f')))
+        return PF_MALFORMED;
+    }
+  }
+  if (ftype == T_VERIFY_REQ || ftype == T_VERIFY_REQ_CRC ||
+      ftype == T_VERIFY_REQ_TRACE) {
+    // token decode AFTER integrity (Python: entries decoded last)
+    for (const auto& e : out.entries)
+      if (!utf8_valid(b + e.off, e.len)) return PF_UTF8;
+  }
+  out.consumed = pos;
+  return PF_OK;
+}
+
+// ---------------------------------------------------------------------------
+// bounded MPSC ring (Vyukov bounded queue; single consumer = the
+// Python drain thread, producers = per-connection reader threads).
+// ---------------------------------------------------------------------------
+
+class MpscRing {
+ public:
+  explicit MpscRing(size_t cap_pow2) : mask_(cap_pow2 - 1),
+                                       cells_(cap_pow2) {
+    for (size_t i = 0; i < cap_pow2; i++)
+      cells_[i].seq.store(i, std::memory_order_relaxed);
+  }
+
+  bool try_push(void* p) {
+    size_t pos = head_.load(std::memory_order_relaxed);
+    for (;;) {
+      Cell& c = cells_[pos & mask_];
+      size_t seq = c.seq.load(std::memory_order_acquire);
+      intptr_t diff = (intptr_t)seq - (intptr_t)pos;
+      if (diff == 0) {
+        if (head_.compare_exchange_weak(pos, pos + 1,
+                                        std::memory_order_relaxed)) {
+          c.data = p;
+          c.seq.store(pos + 1, std::memory_order_release);
+          return true;
+        }
+      } else if (diff < 0) {
+        return false;  // full
+      } else {
+        pos = head_.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  // single-consumer pop: tail_ is plain, only the drain thread moves it
+  void* try_pop() {
+    Cell& c = cells_[tail_ & mask_];
+    size_t seq = c.seq.load(std::memory_order_acquire);
+    if ((intptr_t)seq - (intptr_t)(tail_ + 1) < 0) return nullptr;
+    void* p = c.data;
+    c.seq.store(tail_ + mask_ + 1, std::memory_order_release);
+    tail_++;
+    return p;
+  }
+
+ private:
+  struct Cell {
+    std::atomic<size_t> seq;
+    void* data;
+  };
+  size_t mask_;
+  std::vector<Cell> cells_;
+  std::atomic<size_t> head_{0};
+  size_t tail_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// handle / connection / request records
+// ---------------------------------------------------------------------------
+
+struct Handle;
+
+struct Conn {
+  Handle* h = nullptr;
+  int32_t id = 0;
+  int fd = -1;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<int64_t, std::string> outq;  // seq → encoded response frame
+  int64_t next_send = 0;
+  int64_t assigned = 0;      // seqs handed out by the reader (under mu)
+  bool reader_done = false;
+  bool dead = false;         // send failed: discard, never block
+  std::atomic<int> finished{0};  // 2 = both threads exited
+};
+
+// Request kinds surfaced to the Python drain loop.
+enum { K_VERIFY = 0, K_STATS = 2, K_KEYS = 3 };
+
+struct Req {
+  std::shared_ptr<Conn> conn;
+  int64_t seq = 0;
+  uint8_t ftype = 0;
+  uint8_t kind = K_VERIFY;
+  uint8_t trace_len = 0;
+  char trace[MAX_TRACE_BYTES];
+  double t_recv = 0.0;
+  std::vector<int64_t> offs;  // entry boundaries into blob (n+1)
+  std::string blob;           // concatenated entry bytes
+};
+
+// counter slots (cap_serve_counter)
+enum {
+  CTR_CONNS = 0,
+  CTR_FRAMES = 1,
+  CTR_TOKENS = 2,
+  CTR_PROTO_ERR = 3,
+  CTR_PONGS = 4,
+  CTR_DROPPED_POSTS = 5,
+  CTR_CONNS_CLOSED = 6,
+  CTR_N = 8,
+};
+
+struct Handle {
+  MpscRing ring;
+  std::atomic<int64_t> queued_tokens{0};
+  int64_t max_queued_tokens;
+  std::mutex mu;  // guards the two cvs' sleep/wake protocol
+  std::condition_variable cv_data;   // drain thread sleeps here
+  std::condition_variable cv_space;  // producers sleep here when full
+  std::atomic<bool> stop{false};
+  std::mutex conns_mu;
+  std::unordered_map<int32_t, std::shared_ptr<Conn>> conns;
+  int32_t next_id = 1;
+  Req* carry = nullptr;  // drained but didn't fit the caller's buffers
+  std::atomic<int64_t> ctr[CTR_N];
+  int sweep_tick = 0;
+
+  Handle(size_t cap, int64_t maxq) : ring(cap), max_queued_tokens(maxq) {
+    for (auto& c : ctr) c.store(0);
+  }
+};
+
+static double wall_now() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return (double)tv.tv_sec + (double)tv.tv_usec * 1e-6;
+}
+
+static bool send_all(int fd, const std::string& data) {
+  const char* p = data.data();
+  size_t left = data.size();
+  while (left) {
+    ssize_t w = ::send(fd, p, left, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    left -= (size_t)w;
+  }
+  return true;
+}
+
+static void enqueue_response(const std::shared_ptr<Conn>& c, int64_t seq,
+                             std::string&& data) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->outq.emplace(seq, std::move(data));
+  c->cv.notify_all();
+}
+
+// blockingly push one request into the ring (token watermark +
+// ring-capacity backpressure; false only on shutdown)
+static bool push_req(Handle* h, Req* r, int64_t ntok) {
+  for (;;) {
+    if (h->stop.load(std::memory_order_relaxed)) return false;
+    if (h->queued_tokens.load(std::memory_order_relaxed) <=
+            h->max_queued_tokens &&
+        h->ring.try_push(r)) {
+      h->queued_tokens.fetch_add(ntok, std::memory_order_relaxed);
+      std::lock_guard<std::mutex> lk(h->mu);
+      h->cv_data.notify_one();
+      return true;
+    }
+    std::unique_lock<std::mutex> lk(h->mu);
+    h->cv_space.wait_for(lk, std::chrono::milliseconds(20));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// reader thread: buffered recv → parse → ring (or native pong)
+// ---------------------------------------------------------------------------
+
+static void reader_main(std::shared_ptr<Conn> c) {
+  Handle* h = c->h;
+  std::vector<uint8_t> buf;
+  size_t start = 0;
+  for (;;) {
+    Parsed p;
+    int st = PF_INCOMPLETE;
+    if (buf.size() > start)
+      st = parse_frame(buf.data() + start, (int64_t)(buf.size() - start),
+                       p);
+    if (st == PF_INCOMPLETE) {
+      if (h->stop.load(std::memory_order_relaxed)) break;
+      if (start > 0) {  // compact the consumed prefix
+        buf.erase(buf.begin(), buf.begin() + start);
+        start = 0;
+      }
+      size_t old = buf.size();
+      buf.resize(old + (1 << 16));
+      ssize_t r = ::recv(c->fd, buf.data() + old, 1 << 16, 0);
+      if (r <= 0) {  // EOF / error / shutdown
+        buf.resize(old);
+        break;
+      }
+      buf.resize(old + (size_t)r);
+      continue;
+    }
+    if (st != PF_OK) {
+      // Malformed / oversize / corrupt / bad-UTF-8: same stance as
+      // the Python worker — count it, drop the connection quietly.
+      h->ctr[CTR_PROTO_ERR].fetch_add(1);
+      break;
+    }
+    h->ctr[CTR_FRAMES].fetch_add(1);
+    const uint8_t* base = buf.data() + start;
+    if (p.ftype == T_PING) {
+      int64_t seq;
+      {
+        std::lock_guard<std::mutex> lk(c->mu);
+        seq = c->assigned++;
+      }
+      std::string pong(9, '\0');
+      uint32_t zero = 0;
+      std::memcpy(&pong[0], &MAGIC, 4);
+      pong[4] = (char)T_PONG;
+      std::memcpy(&pong[5], &zero, 4);
+      enqueue_response(c, seq, std::move(pong));
+      h->ctr[CTR_PONGS].fetch_add(1);
+    } else if (p.ftype == T_VERIFY_REQ || p.ftype == T_VERIFY_REQ_CRC ||
+               p.ftype == T_VERIFY_REQ_TRACE || p.ftype == T_STATS_REQ ||
+               p.ftype == T_KEYS_PUSH) {
+      Req* r = new Req();
+      r->conn = c;
+      r->ftype = p.ftype;
+      r->kind = p.ftype == T_STATS_REQ ? K_STATS
+                : p.ftype == T_KEYS_PUSH ? K_KEYS
+                                         : K_VERIFY;
+      {
+        std::lock_guard<std::mutex> lk(c->mu);
+        r->seq = c->assigned++;
+      }
+      r->t_recv = wall_now();
+      r->trace_len = (uint8_t)p.trace_len;
+      if (p.trace_len)
+        std::memcpy(r->trace, base + p.trace_off, (size_t)p.trace_len);
+      size_t nent = p.entries.size();
+      r->offs.resize(nent + 1);
+      r->offs[0] = 0;
+      int64_t tot = 0;
+      for (size_t i = 0; i < nent; i++) {
+        tot += p.entries[i].len;
+        r->offs[i + 1] = tot;
+      }
+      r->blob.resize((size_t)tot);
+      for (size_t i = 0; i < nent; i++)
+        std::memcpy(&r->blob[(size_t)r->offs[i]], base + p.entries[i].off,
+                    (size_t)p.entries[i].len);
+      int64_t ntok = r->kind == K_VERIFY ? (int64_t)nent : 1;
+      if (r->kind == K_VERIFY) h->ctr[CTR_TOKENS].fetch_add(nent);
+      if (!push_req(h, r, ntok)) {
+        delete r;
+        break;
+      }
+    } else {
+      // valid frame, wrong direction (a response type at the server):
+      // protocol violation → drop the connection, same as Python.
+      break;
+    }
+    start += (size_t)p.consumed;
+    if (start == buf.size()) {
+      buf.clear();
+      start = 0;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->reader_done = true;
+    c->cv.notify_all();
+  }
+  // NOTHING may touch the Handle after the finished publish below:
+  // cap_serve_destroy frees it as soon as every conn shows 2 (the
+  // closed-conn counter is maintained by sweep_conns instead).
+  if (c->finished.fetch_add(1) + 1 == 2) ::close(c->fd);
+}
+
+// ---------------------------------------------------------------------------
+// writer thread: strict seq-order sends, discards once the peer broke
+// ---------------------------------------------------------------------------
+
+static void writer_main(std::shared_ptr<Conn> c) {
+  Handle* h = c->h;
+  std::unique_lock<std::mutex> lk(c->mu);
+  for (;;) {
+    auto it = c->outq.find(c->next_send);
+    if (it != c->outq.end()) {
+      std::string data = std::move(it->second);
+      c->outq.erase(it);
+      c->next_send++;
+      bool dead = c->dead;
+      lk.unlock();
+      if (!dead && !send_all(c->fd, data)) {
+        // Broken mid-response: wake the reader out of recv, then keep
+        // DRAINING queued entries so in-flight posts never pile up.
+        ::shutdown(c->fd, SHUT_RDWR);
+        lk.lock();
+        c->dead = true;
+      } else {
+        lk.lock();
+      }
+      continue;
+    }
+    if (h->stop.load(std::memory_order_relaxed)) break;
+    if (c->reader_done && c->next_send >= c->assigned)
+      break;  // every response this connection will ever owe is sent
+    c->cv.wait_for(lk, std::chrono::milliseconds(100));
+  }
+  lk.unlock();
+  (void)h;
+  if (c->finished.fetch_add(1) + 1 == 2) ::close(c->fd);
+}
+
+// remove fully-finished connections (both threads exited → every
+// owed response was sent or discarded; any later post is dropped)
+static void sweep_conns(Handle* h) {
+  std::lock_guard<std::mutex> lk(h->conns_mu);
+  for (auto it = h->conns.begin(); it != h->conns.end();) {
+    if (it->second->finished.load() >= 2) {
+      h->ctr[CTR_CONNS_CLOSED].fetch_add(1);
+      it = h->conns.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// response encoding (mirrors protocol.send_response / _with_crc)
+// ---------------------------------------------------------------------------
+
+static void put_u32(std::string& s, uint32_t v) {
+  s.append((const char*)&v, 4);
+}
+
+static void append_crc(std::string& s) {
+  uint32_t crc = crc32_update(0, (const uint8_t*)s.data(), s.size());
+  put_u32(s, crc);
+}
+
+}  // namespace serve_native
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+using namespace serve_native;
+
+extern "C" {
+
+void* cap_serve_create(int32_t ring_capacity, int64_t max_queued_tokens) {
+  size_t cap = 1;
+  while ((int32_t)cap < (ring_capacity > 0 ? ring_capacity : 4096))
+    cap <<= 1;
+  return new Handle(cap, max_queued_tokens > 0 ? max_queued_tokens
+                                               : (int64_t)4 * 32768);
+}
+
+int32_t cap_serve_add_conn(void* hv, int32_t fd) {
+  Handle* h = (Handle*)hv;
+  if (h->stop.load()) return -1;
+  auto c = std::make_shared<Conn>();
+  c->h = h;
+  c->fd = fd;
+  {
+    std::lock_guard<std::mutex> lk(h->conns_mu);
+    c->id = h->next_id++;
+    h->conns[c->id] = c;
+  }
+  h->ctr[CTR_CONNS].fetch_add(1);
+  std::thread(reader_main, c).detach();
+  std::thread(writer_main, c).detach();
+  if (++h->sweep_tick % 64 == 0) sweep_conns(h);
+  return c->id;
+}
+
+int64_t cap_serve_ring_depth(void* hv) {
+  if (!hv) return 0;
+  return ((Handle*)hv)->queued_tokens.load(std::memory_order_relaxed);
+}
+
+int64_t cap_serve_counter(void* hv, int32_t which) {
+  if (!hv || which < 0 || which >= CTR_N) return -1;
+  return ((Handle*)hv)->ctr[which].load(std::memory_order_relaxed);
+}
+
+// Drain queued requests into flat caller-owned buffers. Returns the
+// number of requests drained (0 on timeout), or -2 when the FIRST
+// request alone exceeds the caller's buffers — out_counts then holds
+// the required sizes and the request is carried for the retry.
+//
+// req_meta stride is 6 int32s per request:
+//   [kind, conn_id, ftype, n_entries, trace_len, reserved]
+// tok_off holds n_tokens+1 cumulative byte offsets into tok_blob.
+// Returns early (before min_tokens / max_wait) when a control record
+// (stats / keys push) is drained — Python must handle it in order.
+int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
+                        double max_wait_s, double idle_wait_s,
+                        uint8_t* tok_blob, int64_t blob_cap,
+                        int64_t* tok_off, int32_t* req_meta,
+                        int64_t* req_seq, double* req_t0,
+                        uint8_t* trace_buf, int32_t max_reqs,
+                        int64_t* out_counts) {
+  Handle* h = (Handle*)hv;
+  using clock = std::chrono::steady_clock;
+  auto t_start = clock::now();
+  auto t_first = t_start;
+  bool have = false;
+  int64_t n_reqs = 0, n_toks = 0, blob_used = 0;
+  tok_off[0] = 0;
+  bool stop_drain = false;
+  while (!stop_drain) {
+    Req* r = h->carry;
+    h->carry = nullptr;
+    if (!r) r = (Req*)h->ring.try_pop();
+    if (!r) {
+      std::unique_lock<std::mutex> lk(h->mu);
+      r = (Req*)h->ring.try_pop();
+      if (!r) {
+        if (h->stop.load(std::memory_order_relaxed)) break;
+        auto now = clock::now();
+        auto until =
+            have ? t_first + std::chrono::duration_cast<clock::duration>(
+                                 std::chrono::duration<double>(max_wait_s))
+                 : t_start + std::chrono::duration_cast<clock::duration>(
+                                 std::chrono::duration<double>(idle_wait_s));
+        if (now >= until) break;
+        h->cv_data.wait_until(lk, until);
+        continue;
+      }
+    }
+    int64_t nent = (int64_t)r->offs.size() - 1;
+    int64_t bl = (int64_t)r->blob.size();
+    if (n_reqs + 1 > (int64_t)max_reqs || n_toks + nent > max_tokens ||
+        blob_used + bl > blob_cap) {
+      h->carry = r;  // keep for the next drain call
+      if (n_reqs == 0) {
+        out_counts[0] = 1;
+        out_counts[1] = nent;
+        out_counts[2] = bl;
+        return -2;  // caller must grow its buffers and retry
+      }
+      break;
+    }
+    if (!have) {
+      have = true;
+      t_first = clock::now();
+    }
+    std::memcpy(tok_blob + blob_used, r->blob.data(), (size_t)bl);
+    for (int64_t j = 0; j < nent; j++)
+      tok_off[n_toks + 1 + j] = blob_used + r->offs[j + 1];
+    int32_t* m = req_meta + n_reqs * 6;
+    m[0] = r->kind;
+    m[1] = r->conn->id;
+    m[2] = r->ftype;
+    m[3] = (int32_t)nent;
+    m[4] = r->trace_len;
+    m[5] = 0;
+    req_seq[n_reqs] = r->seq;
+    req_t0[n_reqs] = r->t_recv;
+    if (r->trace_len)
+      std::memcpy(trace_buf + (size_t)n_reqs * MAX_TRACE_BYTES, r->trace,
+                  r->trace_len);
+    int64_t consumed = r->kind == K_VERIFY ? nent : 1;
+    h->queued_tokens.fetch_sub(consumed, std::memory_order_relaxed);
+    n_reqs++;
+    n_toks += nent;
+    blob_used += bl;
+    bool control = r->kind != K_VERIFY;
+    delete r;
+    {
+      std::lock_guard<std::mutex> lk(h->mu);
+      h->cv_space.notify_all();
+    }
+    if (control) break;  // flush now: Python handles it in order
+    if (n_toks >= min_tokens) stop_drain = true;
+  }
+  out_counts[0] = n_reqs;
+  out_counts[1] = n_toks;
+  out_counts[2] = blob_used;
+  return n_reqs;
+}
+
+// Post one drained span's verdicts: per request, encode the response
+// frame (plain / checksummed / traced mirrors the request type) and
+// hand it to the connection's writer at the request's seq.
+int32_t cap_serve_post_results(void* hv, const int32_t* req_meta,
+                               const int64_t* req_seq,
+                               const uint8_t* trace_buf, int32_t n_reqs,
+                               const uint8_t* statuses,
+                               const uint8_t* payload_blob,
+                               const int64_t* payload_off) {
+  Handle* h = (Handle*)hv;
+  int64_t t = 0;
+  int32_t dropped = 0;
+  for (int32_t i = 0; i < n_reqs; i++) {
+    const int32_t* m = req_meta + i * 6;
+    int32_t conn_id = m[1];
+    uint8_t ftype = (uint8_t)m[2];
+    int64_t ntok = m[3];
+    uint8_t rtype = ftype == T_VERIFY_REQ_CRC ? T_VERIFY_RESP_CRC
+                    : ftype == T_VERIFY_REQ_TRACE ? T_VERIFY_RESP_TRACE
+                                                  : T_VERIFY_RESP;
+    bool crc = rtype != T_VERIFY_RESP;
+    std::string frame;
+    int64_t body = payload_off[t + ntok] - payload_off[t];
+    frame.reserve((size_t)(9 + 70 + ntok * 5 + body + 4));
+    put_u32(frame, MAGIC);
+    frame.push_back((char)rtype);
+    put_u32(frame, (uint32_t)ntok);
+    if (rtype == T_VERIFY_RESP_TRACE) {
+      uint8_t tl = (uint8_t)m[4];
+      frame.push_back((char)tl);
+      frame.append((const char*)(trace_buf + (size_t)i * MAX_TRACE_BYTES),
+                   tl);
+    }
+    for (int64_t j = 0; j < ntok; j++) {
+      int64_t off = payload_off[t + j];
+      int64_t len = payload_off[t + j + 1] - off;
+      frame.push_back((char)statuses[t + j]);
+      put_u32(frame, (uint32_t)len);
+      frame.append((const char*)(payload_blob + off), (size_t)len);
+    }
+    if (crc) append_crc(frame);
+    t += ntok;
+    std::shared_ptr<Conn> c;
+    {
+      std::lock_guard<std::mutex> lk(h->conns_mu);
+      auto it = h->conns.find(conn_id);
+      if (it != h->conns.end()) c = it->second;
+    }
+    if (c) {
+      enqueue_response(c, req_seq[i], std::move(frame));
+    } else {
+      dropped++;
+      h->ctr[CTR_DROPPED_POSTS].fetch_add(1);
+    }
+  }
+  return dropped;
+}
+
+// Post one pre-encoded frame (stats response / keys ack built in
+// Python) at the given request's seq slot.
+int32_t cap_serve_post_raw(void* hv, int32_t conn_id, int64_t seq,
+                           const uint8_t* data, int64_t len) {
+  Handle* h = (Handle*)hv;
+  std::shared_ptr<Conn> c;
+  {
+    std::lock_guard<std::mutex> lk(h->conns_mu);
+    auto it = h->conns.find(conn_id);
+    if (it != h->conns.end()) c = it->second;
+  }
+  if (!c) {
+    h->ctr[CTR_DROPPED_POSTS].fetch_add(1);
+    return 1;
+  }
+  enqueue_response(c, seq, std::string((const char*)data, (size_t)len));
+  return 0;
+}
+
+// Shutdown: wake everything, sever every connection, join (bounded).
+// The handle is freed only when every thread confirmed exit —
+// otherwise it is deliberately leaked (a wedged kernel call must not
+// become a use-after-free).
+void cap_serve_destroy(void* hv) {
+  Handle* h = (Handle*)hv;
+  h->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->cv_data.notify_all();
+    h->cv_space.notify_all();
+  }
+  std::vector<std::shared_ptr<Conn>> cs;
+  {
+    std::lock_guard<std::mutex> lk(h->conns_mu);
+    for (auto& kv : h->conns) cs.push_back(kv.second);
+  }
+  for (auto& c : cs) {
+    ::shutdown(c->fd, SHUT_RDWR);
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->cv.notify_all();
+  }
+  bool all = false;
+  for (int i = 0; i < 500 && !all; i++) {
+    all = true;
+    for (auto& c : cs)
+      if (c->finished.load() < 2) all = false;
+    if (!all) ::usleep(10000);
+  }
+  for (;;) {
+    Req* r = (Req*)h->ring.try_pop();
+    if (!r) break;
+    delete r;
+  }
+  if (h->carry) {
+    delete h->carry;
+    h->carry = nullptr;
+  }
+  if (all) delete h;
+}
+
+// Test/parity hook: classify one frame held fully in a byte buffer,
+// with the exact reader semantics (PF_* status codes above).
+int32_t cap_serve_probe_frame(const uint8_t* data, int64_t len,
+                              int64_t* consumed) {
+  Parsed p;
+  int st = parse_frame(data, len, p);
+  if (consumed) *consumed = (st == PF_OK) ? p.consumed : 0;
+  return st;
+}
+
+// ---------------------------------------------------------------------------
+// native closed-loop load driver (tools/bench_stages.py): streams
+// pipelined plain verify requests and parses responses entirely in C,
+// so a bench against a stub engine isolates the WORKER's Python-side
+// serial cost per token — no Python client chain in the measurement.
+// ---------------------------------------------------------------------------
+
+namespace serve_native {
+
+struct DriveShared {
+  std::atomic<int64_t> tokens{0};
+  std::atomic<int64_t> reqs{0};
+  std::atomic<int32_t> errors{0};
+};
+
+static void drive_one(const char* host, int32_t port, const uint8_t* blob,
+                      const int64_t* offs, int32_t n_tokens,
+                      int32_t req_tokens, int32_t depth, double seconds,
+                      uint32_t seed, DriveShared* sh) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { sh->errors.fetch_add(1); return; }
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    sh->errors.fetch_add(1);
+    return;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // pre-encode a handful of distinct request frames, reused round-robin
+  std::vector<std::string> frames;
+  uint32_t rng = seed * 2654435761u + 12345u;
+  for (int v = 0; v < 16; v++) {
+    rng = rng * 1103515245u + 12345u;
+    int32_t lo = (int32_t)(rng % (uint32_t)(n_tokens > req_tokens
+                                                ? n_tokens - req_tokens
+                                                : 1));
+    std::string f;
+    put_u32(f, MAGIC);
+    f.push_back((char)T_VERIFY_REQ);
+    put_u32(f, (uint32_t)req_tokens);
+    for (int32_t j = 0; j < req_tokens; j++) {
+      int64_t o = offs[lo + j], e = offs[lo + j + 1];
+      put_u32(f, (uint32_t)(e - o));
+      f.append((const char*)(blob + o), (size_t)(e - o));
+    }
+    frames.push_back(std::move(f));
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<
+                      std::chrono::steady_clock::duration>(
+                      std::chrono::duration<double>(seconds));
+  std::vector<uint8_t> buf;
+  size_t start = 0;
+  int inflight = 0;
+  size_t next = 0;
+  bool ok = true;
+  for (;;) {
+    bool in_window = std::chrono::steady_clock::now() < deadline;
+    while (ok && in_window && inflight < depth) {
+      ok = send_all(fd, frames[next++ % frames.size()]);
+      if (ok) inflight++;
+    }
+    if (!inflight || !ok) break;
+    // read one response frame
+    for (;;) {
+      Parsed p;
+      int st = PF_INCOMPLETE;
+      if (buf.size() > start)
+        st = parse_frame(buf.data() + start,
+                         (int64_t)(buf.size() - start), p);
+      if (st == PF_OK) {
+        start += (size_t)p.consumed;
+        if (start == buf.size()) { buf.clear(); start = 0; }
+        inflight--;
+        if (in_window) {
+          sh->tokens.fetch_add((int64_t)p.entries.size());
+          sh->reqs.fetch_add(1);
+        }
+        break;
+      }
+      if (st != PF_INCOMPLETE) { ok = false; break; }
+      if (start > 0) {
+        buf.erase(buf.begin(), buf.begin() + start);
+        start = 0;
+      }
+      size_t old = buf.size();
+      buf.resize(old + (1 << 16));
+      ssize_t r = ::recv(fd, buf.data() + old, 1 << 16, 0);
+      if (r <= 0) { buf.resize(old); ok = false; break; }
+      buf.resize(old + (size_t)r);
+    }
+    if (!in_window && inflight == 0) break;
+  }
+  ::close(fd);
+  if (!ok) sh->errors.fetch_add(1);
+}
+
+}  // namespace serve_native
+
+int32_t cap_bench_drive(const char* host, int32_t port,
+                        const uint8_t* blob, const int64_t* offs,
+                        int32_t n_tokens, int32_t req_tokens,
+                        int32_t depth, double seconds, int32_t n_conns,
+                        int64_t* out_tokens, int64_t* out_reqs) {
+  DriveShared sh;
+  std::vector<std::thread> threads;
+  for (int32_t i = 0; i < (n_conns > 0 ? n_conns : 1); i++)
+    threads.emplace_back(drive_one, host, port, blob, offs, n_tokens,
+                         req_tokens, depth, seconds, (uint32_t)(i + 1),
+                         &sh);
+  for (auto& t : threads) t.join();
+  if (out_tokens) *out_tokens = sh.tokens.load();
+  if (out_reqs) *out_reqs = sh.reqs.load();
+  return sh.errors.load() ? -1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,425 @@
+"""ctypes binding + drain loop for the native (C++) serve chain.
+
+``serve_native.cpp`` (built into ``libcapruntime.so``) owns the
+per-token serve hot path: per-connection reader threads parse and
+validate CVB1 frames GIL-free and feed a bounded lock-free MPSC ring;
+per-connection writer threads encode and send responses in strict
+request order. Python's only per-token work is slicing the drained
+token blob into strings and joining verdict payloads back into one
+buffer — everything else crosses the boundary as whole batches:
+
+    drain()  → one flat buffer of tokens + request descriptors
+    batcher  → one submission per drained chunk (no per-token or
+               per-request callbacks; ``AdaptiveBatcher.submit_handoff``)
+    post()   → one call with every verdict of the chunk
+
+Control frames (stats requests, keyplane KEYS pushes) ride the SAME
+ring in frame order, so a keys push still applies before any verify
+read after it, exactly like the Python chain's reader-thread apply.
+Pings are answered natively without waking Python at all.
+
+Raises ImportError when the library is missing or predates the serve
+chain — ``VerifyWorker`` catches that and falls back to the pure
+Python chain (``serve_chain == "python"``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..obs import decision as _decision
+from . import protocol
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "runtime", "native", "libcapruntime.so")
+
+_SYMBOLS = ("cap_serve_create", "cap_serve_destroy", "cap_serve_add_conn",
+            "cap_serve_drain", "cap_serve_post_results",
+            "cap_serve_post_raw", "cap_serve_ring_depth",
+            "cap_serve_counter", "cap_serve_probe_frame",
+            "cap_bench_drive")
+
+# counter slots, mirroring serve_native.cpp
+CTR_CONNS = 0
+CTR_FRAMES = 1
+CTR_TOKENS = 2
+CTR_PROTO_ERR = 3
+CTR_PONGS = 4
+CTR_DROPPED_POSTS = 5
+CTR_CONNS_CLOSED = 6
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load() -> ctypes.CDLL:
+    """Load (building on first use) and type-check the library; raises
+    ImportError when unbuildable or stale (missing serve symbols)."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from .._build import build_native
+
+        build_native()
+        if not os.path.exists(_LIB_PATH):
+            raise ImportError(f"{_LIB_PATH} not built (run: make native)")
+        lib = ctypes.CDLL(_LIB_PATH)
+        for sym in _SYMBOLS:
+            if not hasattr(lib, sym):
+                raise ImportError(
+                    f"stale libcapruntime.so: missing {sym} "
+                    "(run: make native-build)")
+        lib.cap_serve_create.restype = ctypes.c_void_p
+        lib.cap_serve_create.argtypes = [ctypes.c_int32, ctypes.c_int64]
+        lib.cap_serve_destroy.argtypes = [ctypes.c_void_p]
+        lib.cap_serve_add_conn.restype = ctypes.c_int32
+        lib.cap_serve_add_conn.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.cap_serve_ring_depth.restype = ctypes.c_int64
+        lib.cap_serve_ring_depth.argtypes = [ctypes.c_void_p]
+        lib.cap_serve_counter.restype = ctypes.c_int64
+        lib.cap_serve_counter.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.cap_serve_drain.restype = ctypes.c_int64
+        lib.cap_serve_drain.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double, _u8p, ctypes.c_int64,
+            _i64p, _i32p, _i64p, _f64p, _u8p, ctypes.c_int32, _i64p]
+        lib.cap_serve_post_results.restype = ctypes.c_int32
+        lib.cap_serve_post_results.argtypes = [
+            ctypes.c_void_p, _i32p, _i64p, _u8p, ctypes.c_int32,
+            _u8p, _u8p, _i64p]
+        lib.cap_serve_post_raw.restype = ctypes.c_int32
+        lib.cap_serve_post_raw.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64, _u8p,
+            ctypes.c_int64]
+        lib.cap_serve_probe_frame.restype = ctypes.c_int32
+        lib.cap_serve_probe_frame.argtypes = [_u8p, ctypes.c_int64, _i64p]
+        lib.cap_bench_drive.restype = ctypes.c_int32
+        lib.cap_bench_drive.argtypes = [
+            ctypes.c_char_p, ctypes.c_int32, _u8p, _i64p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_double,
+            ctypes.c_int32, _i64p, _i64p]
+        _lib = lib
+        return lib
+
+
+def probe_frame(data: bytes) -> int:
+    """Classify one complete frame with the NATIVE parser → PF status
+    (0 ok; see protocol.NATIVE_STATUS_ERRORS for the class map). The
+    malformed-frame parity sweep drives this against
+    ``protocol.parse_frame_bytes``."""
+    lib = load()
+    buf = np.frombuffer(bytearray(data), np.uint8) if data else \
+        np.zeros(1, np.uint8)
+    return int(lib.cap_serve_probe_frame(
+        buf.ctypes.data_as(_u8p), len(data), None))
+
+
+class NativeServeChain:
+    """One worker's native frame-I/O front end.
+
+    batcher: the worker's AdaptiveBatcher (must expose
+    ``submit_handoff``). stats_fn / keys_fn: the worker's control-op
+    handlers (``VerifyWorker.stats`` / ``VerifyWorker.apply_keys``).
+    """
+
+    _META_STRIDE = 6
+
+    def __init__(self, batcher, stats_fn: Callable[[], dict],
+                 keys_fn: Callable[[dict, Any], int],
+                 target_batch: int = 4096, max_wait_ms: float = 2.0,
+                 max_batch: int = 32768):
+        self._lib = load()
+        self._batcher = batcher
+        self._stats_fn = stats_fn
+        self._keys_fn = keys_fn
+        self._target = max(1, target_batch)
+        self._h = ctypes.c_void_p(self._lib.cap_serve_create(
+            4096, 4 * max_batch))
+        if not self._h:
+            raise ImportError("cap_serve_create failed")
+        self._stop = threading.Event()
+        self._drained = threading.Event()   # ring empty after stop
+        # drain buffers (grown on demand when a giant frame arrives)
+        self._alloc(max_tokens=max_batch, blob_cap=8 << 20,
+                    max_reqs=4096)
+        self._thread = threading.Thread(
+            target=self._drain_loop, daemon=True,
+            name="cap-tpu-native-drain")
+        self._thread.start()
+
+    def _alloc(self, max_tokens: int, blob_cap: int,
+               max_reqs: int) -> None:
+        self._max_tokens = max_tokens
+        self._blob_cap = blob_cap
+        self._max_reqs = max_reqs
+        self._tok_blob = np.empty(blob_cap, np.uint8)
+        self._tok_off = np.zeros(max_tokens + 1, np.int64)
+        self._req_meta = np.zeros(max_reqs * self._META_STRIDE, np.int32)
+        self._req_seq = np.zeros(max_reqs, np.int64)
+        self._req_t0 = np.zeros(max_reqs, np.float64)
+        self._trace_buf = np.zeros(max_reqs * 64, np.uint8)
+        self._out_counts = np.zeros(3, np.int64)
+
+    # -- connection handoff ------------------------------------------------
+
+    def add_conn(self, conn) -> int:
+        """Take ownership of an accepted socket: its fd moves to the
+        native reader/writer threads (the Python socket object is
+        detached and must not be used again)."""
+        fd = conn.detach()
+        cid = int(self._lib.cap_serve_add_conn(self._h, fd))
+        if cid < 0:
+            os.close(fd)
+        return cid
+
+    # -- stats surface -----------------------------------------------------
+
+    def ring_depth(self) -> int:
+        h = self._h
+        if not h:               # destroyed (post-drain stats snapshot)
+            return 0
+        return int(self._lib.cap_serve_ring_depth(h))
+
+    def counters(self) -> dict:
+        c = self._lib.cap_serve_counter
+        h = self._h
+        if not h:               # destroyed: final counters are gone —
+            return {}           # the postmortem keeps its last doc
+        return {
+            "serve.native.connections": int(c(h, CTR_CONNS)),
+            "serve.native.frames": int(c(h, CTR_FRAMES)),
+            "serve.native.tokens": int(c(h, CTR_TOKENS)),
+            "serve.native.protocol_errors": int(c(h, CTR_PROTO_ERR)),
+            "serve.native.pongs": int(c(h, CTR_PONGS)),
+            "serve.native.dropped_posts": int(c(h, CTR_DROPPED_POSTS)),
+        }
+
+    # -- drain loop --------------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        lib = self._lib
+        h = self._h
+        while True:
+            stopping = self._stop.is_set()
+            # GREEDY drain: block until at least one request is queued
+            # (idle wait), then take everything available and return —
+            # the drain layer adds NO batching window of its own; the
+            # AdaptiveBatcher below owns the latency/throughput
+            # tradeoff, exactly as on the Python chain. Under load the
+            # ring refills while Python processes the previous chunk,
+            # so chunks grow toward max_tokens by themselves.
+            rc = int(lib.cap_serve_drain(
+                h, self._max_tokens, self._max_tokens,
+                0.0,
+                # short idle wait while serving (cheap wakeups keep
+                # close() responsive); near-zero when draining out
+                0.0 if stopping else 0.05,
+                self._tok_blob.ctypes.data_as(_u8p), self._blob_cap,
+                self._tok_off.ctypes.data_as(_i64p),
+                self._req_meta.ctypes.data_as(_i32p),
+                self._req_seq.ctypes.data_as(_i64p),
+                self._req_t0.ctypes.data_as(_f64p),
+                self._trace_buf.ctypes.data_as(_u8p),
+                self._max_reqs,
+                self._out_counts.ctypes.data_as(_i64p)))
+            if rc == -2:
+                # one request alone exceeds the buffers: grow to fit
+                # (bounded by the protocol's own frame caps)
+                need_toks, need_blob = int(self._out_counts[1]), \
+                    int(self._out_counts[2])
+                self._alloc(
+                    max_tokens=max(self._max_tokens, need_toks),
+                    blob_cap=max(self._blob_cap * 2, need_blob),
+                    max_reqs=self._max_reqs)
+                continue
+            if rc <= 0:
+                if stopping:
+                    self._drained.set()
+                    return
+                continue
+            telemetry.gauge("serve.native.ring_depth",
+                            float(self.ring_depth()))
+            try:
+                self._process(int(rc))
+            except Exception:  # noqa: BLE001 - the loop must survive
+                telemetry.count("serve.native.drain_errors")
+
+    def _process(self, n_reqs: int) -> None:
+        t_drain = time.time()
+        n_toks = int(self._out_counts[1])
+        # same accounting names the Python chain counts per frame, so
+        # pool.stats_merged / bench per-worker attribution are
+        # chain-agnostic (control records ride in n_reqs but carry no
+        # tokens; close enough for request accounting).
+        telemetry.count("worker.requests", n_reqs)
+        telemetry.count("worker.tokens", n_toks)
+        blob = self._tok_blob[: int(self._out_counts[2])].tobytes()
+        # ASCII fast path: one whole-blob decode, then str slicing per
+        # token (byte offsets == char offsets). Compact JWS is ASCII
+        # by construction; non-ASCII tokens take the per-slice decode.
+        try:
+            text: Optional[str] = blob.decode("ascii")
+        except UnicodeDecodeError:
+            text = None
+        offs = self._tok_off[: n_toks + 1].tolist()
+        meta = self._req_meta[: n_reqs * self._META_STRIDE]
+        tok_i = 0
+        i = 0
+        while i < n_reqs:
+            kind = int(meta[i * 6 + 0])
+            if kind == 0:
+                # contiguous run of verify requests → ONE submission
+                j = i
+                seg_toks = 0
+                while j < n_reqs and int(meta[j * 6 + 0]) == 0:
+                    seg_toks += int(meta[j * 6 + 3])
+                    j += 1
+                self._submit_segment(i, j, tok_i, seg_toks, blob, text,
+                                     offs, t_drain)
+                tok_i += seg_toks
+                i = j
+            else:
+                self._handle_control(i, kind, blob, offs, tok_i)
+                tok_i += int(meta[i * 6 + 3])
+                i += 1
+
+    def _submit_segment(self, i0: int, i1: int, tok0: int, seg_toks: int,
+                        blob: bytes, text: Optional[str],
+                        offs: List[int], t_drain: float) -> None:
+        with telemetry.span(telemetry.SPAN_NATIVE_DRAIN):
+            if text is not None:
+                tokens = [text[offs[k]: offs[k + 1]]
+                          for k in range(tok0, tok0 + seg_toks)]
+            else:
+                tokens = [blob[offs[k]: offs[k + 1]].decode("utf-8")
+                          for k in range(tok0, tok0 + seg_toks)]
+            n = i1 - i0
+            meta = self._req_meta[i0 * 6: i1 * 6].copy()
+            seqs = self._req_seq[i0:i1].copy()
+            t0s = self._req_t0[i0:i1].copy()
+            traces_raw = self._trace_buf[i0 * 64: i1 * 64].copy()
+            traces: List[tuple] = []
+            for k in range(n):
+                tl = int(meta[k * 6 + 4])
+                if tl:
+                    tid = traces_raw[k * 64: k * 64 + tl].tobytes() \
+                        .decode("ascii")
+                    t_recv = float(t0s[k])
+                    telemetry.trace_span(
+                        tid, telemetry.SPAN_WORKER_DEQUEUE, t_recv,
+                        max(0.0, t_drain - t_recv))
+                    traces.append((tid, t_recv))
+
+        def on_done(results: List[Any]) -> None:
+            # Serve-surface decision records (the r9 contract, same
+            # call the Python chain's responder makes per request —
+            # here once per drained chunk, exact counters either way).
+            _decision.record_batch(
+                "serve", results, tokens=tokens,
+                latency_s=time.time() - t_drain,
+                trace=traces[0][0] if traces else None)
+            self._post(results, meta, seqs, traces_raw, n, traces)
+
+        self._batcher.submit_handoff(
+            tokens, traces=[t for t, _ in traces], on_done=on_done)
+
+    def _post(self, results: List[Any], meta: np.ndarray,
+              seqs: np.ndarray, traces_raw: np.ndarray, n_reqs: int,
+              traces: List[tuple]) -> None:
+        with telemetry.span(telemetry.SPAN_NATIVE_POST):
+            n_tok = len(results)
+            poff = np.zeros(n_tok + 1, np.int64)
+            try:
+                # fast path: every verdict is raw payload bytes (the
+                # raw-claims engines) — one join, all statuses 0
+                pblob = b"".join(results)
+                if n_tok:
+                    np.cumsum(np.fromiter(map(len, results), np.int64,
+                                          count=n_tok), out=poff[1:])
+                st = np.zeros(max(1, n_tok), np.uint8)
+            except TypeError:
+                statuses = bytearray(n_tok)
+                payloads: List[bytes] = []
+                for i, r in enumerate(results):
+                    if isinstance(r, Exception):
+                        statuses[i] = 1
+                        payloads.append(
+                            f"{type(r).__name__}: {r}".encode())
+                    elif isinstance(r, (bytes, bytearray, memoryview)):
+                        payloads.append(bytes(r))
+                    else:
+                        payloads.append(
+                            json.dumps(r, separators=(",", ":")).encode())
+                pblob = b"".join(payloads)
+                if payloads:
+                    np.cumsum([len(p) for p in payloads], out=poff[1:])
+                st = np.frombuffer(bytes(statuses), np.uint8) \
+                    if statuses else np.zeros(1, np.uint8)
+            pb = np.frombuffer(pblob, np.uint8) if pblob else \
+                np.zeros(1, np.uint8)
+            self._lib.cap_serve_post_results(
+                self._h, meta.ctypes.data_as(_i32p),
+                seqs.ctypes.data_as(_i64p),
+                traces_raw.ctypes.data_as(_u8p), n_reqs,
+                st.ctypes.data_as(_u8p), pb.ctypes.data_as(_u8p),
+                poff.ctypes.data_as(_i64p))
+        now = time.time()
+        for tid, t_recv in traces:
+            telemetry.flight(tid, now - t_recv)
+
+    def _handle_control(self, i: int, kind: int, blob: bytes,
+                        offs: List[int], tok0: int) -> None:
+        meta = self._req_meta
+        conn_id = int(meta[i * 6 + 1])
+        seq = int(self._req_seq[i])
+        if kind == 2:  # stats request
+            try:
+                frame = protocol.encode_stats_response(self._stats_fn())
+            except Exception as e:  # noqa: BLE001 - never wedge the loop
+                frame = protocol.encode_stats_response(
+                    {"error": f"{type(e).__name__}"})
+        else:          # keys push (exactly one entry: the payload)
+            try:
+                doc = json.loads(blob[offs[tok0]: offs[tok0 + 1]])
+                got = self._keys_fn(doc.get("jwks") or {},
+                                    doc.get("epoch"))
+                frame = protocol.encode_keys_ack(epoch=got)
+            except Exception as e:  # noqa: BLE001 - acked, like Python
+                telemetry.count("worker.keys_push_errors")
+                frame = protocol.encode_keys_ack(
+                    error=f"{type(e).__name__}: {e}")
+        buf = np.frombuffer(frame, np.uint8)
+        self._lib.cap_serve_post_raw(
+            self._h, conn_id, seq, buf.ctypes.data_as(_u8p), len(frame))
+
+    # -- shutdown ----------------------------------------------------------
+
+    def stop_drain(self, deadline_s: float = 10.0) -> None:
+        """Stop the drain loop AFTER it has emptied the ring into the
+        batcher — queued requests are flushed, not dropped."""
+        self._stop.set()
+        self._drained.wait(timeout=deadline_s)
+        self._thread.join(timeout=deadline_s)
+
+    def destroy(self) -> None:
+        """Tear down the native side (sever connections, join its
+        threads). Call after the batcher has finished so in-flight
+        verdict posts have been written out."""
+        h, self._h = self._h, None
+        if h:
+            self._lib.cap_serve_destroy(h)

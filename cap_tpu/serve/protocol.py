@@ -231,11 +231,18 @@ def send_stats_request(sock: socket.socket) -> None:
     sock.sendall(_HDR.pack(MAGIC, T_STATS_REQ, 0))
 
 
+def encode_stats_response(stats: Any) -> bytes:
+    """Encoded stats-response frame bytes (one response-shaped entry
+    carrying the stats JSON object). The native serve chain posts
+    these verbatim, so both chains share one encoder."""
+    payload = json.dumps(stats, separators=(",", ":")).encode()
+    return (_HDR.pack(MAGIC, T_STATS_RESP, 1)
+            + struct.pack("<BI", 0, len(payload)) + payload)
+
+
 def send_stats_response(sock: socket.socket, stats: Any) -> None:
     """One response-shaped entry carrying the stats JSON object."""
-    payload = json.dumps(stats, separators=(",", ":")).encode()
-    sock.sendall(_HDR.pack(MAGIC, T_STATS_RESP, 1)
-                 + struct.pack("<BI", 0, len(payload)) + payload)
+    sock.sendall(encode_stats_response(stats))
 
 
 def keys_payload(jwks_doc: Dict[str, Any], epoch: int) -> bytes:
@@ -258,10 +265,11 @@ def send_keys_push(sock: socket.socket, jwks_doc: Dict[str, Any],
     sock.sendall(b"".join(_with_crc(parts)))
 
 
-def send_keys_ack(sock: socket.socket, epoch: Optional[int] = None,
-                  error: Optional[str] = None) -> None:
-    """Checksummed KEYS ack (type 12): status 0 + {"epoch": N} on a
-    successful swap, status 1 + error string otherwise."""
+def encode_keys_ack(epoch: Optional[int] = None,
+                    error: Optional[str] = None) -> bytes:
+    """Encoded checksummed KEYS-ack frame bytes (type 12): status 0 +
+    {"epoch": N} on a successful swap, status 1 + error string
+    otherwise. Shared by the socket sender and the native chain."""
     if error is None:
         status, payload = 0, json.dumps(
             {"epoch": int(epoch or 0)}, separators=(",", ":")).encode()
@@ -269,7 +277,14 @@ def send_keys_ack(sock: socket.socket, epoch: Optional[int] = None,
         status, payload = 1, error.encode()
     parts = [_HDR.pack(MAGIC, T_KEYS_ACK, 1),
              _LEN_BU32.pack(status, len(payload)), payload]
-    sock.sendall(b"".join(_with_crc(parts)))
+    return b"".join(_with_crc(parts))
+
+
+def send_keys_ack(sock: socket.socket, epoch: Optional[int] = None,
+                  error: Optional[str] = None) -> None:
+    """Checksummed KEYS ack (type 12): status 0 + {"epoch": N} on a
+    successful swap, status 1 + error string otherwise."""
+    sock.sendall(encode_keys_ack(epoch=epoch, error=error))
 
 
 def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
@@ -386,6 +401,46 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
         # frame can never masquerade as a different (valid) token.
         entries = [e.decode() for e in entries]
     return ftype, entries, trace
+
+
+def parse_frame_bytes(data: bytes) -> Tuple[int, List[Any],
+                                            Optional[str], int]:
+    """Parse ONE complete frame held in a byte buffer →
+    (type, entries, trace-id-or-None, bytes consumed).
+
+    Same validation (and the same typed error classes) as the socket
+    readers — this is the REFERENCE the native reader's frame parser
+    is pinned against: the malformed-frame parity sweep feeds the
+    corpus through this function and through
+    ``cap_serve_probe_frame`` and asserts identical error classes.
+    A buffer that ends mid-frame raises :class:`ConnectionError`,
+    matching a peer that closed mid-frame on the stream paths.
+    """
+    pos = 0
+
+    def take(n: int) -> bytes:
+        nonlocal pos
+        if pos + n > len(data):
+            raise ConnectionError("peer closed mid-frame")
+        b = data[pos: pos + n]
+        pos += n
+        return b
+
+    ftype, entries, trace = _parse_frame(take)
+    return ftype, entries, trace, pos
+
+
+# Native parse-status → Python error class: the shared frame-rejection
+# contract (serve_native.cpp PF_* codes). Status 0 is success, 4 means
+# "incomplete frame" (the stream readers just keep reading; the probe
+# maps it onto the same ConnectionError parse_frame_bytes raises).
+NATIVE_STATUS_ERRORS = {
+    1: MalformedFrameError,
+    2: FrameTooLargeError,
+    3: FrameCorruptError,
+    4: ConnectionError,
+    5: UnicodeDecodeError,
+}
 
 
 class FrameReader:

@@ -52,7 +52,8 @@ class VerifyWorker:
                  uds_path: Optional[str] = None,
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
                  max_batch: int = 32768, raw_claims: bool = True,
-                 obs_port: Optional[int] = None):
+                 obs_port: Optional[int] = None,
+                 serve_native: Optional[bool] = None):
         # The unwrapped engine: keyplane operations (KEYS pushes,
         # epoch reporting) address it directly, whatever raw-claims
         # wrapper the batcher ends up routed through.
@@ -70,6 +71,27 @@ class VerifyWorker:
         self._batcher = AdaptiveBatcher(
             keyset, target_batch=target_batch, max_wait_ms=max_wait_ms,
             max_batch=max_batch)
+        # Serve-chain selection: the NATIVE chain (C++ frame I/O +
+        # lock-free ring, serve/native_serve.py) when requested via
+        # serve_native=True or CAP_SERVE_NATIVE=1, with a graceful
+        # fallback to the pure-Python reader/responder chain when the
+        # library is absent/stale or the transport is UDS (the native
+        # readers own TCP fds). Both chains speak byte-identical CVB1
+        # and reject the same malformed frames with the same classes.
+        if serve_native is None:
+            serve_native = os.environ.get("CAP_SERVE_NATIVE", "0") == "1"
+        self._native = None
+        if serve_native and uds_path is None:
+            try:
+                from .native_serve import NativeServeChain
+
+                self._native = NativeServeChain(
+                    self._batcher, stats_fn=self.stats,
+                    keys_fn=self.apply_keys, target_batch=target_batch,
+                    max_wait_ms=max_wait_ms, max_batch=max_batch)
+            except Exception:  # noqa: BLE001 - fall back, visibly
+                telemetry.count("serve.native_fallbacks")
+                self._native = None
         self._uds_path = uds_path
         if uds_path is not None:
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -116,6 +138,12 @@ class VerifyWorker:
         """The engine's key-table epoch (None: not epoch-versioned)."""
         return getattr(self._engine, "key_epoch", None)
 
+    @property
+    def serve_chain(self) -> str:
+        """Which serve chain this worker runs: "native" (C++ frame I/O
+        + lock-free ring) or "python" (reader/responder threads)."""
+        return "native" if self._native is not None else "python"
+
     def apply_keys(self, jwks_doc: dict, epoch) -> int:
         """Apply one keyplane KEYS push; returns the installed epoch.
 
@@ -136,7 +164,13 @@ class VerifyWorker:
         d = self._batcher.depth()
         out = {"batcher.queued_tokens": d["queued_tokens"],
                "batcher.inflight_batches": d["inflight_batches"],
-               "worker.pid": os.getpid()}
+               "worker.pid": os.getpid(),
+               # 1.0 when the native chain serves this worker — the
+               # numeric form capstat renders as chain=native
+               "serve.native.active": 1.0 if self._native else 0.0}
+        if self._native is not None:
+            out["serve.native.ring_depth"] = float(
+                self._native.ring_depth())
         epoch = self.key_epoch
         if epoch is not None:
             out["keyplane.epoch"] = float(epoch)
@@ -151,12 +185,18 @@ class VerifyWorker:
         """
         rec = telemetry.active()
         obs = self.obs_address
+        native_counters = (self._native.counters()
+                           if self._native is not None else {})
         return {
             "pid": os.getpid(),
             **self._batcher.depth(),
             "key_epoch": self.key_epoch,
+            "serve_chain": self.serve_chain,
+            **({"ring_depth": self._native.ring_depth()}
+               if self._native is not None else {}),
             "obs_port": obs[1] if obs is not None else None,
-            "counters": rec.counters() if rec is not None else {},
+            "counters": {**(rec.counters() if rec is not None else {}),
+                         **native_counters},
             "series": rec.summary() if rec is not None else {},
             # Mergeable form: pool.stats_merged() adds bucket counts
             # across workers for EXACT fleet-wide quantiles.
@@ -176,7 +216,16 @@ class VerifyWorker:
                 os.unlink(self._uds_path)
             except OSError:
                 pass
+        if self._native is not None:
+            # Graceful-drain order: flush the ring into the batcher,
+            # let the batcher finish (its close waits for in-flight
+            # dispatches, whose on_done posts write the responses),
+            # give the native writers a beat, then sever connections.
+            self._native.stop_drain(deadline_s=min(10.0, deadline_s))
         self._batcher.close(deadline_s=deadline_s)
+        if self._native is not None:
+            time.sleep(0.2)
+            self._native.destroy()
 
     # -- internals --------------------------------------------------------
 
@@ -187,6 +236,16 @@ class VerifyWorker:
             except OSError:
                 return  # socket closed
             telemetry.count("worker.connections")
+            if self._native is not None:
+                # Native chain: the fd moves to C++ reader/writer
+                # threads; Python never sees this connection's frames.
+                try:
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+                self._native.add_conn(conn)
+                continue
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="cap-tpu-conn").start()
 

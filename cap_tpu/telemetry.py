@@ -67,13 +67,16 @@ SPAN_BATCHER_FLUSH = "batcher.flush"        # sync verify_batch call
 SPAN_BATCHER_DISPATCH = "batcher.dispatch"  # async dispatch (prep+H2D)
 SPAN_BATCHER_COLLECT = "batcher.collect"    # async device drain
 SPAN_KEYPLANE_SWAP = "keyplane.swap"        # key-table build + hot swap
+SPAN_NATIVE_DRAIN = "serve.native.drain"    # ring drain -> batcher submit
+SPAN_NATIVE_POST = "serve.native.post"      # verdicts -> native writers
 SPAN_ENGINE_PREFIX = "dispatch."            # dispatch.<family>.<detail>
 
 SPAN_NAMES = frozenset({
     SPAN_CLIENT_SUBMIT, SPAN_ROUTER_ATTEMPT, SPAN_ROUTER_HEDGE,
     SPAN_ROUTER_BACKOFF, SPAN_ROUTER_FALLBACK, SPAN_WORKER_DEQUEUE,
     SPAN_BATCHER_FILL, SPAN_BATCHER_FLUSH, SPAN_BATCHER_DISPATCH,
-    SPAN_BATCHER_COLLECT, SPAN_KEYPLANE_SWAP,
+    SPAN_BATCHER_COLLECT, SPAN_KEYPLANE_SWAP, SPAN_NATIVE_DRAIN,
+    SPAN_NATIVE_POST,
 })
 
 # ---------------------------------------------------------------------------
@@ -256,6 +259,22 @@ class Recorder:
             else:
                 self._counters[check_name(name)] = n
             return self._counters[name]
+
+    def count_many(self, increments: Dict[str, int]) -> Dict[str, int]:
+        """Apply several counter increments under ONE lock acquisition;
+        returns the post-increment value per name (same contract as
+        :meth:`count`, batched — the decision hot path uses this so a
+        drained chunk costs one lock round, not one per counter)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            counters = self._counters
+            for name, n in increments.items():
+                if name in counters:
+                    counters[name] += n
+                else:
+                    counters[check_name(name)] = n
+                out[name] = counters[name]
+        return out
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:

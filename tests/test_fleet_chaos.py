@@ -63,14 +63,30 @@ def _assert_verdicts(tokens, results):
                 f"WRONG verdict for {t!r}: accepted"
 
 
-@pytest.fixture
-def fleet():
+@pytest.fixture(params=["python", "native"])
+def fleet(request):
     """2 stub workers with ~80 ms of simulated device time per batch
-    (sleep releases the GIL), so a kill -9 lands MID-BATCH reliably."""
+    (sleep releases the GIL), so a kill -9 lands MID-BATCH reliably.
+
+    Parameterized over BOTH serve chains (CAP_SERVE_NATIVE=0 / =1):
+    every fault mode must produce zero wrong verdicts and zero lost
+    submissions whether the workers run the Python reader/responder
+    chain or the native C++ frame-I/O chain. When the native library
+    can't build on this host, workers fall back to python — assert
+    what actually came up so a silent fallback can't fake coverage.
+    """
+    native = request.param == "native"
     pool = WorkerPool(2, keyset_spec="stub:batch_ms=80",
                       ping_interval=0.2, max_restarts=20,
-                      max_wait_ms=1.0)
+                      max_wait_ms=1.0,
+                      env_extra={"CAP_SERVE_NATIVE":
+                                 "1" if native else "0"})
     assert pool.wait_all_ready(30), "fleet did not come up"
+    chains = set(pool.serve_chains().values())
+    if native and chains != {"native"}:
+        pool.close()
+        pytest.skip(f"native chain unavailable (workers ran {chains})")
+    assert native or chains == {"python"}, chains
     yield pool
     pool.close()
 

@@ -13,6 +13,7 @@ import pytest
 from cap_tpu import telemetry
 from cap_tpu.errors import InvalidSignatureError
 from cap_tpu.serve import AdaptiveBatcher, VerifyClient, VerifyWorker
+from cap_tpu.serve import protocol as P
 from cap_tpu.serve.client import RemoteVerifyError
 
 
@@ -566,3 +567,99 @@ def test_pipelined_stream_abandon_poisons_client(stub_worker):
     assert got and got[0][0] == {"sub": "t0.ok"}
     with pytest.raises(OSError):
         cl.verify_batch(["x.ok"])
+
+
+# ---------------------------------------------------------------------------
+# torn frames: FrameReader must reassemble frames split at EVERY byte
+# boundary across recv() calls (TCP has no message boundaries — a
+# frame can arrive one byte at a time, or glued to its neighbors)
+# ---------------------------------------------------------------------------
+
+class _ScriptedSocket:
+    """recv() serves pre-scripted chunks (never more than asked)."""
+
+    def __init__(self, chunks):
+        self._chunks = [bytes(c) for c in chunks if len(c)]
+
+    def recv(self, n):
+        if not self._chunks:
+            return b""
+        c = self._chunks[0]
+        if len(c) > n:
+            self._chunks[0] = c[n:]
+            return c[:n]
+        self._chunks.pop(0)
+        return c
+
+
+class _CaptureSocket:
+    def __init__(self):
+        self.data = b""
+
+    def sendall(self, b):
+        self.data += b
+
+
+def _frame_bytes(send_fn, *args, **kw):
+    cap = _CaptureSocket()
+    send_fn(cap, *args, **kw)
+    return cap.data
+
+
+def _torn_stream_frames():
+    """A multi-frame byte stream exercising every frame shape the
+    reader handles: plain/crc/traced requests, responses, ping/pong,
+    stats, keys push/ack."""
+    frames = [
+        _frame_bytes(P.send_request, ["torn-a.ok", "torn-b"]),
+        _frame_bytes(P.send_request, ["torn-crc"], crc=True),
+        _frame_bytes(P.send_request, ["torn-tr"],
+                     trace="00112233aabbccdd"),
+        _frame_bytes(P.send_response, [{"sub": "x"}, ValueError("no")]),
+        _frame_bytes(P.send_ping),
+        _frame_bytes(P.send_pong),
+        _frame_bytes(P.send_keys_push, {"keys": []}, 3),
+        _frame_bytes(P.send_keys_ack, epoch=3),
+    ]
+    return frames, b"".join(frames)
+
+
+def _read_all_frames(reader, n):
+    return [reader.recv_frame_ex() for _ in range(n)]
+
+
+def test_frame_reader_torn_at_every_byte_boundary():
+    frames, stream = _torn_stream_frames()
+    want = _read_all_frames(
+        P.FrameReader(_ScriptedSocket([stream])), len(frames))
+    for split in range(1, len(stream)):
+        rd = P.FrameReader(_ScriptedSocket([stream[:split],
+                                            stream[split:]]))
+        got = _read_all_frames(rd, len(frames))
+        assert got == want, f"split at byte {split} diverged"
+
+
+def test_frame_reader_one_byte_at_a_time():
+    frames, stream = _torn_stream_frames()
+    rd = P.FrameReader(_ScriptedSocket(
+        [stream[i:i + 1] for i in range(len(stream))]))
+    want = _read_all_frames(
+        P.FrameReader(_ScriptedSocket([stream])), len(frames))
+    assert _read_all_frames(rd, len(frames)) == want
+
+
+def test_parse_frame_bytes_matches_frame_reader():
+    """The bytes-level reference parser (the native parity contract)
+    agrees with the stream reader frame-for-frame, including consumed
+    offsets that re-chain through the stream."""
+    frames, stream = _torn_stream_frames()
+    want = _read_all_frames(
+        P.FrameReader(_ScriptedSocket([stream])), len(frames))
+    pos = 0
+    got = []
+    for _ in frames:
+        ftype, entries, trace, used = P.parse_frame_bytes(stream[pos:])
+        got.append((ftype, entries, trace))
+        pos += used
+    assert got == want
+    assert pos == len(stream)

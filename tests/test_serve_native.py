@@ -1,0 +1,433 @@
+"""Native serve chain: frame-rejection parity, end-to-end behavior,
+build health.
+
+The contract under test (ISSUE 7): the C++ reader in serve_native.cpp
+must reject EXACTLY the same malformed / oversize / corrupt frames as
+serve/protocol.py, with the same error classes — and the native chain
+end-to-end (CAP_SERVE_NATIVE=1) must be byte-compatible with the
+Python chain on every frame shape, including keys pushes, traced
+requests, and pipelined streams. The build-health test force-compiles
+the native sources so a compiler regression (like the r11 SHA-NI
+probe that silently killed the .so) fails tier-1 instead of silently
+reverting the fleet to the Python chain.
+"""
+
+import json
+import os
+import socket
+import struct
+import threading
+import zlib
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.serve import protocol as P
+from cap_tpu.serve.client import VerifyClient
+from cap_tpu.serve.worker import VerifyWorker
+
+try:
+    from cap_tpu.serve import native_serve
+    native_serve.load()
+    HAVE_NATIVE = True
+except Exception:  # noqa: BLE001 - no compiler / unbuildable
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="native serve runtime not built "
+    "(no compiler on this host?)")
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "clients", "go", "captpu", "testdata")
+
+
+# ---------------------------------------------------------------------------
+# malformed-frame corpus: every entry is (name, frame bytes, expected
+# error class) — the SAME corpus sweeps the Python reference parser
+# and the native reader, asserting identical classes.
+# ---------------------------------------------------------------------------
+
+def _hdr(ftype: int, count: int) -> bytes:
+    return struct.pack("<IBI", P.MAGIC, ftype, count)
+
+
+def _crc_fix(frame: bytes) -> bytes:
+    """Recompute a checksummed frame's trailer over its (possibly
+    patched) body, so only the intended fault is present."""
+    body = frame[:-4]
+    return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def _capture(send_fn, *args, **kw) -> bytes:
+    class _Cap:
+        data = b""
+
+        def sendall(self, b):
+            self.data += b
+
+    cap = _Cap()
+    send_fn(cap, *args, **kw)
+    return cap.data
+
+
+def malformed_corpus():
+    plain_req = _capture(P.send_request, ["corpus-a.ok", "corpus-b"])
+    crc_req = _capture(P.send_request, ["corpus-crc"], crc=True)
+    traced_req = _capture(P.send_request, ["corpus-tr"],
+                          trace="00112233aabbccdd")
+    plain_resp = _capture(P.send_response, [{"s": 1}])
+    crc_resp = _capture(P.send_response, [{"s": 1}], crc=True)
+    corpus = [
+        # -- length bombs: rejected BEFORE any allocation ------------------
+        ("count-bomb", _hdr(P.T_VERIFY_REQ, 0xFFFFFFFF),
+         P.FrameTooLargeError),
+        ("entry-length-bomb",
+         _hdr(P.T_VERIFY_REQ, 1) + struct.pack("<I", 0xFFFFFFFF),
+         P.FrameTooLargeError),
+        ("entry-over-bound",
+         _hdr(P.T_VERIFY_REQ, 1) + struct.pack("<I", P.MAX_ENTRY_BYTES + 1),
+         P.FrameTooLargeError),
+        ("response-length-bomb",
+         _hdr(P.T_VERIFY_RESP, 1) + struct.pack("<BI", 0, 0xFFFFFFFF),
+         P.FrameTooLargeError),
+        # -- structural: bad magic / type / counts -------------------------
+        ("bad-magic", b"XXXX" + plain_req[4:], P.MalformedFrameError),
+        ("unknown-type", _hdr(99, 0), P.MalformedFrameError),
+        ("ping-nonzero-count", _hdr(P.T_PING, 2), P.MalformedFrameError),
+        ("keys-push-two-entries", _crc_fix(
+            _hdr(P.T_KEYS_PUSH, 2) + struct.pack("<I", 1) + b"x"
+            + struct.pack("<I", 1) + b"y" + b"\0\0\0\0"),
+         P.MalformedFrameError),
+        # -- status bytes --------------------------------------------------
+        ("bad-status-plain",
+         _hdr(P.T_VERIFY_RESP, 1) + struct.pack("<BI", 7, 1) + b"z",
+         P.MalformedFrameError),
+        ("bad-status-checksummed", _crc_fix(
+            _hdr(P.T_VERIFY_RESP_CRC, 1) + struct.pack("<BI", 7, 1)
+            + b"z" + b"\0\0\0\0"),
+         P.MalformedFrameError),
+        # -- CRC faults ----------------------------------------------------
+        ("bad-crc-request",
+         crc_req[:-5] + bytes([crc_req[-5] ^ 0x01]) + crc_req[-4:],
+         P.FrameCorruptError),
+        ("bad-crc-response",
+         crc_resp[:15] + bytes([crc_resp[15] ^ 0x80]) + crc_resp[16:],
+         P.FrameCorruptError),
+        ("length-bomb-beats-crc",
+         # a corrupted LENGTH prefix inside a checksummed frame is
+         # rejected as too-large BEFORE the CRC runs: bound checks
+         # precede allocation, CRC protects content — on both chains
+         crc_resp[:12] + bytes([crc_resp[12] ^ 0x80]) + crc_resp[13:],
+         P.FrameTooLargeError),
+        ("bad-crc-traced",
+         traced_req[:20] + bytes([traced_req[20] ^ 0x01])
+         + traced_req[21:],
+         P.FrameCorruptError),
+        # -- trace-context faults ------------------------------------------
+        ("trace-len-zero", _hdr(P.T_VERIFY_REQ_TRACE, 0) + b"\x00",
+         P.MalformedFrameError),
+        ("trace-len-overlong",
+         _hdr(P.T_VERIFY_REQ_TRACE, 0) + bytes([P.MAX_TRACE_BYTES + 1])
+         + b"a" * (P.MAX_TRACE_BYTES + 1) + b"\0\0\0\0",
+         P.MalformedFrameError),
+        ("trace-not-hex", _crc_fix(
+            _hdr(P.T_VERIFY_REQ_TRACE, 0) + bytes([4]) + b"GGGG"
+            + b"\0\0\0\0"),
+         P.MalformedFrameError),
+        ("trace-truncated",
+         # ctx_len says 16 but the stream ends after 4 bytes: on a
+         # byte buffer both parsers classify it "incomplete frame"
+         _hdr(P.T_VERIFY_REQ_TRACE, 0) + bytes([16]) + b"ab12",
+         ConnectionError),
+        # -- token decode --------------------------------------------------
+        ("token-not-utf8",
+         _hdr(P.T_VERIFY_REQ, 1) + struct.pack("<I", 2) + b"\xff\xfe",
+         UnicodeDecodeError),
+        ("truncated-mid-entry",
+         plain_req[: len(plain_req) - 3], ConnectionError),
+    ]
+    return corpus
+
+
+def _python_class(frame: bytes):
+    try:
+        P.parse_frame_bytes(frame)
+        return None
+    except (P.ProtocolError, ConnectionError, UnicodeDecodeError) as e:
+        return type(e)
+
+
+def test_malformed_corpus_python_classes():
+    """The corpus is self-consistent: every entry raises exactly its
+    pinned class through the Python reference parser."""
+    for name, frame, want in malformed_corpus():
+        got = _python_class(frame)
+        assert got is not None, f"{name}: parsed cleanly?!"
+        assert issubclass(got, want) and (
+            want is not ConnectionError or got is ConnectionError), \
+            f"{name}: python raised {got}, want {want}"
+
+
+@needs_native
+def test_malformed_corpus_native_parity():
+    """THE parity sweep: the native reader classifies every corpus
+    frame with the SAME error class as the Python parser."""
+    for name, frame, want in malformed_corpus():
+        st = native_serve.probe_frame(frame)
+        assert st != 0, f"{name}: native parser accepted it"
+        got = P.NATIVE_STATUS_ERRORS[st]
+        assert got is want, (
+            f"{name}: native maps to {got.__name__}, "
+            f"python raises {want.__name__}")
+
+
+@needs_native
+def test_golden_vectors_accepted_by_both_parsers():
+    """Every committed golden wire vector parses cleanly through the
+    Python parser AND the native reader (byte-level compatibility with
+    the Go client's pinned frames)."""
+    names = [f for f in sorted(os.listdir(GOLDEN_DIR))
+             if f.endswith(".bin")]
+    assert names, "golden vectors missing"
+    for name in names:
+        with open(os.path.join(GOLDEN_DIR, name), "rb") as f:
+            data = f.read()
+        ftype, _, _, used = P.parse_frame_bytes(data)
+        assert used == len(data)
+        st = native_serve.probe_frame(data)
+        assert st == 0, f"{name}: native reader rejected it (st={st})"
+
+
+@needs_native
+def test_native_probe_fuzz_parity_on_mutations():
+    """Single-byte mutations of a checksummed request: whatever the
+    Python parser decides (ok / corrupt / malformed / too large), the
+    native reader decides identically, byte for byte."""
+    base = _capture(P.send_request, ["fuzz-a.ok", "fuzz-b"], crc=True)
+    for off in range(len(base)):
+        for xor in (0x01, 0x80):
+            frame = base[:off] + bytes([base[off] ^ xor]) + base[off + 1:]
+            want = _python_class(frame)
+            st = native_serve.probe_frame(frame)
+            got = None if st == 0 else P.NATIVE_STATUS_ERRORS[st]
+            assert got is want or (
+                got is not None and want is not None
+                and issubclass(want, got)), \
+                f"mutation at {off} xor {xor:#x}: native={got} " \
+                f"python={want}"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the native chain serves every frame shape
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def native_worker():
+    if not HAVE_NATIVE:
+        pytest.skip("native serve runtime not built")
+    w = VerifyWorker(StubKeySet(), serve_native=True, max_wait_ms=1.0)
+    assert w.serve_chain == "native"
+    yield w
+    w.close(deadline_s=10)
+
+
+@needs_native
+def test_native_roundtrip_plain_crc_traced(native_worker):
+    host, port = native_worker.address
+    with VerifyClient(host, port) as cl:
+        out = cl.verify_batch(["n1.ok", "n2.bad", "n3.ok"])
+        assert out[0] == {"sub": "n1.ok"}
+        assert isinstance(out[1], Exception)
+        assert out[2] == {"sub": "n3.ok"}
+        assert cl.ping()
+    with VerifyClient(host, port, crc=True) as cl:
+        assert cl.verify_batch(["c1.ok"])[0] == {"sub": "c1.ok"}
+    # traced request: response echoes the trace id, spans recorded
+    with telemetry.recording() as rec:
+        s = socket.create_connection((host, port))
+        try:
+            rd = P.FrameReader(s)
+            P.send_request(s, ["tr.ok"], trace="ab12cd34ab12cd34")
+            ftype, entries, trace = rd.recv_frame_ex()
+            assert ftype == P.T_VERIFY_RESP_TRACE
+            assert trace == "ab12cd34ab12cd34"
+            assert entries[0][0] == 0
+        finally:
+            s.close()
+        # worker-side span + flight entry landed in the recorder
+        names = {sp["name"]
+                 for sp in rec.trace_spans("ab12cd34ab12cd34")}
+        assert telemetry.SPAN_WORKER_DEQUEUE in names
+        assert telemetry.SPAN_BATCHER_FILL in names
+
+
+@needs_native
+def test_native_pipelined_stream_order(native_worker):
+    host, port = native_worker.address
+    with VerifyClient(host, port) as cl:
+        batches = [[f"s{i}-{j}.ok" for j in range(8)] for i in range(40)]
+        outs = list(cl.verify_stream(iter(batches), depth=6))
+        assert len(outs) == len(batches)
+        for want, got in zip(batches, outs):
+            assert [r["sub"] for r in got] == want
+
+
+@needs_native
+def test_native_interleaved_control_ops_stay_in_order(native_worker):
+    """Verify → ping → stats → keys → verify on ONE connection: CVB1
+    responses must come back strictly in request order even though
+    verifies detour through the batcher and controls through the
+    drain loop."""
+    host, port = native_worker.address
+    s = socket.create_connection((host, port))
+    try:
+        rd = P.FrameReader(s)
+        P.send_request(s, ["ord1.ok"])
+        P.send_ping(s)
+        P.send_stats_request(s)
+        P.send_keys_push(s, {"keys": []}, epoch=9)
+        P.send_request(s, ["ord2.ok"])
+        ftype, entries = rd.recv_frame()
+        assert ftype == P.T_VERIFY_RESP and entries[0][0] == 0
+        assert rd.recv_frame()[0] == P.T_PONG
+        ftype, entries = rd.recv_frame()
+        assert ftype == P.T_STATS_RESP
+        stats = json.loads(entries[0][1])
+        assert stats["serve_chain"] == "native"
+        ftype, entries = rd.recv_frame()
+        assert ftype == P.T_KEYS_ACK and entries[0][0] == 0
+        assert json.loads(entries[0][1])["epoch"] == 9
+        ftype, entries = rd.recv_frame()
+        assert ftype == P.T_VERIFY_RESP and entries[0][0] == 0
+        assert native_worker.key_epoch == 9
+    finally:
+        s.close()
+
+
+@needs_native
+def test_native_malformed_frame_drops_connection_quietly(native_worker):
+    host, port = native_worker.address
+    before = native_worker._native.counters()[
+        "serve.native.protocol_errors"]
+    s = socket.create_connection((host, port))
+    try:
+        s.sendall(b"XXXX" + bytes(5))
+        s.settimeout(2.0)
+        assert s.recv(16) == b""        # dropped, nothing sent back
+    finally:
+        s.close()
+    # a GOOD connection still works (the bad one didn't wedge anything)
+    with VerifyClient(host, port) as cl:
+        assert cl.verify_batch(["still.ok"])[0] == {"sub": "still.ok"}
+    after = native_worker._native.counters()[
+        "serve.native.protocol_errors"]
+    assert after == before + 1
+
+
+@needs_native
+def test_native_concurrent_connections_no_cross_talk(native_worker):
+    host, port = native_worker.address
+    errs = []
+
+    def hammer(k):
+        try:
+            with VerifyClient(host, port) as cl:
+                for i in range(30):
+                    toks = [f"c{k}-{i}-{j}.ok" for j in range(4)]
+                    out = cl.verify_batch(toks)
+                    assert [r["sub"] for r in out] == toks
+        except Exception as e:  # noqa: BLE001
+            errs.append(f"{k}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=hammer, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+
+
+@needs_native
+def test_native_ring_depth_gauge_and_obs(native_worker):
+    gauges = native_worker._obs_gauges()
+    assert gauges["serve.native.active"] == 1.0
+    assert "serve.native.ring_depth" in gauges
+    st = native_worker.stats()
+    assert st["serve_chain"] == "native"
+    assert "serve.native.frames" in st["counters"]
+
+
+def test_python_chain_unaffected_by_default():
+    w = VerifyWorker(StubKeySet(), max_wait_ms=1.0)
+    try:
+        assert w.serve_chain == "python"
+        assert w._obs_gauges()["serve.native.active"] == 0.0
+        host, port = w.address
+        with VerifyClient(host, port) as cl:
+            assert cl.verify_batch(["py.ok"])[0] == {"sub": "py.ok"}
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_uds_transport_falls_back_to_python_chain(tmp_path):
+    """Fallback matrix: the native readers own TCP fds, so a UDS
+    worker keeps the Python chain even when native is requested."""
+    w = VerifyWorker(StubKeySet(), uds_path=str(tmp_path / "w.sock"),
+                     serve_native=True, max_wait_ms=1.0)
+    try:
+        assert w.serve_chain == "python"
+        with VerifyClient(uds_path=str(tmp_path / "w.sock")) as cl:
+            assert cl.verify_batch(["uds.ok"])[0] == {"sub": "uds.ok"}
+    finally:
+        w.close(deadline_s=10)
+
+
+# ---------------------------------------------------------------------------
+# build health: the native chain cannot die silently again (r11's
+# SHA-NI probe killed the whole .so on gcc<11 for five rounds)
+# ---------------------------------------------------------------------------
+
+def test_native_build_from_source_and_symbols_resolve(tmp_path):
+    import ctypes
+    import shutil
+
+    from cap_tpu import _build
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler on this host")
+    out = str(tmp_path / "libcapruntime_test.so")
+    _build._build_one(
+        (os.path.join("runtime", "native", "jose_native.cpp"),
+         os.path.join("runtime", "native", "serve_native.cpp")),
+        out, False, timeout=300.0, force=True)
+    assert os.path.exists(out), "native build produced no library"
+    lib = ctypes.CDLL(out)
+    for sym in ("cap_prepare_batch", "cap_sha_batch",
+                "cap_serve_create", "cap_serve_destroy",
+                "cap_serve_add_conn", "cap_serve_drain",
+                "cap_serve_post_results", "cap_serve_post_raw",
+                "cap_serve_probe_frame", "cap_serve_ring_depth",
+                "cap_serve_counter", "cap_bench_drive"):
+        assert hasattr(lib, sym), f"symbol {sym} missing"
+
+
+@needs_native
+def test_batcher_handoff_callback_runs_once_per_chunk():
+    from cap_tpu.serve.batcher import AdaptiveBatcher
+
+    calls = []
+    b = AdaptiveBatcher(StubKeySet(), target_batch=8, max_wait_ms=1.0)
+    try:
+        p = b.submit_handoff(["h1.ok", "h2.bad", "h3.ok"],
+                             on_done=lambda rs: calls.append(list(rs)))
+        p.event.wait(10)
+        assert len(calls) == 1
+        assert calls[0][0] == {"sub": "h1.ok"}
+        assert isinstance(calls[0][1], Exception)
+        # empty handoff: callback still fires, with []
+        b.submit_handoff([], on_done=lambda rs: calls.append(rs))
+        assert calls[1] == []
+    finally:
+        b.close(deadline_s=10)

@@ -11,6 +11,21 @@ Env knobs: CAP_SERVE_CLIENTS (32), CAP_SERVE_REQ_TOKENS (64),
 CAP_SERVE_SECONDS (12 per point), CAP_SERVE_WAITS ("1,5,20"),
 CAP_SERVE_TARGET_BATCH (8192).
 
+ZIPF TOKEN MIX (``CAP_SERVE_ZIPF=s``): request tokens are drawn from a
+Zipf(s) distribution over the unique pool instead of contiguous
+windows — the repeat-heavy traffic shape real ingress has (the same
+bearer token arriving hundreds of times inside its lifetime), and the
+measurement harness ROADMAP item #3's verdict cache needs.
+``CAP_SERVE_ZIPF_POOL=N`` bounds the sampled pool (the repeat-rate
+knob: smaller pool → higher repeat rate). The BENCH json reports
+tokens sent vs unique vs repeats under ``"zipf"``.
+
+SERVE-CHAIN COMPARISON (fleet mode, ``CAP_SERVE_CHAINS=
+"python,native"``): every fleet size runs once per listed chain
+(workers spawned with CAP_SERVE_NATIVE=0/1), and the headline gains
+``serve_native_vps`` / ``serve_python_vps`` and their ratio — the
+host-saturation A/B docs/PERF.md §Round 12 records.
+
 FLEET MODE (``CAP_SERVE_FLEET="1,2"``): instead of one in-process
 worker, spin a ``WorkerPool`` per listed size under the single-owner
 placement model (one worker process per device group — NO chip
@@ -52,8 +67,38 @@ def _quantile(sorted_vals, q):
     return sorted_vals[max(0, math.ceil(q * len(sorted_vals)) - 1)]
 
 
+def _zipf_cfg():
+    """(s, pool) from the env, or None — shipped to client procs."""
+    s = os.environ.get("CAP_SERVE_ZIPF")
+    if not s:
+        return None
+    return (float(s), int(os.environ.get("CAP_SERVE_ZIPF_POOL", 0)))
+
+
+def _zipf_picker(tokens, req_tokens, seed, zipf):
+    """Request generator state for the Zipf token mix: returns
+    ``pick() -> (token_list, index_array)``. Rank→token mapping is a
+    fixed permutation (seed-independent) so every client hammers the
+    SAME hot tokens — that is what makes the mix cacheable."""
+    import numpy as np
+
+    zs, pool = zipf
+    n = min(pool or len(tokens), len(tokens))
+    w = np.arange(1, n + 1, dtype=np.float64) ** -zs
+    cdf = np.cumsum(w)
+    cdf /= cdf[-1]
+    perm = np.random.RandomState(1234).permutation(len(tokens))[:n]
+    rng = np.random.RandomState(seed * 7919 + 17)
+
+    def pick():
+        idx = perm[np.searchsorted(cdf, rng.random_sample(req_tokens))]
+        return [tokens[i] for i in idx], idx
+
+    return pick
+
+
 def _client_proc(host, port, tokens, req_tokens, depth, start_at,
-                 seconds, seed, outq):
+                 seconds, seed, outq, zipf=None):
     """One client PROCESS: its own interpreter, so response decoding
     never shares the worker's (or other clients') GIL — in-process
     client threads cap the whole bench at one core of json parsing
@@ -68,16 +113,29 @@ def _client_proc(host, port, tokens, req_tokens, depth, start_at,
     t0s: deque = deque()
     lats = []
     done = 0
+    sent = 0
+    used = set()
+    picker = _zipf_picker(tokens, req_tokens, seed, zipf) if zipf \
+        else None
     while time.time() < start_at:
         time.sleep(0.005)
     deadline = time.time() + seconds
 
     def gen():
+        nonlocal sent
         rng = seed * 7919 + 17
         while time.time() < deadline:
+            t0s.append(time.perf_counter())
+            if picker is not None:
+                toks, idx = picker()
+                used.update(idx.tolist())
+                sent += len(toks)
+                yield toks
+                continue
             rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
             lo = rng % max(1, len(tokens) - req_tokens)
-            t0s.append(time.perf_counter())
+            sent += req_tokens
+            used.update(range(lo, lo + req_tokens))
             yield tokens[lo: lo + req_tokens]
 
     err = None
@@ -98,7 +156,7 @@ def _client_proc(host, port, tokens, req_tokens, depth, start_at,
         cl.close()
         # ALWAYS report, error or not — a silent child death would
         # stall the parent's collection for its full timeout
-        outq.put((done, lats, err))
+        outq.put((done, lats, err, sent, used))
 
 
 def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
@@ -111,6 +169,7 @@ def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
     worker = VerifyWorker(keyset, target_batch=target_batch,
                           max_wait_ms=max_wait_ms)
     host, port = worker.address
+    zipf = _zipf_cfg()
     # spawn (not fork): children must never inherit live TPU/jax state
     ctx = mp.get_context("spawn")
     outq = ctx.Queue()
@@ -118,18 +177,22 @@ def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
     procs = [ctx.Process(
         target=_client_proc,
         args=(host, port, tokens, req_tokens, depth, start_at,
-              seconds, i, outq), daemon=True)
+              seconds, i, outq, zipf), daemon=True)
         for i in range(n_clients)]
     for p in procs:
         p.start()
     total = 0
     lats = []
     errors = []
+    sent_total = 0
+    used_union: set = set()
     try:
         for _ in procs:
-            d, ls, err = outq.get(timeout=seconds + 300)
+            d, ls, err, sent, used = outq.get(timeout=seconds + 300)
             total += d
             lats.extend(ls)
+            sent_total += sent
+            used_union |= used
             if err:
                 errors.append(err)
         for p in procs:
@@ -140,21 +203,40 @@ def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
         raise RuntimeError(f"client processes failed: {errors[:3]}")
 
     lats.sort()
-    return {
+    pt = {
         "max_wait_ms": max_wait_ms,
         "clients": n_clients,
         "req_tokens": req_tokens,
         "pipeline_depth": depth,
+        "serve_chain": worker.serve_chain,
         "throughput": round(total / seconds, 1),
         "requests": len(lats),
         "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
         "p95_ms": round(_quantile(lats, 0.95) * 1e3, 1),
         "p99_ms": round(_quantile(lats, 0.99) * 1e3, 1),
     }
+    pt.update(_mix_fields(zipf, sent_total, used_union))
+    return pt
+
+
+def _mix_fields(zipf, sent_total: int, used_union: set) -> dict:
+    """Unique-vs-repeat accounting for the BENCH json (exact: the
+    union of every client's sampled indices)."""
+    unique = len(used_union)
+    out = {
+        "tokens_sent": sent_total,
+        "tokens_unique": unique,
+        "tokens_repeat": max(0, sent_total - unique),
+        "repeat_rate": (round(1.0 - unique / sent_total, 4)
+                        if sent_total else None),
+    }
+    if zipf:
+        out["zipf_s"], out["zipf_pool"] = zipf[0], zipf[1] or None
+    return out
 
 
 def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
-                       seed, outq):
+                       seed, outq, zipf=None):
     """One closed-loop FleetClient PROCESS (own interpreter)."""
     from cap_tpu.fleet import FleetClient
 
@@ -162,6 +244,10 @@ def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
                      total_deadline=120.0)
     lats = []
     done = 0
+    sent = 0
+    used = set()
+    picker = _zipf_picker(tokens, req_tokens, seed, zipf) if zipf \
+        else None
     rng = seed * 7919 + 17
     while time.time() < start_at:
         time.sleep(0.005)
@@ -169,10 +255,17 @@ def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
     err = None
     try:
         while time.time() < deadline:
-            rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
-            lo = rng % max(1, len(tokens) - req_tokens)
+            if picker is not None:
+                toks, idx = picker()
+                used.update(idx.tolist())
+            else:
+                rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
+                lo = rng % max(1, len(tokens) - req_tokens)
+                toks = tokens[lo: lo + req_tokens]
+                used.update(range(lo, lo + req_tokens))
+            sent += len(toks)
             t0 = time.perf_counter()
-            out = cl.verify_batch(tokens[lo: lo + req_tokens])
+            out = cl.verify_batch(toks)
             lats.append(time.perf_counter() - t0)
             bad = sum(1 for r in out if isinstance(r, Exception))
             assert bad == 0, f"unexpected failures: {bad}"
@@ -180,43 +273,118 @@ def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
     except BaseException as e:  # noqa: BLE001 - reported to the parent
         err = f"{type(e).__name__}: {e}"
     finally:
-        outq.put((done, lats, err))
+        outq.put((done, lats, err, sent, used))
+
+
+def _native_drive(endpoints, tokens, req_tokens, seconds, n_clients,
+                  depth=32):
+    """Drive every endpoint with the NATIVE closed-loop driver
+    (cap_bench_drive: pipelined plain CVB1 frames, sent and parsed in
+    C threads) — client cost leaves the measurement, so the number is
+    the fleet's serve capacity, not the Python client chain's
+    (CAP_SERVE_DRIVER=native)."""
+    import ctypes
+    import threading
+
+    import numpy as np
+
+    from cap_tpu.serve import native_serve
+
+    lib = native_serve.load()
+    encoded = [t.encode() for t in tokens]
+    blob = np.frombuffer(b"".join(encoded), np.uint8)
+    offs = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offs[1:])
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    conns_per = max(1, n_clients // max(1, len(endpoints)))
+    outs = []
+
+    def drive(host, port):
+        out_tokens = np.zeros(1, np.int64)
+        out_reqs = np.zeros(1, np.int64)
+        lib.cap_bench_drive(            # releases the GIL for the run
+            host.encode(), port, blob.ctypes.data_as(u8p),
+            offs.ctypes.data_as(i64p), len(encoded), req_tokens,
+            depth, seconds, conns_per,
+            out_tokens.ctypes.data_as(i64p),
+            out_reqs.ctypes.data_as(i64p))
+        outs.append((int(out_tokens[0]), int(out_reqs[0])))
+
+    threads = [threading.Thread(target=drive, args=ep, daemon=True)
+               for ep in endpoints]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return (sum(o[0] for o in outs), sum(o[1] for o in outs))
 
 
 def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
                     n_clients: int, req_tokens: int, seconds: float,
-                    max_wait_ms: float, target_batch: int) -> dict:
-    """Throughput of an n-worker fleet under single-owner placement."""
+                    max_wait_ms: float, target_batch: int,
+                    serve_chain=None) -> dict:
+    """Throughput of an n-worker fleet under single-owner placement.
+
+    serve_chain: None (inherit the environment) or "python"/"native" —
+    workers spawn with CAP_SERVE_NATIVE forced accordingly, for the
+    chain A/B the §Round 12 host-saturation comparison needs."""
     import multiprocessing as mp
 
     from cap_tpu.fleet import WorkerPool
 
+    env_extra = {}
+    if serve_chain is not None:
+        env_extra["CAP_SERVE_NATIVE"] = \
+            "1" if serve_chain == "native" else "0"
+    # CAP_SERVE_TELEMETRY=0: workers run with the observability layer
+    # off — isolates the serve chain in the A/B (decision accounting
+    # costs the same on both chains and dominates once the native
+    # chain is on; PERF.md §Round 12)
+    if os.environ.get("CAP_SERVE_TELEMETRY", "1") == "0":
+        env_extra["CAP_FLEET_TELEMETRY"] = "0"
     pool = WorkerPool(n_workers, keyset_spec=keyset_spec,
                       target_batch=target_batch, max_wait_ms=max_wait_ms,
-                      ping_interval=1.0)
+                      ping_interval=1.0, env_extra=env_extra)
     try:
         if not pool.wait_all_ready(120.0):
             raise RuntimeError("fleet did not come up")
         endpoints = sorted(pool.endpoints().values())
-        ctx = mp.get_context("spawn")
-        outq = ctx.Queue()
-        start_at = time.time() + max(4.0, n_clients * 0.15)
-        procs = [ctx.Process(
-            target=_fleet_client_proc,
-            args=(endpoints, tokens, req_tokens, start_at, seconds, i,
-                  outq), daemon=True)
-            for i in range(n_clients)]
-        for p in procs:
-            p.start()
+        chains = pool.serve_chains()
+        zipf = _zipf_cfg()
+        driver = os.environ.get("CAP_SERVE_DRIVER", "python")
         total, lats, errors = 0, [], []
-        for _ in procs:
-            d, ls, err = outq.get(timeout=seconds + 300)
-            total += d
-            lats.extend(ls)
-            if err:
-                errors.append(err)
-        for p in procs:
-            p.join(timeout=30)
+        sent_total = 0
+        used_union: set = set()
+        if driver == "native":
+            # C closed-loop drivers: measures fleet SERVE capacity
+            # (no request-latency quantiles — the driver counts, it
+            # does not time individual requests)
+            total, _n_req = _native_drive(endpoints, tokens,
+                                          req_tokens, seconds,
+                                          n_clients)
+            sent_total = total
+        else:
+            ctx = mp.get_context("spawn")
+            outq = ctx.Queue()
+            start_at = time.time() + max(4.0, n_clients * 0.15)
+            procs = [ctx.Process(
+                target=_fleet_client_proc,
+                args=(endpoints, tokens, req_tokens, start_at, seconds,
+                      i, outq, zipf), daemon=True)
+                for i in range(n_clients)]
+            for p in procs:
+                p.start()
+            for _ in procs:
+                d, ls, err, sent, used = outq.get(timeout=seconds + 300)
+                total += d
+                lats.extend(ls)
+                sent_total += sent
+                used_union |= used
+                if err:
+                    errors.append(err)
+            for p in procs:
+                p.join(timeout=30)
         if errors:
             raise RuntimeError(f"fleet clients failed: {errors[:3]}")
         merged = pool.stats_merged()
@@ -227,11 +395,15 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
     finally:
         pool.close()
     lats.sort()
-    return {
+    pt = {
         "n_workers": n_workers,
         "keyset_spec": keyset_spec,
         "clients": n_clients,
         "req_tokens": req_tokens,
+        # what each worker ANNOUNCED on its ready line (ground truth:
+        # a native request that fell back shows up as python here)
+        "serve_chains": {str(w): c for w, c in sorted(chains.items())},
+        "driver": driver,
         "throughput": round(total / seconds, 1),
         "requests": len(lats),
         "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
@@ -253,6 +425,8 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
             "respawns": agg["restarts"],
         },
     }
+    pt.update(_mix_fields(zipf, sent_total, used_union))
+    return pt
 
 
 def fleet_main() -> None:
@@ -271,21 +445,32 @@ def fleet_main() -> None:
     max_wait_ms = float(os.environ.get("CAP_SERVE_WAITS", "2").split(",")[0])
     target_batch = int(os.environ.get("CAP_SERVE_TARGET_BATCH", 8192))
     if keyset_spec.startswith("stub"):
-        tokens = [f"bench-{i:06d}.ok" for i in range(16384)]
+        # constant first segment: stub tokens model real traffic's
+        # few-distinct-JOSE-headers shape (decision family attribution
+        # caches by header segment; one unique segment per token would
+        # be a pathological workload no IdP produces)
+        tokens = [f"bench.{i:06d}.ok" for i in range(16384)]
     else:
         from cap_tpu import testing as T
 
         _, tokens = T.headline_fixtures(16384)
 
+    # serve-chain A/B: run every size once per listed chain (empty →
+    # one run inheriting the environment's CAP_SERVE_NATIVE)
+    chains = [c for c in os.environ.get(
+        "CAP_SERVE_CHAINS", "").split(",") if c] or [None]
     points = []
     for n in sizes:
-        pt = run_fleet_point(n, keyset_spec, tokens, n_clients,
-                             req_tokens, seconds, max_wait_ms,
-                             target_batch)
-        points.append(pt)
-        print(f"fleet n={n}  thr={pt['throughput']:>9.0f}/s  "
-              f"p50={pt['p50_ms']:6.1f}ms p99={pt['p99_ms']:7.1f}ms  "
-              f"per-worker={pt['per_worker_tokens']}", file=sys.stderr)
+        for chain in chains:
+            pt = run_fleet_point(n, keyset_spec, tokens, n_clients,
+                                 req_tokens, seconds, max_wait_ms,
+                                 target_batch, serve_chain=chain)
+            points.append(pt)
+            print(f"fleet n={n} chain={chain or 'env'}  "
+                  f"thr={pt['throughput']:>9.0f}/s  "
+                  f"p50={pt['p50_ms']:6.1f}ms p99={pt['p99_ms']:7.1f}ms  "
+                  f"per-worker={pt['per_worker_tokens']}",
+                  file=sys.stderr)
 
     best = max(points, key=lambda p: p["throughput"])
     smallest = min(points, key=lambda p: p["n_workers"])
@@ -320,12 +505,26 @@ def fleet_main() -> None:
         ]
     except Exception as e:  # noqa: BLE001 - advisory field
         slo_results = [{"error": repr(e)}]
+    def _chain_best(name):
+        vals = [p["throughput"] for p in points
+                if set((p.get("serve_chains") or {}).values()) == {name}]
+        return max(vals) if vals else None
+
+    native_vps = _chain_best("native")
+    python_vps = _chain_best("python")
     print(json.dumps({
         "metric": "serve_fleet_verifies_per_sec",
         "value": best["throughput"],
         "unit": "verifies/sec",
         "p99_request_latency_ms": best["p99_ms"],
         "fleet_scaling_vs_smallest": scaling,
+        # chain A/B headline (None unless both chains were run):
+        # native-chain best vs python-chain best across the sweep
+        "serve_native_vps": native_vps,
+        "serve_python_vps": python_vps,
+        "chain_speedup_native_vs_python": (
+            round(native_vps / python_vps, 3)
+            if native_vps and python_vps else None),
         "placement_model": "single-owner-per-device",
         # Pool-side supervision attribution for the whole sweep:
         # respawn/crash/hung counters + health-ping latency quantiles.

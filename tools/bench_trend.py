@@ -22,6 +22,16 @@ published weather, not tracked — see docs/PERF.md on stalls):
 - ``resident_mldsa44_vps`` — post-quantum engine rate (ML-DSA-44
                            resident lanes), tracked from round 11 on
 
+A second series, ``BENCH_SERVE_r*.json`` (the serve-chain records
+tools/bench_stages.py + bench_serve.py produce, committed from round
+12 on), tracks the native serve chain:
+
+- ``serve_native_vps``          — native-chain single-worker serve
+                                  rate, device stubbed (higher better)
+- ``stage_python_us_per_token`` — Python-side serial cost per served
+                                  token with the native chain on
+                                  (LOWER is better — inverted check)
+
 MULTICHIP records are checked structurally: the latest round must
 still report ``ok`` (rc 0) on the same-or-larger device count.
 
@@ -53,6 +63,9 @@ THRESHOLD = 0.10          # >10% below best-of-window = regression
 WINDOW = 3                # best of the last 3 preceding rounds
 TRACKED = ("value", "value_peak", "resident_mixed_vps", "serve_fleet",
            "resident_mldsa44_vps")
+# serve-chain series (BENCH_SERVE_r*.json): metric → higher_is_better
+SERVE_TRACKED = {"serve_native_vps": True,
+                 "stage_python_us_per_token": False}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -90,6 +103,55 @@ def load_multichip(repo: str = REPO) -> List[Tuple[int, Dict[str, Any]]]:
         except (OSError, ValueError):
             continue
     return sorted(out)
+
+
+def load_serve_series(repo: str = REPO) -> List[Tuple[int,
+                                                      Dict[str, Any]]]:
+    """[(round, record)] for every BENCH_SERVE_rNN.json, in order."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_SERVE_r*.json"))):
+        m = re.search(r"BENCH_SERVE_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                out.append((int(m.group(1)), json.load(f)))
+        except (OSError, ValueError):
+            continue
+    return sorted(out)
+
+
+def check_serve_series(series: List[Tuple[int, Dict[str, Any]]],
+                       threshold: float = THRESHOLD,
+                       window: int = WINDOW) -> List[str]:
+    """Regressions in the serve-chain series; handles the
+    lower-is-better metric by inverting the comparison."""
+    if len(series) < 2:
+        return []
+    latest_round, latest = series[-1]
+    prior = series[:-1][-window:]
+    findings = []
+    for metric, higher in SERVE_TRACKED.items():
+        vals = [(rnd, d.get(metric)) for rnd, d in prior
+                if isinstance(d.get(metric), (int, float))]
+        if not vals:
+            continue
+        best_round, best = (max(vals, key=lambda t: t[1]) if higher
+                            else min(vals, key=lambda t: t[1]))
+        now = latest.get(metric)
+        if not isinstance(now, (int, float)):
+            findings.append(
+                f"SERVE r{latest_round:02d}: tracked metric {metric!r} "
+                f"disappeared (best r{best_round:02d}={best:.3f})")
+            continue
+        drop = (1.0 - now / best) if higher else (now / best - 1.0)
+        if drop > threshold:
+            findings.append(
+                f"SERVE r{latest_round:02d}: {metric} = {now:.3f}, "
+                f"{drop * 100:.1f}% worse than best-of-last-"
+                f"{len(prior)} (r{best_round:02d}={best:.3f})")
+    return findings
 
 
 def metric_value(parsed: Dict[str, Any], metric: str) -> Optional[float]:
@@ -215,6 +277,22 @@ def selftest(repo: str = REPO) -> List[str]:
     gone.append((3, {"value_peak": 5.0}))
     if not any("disappeared" in f for f in check_series(gone)):
         problems.append("vanished tracked metric NOT flagged")
+    # 4b. serve series: higher-is-better drop and lower-is-better RISE
+    #     must both flag; a clean pair must not
+    sv = [(11, {"serve_native_vps": 1e6,
+                "stage_python_us_per_token": 0.8}),
+          (12, {"serve_native_vps": 1e6,
+                "stage_python_us_per_token": 0.8})]
+    if check_serve_series(sv):
+        problems.append("flat serve series flagged")
+    if not check_serve_series(
+            [sv[0], (12, {"serve_native_vps": 0.8e6,
+                          "stage_python_us_per_token": 0.8})]):
+        problems.append("serve vps regression NOT flagged")
+    if not check_serve_series(
+            [sv[0], (12, {"serve_native_vps": 1e6,
+                          "stage_python_us_per_token": 1.0})]):
+        problems.append("us/token REGRESSION (rise) NOT flagged")
     # 5. the REAL series with a 15% regression injected into a copy of
     #    the newest record: must flag (the acceptance-bar case)
     real = load_series(repo)
@@ -268,7 +346,9 @@ def main(argv=None) -> int:
         return 1
     findings = (check_series(series, threshold=args.threshold)
                 + check_multichip(load_multichip(args.repo))
-                + check_self_describing(series))
+                + check_self_describing(series)
+                + check_serve_series(load_serve_series(args.repo),
+                                     threshold=args.threshold))
     rounds = ", ".join(f"r{r:02d}" for r, _ in series)
     if findings:
         for f in findings:

@@ -176,7 +176,14 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
         extra = data.get("extra") or {}
         counters = snap.get("counters") or {}
         epoch = extra.get("keyplane.epoch")
+        # serve.native.active gauge: 1.0 = native C++ serve chain,
+        # 0.0 = pure-Python chain (absent on pre-native workers)
+        chain = extra.get("serve.native.active")
+        ring = extra.get("serve.native.ring_depth")
         lines.append(f"worker {ep}  pid={int(extra.get('worker.pid', 0))}"
+                     + (f"  chain={'native' if chain else 'python'}"
+                        if chain is not None else "")
+                     + (f"  ring={int(ring)}" if ring is not None else "")
                      + (f"  epoch={int(epoch)}" if epoch is not None
                         else "")
                      + f"  queued={int(extra.get('batcher.queued_tokens', 0))}"
