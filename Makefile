@@ -32,8 +32,9 @@ all: native
 
 native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 
-$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp $(NATIVE_DIR)/serve_native.cpp
-	$(CXX) $(CXXFLAGS) -o $@ $^
+$(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp $(NATIVE_DIR)/serve_native.cpp \
+		$(NATIVE_DIR)/telemetry_native.cpp $(NATIVE_DIR)/telemetry_native.h
+	$(CXX) $(CXXFLAGS) -o $@ $(filter %.cpp,$^)
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
 	$(CXX) $(CXXFLAGS) -o $@ $<
@@ -49,7 +50,9 @@ native-build:
 	$(PYTHON) -c "import ctypes; lib = ctypes.CDLL('$(NATIVE_SO)'); \
 	  [getattr(lib, s) for s in ('cap_prepare_batch', 'cap_serve_create', \
 	   'cap_serve_add_conn', 'cap_serve_drain', 'cap_serve_post_results', \
-	   'cap_serve_probe_frame', 'cap_bench_drive')]; \
+	   'cap_serve_probe_frame', 'cap_bench_drive', 'cap_tel_create', \
+	   'cap_tel_fold', 'cap_serve_post_results_tel', \
+	   'cap_serve_ring_hwm')]; \
 	  ctypes.CDLL('$(CLIENT_SO)').cap_client_connect; \
 	  print('native-build: all serve-native symbols resolve')"
 

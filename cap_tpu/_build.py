@@ -30,13 +30,14 @@ _FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
 EXT_NAME = "_capclaims" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
 
 # (sources, output, needs_python_headers) — paths relative to
-# cap_tpu/. libcapruntime.so is built from TWO translation units:
-# jose_native.cpp (batch JOSE prep) and serve_native.cpp (the GIL-free
-# serve chain) — one .so, so the serve binding and the prep binding
-# load the same library.
+# cap_tpu/. libcapruntime.so is built from THREE translation units:
+# jose_native.cpp (batch JOSE prep), serve_native.cpp (the GIL-free
+# serve chain), and telemetry_native.cpp (the native telemetry
+# plane) — one .so, so every binding loads the same library.
 _TARGETS = [
     ((os.path.join("runtime", "native", "jose_native.cpp"),
-      os.path.join("runtime", "native", "serve_native.cpp")),
+      os.path.join("runtime", "native", "serve_native.cpp"),
+      os.path.join("runtime", "native", "telemetry_native.cpp")),
      os.path.join("runtime", "native", "libcapruntime.so"), False),
     ((os.path.join("serve", "native", "client_native.cpp"),),
      os.path.join("serve", "native", "libcapclient.so"), False),
@@ -52,9 +53,13 @@ def _build_one(sources, out: str, py_headers: bool,
     out = os.path.join(_PKG, out)
     if not srcs:
         return
+    # headers shared between the TUs count toward staleness too
+    deps = srcs + [h for s in srcs
+                   for h in [os.path.splitext(s)[0] + ".h"]
+                   if os.path.exists(h)]
     if not force and os.path.exists(out) and \
             os.path.getmtime(out) >= max(os.path.getmtime(s)
-                                         for s in srcs):
+                                         for s in deps):
         return
     cmd = ["g++", *_FLAGS]
     # -march=native when the compiler supports it (portable fallback

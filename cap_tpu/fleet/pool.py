@@ -112,7 +112,8 @@ class WorkerPool:
                  env_extra: Optional[Dict[str, str]] = None,
                  postmortem_dir: Optional[str] = None,
                  postmortem_interval: float = 1.0,
-                 keys_push_timeout: float = 30.0):
+                 keys_push_timeout: float = 30.0,
+                 serve_chain: Optional[str] = None):
         if placements is None:
             placements = single_owner_placement(
                 n_workers, n_devices if n_devices is not None else n_workers,
@@ -127,6 +128,10 @@ class WorkerPool:
                              "--max-wait-ms", str(max_wait_ms),
                              "--max-batch", str(max_batch),
                              "--drain-deadline-s", str(drain_grace)]
+        if serve_chain is not None:
+            # explicit chain selection ("native"/"python"/"auto") —
+            # the ready line still reports what actually came up
+            self._worker_args += ["--serve-chain", serve_chain]
         self._ping_interval = ping_interval
         self._ping_timeout = ping_timeout
         self._hung_after = hung_after

@@ -206,6 +206,12 @@ def main(argv=None) -> int:
     # the ready line's serve_chain= field reports what actually runs.
     ap.add_argument("--serve-chain", default="auto",
                     choices=["auto", "native", "python"])
+    # Native telemetry plane: "auto" (on whenever the native chain and
+    # telemetry are both on — CAP_SERVE_NATIVE_OBS in the environment
+    # wins) or "off" (force the Python decision fold; the A/B knob
+    # tools/bench_stages.py measures the obs-overhead table with).
+    ap.add_argument("--native-obs", default="auto",
+                    choices=["auto", "off"])
     # Crash postmortems: checkpoint telemetry to this path on a timer
     # and on SIGTERM drain, so the pool can collect a ≤interval-stale
     # document even after kill -9. Empty = disabled. The pool passes
@@ -226,6 +232,8 @@ def main(argv=None) -> int:
     # the tradeoff; the STATS op then serves structural fields only).
     if os.environ.get("CAP_FLEET_TELEMETRY", "1") != "0":
         telemetry.enable()           # STATS op serves real numbers
+    if args.native_obs == "off":
+        os.environ["CAP_SERVE_NATIVE_OBS"] = "0"
     keyset = make_keyset(args.keyset)
     serve_native = (None if args.serve_chain == "auto"
                     else args.serve_chain == "native")

@@ -71,6 +71,38 @@ REASON_CLASSES = frozenset({
     REASON_TRANSPORT, REASON_INTERNAL,
 })
 
+# FIXED-ORDER index form of the registry: the native telemetry plane
+# (runtime/native/telemetry_native.cpp) counts by INDEX in a plain C
+# struct region and the binding maps indices back to these names at
+# scrape time. Order is part of the native ABI — append-only; the
+# layout handshake in native_serve disables the plane on length drift.
+REASON_INDEX = (
+    REASON_MALFORMED, REASON_NOT_SIGNED, REASON_BAD_SIGNATURE,
+    REASON_UNKNOWN_KID, REASON_UNSUPPORTED_ALG, REASON_EXPIRED,
+    REASON_INVALID_CLAIMS, REASON_JWKS_ERROR, REASON_OIDC_FLOW,
+    REASON_TRANSPORT, REASON_INTERNAL,
+)
+_REASON_TO_INDEX = {r: i for i, r in enumerate(REASON_INDEX)}
+
+# classify() resolved per exception TYPE (one dict hit on the reject
+# path instead of an MRO walk per token). RemoteVerifyError is never
+# cached: its reason depends on the MESSAGE head, not the type.
+_REASON_IDX_BY_TYPE: Dict[type, int] = {}
+
+
+def reason_index(err: BaseException) -> int:
+    """Index of ``classify(err)`` in :data:`REASON_INDEX` (cached by
+    exception type; the native fold consumes the index directly)."""
+    t = type(err)
+    if t.__name__ == "RemoteVerifyError":
+        return _REASON_TO_INDEX[classify(err)]
+    idx = _REASON_IDX_BY_TYPE.get(t)
+    if idx is None:
+        idx = _REASON_TO_INDEX[classify(err)]
+        if len(_REASON_IDX_BY_TYPE) < 1024:
+            _REASON_IDX_BY_TYPE[t] = idx
+    return idx
+
 # Exception CLASS NAME -> reason. Keyed by name (not type) so the
 # classifier needs no imports from the crypto-dependent modules and so
 # a wire-roundtripped error ("InvalidSignatureError: ...") classifies
@@ -176,7 +208,11 @@ _HDR_LOCK = threading.Lock()
 
 
 def family_for_alg(alg: Optional[str]) -> str:
-    if not alg:
+    # non-string alg values (e.g. a crafted header {"alg": 5}) must
+    # classify, not raise — a TypeError here used to escape through
+    # record_batch into the serve responder (found by the native-plane
+    # parity sweep's adversarial corpus)
+    if not alg or not isinstance(alg, str):
         return "unknown"
     if alg == "EdDSA":
         return "ed"
@@ -246,6 +282,20 @@ def latency_bucket(latency_s: Optional[float]) -> str:
         if latency_s < bound:
             return label
     return "ge1s"
+
+
+# Fixed-order label table for the native plane (index form of
+# latency_bucket; pinned against it by test).
+LAT_BUCKET_INDEX = ("lt1ms", "lt10ms", "lt100ms", "lt1s", "ge1s", "na")
+
+
+def latency_bucket_index(latency_s: Optional[float]) -> int:
+    if latency_s is None:
+        return 5
+    for i, (bound, _label) in enumerate(_LAT_BUCKETS):
+        if latency_s < bound:
+            return i
+    return 4
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +427,30 @@ def record_batch(surface: str, results: Sequence[Any],
     for reason, idxs in reject_groups.items():
         bulk(f"decision.{surface}.reject.{reason}", idxs, "reject",
              reason)
+
+
+def entry_from_exemplar(key: int, fam_idx: int, lat_idx: int,
+                        kid: Optional[str],
+                        trace: Optional[str]) -> Dict[str, Any]:
+    """One ring entry from a native-plane exemplar record.
+
+    ``key`` is 0 for accept, ``1 + reason_index`` for a reject — the
+    fields come out exactly as :func:`record_batch`'s ``bulk`` builds
+    them (the fuzz parity sweep pins the two paths entry-for-entry).
+    """
+    entry: Dict[str, Any] = {
+        "surface": "serve",
+        "family": FAMILIES[fam_idx],
+        "verdict": "accept" if key == 0 else "reject",
+        "lat": LAT_BUCKET_INDEX[lat_idx],
+    }
+    if key:
+        entry["reason"] = REASON_INDEX[key - 1]
+    if kid:
+        entry["kid"] = kid
+    if trace:
+        entry["trace"] = trace
+    return _checked_entry(entry)
 
 
 def record_one(surface: str, result: Any, token: Optional[str] = None,

@@ -71,17 +71,24 @@ def build_postmortem(reason: str,
     }
     if t_start is not None:
         doc["uptime_s"] = round(time.time() - t_start, 3)
+    snap = None
     if stats_fn is not None:
         try:
             stats = dict(stats_fn())
-            stats.pop("snapshot", None)   # carried below, once
+            # the stats snapshot is the worker's MERGED view (recorder
+            # + native telemetry plane, serve.worker.stats) — prefer
+            # it over the bare recorder so natively-counted decisions
+            # survive into the document (carried once, below)
+            snap = stats.pop("snapshot", None)
             doc["stats"] = stats
         except Exception as e:  # noqa: BLE001 - keep checkpointing
             doc["stats_error"] = repr(e)[:_MAX_STR]
     if rec is not None:
-        doc["snapshot"] = rec.snapshot()
+        doc["snapshot"] = snap if snap else rec.snapshot()
         doc["flight"] = rec.flight_slowest(_FLIGHT_KEEP)
         doc["decisions"] = rec.decisions()
+    elif snap:
+        doc["snapshot"] = snap
     return _scrub(doc)
 
 

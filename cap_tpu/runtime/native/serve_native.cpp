@@ -56,6 +56,8 @@
 #include <sys/types.h>
 #include <unistd.h>
 
+#include "telemetry_native.h"
+
 namespace serve_native {
 
 // ---------------------------------------------------------------------------
@@ -363,6 +365,11 @@ struct Req {
   double t_recv = 0.0;
   std::vector<int64_t> offs;  // entry boundaries into blob (n+1)
   std::string blob;           // concatenated entry bytes
+  // telemetry plane (when attached): per-token family index (-1 =
+  // header-cache miss, resolved by Python on the drain path) and
+  // hashed kid, classified by THIS reader thread at parse time.
+  std::vector<int8_t> fams;
+  std::string kids;  // 12 bytes per token, zero = none
 };
 
 // counter slots (cap_serve_counter)
@@ -380,7 +387,17 @@ enum {
 struct Handle {
   MpscRing ring;
   std::atomic<int64_t> queued_tokens{0};
+  // burst visibility: the highest queued_tokens seen between scrapes
+  // (drain-time sampling misses bursts; cap_serve_ring_hwm resets it)
+  std::atomic<int64_t> ring_hwm{0};
   int64_t max_queued_tokens;
+  // native telemetry plane (nullable; cap_serve_set_telemetry). Owned
+  // by this handle once attached — freed together in destroy.
+  cap_tel::TelPlane* tel = nullptr;
+  // per-token (fam, kid) of the LAST drain call, in drain order —
+  // cap_serve_drain_aux copies them out; single-consumer like carry.
+  std::vector<int8_t> last_fams;
+  std::vector<uint8_t> last_kids;
   std::mutex mu;  // guards the two cvs' sleep/wake protocol
   std::condition_variable cv_data;   // drain thread sleeps here
   std::condition_variable cv_space;  // producers sleep here when full
@@ -433,7 +450,14 @@ static bool push_req(Handle* h, Req* r, int64_t ntok) {
     if (h->queued_tokens.load(std::memory_order_relaxed) <=
             h->max_queued_tokens &&
         h->ring.try_push(r)) {
-      h->queued_tokens.fetch_add(ntok, std::memory_order_relaxed);
+      int64_t now =
+          h->queued_tokens.fetch_add(ntok, std::memory_order_relaxed) +
+          ntok;
+      int64_t hwm = h->ring_hwm.load(std::memory_order_relaxed);
+      while (now > hwm &&
+             !h->ring_hwm.compare_exchange_weak(
+                 hwm, now, std::memory_order_relaxed)) {
+      }
       std::lock_guard<std::mutex> lk(h->mu);
       h->cv_data.notify_one();
       return true;
@@ -523,6 +547,24 @@ static void reader_main(std::shared_ptr<Conn> c) {
       for (size_t i = 0; i < nent; i++)
         std::memcpy(&r->blob[(size_t)r->offs[i]], base + p.entries[i].off,
                     (size_t)p.entries[i].len);
+      if (h->tel && r->kind == K_VERIFY) {
+        // classify each token's family here, GIL-free, while the
+        // frame bytes are cache-hot: header segment = bytes before
+        // the first '.' (token.split(".", 1)[0], byte-for-byte)
+        r->fams.resize(nent);
+        r->kids.assign(nent * cap_tel::KID_LEN, '\0');
+        for (size_t i = 0; i < nent; i++) {
+          const uint8_t* tok = base + p.entries[i].off;
+          int64_t tlen = p.entries[i].len;
+          const uint8_t* dot =
+              (const uint8_t*)std::memchr(tok, '.', (size_t)tlen);
+          int64_t slen = dot ? (int64_t)(dot - tok) : tlen;
+          int32_t kid_len = 0;
+          r->fams[i] = (int8_t)cap_tel::classify(
+              h->tel, tok, slen,
+              (uint8_t*)&r->kids[i * cap_tel::KID_LEN], &kid_len);
+        }
+      }
       int64_t ntok = r->kind == K_VERIFY ? (int64_t)nent : 1;
       if (r->kind == K_VERIFY) h->ctr[CTR_TOKENS].fetch_add(nent);
       if (!push_req(h, r, ntok)) {
@@ -684,6 +726,10 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
   bool have = false;
   int64_t n_reqs = 0, n_toks = 0, blob_used = 0;
   tok_off[0] = 0;
+  if (h->tel) {
+    h->last_fams.clear();
+    h->last_kids.clear();
+  }
   bool stop_drain = false;
   while (!stop_drain) {
     Req* r = h->carry;
@@ -737,6 +783,20 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
     if (r->trace_len)
       std::memcpy(trace_buf + (size_t)n_reqs * MAX_TRACE_BYTES, r->trace,
                   r->trace_len);
+    if (h->tel) {
+      // keep token-aligned (fam, kid) for cap_serve_drain_aux —
+      // control entries get filler slots so offsets line up
+      if (r->kind == K_VERIFY && (int64_t)r->fams.size() == nent) {
+        h->last_fams.insert(h->last_fams.end(), r->fams.begin(),
+                            r->fams.end());
+        h->last_kids.insert(h->last_kids.end(), r->kids.begin(),
+                            r->kids.end());
+      } else {
+        h->last_fams.insert(h->last_fams.end(), (size_t)nent, -1);
+        h->last_kids.insert(h->last_kids.end(),
+                            (size_t)nent * cap_tel::KID_LEN, 0);
+      }
+    }
     int64_t consumed = r->kind == K_VERIFY ? nent : 1;
     h->queued_tokens.fetch_sub(consumed, std::memory_order_relaxed);
     n_reqs++;
@@ -759,16 +819,29 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
 
 // Post one drained span's verdicts: per request, encode the response
 // frame (plain / checksummed / traced mirrors the request type) and
-// hand it to the connection's writer at the request's seq.
-int32_t cap_serve_post_results(void* hv, const int32_t* req_meta,
-                               const int64_t* req_seq,
-                               const uint8_t* trace_buf, int32_t n_reqs,
-                               const uint8_t* statuses,
-                               const uint8_t* payload_blob,
-                               const int64_t* payload_off) {
-  Handle* h = (Handle*)hv;
+// hand it to the connection's writer at the request's seq. When the
+// telemetry plane is attached and fold args are provided, the SAME
+// walk folds the chunk's decisions (cap_tel::fold) and observes
+// per-request latency — accounting rides the encode, not a Python
+// side trip.
+static int32_t post_results_impl(Handle* h, const int32_t* req_meta,
+                                 const int64_t* req_seq,
+                                 const uint8_t* trace_buf,
+                                 const double* req_t0, int32_t n_reqs,
+                                 const uint8_t* statuses,
+                                 const uint8_t* payload_blob,
+                                 const int64_t* payload_off,
+                                 const uint8_t* reasons,
+                                 const int8_t* fams,
+                                 const uint8_t* kids,
+                                 int32_t lat_idx, bool do_fold) {
   int64_t t = 0;
   int32_t dropped = 0;
+  double now = (do_fold && req_t0) ? wall_now() : 0.0;
+  // the chunk's trace id: the first traced request's, exactly like
+  // the drain loop's traces[0] on the Python side
+  const uint8_t* fold_trace = nullptr;
+  int32_t fold_trace_len = 0;
   for (int32_t i = 0; i < n_reqs; i++) {
     const int32_t* m = req_meta + i * 6;
     int32_t conn_id = m[1];
@@ -799,6 +872,15 @@ int32_t cap_serve_post_results(void* hv, const int32_t* req_meta,
     }
     if (crc) append_crc(frame);
     t += ntok;
+    if (do_fold) {
+      if (!fold_trace && m[4] > 0) {
+        fold_trace = trace_buf + (size_t)i * MAX_TRACE_BYTES;
+        fold_trace_len = m[4];
+      }
+      if (req_t0 && h->tel)
+        cap_tel::observe(h->tel, cap_tel::SERIES_REQUEST_S,
+                         now - req_t0[i]);
+    }
     std::shared_ptr<Conn> c;
     {
       std::lock_guard<std::mutex> lk(h->conns_mu);
@@ -812,7 +894,77 @@ int32_t cap_serve_post_results(void* hv, const int32_t* req_meta,
       h->ctr[CTR_DROPPED_POSTS].fetch_add(1);
     }
   }
+  if (do_fold && h->tel && t > 0) {
+    cap_tel::observe(h->tel, cap_tel::SERIES_CHUNK_TOKENS, (double)t);
+    cap_tel::fold(h->tel, t, statuses, reasons, fams, kids, lat_idx,
+                  fold_trace, fold_trace_len);
+  }
   return dropped;
+}
+
+int32_t cap_serve_post_results(void* hv, const int32_t* req_meta,
+                               const int64_t* req_seq,
+                               const uint8_t* trace_buf, int32_t n_reqs,
+                               const uint8_t* statuses,
+                               const uint8_t* payload_blob,
+                               const int64_t* payload_off) {
+  return post_results_impl((Handle*)hv, req_meta, req_seq, trace_buf,
+                           nullptr, n_reqs, statuses, payload_blob,
+                           payload_off, nullptr, nullptr, nullptr, 0,
+                           false);
+}
+
+// The telemetry-folding variant (a separate symbol so a stale .so
+// degrades the plane gracefully — the binding probes for it and falls
+// back to the Python fold when absent). reasons may be NULL when
+// every status is 0 (the all-accept fast path).
+int32_t cap_serve_post_results_tel(
+    void* hv, const int32_t* req_meta, const int64_t* req_seq,
+    const uint8_t* trace_buf, const double* req_t0, int32_t n_reqs,
+    const uint8_t* statuses, const uint8_t* payload_blob,
+    const int64_t* payload_off, const uint8_t* reasons,
+    const int8_t* fams, const uint8_t* kids, int32_t lat_idx) {
+  return post_results_impl((Handle*)hv, req_meta, req_seq, trace_buf,
+                           req_t0, n_reqs, statuses, payload_blob,
+                           payload_off, reasons, fams, kids, lat_idx,
+                           true);
+}
+
+// Attach a telemetry plane (before any connection is added). The
+// handle takes ownership: the plane is freed with the handle in
+// cap_serve_destroy (or deliberately leaked with it when a wedged
+// thread prevents a safe free).
+void cap_serve_set_telemetry(void* hv, void* tel) {
+  ((Handle*)hv)->tel = (cap_tel::TelPlane*)tel;
+}
+
+// Per-token (fam, kid-hash) of the LAST cap_serve_drain call, token-
+// aligned with its tok_off ordering. Single-consumer: must be called
+// from the drain thread, between drains. Returns tokens copied.
+int64_t cap_serve_drain_aux(void* hv, int8_t* fams_out,
+                            uint8_t* kids_out, int64_t max_tokens) {
+  Handle* h = (Handle*)hv;
+  int64_t n = (int64_t)h->last_fams.size();
+  if (n > max_tokens) n = max_tokens;
+  if (n > 0) {
+    std::memcpy(fams_out, h->last_fams.data(), (size_t)n);
+    std::memcpy(kids_out, h->last_kids.data(),
+                (size_t)n * cap_tel::KID_LEN);
+  }
+  return n;
+}
+
+// Ring high-water mark since the last reset (gauge-reset-on-scrape:
+// pass reset=1 to rearm at the CURRENT depth, so the next interval's
+// mark starts from live occupancy, not zero).
+int64_t cap_serve_ring_hwm(void* hv, int32_t reset) {
+  if (!hv) return 0;
+  Handle* h = (Handle*)hv;
+  int64_t hwm = h->ring_hwm.load(std::memory_order_relaxed);
+  if (reset)
+    h->ring_hwm.store(h->queued_tokens.load(std::memory_order_relaxed),
+                      std::memory_order_relaxed);
+  return hwm;
 }
 
 // Post one pre-encoded frame (stats response / keys ack built in
@@ -872,7 +1024,10 @@ void cap_serve_destroy(void* hv) {
     delete h->carry;
     h->carry = nullptr;
   }
-  if (all) delete h;
+  if (all) {
+    if (h->tel) cap_tel::destroy(h->tel);
+    delete h;
+  }  // else: leak handle AND plane — reader threads may still touch both
 }
 
 // Test/parity hook: classify one frame held fully in a byte buffer,

@@ -48,6 +48,22 @@ _SYMBOLS = ("cap_serve_create", "cap_serve_destroy", "cap_serve_add_conn",
             "cap_serve_counter", "cap_serve_probe_frame",
             "cap_bench_drive")
 
+# Telemetry-plane symbols are OPTIONAL: a stale .so that predates the
+# plane still serves (the serve chain falls back to the Python
+# decision fold), it just can't count natively. load() probes these
+# and records the verdict on the library object.
+_TEL_SYMBOLS = ("cap_tel_layout", "cap_tel_create", "cap_tel_destroy",
+                "cap_tel_classify_seg", "cap_tel_learn", "cap_tel_fold",
+                "cap_tel_hist_observe", "cap_tel_counters",
+                "cap_tel_hist_state", "cap_tel_drain_exemplars",
+                "cap_tel_reset", "cap_serve_set_telemetry",
+                "cap_serve_drain_aux", "cap_serve_post_results_tel",
+                "cap_serve_ring_hwm")
+
+# exemplar record stride (telemetry_native.h EX_STRIDE)
+_EX_STRIDE = 88
+_KID_LEN = 12
+
 # counter slots, mirroring serve_native.cpp
 CTR_CONNS = 0
 CTR_FRAMES = 1
@@ -58,6 +74,7 @@ CTR_DROPPED_POSTS = 5
 CTR_CONNS_CLOSED = 6
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
+_i8p = ctypes.POINTER(ctypes.c_int8)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64p = ctypes.POINTER(ctypes.c_int64)
 _f64p = ctypes.POINTER(ctypes.c_double)
@@ -113,8 +130,63 @@ def load() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int32, _u8p, _i64p, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_double,
             ctypes.c_int32, _i64p, _i64p]
+        lib.cap_tel_ok = _setup_tel(lib)
         _lib = lib
         return lib
+
+
+def _setup_tel(lib: ctypes.CDLL) -> bool:
+    """Type the telemetry-plane symbols; False (plane disabled, serve
+    chain unaffected) when the .so predates the plane or its index
+    vocabularies no longer match the Python registries."""
+    from ..obs import decision as _dec
+
+    if not all(hasattr(lib, s) for s in _TEL_SYMBOLS):
+        return False
+    lib.cap_tel_layout.argtypes = [_i32p]
+    lib.cap_tel_create.restype = ctypes.c_void_p
+    lib.cap_tel_create.argtypes = [_f64p, ctypes.c_int32]
+    lib.cap_tel_destroy.argtypes = [ctypes.c_void_p]
+    lib.cap_tel_classify_seg.restype = ctypes.c_int32
+    lib.cap_tel_classify_seg.argtypes = [
+        ctypes.c_void_p, _u8p, ctypes.c_int64, _u8p, _i32p]
+    lib.cap_tel_learn.argtypes = [
+        ctypes.c_void_p, _u8p, ctypes.c_int64, ctypes.c_int32, _u8p,
+        ctypes.c_int32]
+    lib.cap_tel_fold.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _u8p, _u8p, _i8p, _u8p,
+        ctypes.c_int32, _u8p, ctypes.c_int32]
+    lib.cap_tel_hist_observe.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+    lib.cap_tel_counters.argtypes = [ctypes.c_void_p, _i64p]
+    lib.cap_tel_hist_state.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, _i64p, _i64p, _f64p, _f64p,
+        _f64p]
+    lib.cap_tel_drain_exemplars.restype = ctypes.c_int32
+    lib.cap_tel_drain_exemplars.argtypes = [
+        ctypes.c_void_p, _u8p, ctypes.c_int32]
+    lib.cap_tel_reset.argtypes = [ctypes.c_void_p]
+    lib.cap_serve_set_telemetry.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.cap_serve_drain_aux.restype = ctypes.c_int64
+    lib.cap_serve_drain_aux.argtypes = [
+        ctypes.c_void_p, _i8p, _u8p, ctypes.c_int64]
+    lib.cap_serve_post_results_tel.restype = ctypes.c_int32
+    lib.cap_serve_post_results_tel.argtypes = [
+        ctypes.c_void_p, _i32p, _i64p, _u8p, _f64p, ctypes.c_int32,
+        _u8p, _u8p, _i64p, _u8p, _i8p, _u8p, ctypes.c_int32]
+    lib.cap_serve_ring_hwm.restype = ctypes.c_int64
+    lib.cap_serve_ring_hwm.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    # layout handshake: reason/family/latency vocabularies are indexed
+    # in the C structs; any drift must disable the plane, not miscount
+    layout = np.zeros(8, np.int32)
+    lib.cap_tel_layout(layout.ctypes.data_as(_i32p))
+    want = (len(_dec.REASON_INDEX), len(_dec.FAMILIES),
+            len(_dec.LAT_BUCKET_INDEX),
+            1 + len(_dec.REASON_INDEX) + len(_dec.FAMILIES) + 3,
+            _EX_STRIDE, 2, _dec.RING_SAMPLE_EVERY,
+            telemetry.MAX_DECISION_ENTRIES)
+    return tuple(int(v) for v in layout) == want
 
 
 def probe_frame(data: bytes) -> int:
@@ -127,6 +199,252 @@ def probe_frame(data: bytes) -> int:
         np.zeros(1, np.uint8)
     return int(lib.cap_serve_probe_frame(
         buf.ctypes.data_as(_u8p), len(data), None))
+
+
+class NativeTelemetryPlane:
+    """Binding for the native telemetry plane (telemetry_native.cpp).
+
+    The plane holds the serve surface's decision counters, log-bucket
+    histograms, and sampled-exemplar ring in a plain C struct region
+    the GIL never touches. This class is the Python edge of it:
+
+    - ``fix_misses`` resolves header-cache misses with the REAL
+      classifier (``obs/decision._seg_family_kid``) and teaches the
+      native cache — family attribution is therefore bit-exact by
+      construction, the cache only ever holds Python-computed values;
+    - ``pump`` drains sampled exemplars into the active recorder's
+      decision ring (same entries ``record_batch`` would have built);
+    - ``snapshot`` emits the plane's state as a MERGEABLE telemetry
+      snapshot — scrape paths fold it in with ``merge_snapshots``, so
+      fleet quantiles and counter totals stay exact.
+
+    Standalone use (``fold_batch``) exists for the fuzz parity sweep:
+    it drives the same classify → learn → fold path the serve chain
+    uses, without sockets.
+    """
+
+    SERIES_NAMES = ("serve.native.request_s", "serve.native.chunk_tokens")
+    _FAM_UNKNOWN = len(_decision.FAMILIES) - 1
+
+    def __init__(self, lib: Optional[ctypes.CDLL] = None):
+        self._lib = lib if lib is not None else load()
+        if not getattr(self._lib, "cap_tel_ok", False):
+            raise ImportError(
+                "libcapruntime.so lacks the telemetry plane "
+                "(stale build — run: make native-build)")
+        bounds = np.asarray(telemetry.BUCKET_BOUNDS, np.float64)
+        self._n_buckets = len(bounds) + 1
+        self._h: Optional[ctypes.c_void_p] = ctypes.c_void_p(
+            self._lib.cap_tel_create(bounds.ctypes.data_as(_f64p),
+                                     len(bounds)))
+        if not self._h:
+            raise ImportError("cap_tel_create failed")
+        # True until attached to a serve handle (which then owns the
+        # free); standalone planes free themselves in destroy().
+        self._owned = True
+        self._fam_to_idx = {f: i for i, f
+                            in enumerate(_decision.FAMILIES)}
+        n_reason = len(_decision.REASON_INDEX)
+        self._ctr_names = (
+            ["decision.serve.accept"]
+            + [f"decision.serve.reject.{r}"
+               for r in _decision.REASON_INDEX]
+            + [f"decision.serve.family.{f}" for f in _decision.FAMILIES]
+            + ["serve.native.hdr_cache_hits",
+               "serve.native.hdr_cache_misses",
+               "serve.native.exemplar_drops"])
+        self._n_ctr = len(self._ctr_names)
+        self._n_reason = n_reason
+        self._ctr_buf = np.zeros(self._n_ctr, np.int64)
+        self._ex_buf = np.zeros(
+            telemetry.MAX_DECISION_ENTRIES * _EX_STRIDE, np.uint8)
+        self._bucket_buf = np.zeros(self._n_buckets, np.int64)
+        self._pump_lock = threading.Lock()
+        # captured at teardown so the sigterm-drain postmortem (which
+        # checkpoints AFTER the native side is destroyed) still
+        # carries everything the plane ever counted
+        self._final_snapshot: Optional[dict] = None
+
+    # -- classification ---------------------------------------------------
+
+    def classify_seg(self, seg_bytes: bytes):
+        """(fam_idx, kid) via the NATIVE cache; fam_idx -1 = miss."""
+        if not self._h:
+            return (-1, None)
+        if not seg_bytes:
+            return (self._FAM_UNKNOWN, None)
+        buf = np.frombuffer(seg_bytes, np.uint8)
+        kid_out = np.zeros(_KID_LEN, np.uint8)
+        kid_len = ctypes.c_int32(0)
+        fam = int(self._lib.cap_tel_classify_seg(
+            self._h, buf.ctypes.data_as(_u8p), len(seg_bytes),
+            kid_out.ctypes.data_as(_u8p), ctypes.byref(kid_len)))
+        kid = (kid_out[: kid_len.value].tobytes().decode("ascii")
+               if kid_len.value else None)
+        return (fam, kid)
+
+    def learn(self, seg_bytes: bytes, fam_idx: int,
+              kid: Optional[str]) -> None:
+        if not self._h or not seg_bytes:
+            return
+        buf = np.frombuffer(seg_bytes, np.uint8)
+        kb = np.frombuffer(kid.encode(), np.uint8) if kid else None
+        self._lib.cap_tel_learn(
+            self._h, buf.ctypes.data_as(_u8p), len(seg_bytes), fam_idx,
+            kb.ctypes.data_as(_u8p) if kb is not None else None,
+            _KID_LEN if kid else 0)
+
+    def fix_misses(self, tokens, fams: np.ndarray,
+                   kids: np.ndarray) -> None:
+        """Resolve header-cache misses (fam < 0) with the Python
+        classifier and teach the native cache — cold headers cost one
+        Python parse per DISTINCT header, then hit natively forever."""
+        for i in np.nonzero(fams < 0)[0]:
+            tok = tokens[i]
+            seg = tok.split(".", 1)[0] if isinstance(tok, str) else None
+            fam_name, kid = _decision._seg_family_kid(seg)
+            fams[i] = self._fam_to_idx[fam_name]
+            if kid:
+                kids[i * _KID_LEN:(i + 1) * _KID_LEN] = \
+                    np.frombuffer(kid.encode(), np.uint8)
+            if isinstance(seg, str) and 0 < len(seg) <= 1024:
+                self.learn(seg.encode("utf-8"), int(fams[i]), kid)
+
+    # -- standalone fold (the parity sweep's entry point) -----------------
+
+    def fold_batch(self, results, tokens=None, latency_s=None,
+                   trace=None) -> None:
+        """Drive one batch through the native fold exactly as the
+        serve chain would: classify (native cache → Python on miss),
+        statuses from the verify contract, reasons via the indexed
+        classifier, one cap_tel_fold call."""
+        n = len(results)
+        if n == 0 or not self._h:
+            return
+        fams = np.full(n, -1, np.int8)
+        kids = np.zeros(n * _KID_LEN, np.uint8)
+        if tokens is not None:
+            for i, t in enumerate(tokens):
+                if not isinstance(t, str):
+                    fams[i] = self._FAM_UNKNOWN
+                    continue
+                fam, kid = self.classify_seg(
+                    t.split(".", 1)[0].encode("utf-8"))
+                if fam >= 0:
+                    fams[i] = fam
+                    if kid:
+                        kids[i * _KID_LEN:(i + 1) * _KID_LEN] = \
+                            np.frombuffer(kid.encode(), np.uint8)
+            if (fams < 0).any():
+                self.fix_misses(tokens, fams, kids)
+        else:
+            fams[:] = self._FAM_UNKNOWN
+        statuses = np.zeros(n, np.uint8)
+        reasons = None
+        for i, r in enumerate(results):
+            if isinstance(r, BaseException):
+                if reasons is None:
+                    reasons = np.zeros(n, np.uint8)
+                statuses[i] = 1
+                reasons[i] = _decision.reason_index(r)
+        lat_idx = _decision.latency_bucket_index(latency_s)
+        tb = np.frombuffer(trace.encode(), np.uint8) \
+            if trace else None
+        self._lib.cap_tel_fold(
+            self._h, n, statuses.ctypes.data_as(_u8p),
+            reasons.ctypes.data_as(_u8p) if reasons is not None
+            else None,
+            fams.ctypes.data_as(_i8p), kids.ctypes.data_as(_u8p),
+            lat_idx,
+            tb.ctypes.data_as(_u8p) if tb is not None else None,
+            len(tb) if tb is not None else 0)
+
+    # -- scrape side ------------------------------------------------------
+
+    def pump(self, rec: Optional[telemetry.Recorder] = None) -> int:
+        """Drain queued exemplars into the recorder's decision ring;
+        returns how many entries crossed."""
+        if rec is None:
+            rec = telemetry.active()
+        h = self._h
+        if rec is None or not h:
+            return 0
+        with self._pump_lock:
+            n = int(self._lib.cap_tel_drain_exemplars(
+                h, self._ex_buf.ctypes.data_as(_u8p),
+                telemetry.MAX_DECISION_ENTRIES))
+            if not n:
+                return 0
+            entries = []
+            buf = self._ex_buf
+            for i in range(n):
+                r = buf[i * _EX_STRIDE:(i + 1) * _EX_STRIDE]
+                kid_len = int(r[3])
+                kid = (r[4:4 + kid_len].tobytes().decode("ascii")
+                       if kid_len else None)
+                trace_len = int(r[16])
+                trace = (r[17:17 + trace_len].tobytes().decode("ascii")
+                         if trace_len else None)
+                entries.append(_decision.entry_from_exemplar(
+                    int(r[0]), int(r[1]), int(r[2]), kid, trace))
+        rec.decision_many(entries)
+        return n
+
+    def counters(self):
+        """Nonzero plane counters under their registered names (the
+        final pre-teardown values once destroyed)."""
+        h = self._h
+        if not h:
+            return dict((self._final_snapshot or {}).get("counters")
+                        or {})
+        self._lib.cap_tel_counters(h,
+                                   self._ctr_buf.ctypes.data_as(_i64p))
+        return {name: int(v) for name, v
+                in zip(self._ctr_names, self._ctr_buf) if v}
+
+    def _hist_state(self, series: int):
+        count = np.zeros(1, np.int64)
+        smm = np.zeros(3, np.float64)
+        self._lib.cap_tel_hist_state(
+            self._h, series, self._bucket_buf.ctypes.data_as(_i64p),
+            count.ctypes.data_as(_i64p),
+            smm[0:].ctypes.data_as(_f64p),
+            smm[1:].ctypes.data_as(_f64p),
+            smm[2:].ctypes.data_as(_f64p))
+        return {"count": int(count[0]), "sum": float(smm[0]),
+                "min": float(smm[1]), "max": float(smm[2]),
+                "buckets": {str(i): int(c) for i, c
+                            in enumerate(self._bucket_buf) if c}}
+
+    def snapshot(self):
+        """telemetry.Recorder.snapshot()-shaped state: scrape paths
+        merge it with the Python recorder's via merge_snapshots.
+        After teardown, the final pre-destroy snapshot is served."""
+        if not self._h:
+            return dict(self._final_snapshot
+                        or {"v": 1, "counters": {}, "gauges": {},
+                            "series": {}})
+        series = {}
+        for idx, name in enumerate(self.SERIES_NAMES):
+            st = self._hist_state(idx)
+            if st["count"]:
+                series[name] = st
+        return {"v": 1, "counters": self.counters(), "gauges": {},
+                "series": series}
+
+    def observe(self, series: int, value: float) -> None:
+        if self._h:
+            self._lib.cap_tel_hist_observe(self._h, series,
+                                           float(value))
+
+    def reset(self) -> None:
+        if self._h:
+            self._lib.cap_tel_reset(self._h)
+
+    def destroy(self) -> None:
+        h, self._h = self._h, None
+        if h and self._owned:
+            self._lib.cap_tel_destroy(h)
 
 
 class NativeServeChain:
@@ -152,6 +470,22 @@ class NativeServeChain:
             4096, 4 * max_batch))
         if not self._h:
             raise ImportError("cap_serve_create failed")
+        # Native telemetry plane: on when telemetry is enabled, the
+        # library carries the plane symbols, and CAP_SERVE_NATIVE_OBS
+        # isn't 0. Any failure degrades to the Python decision fold
+        # (visible via serve.native.obs_fallbacks) — never to silence.
+        self._plane = None
+        if (telemetry.active() is not None
+                and os.environ.get("CAP_SERVE_NATIVE_OBS", "1") != "0"):
+            try:
+                plane = NativeTelemetryPlane(self._lib)
+                self._lib.cap_serve_set_telemetry(self._h, plane._h)
+                plane._owned = False   # freed with the serve handle
+                self._plane = plane
+            except Exception:  # noqa: BLE001 - fall back, visibly
+                telemetry.count("serve.native.obs_fallbacks")
+                self._plane = None
+        self._final_counters: dict = {}     # captured at destroy
         self._stop = threading.Event()
         self._drained = threading.Event()   # ring empty after stop
         # drain buffers (grown on demand when a giant frame arrives)
@@ -174,6 +508,10 @@ class NativeServeChain:
         self._req_t0 = np.zeros(max_reqs, np.float64)
         self._trace_buf = np.zeros(max_reqs * 64, np.uint8)
         self._out_counts = np.zeros(3, np.int64)
+        # telemetry plane: per-token (family idx, kid hash) of the
+        # last drain, classified by the native readers
+        self._fam_buf = np.full(max_tokens, -1, np.int8)
+        self._kid_buf = np.zeros(max_tokens * _KID_LEN, np.uint8)
 
     # -- connection handoff ------------------------------------------------
 
@@ -195,11 +533,28 @@ class NativeServeChain:
             return 0
         return int(self._lib.cap_serve_ring_depth(h))
 
-    def counters(self) -> dict:
-        c = self._lib.cap_serve_counter
+    def ring_hwm(self, reset: bool = True) -> int:
+        """Ring high-water mark since the last scrape (native-side
+        max of queued tokens — drain-time sampling misses bursts);
+        reset=True rearms the mark at the current depth."""
         h = self._h
-        if not h:               # destroyed: final counters are gone —
-            return {}           # the postmortem keeps its last doc
+        if not h or not getattr(self._lib, "cap_tel_ok", False):
+            return 0
+        return int(self._lib.cap_serve_ring_hwm(h, 1 if reset else 0))
+
+    @property
+    def obs_plane(self) -> Optional[NativeTelemetryPlane]:
+        """The attached native telemetry plane (None: Python fold)."""
+        return self._plane
+
+    def counters(self) -> dict:
+        h = self._h
+        if not h:               # destroyed: serve the final values
+            return dict(self._final_counters)  # (postmortem freshness)
+        return self._read_counters(h)
+
+    def _read_counters(self, h) -> dict:
+        c = self._lib.cap_serve_counter
         return {
             "serve.native.connections": int(c(h, CTR_CONNS)),
             "serve.native.frames": int(c(h, CTR_FRAMES)),
@@ -247,11 +602,21 @@ class NativeServeChain:
                     blob_cap=max(self._blob_cap * 2, need_blob),
                     max_reqs=self._max_reqs)
                 continue
+            if self._plane is not None:
+                # exemplar handoff rides the drain cadence: one call
+                # moves everything the fold sampled since last time
+                # into the recorder's decision ring
+                self._plane.pump()
             if rc <= 0:
                 if stopping:
                     self._drained.set()
                     return
                 continue
+            if self._plane is not None:
+                lib.cap_serve_drain_aux(
+                    h, self._fam_buf.ctypes.data_as(_i8p),
+                    self._kid_buf.ctypes.data_as(_u8p),
+                    self._max_tokens)
             telemetry.gauge("serve.native.ring_depth",
                             float(self.ring_depth()))
             try:
@@ -313,6 +678,18 @@ class NativeServeChain:
             seqs = self._req_seq[i0:i1].copy()
             t0s = self._req_t0[i0:i1].copy()
             traces_raw = self._trace_buf[i0 * 64: i1 * 64].copy()
+            plane = self._plane
+            if plane is not None:
+                # reader-classified (family, kid) per token; the rare
+                # header-cache misses resolve through the Python
+                # classifier ONCE per distinct header, then hit native
+                fams = self._fam_buf[tok0: tok0 + seg_toks].copy()
+                kids = self._kid_buf[tok0 * _KID_LEN:
+                                     (tok0 + seg_toks) * _KID_LEN].copy()
+                if (fams < 0).any():
+                    plane.fix_misses(tokens, fams, kids)
+            else:
+                fams = kids = None
             traces: List[tuple] = []
             for k in range(n):
                 tl = int(meta[k * 6 + 4])
@@ -326,24 +703,40 @@ class NativeServeChain:
                     traces.append((tid, t_recv))
 
         def on_done(results: List[Any]) -> None:
-            # Serve-surface decision records (the r9 contract, same
-            # call the Python chain's responder makes per request —
-            # here once per drained chunk, exact counters either way).
-            _decision.record_batch(
-                "serve", results, tokens=tokens,
-                latency_s=time.time() - t_drain,
-                trace=traces[0][0] if traces else None)
-            self._post(results, meta, seqs, traces_raw, n, traces)
+            # Serve-surface decision records (the r9 contract). With
+            # the native plane attached, the fold happens INSIDE the
+            # response-encode call (cap_serve_post_results_tel) — same
+            # counters, same ring sample positions, no Python pass
+            # over the tokens. Without it, the Python fold runs, same
+            # as the Python chain's responder.
+            if plane is not None:
+                lat_idx = _decision.latency_bucket_index(
+                    time.time() - t_drain)
+                self._post(results, meta, seqs, traces_raw, n, traces,
+                           t0s=t0s, fams=fams, kids=kids,
+                           lat_idx=lat_idx)
+            else:
+                _decision.record_batch(
+                    "serve", results, tokens=tokens,
+                    latency_s=time.time() - t_drain,
+                    trace=traces[0][0] if traces else None)
+                self._post(results, meta, seqs, traces_raw, n, traces)
 
         self._batcher.submit_handoff(
             tokens, traces=[t for t, _ in traces], on_done=on_done)
 
     def _post(self, results: List[Any], meta: np.ndarray,
               seqs: np.ndarray, traces_raw: np.ndarray, n_reqs: int,
-              traces: List[tuple]) -> None:
+              traces: List[tuple],
+              t0s: Optional[np.ndarray] = None,
+              fams: Optional[np.ndarray] = None,
+              kids: Optional[np.ndarray] = None,
+              lat_idx: int = 0) -> None:
+        tel = fams is not None and self._plane is not None
         with telemetry.span(telemetry.SPAN_NATIVE_POST):
             n_tok = len(results)
             poff = np.zeros(n_tok + 1, np.int64)
+            reasons: Optional[np.ndarray] = None
             try:
                 # fast path: every verdict is raw payload bytes (the
                 # raw-claims engines) — one join, all statuses 0
@@ -354,10 +747,16 @@ class NativeServeChain:
                 st = np.zeros(max(1, n_tok), np.uint8)
             except TypeError:
                 statuses = bytearray(n_tok)
+                rbuf = bytearray(n_tok) if tel else None
                 payloads: List[bytes] = []
                 for i, r in enumerate(results):
                     if isinstance(r, Exception):
                         statuses[i] = 1
+                        if rbuf is not None:
+                            # exact reason class, resolved per
+                            # exception TYPE (one dict hit) — the
+                            # native fold consumes the index
+                            rbuf[i] = _decision.reason_index(r)
                         payloads.append(
                             f"{type(r).__name__}: {r}".encode())
                     elif isinstance(r, (bytes, bytearray, memoryview)):
@@ -370,14 +769,31 @@ class NativeServeChain:
                     np.cumsum([len(p) for p in payloads], out=poff[1:])
                 st = np.frombuffer(bytes(statuses), np.uint8) \
                     if statuses else np.zeros(1, np.uint8)
+                if rbuf is not None:
+                    reasons = np.frombuffer(bytes(rbuf), np.uint8)
             pb = np.frombuffer(pblob, np.uint8) if pblob else \
                 np.zeros(1, np.uint8)
-            self._lib.cap_serve_post_results(
-                self._h, meta.ctypes.data_as(_i32p),
-                seqs.ctypes.data_as(_i64p),
-                traces_raw.ctypes.data_as(_u8p), n_reqs,
-                st.ctypes.data_as(_u8p), pb.ctypes.data_as(_u8p),
-                poff.ctypes.data_as(_i64p))
+            if tel:
+                # encode + decision fold + latency observe in ONE
+                # GIL-released native call
+                self._lib.cap_serve_post_results_tel(
+                    self._h, meta.ctypes.data_as(_i32p),
+                    seqs.ctypes.data_as(_i64p),
+                    traces_raw.ctypes.data_as(_u8p),
+                    t0s.ctypes.data_as(_f64p), n_reqs,
+                    st.ctypes.data_as(_u8p), pb.ctypes.data_as(_u8p),
+                    poff.ctypes.data_as(_i64p),
+                    reasons.ctypes.data_as(_u8p)
+                    if reasons is not None else None,
+                    fams.ctypes.data_as(_i8p),
+                    kids.ctypes.data_as(_u8p), lat_idx)
+            else:
+                self._lib.cap_serve_post_results(
+                    self._h, meta.ctypes.data_as(_i32p),
+                    seqs.ctypes.data_as(_i64p),
+                    traces_raw.ctypes.data_as(_u8p), n_reqs,
+                    st.ctypes.data_as(_u8p), pb.ctypes.data_as(_u8p),
+                    poff.ctypes.data_as(_i64p))
         now = time.time()
         for tid, t_recv in traces:
             telemetry.flight(tid, now - t_recv)
@@ -421,5 +837,16 @@ class NativeServeChain:
         threads). Call after the batcher has finished so in-flight
         verdict posts have been written out."""
         h, self._h = self._h, None
+        if self._plane is not None:
+            # last exemplar handoff and a final snapshot capture, then
+            # invalidate under the pump lock (a concurrent scrape's
+            # pump either finished or sees None): the plane's C region
+            # is freed with the handle, but the sigterm-drain
+            # postmortem still reads the captured state
+            self._plane.pump()
+            self._plane._final_snapshot = self._plane.snapshot()
+            with self._plane._pump_lock:
+                self._plane._h = None
         if h:
+            self._final_counters = self._read_counters(h)
             self._lib.cap_serve_destroy(h)

@@ -96,8 +96,16 @@ class ObsServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  extra: Optional[Callable[[], Dict[str, float]]] = None,
+                 snapshot_extra: Optional[Callable[[], Optional[
+                     Dict[str, Any]]]] = None,
                  flight_n: int = 32, handler_timeout_s: float = 5.0):
         self._extra = extra
+        # snapshot_extra: callable returning an ADDITIONAL mergeable
+        # snapshot (or None) folded into /metrics and /snapshot via
+        # merge_snapshots — the worker passes its native telemetry
+        # plane, so natively-counted decisions and histograms scrape
+        # exactly like recorder-side ones.
+        self._snapshot_extra = snapshot_extra
         self._flight_n = flight_n
         obs = self
 
@@ -148,16 +156,27 @@ class ObsServer:
         except Exception:  # noqa: BLE001 - a scrape must never 500 on it
             return {}
 
+    def _snapshot(self, rec) -> Dict[str, Any]:
+        snap = rec.snapshot() if rec is not None else {}
+        if self._snapshot_extra is not None:
+            try:
+                extra_snap = self._snapshot_extra()
+            except Exception:  # noqa: BLE001 - never 500 a scrape
+                extra_snap = None
+            if extra_snap:
+                snap = telemetry.merge_snapshots([snap, extra_snap])
+        return snap
+
     def _respond(self, h: BaseHTTPRequestHandler) -> None:
         rec = telemetry.active()
         path = h.path.split("?", 1)[0]
         if path == "/metrics":
-            snap = rec.snapshot() if rec is not None else {}
-            body = render_prometheus(snap, self._extras()).encode()
+            body = render_prometheus(self._snapshot(rec),
+                                     self._extras()).encode()
             ctype = "text/plain; version=0.0.4"
         elif path == "/snapshot":
             body = json.dumps({
-                "snapshot": rec.snapshot() if rec is not None else {},
+                "snapshot": self._snapshot(rec),
                 "extra": self._extras(),
             }).encode()
             ctype = "application/json"

@@ -118,7 +118,8 @@ class VerifyWorker:
 
             self._obs = ObsServer(
                 host=host if uds_path is None else "127.0.0.1",
-                port=obs_port, extra=self._obs_gauges)
+                port=obs_port, extra=self._obs_gauges,
+                snapshot_extra=self._native_obs_snapshot)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="cap-tpu-accept")
         self._accept_thread.start()
@@ -171,10 +172,35 @@ class VerifyWorker:
         if self._native is not None:
             out["serve.native.ring_depth"] = float(
                 self._native.ring_depth())
+            # burst-visible peak depth since the LAST scrape (the
+            # native side tracks the max at push time; reading it here
+            # rearms the mark — gauge-reset-on-scrape)
+            out["serve.native.ring_hwm"] = float(
+                self._native.ring_hwm(reset=True))
+            out["serve.native.obs_plane"] = (
+                1.0 if self._native.obs_plane is not None else 0.0)
         epoch = self.key_epoch
         if epoch is not None:
             out["keyplane.epoch"] = float(epoch)
         return out
+
+    def _native_obs_snapshot(self):
+        """The native side's mergeable snapshot (None on the python
+        chain): the serve chain's own counters plus — when the
+        telemetry plane is on — its decision counters and histogram
+        series. Scrape paths, STATS and postmortems fold it into the
+        recorder's snapshot with ``merge_snapshots``; the exemplar
+        pump runs first so the decision ring is scrape-fresh."""
+        native = self._native
+        if native is None:
+            return None
+        snap = {"v": 1, "counters": dict(native.counters()),
+                "gauges": {}, "series": {}}
+        plane = native.obs_plane
+        if plane is not None:
+            plane.pump()
+            snap = telemetry.merge_snapshots([snap, plane.snapshot()])
+        return snap
 
     def stats(self) -> dict:
         """Process-local load/health snapshot (the STATS op payload).
@@ -187,6 +213,17 @@ class VerifyWorker:
         obs = self.obs_address
         native_counters = (self._native.counters()
                            if self._native is not None else {})
+        # Native telemetry plane: its counters (decision.serve.*) and
+        # histogram series live in the C region, not the recorder —
+        # merge them here so STATS, postmortems and pool.stats_merged
+        # see one coherent worker, whichever side counted.
+        plane_snap = self._native_obs_snapshot()
+        snap = rec.snapshot() if rec is not None else {}
+        series = rec.summary() if rec is not None else {}
+        if plane_snap is not None:
+            snap = telemetry.merge_snapshots([snap, plane_snap])
+            series = {**series, **telemetry.summarize_snapshot(
+                {"series": plane_snap.get("series") or {}})}
         return {
             "pid": os.getpid(),
             **self._batcher.depth(),
@@ -196,11 +233,13 @@ class VerifyWorker:
                if self._native is not None else {}),
             "obs_port": obs[1] if obs is not None else None,
             "counters": {**(rec.counters() if rec is not None else {}),
-                         **native_counters},
-            "series": rec.summary() if rec is not None else {},
+                         **native_counters,
+                         **((plane_snap.get("counters") or {})
+                            if plane_snap is not None else {})},
+            "series": series,
             # Mergeable form: pool.stats_merged() adds bucket counts
             # across workers for EXACT fleet-wide quantiles.
-            "snapshot": rec.snapshot() if rec is not None else {},
+            "snapshot": snap,
         }
 
     def close(self, deadline_s: float = 120.0) -> None:

@@ -344,6 +344,12 @@ class Recorder:
         with self._lock:
             self._decisions.append(dict(entry))
 
+    def decision_many(self, entries: Sequence[Dict[str, Any]]) -> None:
+        """Append several decision records under ONE lock round (the
+        native plane's exemplar pump hands over a drained batch)."""
+        with self._lock:
+            self._decisions.extend(dict(e) for e in entries)
+
     # -- read side --------------------------------------------------------
 
     def decisions(self) -> List[dict]:

@@ -529,6 +529,13 @@ def test_kill9_leaves_readable_postmortem(fleet, tmp_path):
     assert counters.get("decision.serve.accept", 0) >= 1
     assert counters.get(
         "decision.serve.reject.bad_signature", 0) >= 1
+    if set(fleet.serve_chains().values()) == {"native"}:
+        # native chain: the decision counters above came from the
+        # NATIVE telemetry plane (merged into the checkpoint by
+        # worker.stats), and the chain's own counters ride along —
+        # the postmortem carries the native side of the worker
+        assert counters.get("serve.native.frames", 0) >= 1
+        assert counters.get("serve.native.tokens", 0) >= 1
     assert doc.get("flight"), "final flight ring missing"
     # capstat renders the collected doc (write it like an operator
     # saving the pool's copy).
@@ -544,6 +551,16 @@ def test_sigterm_drain_writes_fresh_postmortem(fleet):
     """Graceful restart: the worker's SIGTERM handler writes a FINAL
     checkpoint (reason sigterm-drain) after the drain completes."""
     victim = fleet.pid(1)
+    # give the victim served traffic so the final checkpoint has
+    # something to account for (direct connection: routing must not
+    # send it to worker 0)
+    from cap_tpu.serve.client import VerifyClient
+
+    host, port = fleet.address(1)
+    with VerifyClient(host, port) as direct:
+        _assert_verdicts(["drain-a.ok", "drain-b.bad"],
+                         direct.verify_batch(["drain-a.ok",
+                                              "drain-b.bad"]))
     fleet.restart(1, graceful=True)
     doc = fleet.postmortem(1)
     assert doc is not None
@@ -551,6 +568,13 @@ def test_sigterm_drain_writes_fresh_postmortem(fleet):
     assert doc["reason"] == "sigterm-drain"
     # fresh: written within the drain window, not a stale checkpoint
     assert time.time() - doc["t_write"] < 30
+    counters = doc.get("snapshot", {}).get("counters", {})
+    assert counters.get("decision.serve.accept", 0) >= 1
+    if set(fleet.serve_chains().values()) == {"native"}:
+        # the final checkpoint runs AFTER the native teardown: the
+        # merged native-plane + chain counters must have survived
+        assert counters.get("serve.native.frames", 0) >= 1
+        assert counters.get("serve.native.tokens", 0) >= 2
 
 
 # ---------------------------------------------------------------------------

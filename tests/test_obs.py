@@ -243,3 +243,51 @@ def test_merge_preserves_fleet_totals():
     s = telemetry.summarize_snapshot(merged)["batcher.batch_size"]
     assert s["count"] == 150
     assert s["max"] == 99.0
+
+
+# ---------------------------------------------------------------------------
+# capstat --watch burn view: per-interval counter deltas
+# ---------------------------------------------------------------------------
+
+def test_capstat_counter_deltas_and_respawn_reset():
+    """Delta math across scrapes: normal growth subtracts, a worker
+    respawn (counter goes BACKWARDS) clamps to the fresh value —
+    never a negative rate — and a newly appearing counter counts
+    from zero."""
+    from tools import capstat
+
+    prev = {"worker.tokens": 1000, "worker.requests": 50,
+            "decision.serve.accept": 400}
+    cur = {"worker.tokens": 1600,          # +600
+           "worker.requests": 20,          # respawn reset → 20
+           "decision.serve.accept": 400,   # unchanged → 0
+           "decision.serve.reject.expired": 7}  # new → 7
+    deltas = capstat.counter_deltas(prev, cur)
+    assert deltas == {"worker.tokens": 600, "worker.requests": 20,
+                      "decision.serve.accept": 0,
+                      "decision.serve.reject.expired": 7}
+    assert all(v >= 0 for v in deltas.values())
+    rendered = capstat.render_deltas(deltas, 2.0)
+    assert "worker.tokens" in rendered and "+600" in rendered
+    assert "300.0/s" in rendered
+    # zero-delta counters are hidden from the burn view
+    assert "decision.serve.accept" not in rendered
+    # an all-quiet interval still renders something readable
+    assert "(no counter movement)" in capstat.render_deltas(
+        {"worker.tokens": 0}, 2.0)
+
+
+def test_capstat_renders_ring_hwm():
+    from tools import capstat
+
+    data = {"127.0.0.1:1": {
+        "snapshot": {}, "flight": [],
+        "extra": {"worker.pid": 7, "batcher.queued_tokens": 0,
+                  "batcher.inflight_batches": 0,
+                  "serve.native.active": 1.0,
+                  "serve.native.ring_depth": 3.0,
+                  "serve.native.ring_hwm": 96.0},
+    }}
+    rendered = capstat.render_fleet(data)
+    assert "chain=native" in rendered
+    assert "ring_hwm=96" in rendered

@@ -400,7 +400,8 @@ def test_native_build_from_source_and_symbols_resolve(tmp_path):
     out = str(tmp_path / "libcapruntime_test.so")
     _build._build_one(
         (os.path.join("runtime", "native", "jose_native.cpp"),
-         os.path.join("runtime", "native", "serve_native.cpp")),
+         os.path.join("runtime", "native", "serve_native.cpp"),
+         os.path.join("runtime", "native", "telemetry_native.cpp")),
         out, False, timeout=300.0, force=True)
     assert os.path.exists(out), "native build produced no library"
     lib = ctypes.CDLL(out)
@@ -409,7 +410,15 @@ def test_native_build_from_source_and_symbols_resolve(tmp_path):
                 "cap_serve_add_conn", "cap_serve_drain",
                 "cap_serve_post_results", "cap_serve_post_raw",
                 "cap_serve_probe_frame", "cap_serve_ring_depth",
-                "cap_serve_counter", "cap_bench_drive"):
+                "cap_serve_counter", "cap_bench_drive",
+                # the native telemetry plane (ISSUE 8)
+                "cap_tel_layout", "cap_tel_create", "cap_tel_destroy",
+                "cap_tel_classify_seg", "cap_tel_learn",
+                "cap_tel_fold", "cap_tel_hist_observe",
+                "cap_tel_counters", "cap_tel_hist_state",
+                "cap_tel_drain_exemplars", "cap_tel_reset",
+                "cap_serve_set_telemetry", "cap_serve_drain_aux",
+                "cap_serve_post_results_tel", "cap_serve_ring_hwm"):
         assert hasattr(lib, sym), f"symbol {sym} missing"
 
 

@@ -260,3 +260,88 @@ def test_verify_batch_emits_stage_metrics():
     # no metric name may carry payload material
     for name in list(counters) + list(summ):
         assert "eyJ" not in name and len(name) < 80
+
+
+# ---------------------------------------------------------------------------
+# merge_snapshots edge cases (the native-plane scrape/merge contract)
+# ---------------------------------------------------------------------------
+
+def test_merge_empty_and_counterless_snapshots():
+    """Empty snapshots, None entries, and snapshots with only some
+    sections must merge without inventing keys."""
+    rec = telemetry.Recorder()
+    rec.count("a", 3)
+    merged = telemetry.merge_snapshots(
+        [None, {}, {"v": 1}, {"counters": {}}, rec.snapshot(),
+         {"v": 1, "counters": {"a": 2}, "gauges": {}, "series": {}}])
+    assert merged["counters"] == {"a": 5}
+    assert merged["gauges"] == {}
+    assert merged["series"] == {}
+    # and a merge of nothing at all is a valid empty snapshot
+    empty = telemetry.merge_snapshots([])
+    assert empty["counters"] == {} and empty["series"] == {}
+
+
+def test_merge_disjoint_bucket_sets():
+    """Two snapshots whose histograms occupy DISJOINT buckets: the
+    merged series must contain both, with counts, sum, min and max
+    identical to one recorder that saw every sample."""
+    a, b, ref = (telemetry.Recorder() for _ in range(3))
+    for v in (1e-6, 2e-6, 4e-6):
+        a.observe("s", v)
+        ref.observe("s", v)
+    for v in (10.0, 20.0, 40.0):
+        b.observe("s", v)
+        ref.observe("s", v)
+    merged = telemetry.merge_snapshots([a.snapshot(), b.snapshot()])
+    ref_state = ref._series["s"].state()
+    got = merged["series"]["s"]
+    assert got["buckets"] == ref_state["buckets"]
+    assert got["count"] == 6
+    assert got["min"] == 1e-6 and got["max"] == 40.0
+    assert got["sum"] == pytest.approx(ref_state["sum"])
+
+
+def test_merge_max_bucket_index_observation():
+    """An observation beyond the last bound lands in the OVERFLOW
+    bucket (index len(BUCKET_BOUNDS)); the merge must carry it and
+    from_state must not drop it."""
+    rec = telemetry.Recorder()
+    rec.observe("s", telemetry._HIST_HI * 10)     # overflow bucket
+    rec.observe("s", telemetry.BUCKET_BOUNDS[-1])  # last real bound
+    snap = rec.snapshot()
+    overflow_idx = str(len(telemetry.BUCKET_BOUNDS))
+    assert overflow_idx in snap["series"]["s"]["buckets"]
+    merged = telemetry.merge_snapshots([snap, snap])
+    assert merged["series"]["s"]["buckets"][overflow_idx] == 2
+    h = telemetry.Histogram.from_state(merged["series"]["s"])
+    assert h.count == 4
+    assert h.quantile(0.99) <= h.vmax
+
+
+def test_merge_native_plane_snapshot_schema_parity():
+    """A native-plane snapshot (serve/native_serve.py shape) merges
+    with a recorder snapshot under the SAME schema: counters add,
+    series bucket-merge — the scrape path's contract. Runs without
+    the native library: the shape is what is pinned here."""
+    rec = telemetry.Recorder()
+    rec.count("decision.serve.accept", 5)
+    rec.observe("serve.native.request_s", 0.001)
+    nat = {
+        "v": 1,
+        "counters": {"decision.serve.accept": 7,
+                     "decision.serve.family.es": 12},
+        "gauges": {},
+        "series": {"serve.native.request_s": {
+            "count": 2, "sum": 0.004, "min": 0.001, "max": 0.003,
+            "buckets": {"55": 1, "61": 1}}},
+    }
+    merged = telemetry.merge_snapshots([rec.snapshot(), nat])
+    assert merged["counters"]["decision.serve.accept"] == 12
+    assert merged["counters"]["decision.serve.family.es"] == 12
+    s = merged["series"]["serve.native.request_s"]
+    assert s["count"] == 3
+    assert s["max"] == 0.003
+    # summarize accepts the merged form (what capstat renders)
+    assert "serve.native.request_s" in telemetry.summarize_snapshot(
+        merged)

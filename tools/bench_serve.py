@@ -403,6 +403,12 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
         # what each worker ANNOUNCED on its ready line (ground truth:
         # a native request that fell back shows up as python here)
         "serve_chains": {str(w): c for w, c in sorted(chains.items())},
+        # True when the workers' decision fold ran on the NATIVE
+        # telemetry plane (detected from plane-only counters in the
+        # merged scrape — not from the requested knob, so a silent
+        # obs fallback shows up as false in the record)
+        "native_obs": any(k.startswith("serve.native.hdr_cache")
+                          for k in (agg.get("counters") or {})),
         "driver": driver,
         "throughput": round(total / seconds, 1),
         "requests": len(lats),

@@ -65,7 +65,11 @@ TRACKED = ("value", "value_peak", "resident_mixed_vps", "serve_fleet",
            "resident_mldsa44_vps")
 # serve-chain series (BENCH_SERVE_r*.json): metric → higher_is_better
 SERVE_TRACKED = {"serve_native_vps": True,
-                 "stage_python_us_per_token": False}
+                 "stage_python_us_per_token": False,
+                 # full-observability native chain (native telemetry
+                 # plane on): us/token, lower is better — the r13
+                 # "obs on at wire speed" contract must not erode
+                 "serve_native_obs_us_per_token": False}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -280,19 +284,36 @@ def selftest(repo: str = REPO) -> List[str]:
     # 4b. serve series: higher-is-better drop and lower-is-better RISE
     #     must both flag; a clean pair must not
     sv = [(11, {"serve_native_vps": 1e6,
-                "stage_python_us_per_token": 0.8}),
+                "stage_python_us_per_token": 0.8,
+                "serve_native_obs_us_per_token": 0.9}),
           (12, {"serve_native_vps": 1e6,
-                "stage_python_us_per_token": 0.8})]
+                "stage_python_us_per_token": 0.8,
+                "serve_native_obs_us_per_token": 0.9})]
     if check_serve_series(sv):
         problems.append("flat serve series flagged")
     if not check_serve_series(
             [sv[0], (12, {"serve_native_vps": 0.8e6,
-                          "stage_python_us_per_token": 0.8})]):
+                          "stage_python_us_per_token": 0.8,
+                          "serve_native_obs_us_per_token": 0.9})]):
         problems.append("serve vps regression NOT flagged")
     if not check_serve_series(
             [sv[0], (12, {"serve_native_vps": 1e6,
-                          "stage_python_us_per_token": 1.0})]):
+                          "stage_python_us_per_token": 1.0,
+                          "serve_native_obs_us_per_token": 0.9})]):
         problems.append("us/token REGRESSION (rise) NOT flagged")
+    if not check_serve_series(
+            [sv[0], (12, {"serve_native_vps": 1e6,
+                          "stage_python_us_per_token": 0.8,
+                          "serve_native_obs_us_per_token": 1.2})]):
+        problems.append("obs us/token REGRESSION (rise) NOT flagged")
+    # a round that predates the obs metric must not flag when the
+    # NEXT round introduces it (absent-everywhere-before is not a
+    # disappearance)
+    if check_serve_series(
+            [(11, {"serve_native_vps": 1e6,
+                   "stage_python_us_per_token": 0.8}),
+             sv[1]]):
+        problems.append("introducing the obs metric flagged")
     # 5. the REAL series with a 15% regression injected into a copy of
     #    the newest record: must flag (the acceptance-bar case)
     real = load_series(repo)

@@ -143,7 +143,8 @@ def render_trace(trace_id: str, spans: Sequence[dict]) -> str:
 
 # Series that are NOT durations (tokens, ratios, lane counts): render
 # raw instead of milliseconds.
-_UNITLESS_SUFFIXES = ("_size", "_ratio", ".lanes", ".fill_ratio")
+_UNITLESS_SUFFIXES = ("_size", "_ratio", ".lanes", ".fill_ratio",
+                      "_tokens")
 
 
 def _series_rows(summary: Dict[str, Dict[str, float]]) -> List[str]:
@@ -180,10 +181,15 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
         # 0.0 = pure-Python chain (absent on pre-native workers)
         chain = extra.get("serve.native.active")
         ring = extra.get("serve.native.ring_depth")
+        # peak queued tokens since the previous scrape (native-side
+        # high-water mark — bursts the point-in-time ring= misses)
+        hwm = extra.get("serve.native.ring_hwm")
         lines.append(f"worker {ep}  pid={int(extra.get('worker.pid', 0))}"
                      + (f"  chain={'native' if chain else 'python'}"
                         if chain is not None else "")
                      + (f"  ring={int(ring)}" if ring is not None else "")
+                     + (f"  ring_hwm={int(hwm)}" if hwm is not None
+                        else "")
                      + (f"  epoch={int(epoch)}" if epoch is not None
                         else "")
                      + f"  queued={int(extra.get('batcher.queued_tokens', 0))}"
@@ -254,6 +260,39 @@ def _decision_rows(counters: Dict[str, Any]) -> List[str]:
                     f"reject={row['reject']}"
                     + (f"  ({reasons})" if reasons else ""))
     return rows
+
+
+def counter_deltas(prev: Dict[str, Any],
+                   cur: Dict[str, Any]) -> Dict[str, int]:
+    """Per-interval counter increases between two merged scrapes.
+
+    Counters are cumulative per process, so a worker respawn RESETS
+    its contribution and the merged total can go backwards. Burn must
+    never render negative: a counter below its previous value (or one
+    that just appeared) is treated as freshly started — the delta is
+    its current value, the Prometheus ``increase()`` stance. Pinned by
+    test across a simulated respawn.
+    """
+    out: Dict[str, int] = {}
+    for k, v in cur.items():
+        p = prev.get(k)
+        v = int(v)
+        out[k] = v if (p is None or v < int(p)) else v - int(p)
+    return out
+
+
+def render_deltas(deltas: Dict[str, int], interval_s: float) -> str:
+    """The --watch burn view: per-interval counter deltas and rates
+    (quantiles stay absolute — they are already windowless)."""
+    rows = [f"interval deltas ({interval_s:g}s)"]
+    for k, d in sorted(deltas.items()):
+        if not d:
+            continue
+        rate = d / interval_s if interval_s > 0 else 0.0
+        rows.append(f"  {k:<44} +{d:<10} {rate:10.1f}/s")
+    if len(rows) == 1:
+        rows.append("  (no counter movement)")
+    return "\n".join(rows)
 
 
 def merged_snapshot(worker_data: Dict[str, Dict[str, Any]],
@@ -342,6 +381,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             client = json.load(f)
 
     breached = False
+    prev_counters: Optional[Dict[str, int]] = None
+    prev_t = time.monotonic()
     while True:
         worker_data: Dict[str, Dict[str, Any]] = {}
         for ep in args.endpoints:
@@ -368,6 +409,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             }, indent=1))
         else:
             print(render_fleet(worker_data, client))
+            if args.watch:
+                # burn view: cumulative counters hide movement at a
+                # glance — show what changed THIS interval (respawn
+                # resets clamp to the fresh value, never negative)
+                cur = {k: int(v) for k, v in (merged_snapshot(
+                    worker_data).get("counters") or {}).items()}
+                now = time.monotonic()
+                if prev_counters is not None:
+                    print(render_deltas(
+                        counter_deltas(prev_counters, cur),
+                        now - prev_t))
+                prev_counters, prev_t = cur, now
         if args.slo or args.slo_rules:
             table, breach = run_slo(worker_data, client,
                                     args.slo_rules)

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""obs-smoke: boot a 2-worker stub fleet, scrape it, fail on gaps.
+"""obs-smoke: boot stub fleets, scrape them, fail on gaps.
 
 The CI guard for the observability surface (``make obs-smoke``):
 
@@ -15,9 +15,16 @@ The CI guard for the observability surface (``make obs-smoke``):
    for the mixed batch (cap_tpu.obs.decision);
 5. FAIL if the SLO engine cannot evaluate the default rules over the
    live fleet's merged counters, or if the wrong-verdict objective is
-   breached.
+   breached;
+6. NATIVE-CHAIN GATE: repeat the same load against a fleet booted
+   with ``--serve-chain native`` (the native telemetry plane counts
+   the serve surface in C) and FAIL on any missing/NaN gauge —
+   including ``serve.native.ring_hwm`` — or on any decision-counter
+   divergence from the python-chain run: obs must cost less, never
+   count differently. Skipped with a notice when the native library
+   cannot build on this host.
 
-Runs under JAX_PLATFORMS=cpu inside the tier-1 time budget (~10 s).
+Runs under JAX_PLATFORMS=cpu inside the tier-1 time budget (~15 s).
 """
 
 from __future__ import annotations
@@ -38,25 +45,41 @@ REQUIRED_PROM = [
     "cap_batcher_batch_size",       # summary (quantiles + _sum/_count)
 ]
 
+# gauges the native chain must additionally serve on every scrape
+REQUIRED_NATIVE_GAUGES = ["serve.native.ring_depth",
+                          "serve.native.ring_hwm",
+                          "serve.native.obs_plane"]
 
-def main() -> int:
+
+def run_fleet(serve_chain):
+    """Boot one 2-worker stub fleet on the given serve chain, drive
+    the canonical mixed load, scrape and gate it. Returns (failures,
+    info) where info carries the decision counters for cross-chain
+    parity and the chains that actually came up."""
     from cap_tpu import telemetry
     from cap_tpu.fleet import FleetClient, WorkerPool
     from cap_tpu.fleet.worker_main import StubKeySet
+    from cap_tpu.obs import decision as obs_decision
+    from cap_tpu.obs import slo as obs_slo
     from tools import capstat
 
     failures = []
-    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.3)
+    info = {"chains": set(), "serve_decisions": {},
+            "router_decisions": {}, "tid": None}
+    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.3,
+                      serve_chain=serve_chain)
     try:
         if not pool.wait_all_ready(30):
-            print("obs-smoke: fleet did not come up", file=sys.stderr)
-            return 1
+            return ([f"{serve_chain}: fleet did not come up"], info)
+        info["chains"] = set(pool.serve_chains().values())
         telemetry.enable()
+        telemetry.active().reset()   # per-run router counters
         cl = FleetClient(pool, fallback=StubKeySet(), rr_seed=0)
         with telemetry.trace() as tid:
             for i in range(4):
                 out = cl.verify_batch([f"smoke-{i}.ok", f"smoke-{i}.bad"])
                 assert len(out) == 2
+        info["tid"] = tid
         obs = pool.obs_endpoints()
         if len(obs) != 2:
             failures.append(f"expected 2 obs endpoints, got {obs}")
@@ -74,6 +97,16 @@ def main() -> int:
                 failures.append(f"worker {wid}: NaN value in /metrics")
             traced = traced or any(e.get("trace") == tid
                                    for e in worker_data[ep]["flight"])
+            if serve_chain == "native":
+                extra = worker_data[ep].get("extra") or {}
+                for g in REQUIRED_NATIVE_GAUGES:
+                    v = extra.get(g)
+                    if v is None:
+                        failures.append(
+                            f"worker {wid}: missing native gauge {g}")
+                    elif v != v:
+                        failures.append(
+                            f"worker {wid}: native gauge {g} is NaN")
         failures.extend(capstat.check_required(worker_data))
         if not traced:
             failures.append(
@@ -88,9 +121,6 @@ def main() -> int:
         # half rejected, so BOTH verdicts must have counted on every
         # exercised surface — workers (merged scrape) and the router
         # (this process's recorder).
-        from cap_tpu.obs import decision as obs_decision
-        from cap_tpu.obs import slo as obs_slo
-
         worker_counters = telemetry.merge_snapshots(
             [d["snapshot"] for d in worker_data.values()]
         ).get("counters") or {}
@@ -99,6 +129,11 @@ def main() -> int:
         router_counters = telemetry.active().snapshot()["counters"]
         failures.extend(obs_decision.nonzero_check(router_counters,
                                                    ["router"]))
+        info["serve_decisions"] = obs_decision.decision_counters(
+            {k: v for k, v in worker_counters.items()
+             if k.startswith("decision.serve.")})
+        info["router_decisions"] = obs_decision.decision_counters(
+            router_counters)
 
         # SLO engine over the LIVE fleet: an evaluation error (not a
         # breach — a crash/parse failure) is a smoke failure; so is a
@@ -116,14 +151,46 @@ def main() -> int:
             failures.append(f"SLO engine evaluation error: {e!r}")
     finally:
         pool.close()
+    return ([f"{serve_chain}: {f}" for f in failures], info)
+
+
+def main() -> int:
+    failures, py_info = run_fleet("python")
+    if py_info["chains"] != {"python"}:
+        failures.append(f"python run came up as {py_info['chains']}")
+
+    # native-chain gate: same load, native serve chain + telemetry
+    # plane; decision counters must be IDENTICAL to the python run
+    native_ok = False
+    try:
+        from cap_tpu.serve import native_serve
+        native_ok = bool(getattr(native_serve.load(), "cap_tel_ok",
+                                 False))
+    except Exception:  # noqa: BLE001 - no compiler on this host
+        native_ok = False
+    if native_ok:
+        nat_failures, nat_info = run_fleet("native")
+        if nat_info["chains"] != {"native"}:
+            nat_failures.append(
+                f"native run came up as {nat_info['chains']}")
+        failures.extend(nat_failures)
+        if nat_info["serve_decisions"] != py_info["serve_decisions"]:
+            failures.append(
+                "native/python serve decision counters diverge: "
+                f"native={nat_info['serve_decisions']} "
+                f"python={py_info['serve_decisions']}")
+    else:
+        print("obs-smoke NOTE: native serve runtime unavailable — "
+              "native-chain gate skipped", file=sys.stderr)
+
     if failures:
         for f in failures:
             print(f"obs-smoke FAIL: {f}", file=sys.stderr)
         return 1
-    print("obs-smoke OK: 2 workers scraped, required gauges present, "
-          f"trace {tid} landed in a flight recorder, decision "
-          "counters nonzero on serve+router, SLO engine evaluated "
-          "clean")
+    print("obs-smoke OK: python fleet scraped clean (gauges, trace "
+          "reassembly, decision counters, SLO engine)"
+          + (", native fleet scraped clean with counter parity to "
+             "the python run" if native_ok else ""))
     return 0
 
 
