@@ -47,6 +47,7 @@ from .. import telemetry
 from ..errors import CapError
 from ..obs import decision as _decision
 from ..serve import protocol
+from ..serve import vcache as _vcache
 from ..serve.client import RemoteVerifyError
 
 Endpoint = Tuple[str, int]
@@ -132,12 +133,29 @@ class FleetClient:
                  backoff_base: float = 0.05, backoff_max: float = 1.0,
                  breaker_threshold: int = 3, breaker_reset_s: float = 1.0,
                  hedge_after: Optional[float] = None,
-                 rr_seed: Optional[int] = None):
+                 rr_seed: Optional[int] = None,
+                 vcache=None):
         if hasattr(endpoints, "endpoints"):       # a WorkerPool
             self._pool = endpoints
             endpoints = endpoints.endpoints
         else:
             self._pool = None
+        # Client-side verdict-cache tier (opt-in): hot tokens short-
+        # circuit BEFORE the wire, with the same epoch/exp/nbf clamps
+        # as the worker tier. Epoch clamp: pool-backed clients track
+        # the pool's push-target epoch per call; bare-endpoint clients
+        # (no epoch visibility) get a short hard TTL instead.
+        # vcache: None → CAP_CLIENT_VCACHE=1 enables; True → default
+        # cache; or pass a configured VerdictCache instance.
+        if vcache is None:
+            vcache = os.environ.get("CAP_CLIENT_VCACHE", "0") == "1"
+        if vcache is True:
+            vcache = _vcache.VerdictCache(
+                max_ttl_s=300.0 if self._pool is not None else 30.0)
+        self._vcache: Optional[_vcache.VerdictCache] = \
+            vcache if isinstance(vcache, _vcache.VerdictCache) else None
+        if self._vcache is not None and self._pool is not None:
+            self._vcache.set_epoch(self._pool_epoch())
         self._endpoints_src = endpoints
         self._fallback = fallback
         self._attempt_timeout = attempt_timeout
@@ -212,6 +230,40 @@ class FleetClient:
                         sum(1 for b in self._breakers.values()
                             if b.open_until > now))
 
+    # -- client-side verdict cache ----------------------------------------
+
+    def _pool_epoch(self) -> Optional[int]:
+        try:
+            return self._pool.keys_epoch()
+        except Exception:  # noqa: BLE001 - cache stays conservative
+            return None
+
+    def _cache_consult(self, tokens: List[str]):
+        """(hits, miss_idx, fill) — fill(fresh) merges the routed miss
+        verdicts into hits IN PLACE and inserts them. None when the
+        client tier is off."""
+        vc = self._vcache
+        if vc is None:
+            return None
+        if self._pool is not None:
+            ep = self._pool_epoch()
+            if ep != vc.epoch:
+                # a rotation reached the fleet since our last call:
+                # cached verdicts from before it die immediately
+                vc.bump_epoch(ep)
+        hits, miss_idx, digests = vc.lookup_batch(tokens)
+        epoch0 = vc.epoch
+
+        def fill(fresh: List[Any]) -> List[Any]:
+            vc.insert_batch([digests[i] for i in miss_idx], fresh,
+                            tokens=[tokens[i] for i in miss_idx],
+                            epoch=epoch0)
+            for j, i in enumerate(miss_idx):
+                hits[i] = fresh[j]
+            return hits
+
+        return hits, miss_idx, fill
+
     # -- verify ----------------------------------------------------------
 
     def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
@@ -230,8 +282,19 @@ class FleetClient:
             return []
         t0 = time.perf_counter()
         with telemetry.span(telemetry.SPAN_CLIENT_SUBMIT):
-            out = self._verify_batch_routed(
-                tokens, telemetry.current_trace())
+            consult = self._cache_consult(tokens)
+            if consult is None:
+                out = self._verify_batch_routed(
+                    tokens, telemetry.current_trace())
+            else:
+                hits, miss_idx, fill = consult
+                if miss_idx:
+                    fresh = self._verify_batch_routed(
+                        [tokens[i] for i in miss_idx],
+                        telemetry.current_trace())
+                    out = fill(fresh)
+                else:
+                    out = hits
         # Router-surface decision records: the verdicts the CALLER
         # sees, whichever path produced them (worker, hedge peer, or
         # the terminal oracle) — worker rejections arrive as
@@ -445,6 +508,8 @@ class FleetClient:
             "breakers": {f"{ep[0]}:{ep[1]}": st
                          for ep, st in self.breaker_states().items()},
         }
+        if self._vcache is not None:
+            out["vcache"] = self._vcache.stats()
         skew = self.key_epoch_skew()
         if skew is not None:
             out["key_epochs"] = {str(k): v for k, v in

@@ -212,6 +212,12 @@ def main(argv=None) -> int:
     # tools/bench_stages.py measures the obs-overhead table with).
     ap.add_argument("--native-obs", default="auto",
                     choices=["auto", "off"])
+    # Verdict cache: "auto" (on unless CAP_SERVE_VCACHE=0 in the
+    # environment) or "off" (force the cache tier — worker cache,
+    # native digest handoff, batcher in-flight dedup — off; the
+    # graceful-off switch docs/SERVE.md documents).
+    ap.add_argument("--vcache", default="auto",
+                    choices=["auto", "off"])
     # Crash postmortems: checkpoint telemetry to this path on a timer
     # and on SIGTERM drain, so the pool can collect a ≤interval-stale
     # document even after kill -9. Empty = disabled. The pool passes
@@ -234,6 +240,8 @@ def main(argv=None) -> int:
         telemetry.enable()           # STATS op serves real numbers
     if args.native_obs == "off":
         os.environ["CAP_SERVE_NATIVE_OBS"] = "0"
+    if args.vcache == "off":
+        os.environ["CAP_SERVE_VCACHE"] = "0"
     keyset = make_keyset(args.keyset)
     serve_native = (None if args.serve_chain == "auto"
                     else args.serve_chain == "native")

@@ -62,6 +62,10 @@ hedge_rate       ratio fleet.hedges / worker.requests max 0.25
 # last ack; docs/KEYPLANE.md) and pushes must not be flaking.
 rotation_lag     quantile keyplane.propagate_s p99 max 5
 push_failures    ratio keyplane.push_failures / keyplane.push_attempts max 0.5
+# Verdict cache: the serve-time tripwire must NEVER fire — a cached
+# accept served past its exp/epoch clamp would be a wrong verdict in
+# the making (docs/SERVE.md cache-tier invalidation matrix).
+stale_accepts    counter vcache.stale_accepts max 0
 """
 
 

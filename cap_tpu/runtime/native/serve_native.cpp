@@ -58,7 +58,16 @@
 
 #include "telemetry_native.h"
 
+// SHA-256 from jose_native.cpp (same .so, SHA-NI dispatched): the
+// verdict-cache token digest is sha256(token)[:16], computed here in
+// the reader threads so the Python drain does zero hashing.
+namespace sha2 {
+void sha256(const uint8_t* data, size_t len, uint8_t out[32]);
+}
+
 namespace serve_native {
+
+static const int DIG_LEN = 16;  // vcache.DIGEST_LEN
 
 // ---------------------------------------------------------------------------
 // CVB1 wire constants — mirror serve/protocol.py exactly.
@@ -370,6 +379,9 @@ struct Req {
   // hashed kid, classified by THIS reader thread at parse time.
   std::vector<int8_t> fams;
   std::string kids;  // 12 bytes per token, zero = none
+  // verdict cache (when enabled): sha256(token)[:16] per token,
+  // computed by THIS reader thread at parse time
+  std::string digests;
 };
 
 // counter slots (cap_serve_counter)
@@ -398,6 +410,10 @@ struct Handle {
   // cap_serve_drain_aux copies them out; single-consumer like carry.
   std::vector<int8_t> last_fams;
   std::vector<uint8_t> last_kids;
+  // verdict-cache digests (cap_serve_set_digests arms the readers;
+  // cap_serve_drain_digests copies the last drain's out)
+  std::atomic<int32_t> digests_on{0};
+  std::vector<uint8_t> last_digests;
   std::mutex mu;  // guards the two cvs' sleep/wake protocol
   std::condition_variable cv_data;   // drain thread sleeps here
   std::condition_variable cv_space;  // producers sleep here when full
@@ -547,6 +563,18 @@ static void reader_main(std::shared_ptr<Conn> c) {
       for (size_t i = 0; i < nent; i++)
         std::memcpy(&r->blob[(size_t)r->offs[i]], base + p.entries[i].off,
                     (size_t)p.entries[i].len);
+      if (r->kind == K_VERIFY &&
+          h->digests_on.load(std::memory_order_relaxed)) {
+        // verdict-cache digest per token, while the bytes are hot
+        // (SHA-NI where the CPU has it — ~0.1 µs for a typical token)
+        r->digests.resize(nent * DIG_LEN);
+        uint8_t d32[32];
+        for (size_t i = 0; i < nent; i++) {
+          sha2::sha256(base + p.entries[i].off,
+                       (size_t)p.entries[i].len, d32);
+          std::memcpy(&r->digests[i * DIG_LEN], d32, DIG_LEN);
+        }
+      }
       if (h->tel && r->kind == K_VERIFY) {
         // classify each token's family here, GIL-free, while the
         // frame bytes are cache-hot: header segment = bytes before
@@ -730,6 +758,8 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
     h->last_fams.clear();
     h->last_kids.clear();
   }
+  bool want_digests = h->digests_on.load(std::memory_order_relaxed);
+  if (want_digests) h->last_digests.clear();
   bool stop_drain = false;
   while (!stop_drain) {
     Req* r = h->carry;
@@ -795,6 +825,18 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
         h->last_fams.insert(h->last_fams.end(), (size_t)nent, -1);
         h->last_kids.insert(h->last_kids.end(),
                             (size_t)nent * cap_tel::KID_LEN, 0);
+      }
+    }
+    if (want_digests) {
+      // token-aligned digests; zero filler (= "rehash in Python")
+      // for control records and requests read before arming
+      if (r->kind == K_VERIFY &&
+          (int64_t)r->digests.size() == nent * DIG_LEN) {
+        h->last_digests.insert(h->last_digests.end(),
+                               r->digests.begin(), r->digests.end());
+      } else {
+        h->last_digests.insert(h->last_digests.end(),
+                               (size_t)nent * DIG_LEN, 0);
       }
     }
     int64_t consumed = r->kind == K_VERIFY ? nent : 1;
@@ -951,6 +993,26 @@ int64_t cap_serve_drain_aux(void* hv, int8_t* fams_out,
     std::memcpy(kids_out, h->last_kids.data(),
                 (size_t)n * cap_tel::KID_LEN);
   }
+  return n;
+}
+
+// Arm (or disarm) reader-side verdict-cache digests. Call before the
+// first connection is added — readers sample the flag per frame.
+void cap_serve_set_digests(void* hv, int32_t on) {
+  ((Handle*)hv)->digests_on.store(on, std::memory_order_relaxed);
+}
+
+// Per-token sha256[:16] digests of the LAST cap_serve_drain call,
+// token-aligned with its tok_off ordering (zero rows = compute in
+// Python). Single-consumer, like cap_serve_drain_aux.
+int64_t cap_serve_drain_digests(void* hv, uint8_t* digests_out,
+                                int64_t max_tokens) {
+  Handle* h = (Handle*)hv;
+  int64_t n = (int64_t)(h->last_digests.size() / DIG_LEN);
+  if (n > max_tokens) n = max_tokens;
+  if (n > 0)
+    std::memcpy(digests_out, h->last_digests.data(),
+                (size_t)n * DIG_LEN);
   return n;
 }
 

@@ -16,6 +16,8 @@ telemetry records only counts and timings.
 
 from .batcher import AdaptiveBatcher
 from .client import VerifyClient
+from .vcache import VerdictCache
 from .worker import VerifyWorker
 
-__all__ = ["AdaptiveBatcher", "VerifyClient", "VerifyWorker"]
+__all__ = ["AdaptiveBatcher", "VerdictCache", "VerifyClient",
+           "VerifyWorker"]

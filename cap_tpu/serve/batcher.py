@@ -18,6 +18,7 @@ measures, available to every serve client.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -69,8 +70,26 @@ class AdaptiveBatcher:
 
     def __init__(self, keyset, target_batch: int = 4096,
                  max_wait_ms: float = 2.0, max_batch: int = 32768,
-                 max_queued_tokens: int = 0):
+                 max_queued_tokens: int = 0,
+                 dedup: Optional[bool] = None):
         self._keyset = keyset
+        # In-flight replay dedup (ROADMAP #3): identical tokens queued
+        # together verify ONCE per flush and the single verdict fans
+        # out to every waiter (verify is deterministic, so duplicate
+        # suppression cannot change any verdict; per-submission trace
+        # ids and decision records are untouched — they attach to the
+        # _Pending, not to the deduped dispatch list). dedup=None →
+        # CAP_SERVE_DEDUP if set, else the vcache tier's master switch
+        # (CAP_SERVE_VCACHE=0 turns the whole tier off).
+        if dedup is None:
+            env = os.environ.get("CAP_SERVE_DEDUP")
+            if env is not None:
+                dedup = env != "0"
+            else:
+                from .vcache import enabled_from_env
+
+                dedup = enabled_from_env(True)
+        self._dedup = bool(dedup)
         self._target = target_batch
         self._max_wait = max_wait_ms / 1000.0
         self._max_batch = max_batch
@@ -244,26 +263,59 @@ class AdaptiveBatcher:
                 traces.append(tid)
                 telemetry.trace_span(tid, telemetry.SPAN_BATCHER_FILL,
                                      p.t0_wall, now_wall - p.t0_wall)
+        # In-flight dedup: collapse identical tokens queued in this
+        # flush to ONE dispatch slot each; the verdict fans back out
+        # in _expand. Digest equality == token equality (the vcache's
+        # sha256 contract), so string identity is the same key.
+        send_tokens = tokens
+        expand: Optional[List[int]] = None
+        # len(set()) probe first: all-unique flushes (the common case
+        # once the vcache absorbs repeats upstream) pay one C-speed
+        # pass, not a per-token Python dict loop.
+        if self._dedup and n > 1 and len(set(tokens)) < n:
+            first: Dict[Any, int] = {}
+            idx_map: List[int] = []
+            uniq: List[Any] = []
+            for t in tokens:
+                j = first.get(t)
+                if j is None:
+                    j = first[t] = len(uniq)
+                    uniq.append(t)
+                idx_map.append(j)
+            telemetry.count("batcher.dedup_fanout", n - len(uniq))
+            send_tokens = uniq
+            expand = idx_map
         dispatch = getattr(self._keyset, "verify_batch_async", None)
         if dispatch is not None:
             self._slot.acquire()          # backpressure BEFORE dispatch
             try:
                 with telemetry.trace_scope(traces), \
                         telemetry.span(telemetry.SPAN_BATCHER_DISPATCH):
-                    collect = dispatch(tokens)
+                    collect = dispatch(send_tokens)
             except Exception as e:  # noqa: BLE001 - fan the failure out
                 self._slot.release()
-                self._distribute(batch, [e] * len(tokens))
+                self._distribute(batch, [e] * n)
                 return
-            self._inflight.put((batch, len(tokens), collect))
+            self._inflight.put((batch, n, collect, expand))
             return
         try:
             with telemetry.trace_scope(traces), \
                     telemetry.span(telemetry.SPAN_BATCHER_FLUSH):
-                results = self._keyset.verify_batch(tokens)
+                results = self._expand(
+                    self._keyset.verify_batch(send_tokens), expand)
         except Exception as e:  # noqa: BLE001 - fan the failure out
-            results = [e] * len(tokens)
+            results = [e] * n
         self._distribute(batch, results)
+
+    @staticmethod
+    def _expand(results: List[Any],
+                expand: Optional[List[int]]) -> List[Any]:
+        """Fan a deduped dispatch's verdicts back out to every queued
+        position (shared verdict objects — verify is deterministic and
+        downstream only reads them)."""
+        if expand is None:
+            return results
+        return [results[j] for j in expand]
 
     def _collect_loop(self) -> None:
         # The dispatcher enqueues _DISPATCHER_DONE on exit, so by FIFO
@@ -277,14 +329,14 @@ class AdaptiveBatcher:
             item = self._inflight.get()
             if item is _DISPATCHER_DONE:
                 return
-            batch, n_tokens, collect = item
+            batch, n_tokens, collect, expand = item
             traces = [tid for p in batch
                       for tid in (p.traces
                                   or ((p.trace,) if p.trace else ()))]
             try:
                 with telemetry.trace_scope(traces), \
                         telemetry.span(telemetry.SPAN_BATCHER_COLLECT):
-                    results = collect()
+                    results = self._expand(collect(), expand)
             except Exception as e:  # noqa: BLE001 - fan the failure out
                 results = [e] * n_tokens
             finally:

@@ -60,9 +60,16 @@ _TEL_SYMBOLS = ("cap_tel_layout", "cap_tel_create", "cap_tel_destroy",
                 "cap_serve_drain_aux", "cap_serve_post_results_tel",
                 "cap_serve_ring_hwm")
 
+# Verdict-cache digest symbols are OPTIONAL too: a stale .so without
+# them still serves — the drain loop hashes in Python instead of
+# riding the reader threads' sha256 (serve.native.digest_fallbacks).
+_VC_SYMBOLS = ("cap_serve_set_digests", "cap_serve_drain_digests")
+
 # exemplar record stride (telemetry_native.h EX_STRIDE)
 _EX_STRIDE = 88
 _KID_LEN = 12
+_DIG_LEN = 16
+_ZERO_DIG = b"\x00" * _DIG_LEN
 
 # counter slots, mirroring serve_native.cpp
 CTR_CONNS = 0
@@ -131,8 +138,22 @@ def load() -> ctypes.CDLL:
             ctypes.c_int32, ctypes.c_int32, ctypes.c_double,
             ctypes.c_int32, _i64p, _i64p]
         lib.cap_tel_ok = _setup_tel(lib)
+        lib.cap_vc_ok = _setup_vc(lib)
         _lib = lib
         return lib
+
+
+def _setup_vc(lib: ctypes.CDLL) -> bool:
+    """Type the verdict-cache digest symbols; False (Python-side
+    hashing fallback, serve chain unaffected) on a stale .so."""
+    if not all(hasattr(lib, s) for s in _VC_SYMBOLS):
+        return False
+    lib.cap_serve_set_digests.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int32]
+    lib.cap_serve_drain_digests.restype = ctypes.c_int64
+    lib.cap_serve_drain_digests.argtypes = [ctypes.c_void_p, _u8p,
+                                            ctypes.c_int64]
+    return True
 
 
 def _setup_tel(lib: ctypes.CDLL) -> bool:
@@ -460,7 +481,7 @@ class NativeServeChain:
     def __init__(self, batcher, stats_fn: Callable[[], dict],
                  keys_fn: Callable[[dict, Any], int],
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
-                 max_batch: int = 32768):
+                 max_batch: int = 32768, vcache=None):
         self._lib = load()
         self._batcher = batcher
         self._stats_fn = stats_fn
@@ -470,6 +491,21 @@ class NativeServeChain:
             4096, 4 * max_batch))
         if not self._h:
             raise ImportError("cap_serve_create failed")
+        # Verdict cache (the worker's instance — one cache serves both
+        # chains, so the worker's apply_keys invalidation hook covers
+        # this chain too). When the library carries the digest symbols
+        # the C readers sha256 each token at frame-parse time and the
+        # drain picks the digests up next to fams/kids — zero Python
+        # hashing on the hot path; otherwise lookup_batch hashes in
+        # Python (counted, visible).
+        self._vcache = vcache
+        self._native_digests = False
+        if vcache is not None and getattr(self._lib, "cap_vc_ok",
+                                          False):
+            self._lib.cap_serve_set_digests(self._h, 1)
+            self._native_digests = True
+        elif vcache is not None:
+            telemetry.count("serve.native.digest_fallbacks")
         # Native telemetry plane: on when telemetry is enabled, the
         # library carries the plane symbols, and CAP_SERVE_NATIVE_OBS
         # isn't 0. Any failure degrades to the Python decision fold
@@ -512,6 +548,10 @@ class NativeServeChain:
         # last drain, classified by the native readers
         self._fam_buf = np.full(max_tokens, -1, np.int8)
         self._kid_buf = np.zeros(max_tokens * _KID_LEN, np.uint8)
+        # verdict cache: per-token digest of the last drain (sha256
+        # truncated, computed by the native readers; all-zero rows
+        # fall back to Python hashing)
+        self._dig_buf = np.zeros(max_tokens * _DIG_LEN, np.uint8)
 
     # -- connection handoff ------------------------------------------------
 
@@ -617,6 +657,10 @@ class NativeServeChain:
                     h, self._fam_buf.ctypes.data_as(_i8p),
                     self._kid_buf.ctypes.data_as(_u8p),
                     self._max_tokens)
+            if self._native_digests:
+                lib.cap_serve_drain_digests(
+                    h, self._dig_buf.ctypes.data_as(_u8p),
+                    self._max_tokens)
             telemetry.gauge("serve.native.ring_depth",
                             float(self.ring_depth()))
             try:
@@ -708,7 +752,9 @@ class NativeServeChain:
             # response-encode call (cap_serve_post_results_tel) — same
             # counters, same ring sample positions, no Python pass
             # over the tokens. Without it, the Python fold runs, same
-            # as the Python chain's responder.
+            # as the Python chain's responder. Cache hits flow through
+            # the SAME fold — the decision counters cannot tell a
+            # cached verdict from a fresh one (that is the parity pin).
             if plane is not None:
                 lat_idx = _decision.latency_bucket_index(
                     time.time() - t_drain)
@@ -722,8 +768,54 @@ class NativeServeChain:
                     trace=traces[0][0] if traces else None)
                 self._post(results, meta, seqs, traces_raw, n, traces)
 
+        vc = self._vcache
+        if vc is None:
+            self._batcher.submit_handoff(
+                tokens, traces=[t for t, _ in traces], on_done=on_done)
+            return
+        # Verdict-cache consult BEFORE the batcher: reader-computed
+        # digests when the .so carries them (all-zero rows — stale
+        # carry, control filler — rehash in Python), else lookup_batch
+        # hashes itself.
+        dig_list = None
+        if self._native_digests:
+            db = self._dig_buf[tok0 * _DIG_LEN:
+                               (tok0 + seg_toks) * _DIG_LEN].tobytes()
+            dig_list = [None if (d := db[k * _DIG_LEN:
+                                         (k + 1) * _DIG_LEN])
+                        == _ZERO_DIG else d for k in range(seg_toks)]
+        hits, miss_idx, digs = vc.lookup_batch(tokens, digests=dig_list)
+        if not miss_idx:
+            # every token answered from cache: encode + fold directly,
+            # no batcher round-trip (memory-speed path)
+            on_done(hits)
+            return
+        if len(miss_idx) == len(tokens):
+            epoch0 = vc.epoch
+
+            def on_done_fill(fresh: List[Any]) -> None:
+                vc.insert_batch(digs, fresh, tokens=tokens,
+                                epoch=epoch0)
+                on_done(fresh)
+
+            self._batcher.submit_handoff(
+                tokens, traces=[t for t, _ in traces],
+                on_done=on_done_fill)
+            return
+        epoch0 = vc.epoch
+        miss_tokens = [tokens[i] for i in miss_idx]
+
+        def on_done_merge(fresh: List[Any]) -> None:
+            vc.insert_batch([digs[i] for i in miss_idx], fresh,
+                            tokens=miss_tokens, epoch=epoch0)
+            full = hits
+            for j, i in enumerate(miss_idx):
+                full[i] = fresh[j]
+            on_done(full)
+
         self._batcher.submit_handoff(
-            tokens, traces=[t for t, _ in traces], on_done=on_done)
+            miss_tokens, traces=[t for t, _ in traces],
+            on_done=on_done_merge)
 
     def _post(self, results: List[Any], meta: np.ndarray,
               seqs: np.ndarray, traces_raw: np.ndarray, n_reqs: int,

@@ -18,7 +18,49 @@ from typing import Optional, Tuple
 from .. import telemetry
 from ..obs import decision as _decision
 from . import protocol
+from . import vcache as _vcache
 from .batcher import AdaptiveBatcher
+
+# shared pre-set event for all-cache-hit submissions (nothing to wait on)
+_DONE_EVENT = threading.Event()
+_DONE_EVENT.set()
+
+
+class _CachePending:
+    """A pending-shaped handle over a cache-consulted submission.
+
+    Mirrors the ``_Pending`` surface the responder loop reads
+    (``tokens`` / ``ts`` / ``event`` / ``results``): all-hit requests
+    carry their verdicts immediately (event pre-set, no batcher
+    round-trip); partial hits wait on the underlying miss submission
+    and merge lazily at respond time, filling the cache with the fresh
+    verdicts as a side effect."""
+
+    __slots__ = ("tokens", "ts", "event", "_hits", "_miss_idx",
+                 "_inner", "_fill", "_results")
+
+    def __init__(self, tokens, hits, miss_idx, inner, fill):
+        self.tokens = tokens
+        self.ts = time.monotonic()
+        self._hits = hits
+        self._miss_idx = miss_idx
+        self._inner = inner
+        self._fill = fill
+        self._results = None
+        self.event = inner.event if inner is not None else _DONE_EVENT
+
+    @property
+    def results(self):
+        if self._results is None:
+            out = self._hits
+            if self._inner is not None:
+                fresh = self._inner.results
+                for j, i in enumerate(self._miss_idx):
+                    out[i] = fresh[j]
+                if self._fill is not None:
+                    self._fill(self._miss_idx, fresh)
+            self._results = out
+        return self._results
 
 
 class _RawClaimsSync:
@@ -53,7 +95,9 @@ class VerifyWorker:
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
                  max_batch: int = 32768, raw_claims: bool = True,
                  obs_port: Optional[int] = None,
-                 serve_native: Optional[bool] = None):
+                 serve_native: Optional[bool] = None,
+                 vcache: Optional[bool] = None,
+                 vcache_capacity: int = 0):
         # The unwrapped engine: keyplane operations (KEYS pushes,
         # epoch reporting) address it directly, whatever raw-claims
         # wrapper the batcher ends up routed through.
@@ -68,9 +112,26 @@ class VerifyWorker:
             keyset = _RawClaims(keyset)
         elif raw_claims and hasattr(keyset, "verify_batch_raw"):
             keyset = _RawClaimsSync(keyset)
+        # Verdict cache (ROADMAP #3): consulted in both serve chains'
+        # drain paths BEFORE the batcher; epoch-invalidated by KEYS
+        # pushes (apply_keys below), exp/nbf-clamped per entry. Off via
+        # vcache=False or CAP_SERVE_VCACHE=0 (the graceful-off switch),
+        # which also turns the batcher's in-flight dedup off (one tier,
+        # one switch) unless CAP_SERVE_DEDUP overrides explicitly.
+        if vcache is None:
+            vcache = _vcache.enabled_from_env(True)
         self._batcher = AdaptiveBatcher(
             keyset, target_batch=target_batch, max_wait_ms=max_wait_ms,
-            max_batch=max_batch)
+            max_batch=max_batch,
+            dedup=(None if os.environ.get("CAP_SERVE_DEDUP") is not None
+                   else bool(vcache)))
+        self._vcache: Optional[_vcache.VerdictCache] = None
+        if vcache:
+            self._vcache = _vcache.VerdictCache(
+                capacity=vcache_capacity
+                or int(os.environ.get("CAP_SERVE_VCACHE_CAP", "65536")))
+            self._vcache.set_epoch(getattr(self._engine, "key_epoch",
+                                           None))
         # Serve-chain selection: the NATIVE chain (C++ frame I/O +
         # lock-free ring, serve/native_serve.py) when requested via
         # serve_native=True or CAP_SERVE_NATIVE=1, with a graceful
@@ -88,7 +149,8 @@ class VerifyWorker:
                 self._native = NativeServeChain(
                     self._batcher, stats_fn=self.stats,
                     keys_fn=self.apply_keys, target_batch=target_batch,
-                    max_wait_ms=max_wait_ms, max_batch=max_batch)
+                    max_wait_ms=max_wait_ms, max_batch=max_batch,
+                    vcache=self._vcache)
             except Exception:  # noqa: BLE001 - fall back, visibly
                 telemetry.count("serve.native_fallbacks")
                 self._native = None
@@ -157,6 +219,13 @@ class VerifyWorker:
                 f"{type(self._engine).__name__} does not support hot "
                 "key rotation")
         got = swap(jwks_doc, epoch=epoch)
+        if self._vcache is not None:
+            # Atomic cache invalidation rides the SAME push that swaps
+            # the tables: cached verdicts from the previous epoch die
+            # immediately (grace 0 — the ENGINE's grace window covers
+            # retired-kid re-verifies; the cache never extends it), so
+            # a cached accept cannot outlive a rotated key.
+            self._vcache.bump_epoch(got)
         telemetry.count("worker.keys_pushes")
         telemetry.gauge("keyplane.epoch", got)
         return got
@@ -182,6 +251,8 @@ class VerifyWorker:
         epoch = self.key_epoch
         if epoch is not None:
             out["keyplane.epoch"] = float(epoch)
+        if self._vcache is not None:
+            out["vcache.size"] = float(self._vcache.size())
         return out
 
     def _native_obs_snapshot(self):
@@ -363,8 +434,7 @@ class VerifyWorker:
                 # traced one a traced response echoing its trace id —
                 # the fleet router's end-to-end integrity envelope.
                 if ftype == protocol.T_VERIFY_REQ_TRACE:
-                    pending = self._batcher.submit_nowait(entries,
-                                                          trace=trace)
+                    pending = self._cached_submit(entries, trace=trace)
                     telemetry.trace_span(
                         trace, telemetry.SPAN_WORKER_DEQUEUE, t_recv,
                         time.time() - t_recv)
@@ -372,13 +442,36 @@ class VerifyWorker:
                     continue
                 crc = ftype == protocol.T_VERIFY_REQ_CRC
                 respq.put(("batch_crc" if crc else "batch",
-                           self._batcher.submit_nowait(entries), None))
+                           self._cached_submit(entries), None))
         finally:
             respq.put(None)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _cached_submit(self, entries, trace: Optional[str] = None):
+        """Consult the verdict cache, then submit only the misses.
+
+        All-hit requests never touch the batcher (answered at memory
+        speed); partial hits submit the miss subset and merge at
+        respond time. Returns a pending-shaped handle either way."""
+        vc = self._vcache
+        if vc is None:
+            return self._batcher.submit_nowait(entries, trace=trace)
+        hits, miss_idx, digests = vc.lookup_batch(entries)
+        if not miss_idx:
+            return _CachePending(list(entries), hits, (), None, None)
+        epoch0 = vc.epoch
+
+        def fill(idxs, fresh):
+            vc.insert_batch([digests[i] for i in idxs], fresh,
+                            tokens=[entries[i] for i in idxs],
+                            epoch=epoch0)
+
+        inner = self._batcher.submit_nowait(
+            [entries[i] for i in miss_idx], trace=trace)
+        return _CachePending(list(entries), hits, miss_idx, inner, fill)
 
     def _respond_loop(self, conn: socket.socket, respq) -> None:
         broken = False

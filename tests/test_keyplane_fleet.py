@@ -93,13 +93,24 @@ def test_push_keys_reaches_every_worker(pool):
     assert agg["epoch_skew"] == 0
 
 
-def test_push_records_propagation_telemetry(pool):
-    with telemetry.recording() as rec:
-        pool.push_keys(_jwks("t-1"))
-        assert rec.counters().get("keyplane.pushes") == 1
-        assert rec.counters().get("keyplane.push_attempts") == 2
-        assert "keyplane.propagate_s" in rec.summary()
-        assert rec.gauges().get("keyplane.epoch") == 1
+def test_push_records_propagation_telemetry():
+    # Own pool with a LONG supervisor interval: push_keys records the
+    # distribution target before contacting workers, so a concurrent
+    # supervisor sweep can legitimately re-push a not-yet-contacted
+    # worker and add a third push_attempt — quiescing the sweep makes
+    # the exact ==2 accounting deterministic (seen flaking under
+    # full-suite CPU contention).
+    p = WorkerPool(2, keyset_spec="stub", ping_interval=30.0)
+    try:
+        assert p.wait_all_ready(30)
+        with telemetry.recording() as rec:
+            p.push_keys(_jwks("t-1"))
+            assert rec.counters().get("keyplane.pushes") == 1
+            assert rec.counters().get("keyplane.push_attempts") == 2
+            assert "keyplane.propagate_s" in rec.summary()
+            assert rec.gauges().get("keyplane.epoch") == 1
+    finally:
+        p.close()
 
 
 def test_worker_obs_scrape_carries_epoch(pool):
@@ -307,5 +318,103 @@ def test_supervisor_repushes_after_transient_push_failure():
         # The supervisor notices the stale epoch on its next sweep and
         # re-pushes the CURRENT distribution.
         assert _wait_epochs(pool, 3, timeout=15), pool.key_epochs()
+    finally:
+        pool.close()
+
+
+@pytest.mark.chaos
+def test_rotation_kill9_under_repeated_token_load_cache_tier():
+    """ROADMAP #3 chaos bar: keyplane rotation with a kill -9 landing
+    mid-push, under sustained REPEATED-token load (the verdict-cache
+    regime). Every verdict stays ground-truth-correct through the
+    rotation and the respawn (a stale cached accept would fail the
+    per-token check), the live fleet's ``vcache.stale_accepts``
+    tripwire never moves, and the killed worker's postmortem carries
+    the cache-invalidation counter (``vcache.epoch_bumps``) from the
+    push it applied before dying."""
+    from cap_tpu.obs import postmortem as obs_postmortem
+
+    pool = WorkerPool(2, keyset_spec="stub:batch_ms=5",
+                      ping_interval=0.2, max_restarts=20,
+                      max_wait_ms=1.0)
+    try:
+        assert pool.wait_all_ready(30)
+        cl = FleetClient(pool, fallback=StubKeySet(),
+                         attempt_timeout=2.0, total_deadline=30.0,
+                         rr_seed=0)
+        hot = [f"hot-{i}.ok" for i in range(3)] + ["hot-x.bad"]
+        stop = threading.Event()
+        failures = []
+        done = []
+
+        def driver(d):
+            while not stop.is_set():
+                try:
+                    res = cl.verify_batch(hot)
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"driver {d}: {e!r}")
+                    return
+                if len(res) != len(hot):
+                    failures.append(f"driver {d}: lost submissions")
+                    return
+                for t, r in zip(hot, res):
+                    ok = not isinstance(r, Exception)
+                    if ok != t.endswith(".ok") or \
+                            (ok and r != {"sub": t}):
+                        failures.append(
+                            f"driver {d}: WRONG verdict for {t!r}")
+                        return
+                done.append(len(res))
+
+        threads = [threading.Thread(target=driver, args=(d,))
+                   for d in range(3)]
+        for t in threads:
+            t.start()
+        # Rotation 1 lands cleanly: both workers bump their caches.
+        time.sleep(0.5)
+        pool.push_keys(_jwks("rot-1"), epoch=1)
+        assert _wait_epochs(pool, 1, timeout=15)
+        # Let the killed worker checkpoint a postmortem that already
+        # contains the epoch-1 invalidation + cache hits.
+        victim = pool.pid(0)
+        pm_path = pool.postmortem_path(0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            doc = obs_postmortem.read_postmortem(pm_path)
+            if doc and (doc.get("snapshot", {}).get("counters", {})
+                        .get("vcache.epoch_bumps", 0)) >= 1:
+                break
+            time.sleep(0.1)
+        # Rotation 2 with the SIGKILL landing mid-push.
+        killer = threading.Thread(target=lambda: kill9(victim))
+        killer.start()
+        pool.push_keys(_jwks("rot-2"), epoch=2)
+        killer.join(timeout=10)
+        assert _wait_epochs(pool, 2, timeout=60), pool.key_epochs()
+        assert pool.pid(0) != victim
+        # Sustained repeated-token load PAST any grace window (cache
+        # bumps use grace 0; engines' grace is irrelevant to stubs).
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "driver wedged"
+        assert not failures, failures[:3]
+        assert sum(done) > 0
+        # Zero stale accepts after grace expiry, fleet-wide: the
+        # serve-time tripwire on the live workers never moved, and the
+        # repeats DID hit the cache (the load was cache-shaped).
+        agg = pool.stats_merged()["aggregate"]["counters"]
+        assert agg.get("vcache.stale_accepts", 0) == 0
+        assert agg.get("vcache.hits", 0) > 0
+        assert agg.get("vcache.epoch_bumps", 0) >= 1
+        # The killed worker's postmortem carries the invalidation
+        # counter — the epoch-1 bump it applied before the SIGKILL.
+        doc = pool.postmortem(0)
+        assert doc is not None, "no postmortem collected"
+        pm_counters = doc.get("snapshot", {}).get("counters", {})
+        assert pm_counters.get("vcache.epoch_bumps", 0) >= 1, \
+            sorted(k for k in pm_counters if k.startswith("vcache"))
+        assert pm_counters.get("vcache.hits", 0) > 0
     finally:
         pool.close()

@@ -1,0 +1,565 @@
+"""Verdict cache + in-flight replay dedup (ROADMAP #3).
+
+Correctness-preserving contract: the cache tier may change how FAST a
+verdict is produced, never WHICH verdict — pinned here as unit clamps
+(exp/nbf/epoch/grace/TTL/terminal-reject rules), batcher dedup
+fan-out, both serve chains end-to-end, the FleetClient tier, and a
+randomized mixed parity sweep (expiring tokens crossing ``exp``
+mid-run, an epoch swap mid-run) asserting bit-identical verdicts AND
+serve-surface decision-reason counters with the cache on vs off.
+"""
+
+import base64
+import hashlib
+import json
+import threading
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.errors import (
+    InvalidSignatureError,
+    MalformedTokenError,
+    UnknownKeyIDError,
+)
+from cap_tpu.serve.protocol import ProtocolError
+from cap_tpu.serve import AdaptiveBatcher, VerifyClient, VerifyWorker
+from cap_tpu.serve import vcache as V
+from cap_tpu.serve.client import RemoteVerifyError
+
+
+def _payload(claims):
+    return base64.urlsafe_b64encode(
+        json.dumps(claims).encode()).rstrip(b"=").decode()
+
+
+def _tok(name, ok=True, **claims):
+    """A stub-verifiable token whose middle segment carries real
+    claims (the vcache parses exp/nbf out of it)."""
+    mid = _payload(claims) if claims else "e30"
+    return f"{name}.{mid}.{'ok' if ok else 'bad'}"
+
+
+class CountingStub:
+    """Suffix-determined verdicts; records every engine-visible token
+    (the dedup/cache assertions read ``seen``)."""
+
+    def __init__(self):
+        self.seen = []
+        self.lock = threading.Lock()
+        self.key_epoch = 0
+
+    def swap_keys(self, jwks, epoch=None, grace_s=0.0):
+        self.key_epoch = (self.key_epoch + 1 if epoch is None
+                          else int(epoch))
+        return self.key_epoch
+
+    def verify_batch(self, tokens):
+        with self.lock:
+            self.seen.extend(tokens)
+        return [{"sub": t} if t.endswith(".ok")
+                else InvalidSignatureError("bad sig") for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# unit: the cache itself
+# ---------------------------------------------------------------------------
+
+
+def test_digest_definition_is_sha256_16():
+    assert V.DIGEST_LEN == 16
+    assert V.token_digest("abc") == hashlib.sha256(b"abc").digest()[:16]
+    assert V.token_digest(b"abc") == V.token_digest("abc")
+
+
+def test_roundtrip_and_counter_exactness():
+    vc = V.VerdictCache()
+    d = V.token_digest("t.ok")
+    assert vc.get(d) is V.MISS
+    assert vc.insert(d, {"sub": "t"}, token="t.ok", epoch=None)
+    assert vc.get(d) == {"sub": "t"}
+    st = vc.stats()
+    assert st["vcache.lookups"] == 2
+    assert st["vcache.hits"] + st["vcache.misses"] == \
+        st["vcache.lookups"]
+    assert st["vcache.stale_accepts"] == 0
+
+
+def test_exp_clamp_never_serves_past_exp():
+    vc = V.VerdictCache()
+    now = time.time()
+    tok = _tok("e", exp=now + 0.2)
+    d = V.token_digest(tok)
+    assert vc.insert(d, {"sub": "e", "exp": now + 0.2}, token=tok,
+                     epoch=None)
+    assert vc.get(d) != V.MISS
+    time.sleep(0.25)
+    assert vc.get(d) is V.MISS          # expired → miss, re-verify
+    # already-expired claims never insert at all
+    assert not vc.insert(d, {"exp": now - 1}, token=tok, epoch=None)
+
+
+def test_nbf_clamp():
+    vc = V.VerdictCache()
+    d = V.token_digest("n")
+    assert vc.insert(d, {"nbf": time.time() + 30}, token="n",
+                     epoch=None)
+    assert vc.get(d) is V.MISS          # not yet valid → engine decides
+
+
+def test_exp_parsed_from_token_payload_for_raw_bytes():
+    vc = V.VerdictCache()
+    tok = _tok("p", exp=time.time() - 1)
+    d = V.token_digest(tok)
+    # raw-claims accept whose bytes do not parse as JSON with exp:
+    # the clamp falls back to the token's own payload segment
+    assert not vc.insert(d, b"not-json", token=tok, epoch=None)
+
+
+def test_epoch_bump_invalidates_and_grace_retains():
+    vc = V.VerdictCache()
+    vc.set_epoch(1)
+    d = V.token_digest("g.ok")
+    vc.insert(d, b'{"sub":"g"}', token="g.ok", epoch=1)
+    # bump with grace: previous-epoch entries survive the window
+    vc.bump_epoch(2, grace_s=0.3)
+    assert vc.get(d) != V.MISS
+    time.sleep(0.35)
+    assert vc.get(d) is V.MISS
+    # two epochs behind is invalid even inside a fresh grace window
+    vc.insert(d, b"x", token="g.ok", epoch=2)
+    vc.bump_epoch(3, grace_s=5.0)
+    assert vc.get(d) != V.MISS          # prev epoch, in grace
+    vc.bump_epoch(4, grace_s=5.0)
+    assert vc.get(d) is V.MISS          # 2 behind now
+    assert vc.stats()["vcache.epoch_bumps"] == 3
+
+
+def test_bump_same_epoch_is_noop():
+    vc = V.VerdictCache()
+    vc.set_epoch(5)
+    d = V.token_digest("s.ok")
+    vc.insert(d, b"v", token="s.ok", epoch=5)
+    vc.bump_epoch(5)
+    assert vc.get(d) != V.MISS
+    assert vc.stats()["vcache.epoch_bumps"] == 0
+
+
+def test_insert_racing_a_rotation_is_dropped():
+    vc = V.VerdictCache()
+    vc.set_epoch(1)
+    d = V.token_digest("r.ok")
+    vc.bump_epoch(2)
+    # verified under epoch 1, rotation landed before the fill
+    assert not vc.insert(d, b"v", token="r.ok", epoch=1)
+    assert vc.get(d) is V.MISS
+
+
+def test_only_terminal_rejects_cached():
+    vc = V.VerdictCache()
+    assert vc.cacheable(InvalidSignatureError("x"))
+    assert vc.cacheable(MalformedTokenError("x"))
+    assert vc.cacheable({"sub": "a"})
+    assert not vc.cacheable(UnknownKeyIDError("x"))   # refresh may fix
+    assert not vc.cacheable(ProtocolError("x"))       # transport
+    assert not vc.cacheable(TimeoutError("x"))
+    d = V.token_digest("u")
+    assert not vc.insert(d, UnknownKeyIDError("x"), token="u",
+                         epoch=None)
+    assert vc.stats()["vcache.insert_skips"] == 1
+
+
+def test_bounded_eviction():
+    vc = V.VerdictCache(capacity=32, shards=4)
+    for i in range(200):
+        vc.insert(V.token_digest(f"t{i}"), b"v", token=f"t{i}",
+                  epoch=None)
+    assert vc.size() <= 32
+    st = vc.stats()
+    assert st["vcache.evictions"] >= 200 - 32
+    assert st["vcache.inserts"] == 200
+
+
+def test_ttl_bound_for_expless_tokens():
+    vc = V.VerdictCache(max_ttl_s=0.2)
+    d = V.token_digest("ttl.ok")
+    vc.insert(d, b"v", token="ttl.ok", epoch=None)
+    assert vc.get(d) != V.MISS
+    time.sleep(0.25)
+    assert vc.get(d) is V.MISS
+
+
+def test_lookup_batch_uses_supplied_digests_and_falls_back():
+    vc = V.VerdictCache()
+    toks = ["a.ok", "b.ok"]
+    d0 = V.token_digest("a.ok")
+    vc.insert(d0, b"va", token="a.ok", epoch=None)
+    # supplied digest for a, zero/None for b (native zero-row path)
+    hits, miss_idx, digs = vc.lookup_batch(toks, digests=[d0, None])
+    assert hits[0] == b"va" and miss_idx == [1]
+    assert digs[1] == V.token_digest("b.ok")
+
+
+# ---------------------------------------------------------------------------
+# batcher: in-flight replay dedup
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_verifies_once_and_fans_out():
+    ks = CountingStub()
+    b = AdaptiveBatcher(ks, target_batch=64, max_wait_ms=20.0,
+                        dedup=True)
+    try:
+        p1 = b.submit_nowait(["d.ok", "d.ok", "x.bad"])
+        p2 = b.submit_nowait(["d.ok", "y.ok"])
+        p1.event.wait(5)
+        p2.event.wait(5)
+        assert p1.results[0] == {"sub": "d.ok"}
+        assert p1.results[1] == {"sub": "d.ok"}
+        assert isinstance(p1.results[2], InvalidSignatureError)
+        assert p2.results == [{"sub": "d.ok"}, {"sub": "y.ok"}]
+        # the engine saw each distinct token ONCE per flush
+        assert sorted(ks.seen) == sorted(["d.ok", "x.bad", "y.ok"]) \
+            or ks.seen.count("d.ok") < 3   # (split flushes tolerated)
+    finally:
+        b.close(5)
+
+
+def test_dedup_off_sends_everything():
+    ks = CountingStub()
+    b = AdaptiveBatcher(ks, target_batch=64, max_wait_ms=20.0,
+                        dedup=False)
+    try:
+        p = b.submit_nowait(["d.ok", "d.ok", "d.ok"])
+        p.event.wait(5)
+        assert ks.seen.count("d.ok") == 3
+    finally:
+        b.close(5)
+
+
+def test_dedup_async_pipeline_path():
+    from cap_tpu.fleet.worker_main import StubKeySet as FleetStub
+
+    ks = FleetStub(pipeline=1)
+    b = AdaptiveBatcher(ks, target_batch=64, max_wait_ms=20.0,
+                        dedup=True)
+    try:
+        p = b.submit_nowait(["a.ok"] * 8 + ["b.bad"] * 2)
+        p.event.wait(5)
+        assert p.results[:8] == [{"sub": "a.ok"}] * 8
+        assert all(isinstance(r, InvalidSignatureError)
+                   for r in p.results[8:])
+    finally:
+        b.close(5)
+
+
+def test_dedup_counts_fanout():
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    b = AdaptiveBatcher(ks, target_batch=64, max_wait_ms=20.0,
+                        dedup=True)
+    try:
+        p = b.submit_nowait(["z.ok"] * 10)
+        p.event.wait(5)
+        assert rec.counters().get("batcher.dedup_fanout", 0) == 9
+    finally:
+        b.close(5)
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# worker end-to-end (python chain; native chain below, build-gated)
+# ---------------------------------------------------------------------------
+
+
+def _drive(worker, seq):
+    host, port = worker.address
+    out = []
+    with VerifyClient(host, port) as c:
+        for batch in seq:
+            out.append(c.verify_batch(batch))
+    return out
+
+
+def _norm(results):
+    """Comparable verdict form: claims dict or (reject class head)."""
+    out = []
+    for batch in results:
+        out.append([str(r).split(":", 1)[0] if isinstance(r, Exception)
+                    else r for r in batch])
+    return out
+
+
+def _serve_decisions(rec):
+    return {k: v for k, v in rec.counters().items()
+            if k.startswith("decision.serve.")}
+
+
+def _run_sweep(serve_native, vcache, seq, rotate_at=None):
+    """One sweep run → (normalized verdicts, serve decision counters).
+
+    rotate_at: batch index before which an epoch swap is applied —
+    the mid-run invalidation leg of the parity pin."""
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    w = VerifyWorker(ks, target_batch=128, max_wait_ms=2.0,
+                     serve_native=serve_native, vcache=vcache)
+    try:
+        if serve_native and w.serve_chain != "native":
+            pytest.skip("native serve chain unavailable")
+        host, port = w.address
+        out = []
+        with VerifyClient(host, port) as c:
+            for i, batch in enumerate(seq):
+                if rotate_at is not None and i == rotate_at:
+                    w.apply_keys({}, 2)
+                out.append(c.verify_batch(batch))
+        return _norm(out), _serve_decisions(rec)
+    finally:
+        w.close(10)
+        telemetry.disable()
+
+
+def _mixed_sequence(n_batches=24, seed=7):
+    """Randomized repeat-heavy mix: hot tokens, rejects, an expiring
+    token whose exp lands mid-run."""
+    import random
+
+    rng = random.Random(seed)
+    exp_soon = time.time() + 0.8
+    pool = ([_tok(f"hot{i}", ok=True, exp=time.time() + 3600)
+             for i in range(4)]
+            + [_tok(f"bad{i}", ok=False) for i in range(2)]
+            + [_tok("expiring", ok=True, exp=exp_soon)])
+    seq = []
+    for _ in range(n_batches):
+        seq.append([rng.choice(pool)
+                    for _ in range(rng.randrange(1, 6))])
+    return seq
+
+
+@pytest.mark.parametrize("serve_native", [False, True])
+def test_parity_cache_on_vs_off_mixed_sweep(serve_native):
+    """The acceptance pin: bit-identical verdicts AND decision-reason
+    counters, cache on vs off, incl. exp crossing + epoch swap."""
+    seq = _mixed_sequence()
+    on_verdicts, on_dec = _run_sweep(serve_native, True, seq,
+                                     rotate_at=12)
+    off_verdicts, off_dec = _run_sweep(serve_native, False, seq,
+                                       rotate_at=12)
+    assert on_verdicts == off_verdicts
+    assert on_dec == off_dec
+
+
+def test_worker_cache_hits_and_all_hit_fast_path():
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    w = VerifyWorker(ks, target_batch=64, max_wait_ms=2.0,
+                     vcache=True)
+    try:
+        out = _drive(w, [["h.x.ok", "r.x.bad"],
+                         ["h.x.ok", "r.x.bad"],
+                         ["h.x.ok"]])
+        assert out[0][0] == {"sub": "h.x.ok"}
+        assert isinstance(out[1][1], RemoteVerifyError)
+        assert out[2][0] == out[0][0]
+        c = rec.counters()
+        assert c.get("vcache.hits", 0) >= 3
+        assert c["vcache.lookups"] == c["vcache.hits"] \
+            + c["vcache.misses"]
+        # repeats never reached the engine
+        assert ks.seen.count("h.x.ok") == 1
+        assert ks.seen.count("r.x.bad") == 1
+        # decision records fired for EVERY response, hit or miss
+        dec = _serve_decisions(rec)
+        assert dec["decision.serve.accept"] == 3
+        assert dec["decision.serve.reject.bad_signature"] == 2
+    finally:
+        w.close(10)
+        telemetry.disable()
+
+
+def test_worker_epoch_swap_invalidates_cache():
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    w = VerifyWorker(ks, max_wait_ms=2.0, vcache=True)
+    try:
+        _drive(w, [["e.x.ok"], ["e.x.ok"]])
+        assert ks.seen.count("e.x.ok") == 1
+        w.apply_keys({}, 9)
+        _drive(w, [["e.x.ok"]])
+        # rotation dropped the cached verdict → engine re-verified
+        assert ks.seen.count("e.x.ok") == 2
+        assert rec.counters().get("vcache.epoch_bumps", 0) == 1
+        assert rec.counters().get("vcache.stale_accepts", 0) == 0
+    finally:
+        w.close(10)
+        telemetry.disable()
+
+
+def test_vcache_off_switch(monkeypatch):
+    monkeypatch.setenv("CAP_SERVE_VCACHE", "0")
+    ks = CountingStub()
+    w = VerifyWorker(ks, max_wait_ms=2.0)
+    try:
+        assert w._vcache is None
+        _drive(w, [["o.x.ok"], ["o.x.ok"]])
+        assert ks.seen.count("o.x.ok") == 2
+    finally:
+        w.close(10)
+
+
+# ---------------------------------------------------------------------------
+# native chain: digest cross-parity (C sha256 == Python hashlib)
+# ---------------------------------------------------------------------------
+
+
+def _native_available():
+    try:
+        from cap_tpu.serve import native_serve
+
+        return bool(getattr(native_serve.load(), "cap_vc_ok", False))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native serve runtime unavailable")
+def test_native_reader_digests_match_python_hashing():
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    w = VerifyWorker(ks, max_wait_ms=2.0, serve_native=True,
+                     vcache=True)
+    try:
+        assert w.serve_chain == "native"
+        assert w._native._native_digests
+        tok = "nd.x.ok"
+        _drive(w, [[tok]])
+        # the cache was filled under the C-computed digest; a lookup
+        # by the PYTHON digest must hit — the two definitions agree
+        assert w._vcache.get(V.token_digest(tok)) is not V.MISS
+        _drive(w, [[tok]])
+        assert ks.seen.count(tok) == 1
+        assert rec.counters().get("vcache.hits", 0) >= 1
+    finally:
+        w.close(10)
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# client tier (FleetClient)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_client_tier_short_circuits_before_wire():
+    from cap_tpu.fleet.worker_main import StubKeySet as FleetStub
+
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    w = VerifyWorker(ks, max_wait_ms=2.0, vcache=False)
+    try:
+        from cap_tpu.fleet import FleetClient
+
+        cl = FleetClient([w.address], fallback=FleetStub(),
+                         vcache=True)
+        o1 = cl.verify_batch(["fc.x.ok", "fb.x.bad"])
+        o2 = cl.verify_batch(["fc.x.ok", "fb.x.bad"])
+        assert o1[0] == o2[0] == {"sub": "fc.x.ok"}
+        assert type(o1[1]) is type(o2[1])
+        # the repeat never crossed the wire
+        assert ks.seen.count("fc.x.ok") == 1
+        snap = cl.snapshot()
+        assert snap["vcache"]["vcache.hits"] == 2
+        # router decision counters fired per CALL, hit or miss
+        dec = {k: v for k, v in rec.counters().items()
+               if k.startswith("decision.router.")}
+        assert dec["decision.router.accept"] == 2
+    finally:
+        w.close(10)
+        telemetry.disable()
+
+
+def test_fleet_client_tier_parity_on_vs_off():
+    from cap_tpu.fleet import FleetClient
+    from cap_tpu.fleet.worker_main import StubKeySet as FleetStub
+
+    ks = CountingStub()
+    w = VerifyWorker(ks, max_wait_ms=2.0, vcache=False)
+    try:
+        seq = _mixed_sequence(n_batches=10, seed=3)
+        outs = {}
+        for state in (True, False):
+            cl = FleetClient([w.address], fallback=FleetStub(),
+                             vcache=state)
+            outs[state] = _norm([cl.verify_batch(b) for b in seq])
+        assert outs[True] == outs[False]
+    finally:
+        w.close(10)
+
+
+# ---------------------------------------------------------------------------
+# dedup preserves per-request trace timelines (acceptance: capstat
+# --trace reassembles a deduped member's timeline end-to-end)
+# ---------------------------------------------------------------------------
+
+
+def test_deduped_members_keep_their_trace_timelines():
+    import sys
+    import os
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from tools import capstat
+
+    rec = telemetry.enable()
+    rec.reset()
+    ks = CountingStub()
+    # big window so both traced submissions coalesce into ONE flush
+    w = VerifyWorker(ks, target_batch=4096, max_wait_ms=120.0,
+                     vcache=True)
+    try:
+        host, port = w.address
+        tids = []
+        results = []
+
+        def one():
+            with telemetry.trace() as tid:
+                tids.append(tid)
+                with VerifyClient(host, port) as c:
+                    from cap_tpu.serve import protocol as P
+
+                    P.send_request(c._sock, ["tr.x.ok"], trace=tid)
+                    ftype, entries, echo = \
+                        c._reader.recv_frame_ex()
+                    results.append((ftype, entries, echo))
+
+        th = [threading.Thread(target=one) for _ in range(2)]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join(15)
+        assert len(results) == 2
+        # the engine verified the duplicate ONCE
+        assert ks.seen.count("tr.x.ok") == 1
+        flight = [{"trace": e.get("trace"), "spans": e.get("spans", [])}
+                  for e in rec.flight_slowest()]
+        for tid in tids:
+            spans = capstat.reassemble_trace(
+                tid, [{"flight": [e for e in flight
+                                  if e["trace"] == tid]}])
+            names = {s["name"] for s in spans}
+            # end-to-end: wire dequeue + batcher fill present for BOTH
+            # members even though they shared one verify
+            assert telemetry.SPAN_WORKER_DEQUEUE in names, \
+                (tid, names)
+            assert telemetry.SPAN_BATCHER_FILL in names, (tid, names)
+    finally:
+        w.close(10)
+        telemetry.disable()

@@ -17,8 +17,20 @@ windows — the repeat-heavy traffic shape real ingress has (the same
 bearer token arriving hundreds of times inside its lifetime), and the
 measurement harness ROADMAP item #3's verdict cache needs.
 ``CAP_SERVE_ZIPF_POOL=N`` bounds the sampled pool (the repeat-rate
-knob: smaller pool → higher repeat rate). The BENCH json reports
-tokens sent vs unique vs repeats under ``"zipf"``.
+knob: smaller pool → higher repeat rate). The pool's rank→token
+permutation is computed ONCE in the parent from a pinned seed
+(``CAP_SERVE_ZIPF_SEED``, default 1234) and shipped to every driver
+process, so repeat_rate is exact and comparable across every
+``CAP_SERVE_FLEET`` / chain / vcache arm. The BENCH json reports
+tokens sent vs unique vs repeats per point.
+
+VERDICT-CACHE A/B (fleet mode, ``CAP_SERVE_VCACHES="on,off"``): every
+(size, chain) arm runs once per cache state (workers spawned with
+CAP_SERVE_VCACHE=1/0), each point records its worker-side
+``cache`` counters (lookups/hits/misses/evictions/dedup_fanout/
+stale_accepts), and the headline gains ``zipf_cached_vps`` /
+``zipf_uncached_vps`` and their ratio — the §Round 14 measurement of
+ROADMAP #3's ≥5×-at-90%-repeat bar.
 
 SERVE-CHAIN COMPARISON (fleet mode, ``CAP_SERVE_CHAINS=
 "python,native"``): every fleet size runs once per listed chain
@@ -75,19 +87,39 @@ def _zipf_cfg():
     return (float(s), int(os.environ.get("CAP_SERVE_ZIPF_POOL", 0)))
 
 
-def _zipf_picker(tokens, req_tokens, seed, zipf):
+def _zipf_pool_indices(n_tokens, zipf):
+    """The SHARED Zipf pool: rank→token-index permutation, computed
+    ONCE in the parent from a pinned seed (``CAP_SERVE_ZIPF_SEED``,
+    default 1234) and shipped to every driver process. Every client in
+    every arm (fleet size × serve chain × vcache) then hammers the
+    IDENTICAL hot-token set, so ``repeat_rate`` in the json is exact
+    and comparable across ``CAP_SERVE_FLEET`` arms — drivers must
+    never regenerate the pool per process."""
+    import numpy as np
+
+    if zipf is None:
+        return None
+    _, pool = zipf
+    n = min(pool or n_tokens, n_tokens)
+    seed = int(os.environ.get("CAP_SERVE_ZIPF_SEED", "1234"))
+    return np.random.RandomState(seed).permutation(n_tokens)[:n]
+
+
+def _zipf_picker(tokens, req_tokens, seed, zipf, pool_idx=None):
     """Request generator state for the Zipf token mix: returns
-    ``pick() -> (token_list, index_array)``. Rank→token mapping is a
-    fixed permutation (seed-independent) so every client hammers the
-    SAME hot tokens — that is what makes the mix cacheable."""
+    ``pick() -> (token_list, index_array)``. Rank→token mapping is the
+    parent's shared pinned permutation (``pool_idx``) so every client
+    hammers the SAME hot tokens — that is what makes the mix
+    cacheable."""
     import numpy as np
 
     zs, pool = zipf
-    n = min(pool or len(tokens), len(tokens))
+    perm = (np.asarray(pool_idx) if pool_idx is not None
+            else _zipf_pool_indices(len(tokens), zipf))
+    n = len(perm)
     w = np.arange(1, n + 1, dtype=np.float64) ** -zs
     cdf = np.cumsum(w)
     cdf /= cdf[-1]
-    perm = np.random.RandomState(1234).permutation(len(tokens))[:n]
     rng = np.random.RandomState(seed * 7919 + 17)
 
     def pick():
@@ -98,7 +130,7 @@ def _zipf_picker(tokens, req_tokens, seed, zipf):
 
 
 def _client_proc(host, port, tokens, req_tokens, depth, start_at,
-                 seconds, seed, outq, zipf=None):
+                 seconds, seed, outq, zipf=None, pool_idx=None):
     """One client PROCESS: its own interpreter, so response decoding
     never shares the worker's (or other clients') GIL — in-process
     client threads cap the whole bench at one core of json parsing
@@ -115,8 +147,8 @@ def _client_proc(host, port, tokens, req_tokens, depth, start_at,
     done = 0
     sent = 0
     used = set()
-    picker = _zipf_picker(tokens, req_tokens, seed, zipf) if zipf \
-        else None
+    picker = _zipf_picker(tokens, req_tokens, seed, zipf,
+                          pool_idx=pool_idx) if zipf else None
     while time.time() < start_at:
         time.sleep(0.005)
     deadline = time.time() + seconds
@@ -170,6 +202,7 @@ def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
                           max_wait_ms=max_wait_ms)
     host, port = worker.address
     zipf = _zipf_cfg()
+    pool_idx = _zipf_pool_indices(len(tokens), zipf)
     # spawn (not fork): children must never inherit live TPU/jax state
     ctx = mp.get_context("spawn")
     outq = ctx.Queue()
@@ -177,7 +210,7 @@ def run_point(keyset, tokens, max_wait_ms: float, n_clients: int,
     procs = [ctx.Process(
         target=_client_proc,
         args=(host, port, tokens, req_tokens, depth, start_at,
-              seconds, i, outq, zipf), daemon=True)
+              seconds, i, outq, zipf, pool_idx), daemon=True)
         for i in range(n_clients)]
     for p in procs:
         p.start()
@@ -236,7 +269,7 @@ def _mix_fields(zipf, sent_total: int, used_union: set) -> dict:
 
 
 def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
-                       seed, outq, zipf=None):
+                       seed, outq, zipf=None, pool_idx=None):
     """One closed-loop FleetClient PROCESS (own interpreter)."""
     from cap_tpu.fleet import FleetClient
 
@@ -246,8 +279,8 @@ def _fleet_client_proc(endpoints, tokens, req_tokens, start_at, seconds,
     done = 0
     sent = 0
     used = set()
-    picker = _zipf_picker(tokens, req_tokens, seed, zipf) if zipf \
-        else None
+    picker = _zipf_picker(tokens, req_tokens, seed, zipf,
+                          pool_idx=pool_idx) if zipf else None
     rng = seed * 7919 + 17
     while time.time() < start_at:
         time.sleep(0.005)
@@ -323,12 +356,15 @@ def _native_drive(endpoints, tokens, req_tokens, seconds, n_clients,
 def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
                     n_clients: int, req_tokens: int, seconds: float,
                     max_wait_ms: float, target_batch: int,
-                    serve_chain=None) -> dict:
+                    serve_chain=None, vcache=None) -> dict:
     """Throughput of an n-worker fleet under single-owner placement.
 
     serve_chain: None (inherit the environment) or "python"/"native" —
     workers spawn with CAP_SERVE_NATIVE forced accordingly, for the
-    chain A/B the §Round 12 host-saturation comparison needs."""
+    chain A/B the §Round 12 host-saturation comparison needs.
+    vcache: None (inherit) or "on"/"off" — the verdict-cache A/B arm
+    (CAP_SERVE_VCACHE forced in the workers) the §Round 14
+    cached-vs-uncached Zipf comparison needs."""
     import multiprocessing as mp
 
     from cap_tpu.fleet import WorkerPool
@@ -337,6 +373,8 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
     if serve_chain is not None:
         env_extra["CAP_SERVE_NATIVE"] = \
             "1" if serve_chain == "native" else "0"
+    if vcache is not None:
+        env_extra["CAP_SERVE_VCACHE"] = "1" if vcache == "on" else "0"
     # CAP_SERVE_TELEMETRY=0: workers run with the observability layer
     # off — isolates the serve chain in the A/B (decision accounting
     # costs the same on both chains and dominates once the native
@@ -352,6 +390,7 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
         endpoints = sorted(pool.endpoints().values())
         chains = pool.serve_chains()
         zipf = _zipf_cfg()
+        pool_idx = _zipf_pool_indices(len(tokens), zipf)
         driver = os.environ.get("CAP_SERVE_DRIVER", "python")
         total, lats, errors = 0, [], []
         sent_total = 0
@@ -371,7 +410,7 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
             procs = [ctx.Process(
                 target=_fleet_client_proc,
                 args=(endpoints, tokens, req_tokens, start_at, seconds,
-                      i, outq, zipf), daemon=True)
+                      i, outq, zipf, pool_idx), daemon=True)
                 for i in range(n_clients)]
             for p in procs:
                 p.start()
@@ -409,6 +448,23 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
         # obs fallback shows up as false in the record)
         "native_obs": any(k.startswith("serve.native.hdr_cache")
                           for k in (agg.get("counters") or {})),
+        # verdict-cache arm + exact worker-side cache accounting for
+        # this point (merged scrape counters — hit rate of the serve
+        # tier, not the drivers')
+        "vcache": vcache or "env",
+        "cache": {
+            "lookups": (agg.get("counters") or {}).get(
+                "vcache.lookups", 0),
+            "hits": (agg.get("counters") or {}).get("vcache.hits", 0),
+            "misses": (agg.get("counters") or {}).get(
+                "vcache.misses", 0),
+            "evictions": (agg.get("counters") or {}).get(
+                "vcache.evictions", 0),
+            "dedup_fanout": (agg.get("counters") or {}).get(
+                "batcher.dedup_fanout", 0),
+            "stale_accepts": (agg.get("counters") or {}).get(
+                "vcache.stale_accepts", 0),
+        },
         "driver": driver,
         "throughput": round(total / seconds, 1),
         "requests": len(lats),
@@ -465,18 +521,32 @@ def fleet_main() -> None:
     # one run inheriting the environment's CAP_SERVE_NATIVE)
     chains = [c for c in os.environ.get(
         "CAP_SERVE_CHAINS", "").split(",") if c] or [None]
+    # verdict-cache A/B: CAP_SERVE_VCACHES="on,off" runs every
+    # (size, chain) arm once per listed cache state — the §Round 14
+    # cached-vs-uncached Zipf headline pair
+    vcaches = [v for v in os.environ.get(
+        "CAP_SERVE_VCACHES", "").split(",") if v] or [None]
     points = []
     for n in sizes:
         for chain in chains:
-            pt = run_fleet_point(n, keyset_spec, tokens, n_clients,
-                                 req_tokens, seconds, max_wait_ms,
-                                 target_batch, serve_chain=chain)
-            points.append(pt)
-            print(f"fleet n={n} chain={chain or 'env'}  "
-                  f"thr={pt['throughput']:>9.0f}/s  "
-                  f"p50={pt['p50_ms']:6.1f}ms p99={pt['p99_ms']:7.1f}ms  "
-                  f"per-worker={pt['per_worker_tokens']}",
-                  file=sys.stderr)
+            for vc in vcaches:
+                pt = run_fleet_point(n, keyset_spec, tokens, n_clients,
+                                     req_tokens, seconds, max_wait_ms,
+                                     target_batch, serve_chain=chain,
+                                     vcache=vc)
+                points.append(pt)
+                hit_line = ""
+                if pt["cache"]["lookups"]:
+                    rate = (100.0 * pt["cache"]["hits"]
+                            / pt["cache"]["lookups"])
+                    hit_line = f"  vc_hit={rate:.1f}%"
+                print(f"fleet n={n} chain={chain or 'env'} "
+                      f"vc={vc or 'env'}  "
+                      f"thr={pt['throughput']:>9.0f}/s  "
+                      f"p50={pt['p50_ms']:6.1f}ms "
+                      f"p99={pt['p99_ms']:7.1f}ms{hit_line}  "
+                      f"per-worker={pt['per_worker_tokens']}",
+                      file=sys.stderr)
 
     best = max(points, key=lambda p: p["throughput"])
     smallest = min(points, key=lambda p: p["n_workers"])
@@ -518,6 +588,17 @@ def fleet_main() -> None:
 
     native_vps = _chain_best("native")
     python_vps = _chain_best("python")
+
+    # verdict-cache Zipf headline pair: best cache-on vs best
+    # cache-off throughput among the Zipf-mix points (None unless the
+    # Zipf mode and both arms ran)
+    def _vc_best(state):
+        vals = [p["throughput"] for p in points
+                if p.get("vcache") == state and p.get("zipf_s")]
+        return max(vals) if vals else None
+
+    zipf_cached_vps = _vc_best("on")
+    zipf_uncached_vps = _vc_best("off")
     print(json.dumps({
         "metric": "serve_fleet_verifies_per_sec",
         "value": best["throughput"],
@@ -531,6 +612,14 @@ def fleet_main() -> None:
         "chain_speedup_native_vs_python": (
             round(native_vps / python_vps, 3)
             if native_vps and python_vps else None),
+        # verdict-cache Zipf headline (None unless CAP_SERVE_ZIPF and
+        # CAP_SERVE_VCACHES=on,off both ran): end-to-end vps with the
+        # cache tier on vs off on the identical pinned token pool.
+        "zipf_cached_vps": zipf_cached_vps,
+        "zipf_uncached_vps": zipf_uncached_vps,
+        "cache_speedup_on_vs_off": (
+            round(zipf_cached_vps / zipf_uncached_vps, 3)
+            if zipf_cached_vps and zipf_uncached_vps else None),
         "placement_model": "single-owner-per-device",
         # Pool-side supervision attribution for the whole sweep:
         # respawn/crash/hung counters + health-ping latency quantiles.

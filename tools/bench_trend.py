@@ -31,6 +31,9 @@ tools/bench_stages.py + bench_serve.py produce, committed from round
 - ``stage_python_us_per_token`` — Python-side serial cost per served
                                   token with the native chain on
                                   (LOWER is better — inverted check)
+- ``zipf_cached_vps``           — end-to-end fleet rate on the Zipf
+                                  90%-repeat mix with the verdict
+                                  cache ON (higher better; round 14+)
 
 MULTICHIP records are checked structurally: the latest round must
 still report ``ok`` (rc 0) on the same-or-larger device count.
@@ -69,7 +72,11 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # full-observability native chain (native telemetry
                  # plane on): us/token, lower is better — the r13
                  # "obs on at wire speed" contract must not erode
-                 "serve_native_obs_us_per_token": False}
+                 "serve_native_obs_us_per_token": False,
+                 # verdict-cache tier: end-to-end Zipf(0.9-repeat)
+                 # fleet rate with the cache ON (higher is better) —
+                 # the r14 memory-speed-repeats contract
+                 "zipf_cached_vps": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -314,6 +321,19 @@ def selftest(repo: str = REPO) -> List[str]:
                    "stage_python_us_per_token": 0.8}),
              sv[1]]):
         problems.append("introducing the obs metric flagged")
+    # 4c. verdict-cache Zipf headline: a drop must flag, introducing
+    #     the metric must not, and it vanishing must flag
+    zc = [(13, {"serve_native_vps": 1e6}),
+          (14, {"serve_native_vps": 1e6, "zipf_cached_vps": 5e5})]
+    if check_serve_series(zc):
+        problems.append("introducing zipf_cached_vps flagged")
+    if not check_serve_series(
+            [zc[1], (15, {"serve_native_vps": 1e6,
+                          "zipf_cached_vps": 3e5})]):
+        problems.append("zipf_cached_vps regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [zc[1], (15, {"serve_native_vps": 1e6})])):
+        problems.append("vanished zipf_cached_vps NOT flagged")
     # 5. the REAL series with a 15% regression injected into a copy of
     #    the newest record: must flag (the acceptance-bar case)
     real = load_series(repo)

@@ -184,6 +184,7 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
         # peak queued tokens since the previous scrape (native-side
         # high-water mark — bursts the point-in-time ring= misses)
         hwm = extra.get("serve.native.ring_hwm")
+        vc = _vc_cell(counters, extra.get("vcache.size"))
         lines.append(f"worker {ep}  pid={int(extra.get('worker.pid', 0))}"
                      + (f"  chain={'native' if chain else 'python'}"
                         if chain is not None else "")
@@ -192,6 +193,7 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
                         else "")
                      + (f"  epoch={int(epoch)}" if epoch is not None
                         else "")
+                     + (f"  vc={vc}" if vc is not None else "")
                      + f"  queued={int(extra.get('batcher.queued_tokens', 0))}"
                      f"  inflight={int(extra.get('batcher.inflight_batches', 0))}"
                      f"  requests={counters.get('worker.requests', 0)}"
@@ -210,6 +212,17 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
     lines.extend(_series_rows(telemetry.summarize_snapshot(merged)))
     agg_counters = merged.get("counters") or {}
     lines.extend(_decision_rows(agg_counters))
+    if agg_counters.get("vcache.lookups"):
+        lines.append(
+            f"  vcache: hit_rate={_vc_rate(agg_counters)}  "
+            f"hits={agg_counters.get('vcache.hits', 0)} "
+            f"misses={agg_counters.get('vcache.misses', 0)} "
+            f"evictions={agg_counters.get('vcache.evictions', 0)} "
+            f"epoch_bumps={agg_counters.get('vcache.epoch_bumps', 0)} "
+            f"dedup_fanout="
+            f"{agg_counters.get('batcher.dedup_fanout', 0)} "
+            f"stale_accepts="
+            f"{agg_counters.get('vcache.stale_accepts', 0)}")
     for fam in ("rs", "ps", "es", "ed"):
         waste = agg_counters.get(f"device.{fam}.pad_waste_rows")
         toks = agg_counters.get(f"device.{fam}.tokens")
@@ -246,6 +259,22 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
                          f"open_for_s={st.get('open_for_s', 0.0):.2f}")
         lines.extend(_series_rows(telemetry.summarize_snapshot(csnap)))
     return "\n".join(lines)
+
+
+def _vc_rate(counters: Dict[str, Any]) -> str:
+    """Verdict-cache hit rate over a counter map, as "NN.N%"."""
+    lookups = int(counters.get("vcache.lookups", 0) or 0)
+    hits = int(counters.get("vcache.hits", 0) or 0)
+    return f"{100.0 * hits / lookups:.1f}%" if lookups else "0.0%"
+
+
+def _vc_cell(counters: Dict[str, Any], size: Any) -> Optional[str]:
+    """Per-worker ``vc=hit%/size`` cell (None when the worker has no
+    cache tier — pre-cache workers or --vcache off)."""
+    if not counters.get("vcache.lookups") and size is None:
+        return None
+    sz = int(size) if size is not None else 0
+    return f"{_vc_rate(counters)}/{sz}"
 
 
 def _decision_rows(counters: Dict[str, Any]) -> List[str]:

@@ -13,6 +13,11 @@ The CI guard for the observability surface (``make obs-smoke``):
 4. FAIL if any exercised surface (serve worker, fleet router) reports
    ZERO decision counters — accept AND reject must both have counted
    for the mixed batch (cap_tpu.obs.decision);
+4b. VERDICT-CACHE GATE: drive a repeated-token burst and FAIL if the
+   workers report zero ``vcache.hits``, if the exactness invariant
+   ``vcache.lookups == vcache.hits + vcache.misses`` does not hold on
+   the merged scrape, or if the ``vcache.stale_accepts`` tripwire
+   moved — on BOTH serve chains;
 5. FAIL if the SLO engine cannot evaluate the default rules over the
    live fleet's merged counters, or if the wrong-verdict objective is
    breached;
@@ -134,6 +139,32 @@ def run_fleet(serve_chain):
              if k.startswith("decision.serve.")})
         info["router_decisions"] = obs_decision.decision_counters(
             router_counters)
+
+        # Verdict-cache gate: a repeated-token burst (client tier off
+        # — the workers must see every repeat) has to HIT, and the
+        # exactness invariant hits+misses == lookups must hold on the
+        # fresh merged scrape. stale_accepts is the serve-time clamp
+        # tripwire: any movement in a clean run is a cache bug.
+        for _ in range(5):
+            out = cl.verify_batch(["smoke-hot.ok"] * 4)
+            assert len(out) == 4
+        cache_counters = telemetry.merge_snapshots(
+            [capstat.scrape(f"{host}:{port}")["snapshot"]
+             for _, (host, port) in sorted(obs.items())]
+        ).get("counters") or {}
+        hits = cache_counters.get("vcache.hits", 0)
+        misses = cache_counters.get("vcache.misses", 0)
+        lookups = cache_counters.get("vcache.lookups", 0)
+        if hits <= 0:
+            failures.append("verdict cache: zero hits after a "
+                            "repeated-token burst")
+        if lookups != hits + misses:
+            failures.append(
+                f"verdict cache: lookups {lookups} != hits {hits} + "
+                f"misses {misses} (accounting drift)")
+        if cache_counters.get("vcache.stale_accepts", 0):
+            failures.append("verdict cache: stale_accepts tripwire "
+                            "moved in a clean run")
 
         # SLO engine over the LIVE fleet: an evaluation error (not a
         # breach — a crash/parse failure) is a smoke failure; so is a
