@@ -22,18 +22,19 @@ claims-probe-failed:
 	@echo "error: could not import cap_tpu._build with PYTHON=$(PYTHON); claims extension name unknown" >&2; exit 1
 else
 CLAIMS_SO := $(NATIVE_DIR)/$(CLAIMS_EXT_NAME)
-$(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp
+$(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp $(NATIVE_DIR)/claims_tape.h
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat check
+.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat claims-parity check
 
 all: native
 
 native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 
 $(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp $(NATIVE_DIR)/serve_native.cpp \
-		$(NATIVE_DIR)/telemetry_native.cpp $(NATIVE_DIR)/telemetry_native.h
+		$(NATIVE_DIR)/telemetry_native.cpp $(NATIVE_DIR)/telemetry_native.h \
+		$(NATIVE_DIR)/claims_validate.cpp $(NATIVE_DIR)/claims_tape.h
 	$(CXX) $(CXXFLAGS) -o $@ $(filter %.cpp,$^)
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
@@ -52,7 +53,8 @@ native-build:
 	   'cap_serve_add_conn', 'cap_serve_drain', 'cap_serve_post_results', \
 	   'cap_serve_probe_frame', 'cap_bench_drive', 'cap_tel_create', \
 	   'cap_tel_fold', 'cap_serve_post_results_tel', \
-	   'cap_serve_ring_hwm')]; \
+	   'cap_serve_ring_hwm', 'cap_claims_layout', \
+	   'cap_claims_validate_batch')]; \
 	  ctypes.CDLL('$(CLIENT_SO)').cap_client_connect; \
 	  print('native-build: all serve-native symbols resolve')"
 
@@ -100,6 +102,15 @@ bench-trend:
 mldsa-kat:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/mldsa_kat.py
 
+# Claims-rule differential gate: the generated ~1k adversarial corpus
+# through the dict path, the raw-path Python rules, and the native
+# claims engine (claims_validate.cpp) — verdicts and reason classes
+# must be bit-identical, and every native status code must be
+# exercised. Crypto-free, jax-free, fails if the engine won't load.
+claims-parity: native
+	JAX_PLATFORMS=cpu $(PYTHON) tools/claims_parity.py
+
 # The default local CI gate: observability smoke + keyplane rotation
-# smoke + perf-trend sentinel + post-quantum KAT gate.
-check: obs-smoke keyplane-smoke bench-trend mldsa-kat
+# smoke + perf-trend sentinel + post-quantum KAT gate + claims-rule
+# differential gate.
+check: obs-smoke keyplane-smoke bench-trend mldsa-kat claims-parity

@@ -185,6 +185,118 @@ def _rotation_fields(ks, jwks, tokens) -> dict:
     }}
 
 
+def _oidc_ab_fields() -> dict:
+    """CAP_BENCH_OIDC_NATIVE=0,1: the config-⑤ A/B over a REAL
+    accelerated keyset (ES256 — runs crypto-free via the host signer).
+
+    Interleaved same-window arms per rep (the r14 weather rule):
+    ③-analog raw signature verify (``verify_batch_raw``), ⑤-raw with
+    the Python rules (``CAP_OIDC_NATIVE=0`` → ``oidc_raw_vps``), and
+    ⑤-raw with the native claims engine (``oidc_native_vps``). The
+    ratio fields are the ROADMAP-#4 acceptance (⑤-raw ≤ 1.15 × ③ at
+    equal link MB/s); on a chip host this measures the real ladder,
+    device-stubbed hosts track the host-side story via
+    tools/bench_stages.py's claims row instead.
+    """
+    import hashlib
+    import random
+    import statistics as _st
+
+    from cap_tpu.jwt.jose import b64url_encode
+    from cap_tpu.jwt.jwk import parse_jwks
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+    from cap_tpu.oidc import Config, Provider, Request
+    from cap_tpu.tpu.ec import HostECPublicKey, curve, host_ecdsa_sign
+
+    arms = [a for a in os.environ.get(
+        "CAP_BENCH_OIDC_NATIVE", "").split(",") if a]
+    if not arms:
+        return {}
+    n = min(int(os.environ.get("CAP_BENCH_OIDC_BATCH", 1 << 14)),
+            1 << 17)
+    reps = int(os.environ.get("CAP_BENCH_OIDC_REPS", 3))
+    issuer, client = "https://bench.idp.example/", "bench-client"
+    # crypto-free ES256 fixtures (host signer + pure-int keys, the
+    # r11 pattern) so the A/B runs on hosts without `cryptography`
+    rng = random.Random(0x0517C)
+    cp = curve("P-256")
+    priv_d, jwk_dicts = [], []
+    for i in range(4):
+        d = rng.randrange(1, cp.n)
+        pub = HostECPublicKey.from_private("P-256", d).public_numbers()
+        priv_d.append(d)
+        jwk_dicts.append({
+            "kty": "EC", "crv": "P-256", "alg": "ES256",
+            "kid": f"oidc-{i}",
+            "x": b64url_encode(pub.x.to_bytes(32, "big")),
+            "y": b64url_encode(pub.y.to_bytes(32, "big")),
+        })
+    ks = TPUBatchKeySet(parse_jwks({"keys": jwk_dicts}))
+    cfg = Config(issuer=issuer, client_id=client,
+                 supported_signing_algs=["ES256"])
+    p = Provider(cfg, keyset=ks, discovery_doc={"issuer": issuer})
+    req = Request(3600.0, "http://127.0.0.1:1/cb")
+
+    def sign(claims: dict, i: int) -> str:
+        h = b64url_encode(json.dumps(
+            {"alg": "ES256", "kid": f"oidc-{i % 4}"},
+            separators=(",", ":")).encode())
+        pl = b64url_encode(json.dumps(
+            claims, separators=(",", ":")).encode())
+        e = int.from_bytes(
+            hashlib.sha256(f"{h}.{pl}".encode()).digest(), "big")
+        r, s = host_ecdsa_sign("P-256", priv_d[i % 4], e,
+                               rng.randrange(1, cp.n))
+        return f"{h}.{pl}." + b64url_encode(
+            r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+
+    now = time.time()
+    uniq = [sign({"iss": issuer, "sub": f"u{i:05d}", "aud": [client],
+                  "exp": now + 86400, "iat": now,
+                  "nonce": req.nonce(), "jti": f"b{i:05d}"}, i)
+            for i in range(min(n, 2048))]
+    toks = (uniq * (n // len(uniq) + 1))[:n]
+
+    def rate(fn):
+        out = fn()
+        bad = sum(1 for r in out if isinstance(r, Exception))
+        assert bad == 0, f"{bad} unexpected rejects"
+        t0 = time.perf_counter()
+        fn()
+        return n / (time.perf_counter() - t0)
+
+    prev = os.environ.get("CAP_OIDC_NATIVE")
+    series = {"raw3": [], "0": [], "1": []}
+    try:
+        ks.verify_batch_raw(toks[:256])      # warm compile
+        for _ in range(reps):
+            series["raw3"].append(rate(
+                lambda: ks.verify_batch_raw(toks)))
+            for arm in arms:
+                os.environ["CAP_OIDC_NATIVE"] = arm
+                series[arm].append(rate(
+                    lambda: p.verify_id_token_batch(toks, req,
+                                                    raw=True)))
+    finally:
+        if prev is None:
+            os.environ.pop("CAP_OIDC_NATIVE", None)
+        else:
+            os.environ["CAP_OIDC_NATIVE"] = prev
+
+    med = {k: _st.median(v) for k, v in series.items() if v}
+    fields = {"oidc_batch": n,
+              "cfg3_raw_verify_vps": round(med["raw3"], 1)}
+    if "0" in med:
+        fields["oidc_raw_vps"] = round(med["0"], 1)
+        fields["oidc_python_over_cfg3"] = round(
+            med["raw3"] / med["0"], 3)
+    if "1" in med:
+        fields["oidc_native_vps"] = round(med["1"], 1)
+        fields["oidc_native_over_cfg3"] = round(
+            med["raw3"] / med["1"], 3)
+    return {"oidc": fields}
+
+
 def _probe_wire_mbps() -> float:
     """Raw sustained H2D bandwidth right now (16 MB u8, best of 2)."""
     import jax
@@ -392,6 +504,14 @@ def main() -> None:
             print(f"rotation bench failed: {e!r}", file=sys.stderr)
             rotate_fields = {"rotate": {"error": repr(e)}}
 
+    oidc_fields = {}
+    if os.environ.get("CAP_BENCH_OIDC_NATIVE"):
+        try:
+            oidc_fields = _oidc_ab_fields()
+        except Exception as e:  # noqa: BLE001 - advisory field
+            print(f"oidc A/B bench failed: {e!r}", file=sys.stderr)
+            oidc_fields = {"oidc": {"error": repr(e)}}
+
     print(f"sign={sign_s:.1f}s window={window} "
           f"rates={[round(r) for r in rates]} "
           f"interval_s p50={slats[len(slats) // 2]:.3f} p99={p99:.3f} "
@@ -460,6 +580,10 @@ def main() -> None:
         # CAP_BENCH_ROTATE=1 only: hot-rotation cost (swap latency,
         # grace-window integrity, unknown-kid fallback burst).
         **rotate_fields,
+        # CAP_BENCH_OIDC_NATIVE=0,1 only: the config-⑤ A/B —
+        # oidc_raw_vps (Python rules) vs oidc_native_vps (native
+        # claims engine) vs the ③-analog raw verify, same window.
+        **oidc_fields,
     }))
 
 

@@ -30,14 +30,16 @@ _FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
 EXT_NAME = "_capclaims" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
 
 # (sources, output, needs_python_headers) — paths relative to
-# cap_tpu/. libcapruntime.so is built from THREE translation units:
+# cap_tpu/. libcapruntime.so is built from FOUR translation units:
 # jose_native.cpp (batch JOSE prep), serve_native.cpp (the GIL-free
-# serve chain), and telemetry_native.cpp (the native telemetry
-# plane) — one .so, so every binding loads the same library.
+# serve chain), telemetry_native.cpp (the native telemetry plane),
+# and claims_validate.cpp (the OIDC claims-rule engine) — one .so, so
+# every binding loads the same library.
 _TARGETS = [
     ((os.path.join("runtime", "native", "jose_native.cpp"),
       os.path.join("runtime", "native", "serve_native.cpp"),
-      os.path.join("runtime", "native", "telemetry_native.cpp")),
+      os.path.join("runtime", "native", "telemetry_native.cpp"),
+      os.path.join("runtime", "native", "claims_validate.cpp")),
      os.path.join("runtime", "native", "libcapruntime.so"), False),
     ((os.path.join("serve", "native", "client_native.cpp"),),
      os.path.join("serve", "native", "libcapclient.so"), False),
@@ -53,10 +55,17 @@ def _build_one(sources, out: str, py_headers: bool,
     out = os.path.join(_PKG, out)
     if not srcs:
         return
-    # headers shared between the TUs count toward staleness too
+    # headers shared between the TUs count toward staleness too: the
+    # same-basename .h of each source plus the cross-TU tape header
+    # (claims_tape.h is included by BOTH claims_ext.cpp and
+    # claims_validate.cpp — an edit there must rebuild both .so's)
+    src_dirs = {os.path.dirname(s) for s in srcs}
     deps = srcs + [h for s in srcs
                    for h in [os.path.splitext(s)[0] + ".h"]
                    if os.path.exists(h)]
+    deps += [h for d in src_dirs
+             for h in [os.path.join(d, "claims_tape.h")]
+             if os.path.exists(h) and h not in deps]
     if not force and os.path.exists(out) and \
             os.path.getmtime(out) >= max(os.path.getmtime(s)
                                          for s in deps):

@@ -32,6 +32,12 @@ Keyset specs (``--keyset``):
   rotation without a worker restart — see docs/KEYPLANE.md.
 - ``oidc:<issuer>`` — same, with the JWKS URL resolved through OIDC
   discovery (issuer-equality enforced).
+- ``oidc-rp:issuer=I;client=C;nonce=N[;algs=ES256+RS256][;aud=a+b]
+  [;keyset=<inner spec>]`` — the serve tier's FULL OIDC surface:
+  wraps the inner engine (default ``stub:raw=1,echo=1``) in
+  ``oidc.OIDCRawKeySet`` so every served token passes signature
+  verification AND registered-claims validation (native rules engine
+  behind ``CAP_OIDC_NATIVE``; see docs/SERVE.md).
 
 Every keyset kind accepts the fleet's KEYS pushes (CVB1 type 11):
 ``swap_keys`` swaps the live tables and the ready line / STATS /
@@ -60,9 +66,17 @@ class StubKeySet:
     """
 
     def __init__(self, batch_ms: float = 0.0, token_us: float = 0.0,
-                 pipeline: float = 0.0, raw: float = 0.0):
+                 pipeline: float = 0.0, raw: float = 0.0,
+                 echo: float = 0.0):
         self._batch_s = batch_ms / 1e3
         self._token_s = token_us / 1e6
+        # echo=1 (raw mode only): a verified token's payload is its
+        # OWN base64url-decoded middle segment instead of the fixed
+        # stub bytes — the crypto-free seam the OIDC serve surface and
+        # the claims differential suite drive real claim JSON through
+        # (verdict still suffix-determined; undecodable middles keep
+        # the fixed payload so the stub can never raise).
+        self._echo = bool(echo)
         # raw=1: serve the raw-claims interface real engines expose
         # (verify_batch_raw → payload BYTES per verified token), so a
         # bench against the stub exercises the same zero-reserialize
@@ -92,6 +106,9 @@ class StubKeySet:
             reject = InvalidSignatureError(
                 "no known key successfully validated the token signature")
             ok = b'{"sub":"stub"}'
+            if self._echo:
+                return [self._echo_payload(t, ok)
+                        if t.endswith(".ok") else reject for t in tokens]
             return [ok if t.endswith(".ok") else reject for t in tokens]
         return [
             {"sub": t} if t.endswith(".ok")
@@ -100,6 +117,24 @@ class StubKeySet:
             for t in tokens
         ]
 
+    @staticmethod
+    def _echo_payload(token: str, default: bytes) -> bytes:
+        import base64
+        import binascii
+
+        parts = token.split(".")
+        if len(parts) != 3:
+            return default
+        try:
+            pad = "=" * (-len(parts[1]) % 4)
+            # validate=True: stdlib b64decode silently DROPS foreign
+            # characters otherwise, and a corrupt middle segment must
+            # keep the fixed payload, not decode to garbage
+            return base64.b64decode(
+                parts[1].replace("-", "+").replace("_", "/") + pad,
+                validate=True)
+        except (ValueError, binascii.Error):
+            return default
 
     def verify_batch(self, tokens):
         sleep_s = self._batch_s + self._token_s * len(tokens)
@@ -143,10 +178,30 @@ def make_keyset(spec: str):
                 if not kv:
                     continue
                 k, _, v = kv.partition("=")
-                if k not in ("batch_ms", "token_us", "pipeline", "raw"):
+                if k not in ("batch_ms", "token_us", "pipeline", "raw",
+                             "echo"):
                     raise ValueError(f"unknown stub option {k!r}")
                 kwargs[k] = float(v)
         return StubKeySet(**kwargs)
+    if spec.startswith("oidc-rp:"):
+        # Full OIDC verify-AND-validate serving: wrap an inner engine
+        # spec in the Provider-backed serve surface. Options are
+        # ';'-separated k=v; `keyset=` holds the inner spec verbatim
+        # (its own ','/':' intact). Discovery is injected, not
+        # fetched — an `oidc-rp:` worker boots without IdP traffic.
+        from ..oidc.serve_keyset import oidc_rp_keyset_from_spec
+
+        opts = {}
+        for part in spec[len("oidc-rp:"):].split(";"):
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            if k not in ("issuer", "client", "nonce", "algs", "aud",
+                         "redirect", "keyset"):
+                raise ValueError(f"unknown oidc-rp option {k!r}")
+            opts[k] = v
+        inner = make_keyset(opts.pop("keyset", "stub:raw=1,echo=1"))
+        return oidc_rp_keyset_from_spec(opts, inner)
     if spec.startswith("jwks:"):
         _configure_devices()
         import json

@@ -17,11 +17,13 @@ from .pkce import CodeVerifier, S256Verifier, create_code_challenge
 from .prompt import Prompt
 from .provider import Provider
 from .request import REQUEST_EXPIRY_SKEW, Request
+from .serve_keyset import OIDCRawKeySet
 from .token import TOKEN_EXPIRY_SKEW, AccessToken, RefreshToken, Token
 
 __all__ = [
     "ClientSecret", "Config", "Display", "DEFAULT_ID_LENGTH", "new_id",
     "IDToken", "CodeVerifier", "S256Verifier", "create_code_challenge",
-    "Prompt", "Provider", "REQUEST_EXPIRY_SKEW", "Request",
-    "TOKEN_EXPIRY_SKEW", "AccessToken", "RefreshToken", "Token",
+    "Prompt", "Provider", "OIDCRawKeySet", "REQUEST_EXPIRY_SKEW",
+    "Request", "TOKEN_EXPIRY_SKEW", "AccessToken", "RefreshToken",
+    "Token",
 ]

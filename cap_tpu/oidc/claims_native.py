@@ -1,0 +1,259 @@
+"""Binding for the native OIDC claims-rule engine.
+
+``runtime/native/claims_validate.cpp`` (the fourth TU of
+libcapruntime.so) evaluates the pure-comparison subset of the
+registered-claims rules — iss equality, exp/nbf/iat windows with the
+verify leeway, nonce equality, aud membership + multi-aud-contains-
+client_id, and the azp simple-equality arm — in one GIL-free batched
+call per verify batch, directly off the phase-1 claims tape. This
+module is the Python edge of it:
+
+- :data:`STATUS_INDEX` is the FIXED-ORDER status registry (the
+  ``REASON_INDEX`` pattern from the r13 telemetry plane): index IS the
+  native ABI, append-only, and :func:`_handshake` disables the engine
+  when a stale ``.so`` reports a different registry length or version
+  — a drifted library can refuse, never misclassify.
+- :data:`STATUS_ERROR_NAMES` maps reject statuses **by NAME** onto the
+  :mod:`cap_tpu.errors` taxonomy, so a native reject constructs the
+  SAME exception class Python's ``_validate_id_claims`` would raise
+  (messages match verbatim where the Python message is static;
+  dynamic-part messages keep the template without the payload value —
+  the differential suite pins verdicts and classes, and the obs
+  reason-class mapping rides the class alone).
+- status ``fallback`` (and an unavailable/disabled engine) routes the
+  token to the existing Python rule path — the conservative-fallback
+  contract ``registered_batch`` already uses, counted on
+  ``oidc.native_fallbacks``; natively decided tokens count on
+  ``oidc.native_validated``.
+
+Switch: ``CAP_OIDC_NATIVE=0`` disables the engine (the graceful kill
+switch, same stance as ``CAP_SERVE_VCACHE``); anything else leaves it
+on whenever the library loads and the layout handshake passes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from .. import errors as _errors
+
+# ---------------------------------------------------------------------------
+# status registry (native ABI — append-only; claims_validate.cpp's
+# VStatus enum and kNumStatus are the C side of this table)
+# ---------------------------------------------------------------------------
+
+LAYOUT_VERSION = 1
+
+STATUS_OK = 0
+STATUS_FALLBACK = 1
+
+STATUS_INDEX = (
+    "ok",                        # 0  accepted natively
+    "fallback",                  # 1  Python rules decide this token
+    "missing_exp",               # 2
+    "expired",                   # 3
+    "not_before",                # 4
+    "wrong_issuer",              # 5
+    "unsupported_alg",           # 6
+    "wrong_nonce",               # 7
+    "future_iat",                # 8
+    "aud_non_string",            # 9
+    "aud_mismatch",              # 10
+    "multi_aud_missing_client",  # 11
+    "azp_mismatch",              # 12
+)
+
+# status name → errors.py class NAME (by-name so the mapping is
+# wire-roundtrip stable, the decision.REASON_FOR_ERROR stance; the
+# differential suite pins every entry against what
+# provider._validate_id_claims actually raises)
+STATUS_ERROR_NAMES = {
+    "missing_exp": "MissingClaimError",
+    "expired": "ExpiredTokenError",
+    "not_before": "InvalidNotBeforeError",
+    "wrong_issuer": "InvalidIssuerError",
+    "unsupported_alg": "UnsupportedAlgError",
+    "wrong_nonce": "InvalidNonceError",
+    "future_iat": "InvalidIssuedAtError",
+    "aud_non_string": "InvalidAudienceError",
+    "aud_mismatch": "InvalidAudienceError",
+    "multi_aud_missing_client": "InvalidAudienceError",
+    "azp_mismatch": "InvalidAuthorizedPartyError",
+}
+
+# Registered span: the whole claims-validation stage of one raw batch
+# (native call or Python rule loop — whichever ran).
+SPAN_OIDC_VALIDATE = telemetry.SPAN_OIDC_VALIDATE
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def status_error(status: int, alg: Optional[str] = None,
+                 client_id: str = "", now: Optional[float] = None
+                 ) -> Exception:
+    """Construct the taxonomy exception for one native reject status.
+
+    Messages mirror provider.py's wording; static messages are
+    byte-identical, dynamic ones keep the template with the parts the
+    binding knows (alg from the header-segment cache, client_id from
+    the policy) — classes, and therefore obs reason classes, always
+    match the Python engine exactly.
+    """
+    name = STATUS_INDEX[status]
+    cls = getattr(_errors, STATUS_ERROR_NAMES[name])
+    if name == "missing_exp":
+        return cls("id_token missing exp claim")
+    if name == "expired":
+        return cls("token is expired")
+    if name == "not_before":
+        return cls("current time before the nbf (not before) time")
+    if name == "wrong_issuer":
+        return cls("id token issued by a different provider")
+    if name == "unsupported_alg":
+        return cls(f"id_token signed with unsupported algorithm {alg!r}")
+    if name == "wrong_nonce":
+        return cls("invalid id_token nonce")
+    if name == "future_iat":
+        return cls(f"current time {now} before the iat (issued at) time")
+    if name == "aud_non_string":
+        return cls("aud claim contains a non-string value")
+    if name == "aud_mismatch":
+        return cls("invalid id_token audiences")
+    if name == "multi_aud_missing_client":
+        return cls("multiple audiences and one of them is not equal "
+                   f"client_id ({client_id})")
+    if name == "azp_mismatch":
+        return cls(f"authorized party is not equal client_id ({client_id})")
+    raise ValueError(f"not a reject status: {status}")
+
+
+def pack_policy(issuer: str, client_id: str, nonce: str,
+                audiences: Sequence[str], leeway: float,
+                max_age_requested: bool) -> bytes:
+    """Compile one batch's rule policy into the native blob (format
+    documented in claims_validate.cpp's parse_policy)."""
+    iss = issuer.encode("utf-8")
+    cli = client_id.encode("utf-8")
+    non = nonce.encode("utf-8")
+    auds = [a.encode("utf-8") for a in audiences]
+    head = struct.pack("<IIdI", 1, 1 if max_age_requested else 0,
+                       float(leeway), len(auds))
+    lens = struct.pack("<III", len(iss), len(cli), len(non))
+    lens += struct.pack(f"<{len(auds)}I", *[len(a) for a in auds]) \
+        if auds else b""
+    return head + lens + iss + cli + non + b"".join(auds)
+
+
+class _Engine:
+    """One loaded-and-handshaked native engine (module singleton)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        lib.cap_claims_layout.argtypes = [_i32p]
+        layout = np.zeros(2, np.int32)
+        lib.cap_claims_layout(layout.ctypes.data_as(_i32p))
+        if (int(layout[0]), int(layout[1])) != (LAYOUT_VERSION,
+                                                len(STATUS_INDEX)):
+            raise RuntimeError(
+                f"claims engine layout drift: lib reports "
+                f"{layout.tolist()}, binding expects "
+                f"[{LAYOUT_VERSION}, {len(STATUS_INDEX)}]")
+        lib.cap_claims_validate_batch.restype = ctypes.c_int32
+        lib.cap_claims_validate_batch.argtypes = [
+            _u8p, ctypes.c_int64, _i64p, _i64p, ctypes.c_int64,
+            _u8p, ctypes.c_int64, _u8p, ctypes.c_double, _u8p,
+            ctypes.c_int32,
+        ]
+        self._lib = lib
+
+    def validate(self, payloads: Sequence[bytes], alg_ok: np.ndarray,
+                 now: float, policy: bytes) -> Optional[np.ndarray]:
+        """[status u8] per payload, or None when the native call
+        refuses (unusable policy/spans → whole-batch Python path)."""
+        n = len(payloads)
+        if n == 0:
+            return np.zeros(0, np.uint8)
+        scratch = np.frombuffer(b"".join(payloads), np.uint8)
+        if len(scratch) == 0:
+            scratch = np.zeros(1, np.uint8)
+        lens = np.fromiter((len(p) for p in payloads), np.int64, count=n)
+        offs = np.zeros(n, np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        pol = np.frombuffer(policy, np.uint8)
+        out = np.zeros(n, np.uint8)
+        rc = self._lib.cap_claims_validate_batch(
+            scratch.ctypes.data_as(_u8p), len(scratch),
+            offs.ctypes.data_as(_i64p), lens.ctypes.data_as(_i64p), n,
+            pol.ctypes.data_as(_u8p), len(pol),
+            np.ascontiguousarray(alg_ok, np.uint8).ctypes.data_as(_u8p),
+            float(now), out.ctypes.data_as(_u8p), 0)
+        if rc != 0:
+            return None
+        return out
+
+
+_engine: Optional[_Engine] = None
+_engine_probed = False
+
+
+def _load_engine() -> Optional[_Engine]:
+    """Load + handshake once per process; None = engine unavailable
+    (missing/stale library, layout drift — every caller then takes the
+    Python rule path, visibly via oidc.native_fallbacks)."""
+    global _engine, _engine_probed
+    if _engine_probed:
+        return _engine
+    _engine_probed = True
+    try:
+        # native_binding owns the build-on-first-use latch and the one
+        # CDLL handle every libcapruntime consumer shares
+        from ..runtime import native_binding
+
+        _engine = _Engine(native_binding._lib)
+    except Exception:  # noqa: BLE001 - graceful: Python rules serve
+        _engine = None
+    return _engine
+
+
+def enabled() -> bool:
+    """True when the native rules engine will serve the next batch
+    (CAP_OIDC_NATIVE kill switch honored per call, library loaded,
+    layout handshake passed)."""
+    if os.environ.get("CAP_OIDC_NATIVE", "1") == "0":
+        return False
+    return _load_engine() is not None
+
+
+def validate_payloads(payloads: Sequence[bytes], alg_ok: np.ndarray,
+                      now: float, policy: bytes) -> Optional[np.ndarray]:
+    """One native batched rules call; None → caller takes the Python
+    path for the whole batch (engine off/unavailable/refused)."""
+    if not enabled():
+        return None
+    eng = _load_engine()
+    assert eng is not None
+    return eng.validate(payloads, alg_ok, now, policy)
+
+
+def count_validated(n: int) -> None:
+    if n:
+        telemetry.count("oidc.native_validated", n)
+
+
+def count_fallbacks(n: int) -> None:
+    if n:
+        telemetry.count("oidc.native_fallbacks", n)
+
+
+def _reset_for_tests() -> None:
+    """Forget the probed engine (stale-.so / drift tests re-probe)."""
+    global _engine, _engine_probed
+    _engine = None
+    _engine_probed = False

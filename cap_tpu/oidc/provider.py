@@ -40,6 +40,7 @@ from ..errors import (
     UnsupportedAlgError,
     UserInfoFailedError,
 )
+from .. import telemetry as _telemetry
 from ..jwt.jose import is_json_form, peek_alg
 from ..jwt.keyset import JSONWebKeySet, KeySet
 from ..utils import http as _http
@@ -72,6 +73,10 @@ class Provider:
         # exact header bytes, and peek_alg's per-token re-parse was the
         # binding term of the batched id_token path (docs/PERF.md r5).
         self._alg_cache: Dict[str, str] = {}
+        # (allowed?, alg) by header segment — the native claims
+        # engine's per-token alg_ok input (bounded like _alg_cache;
+        # exact: supported_signing_algs is fixed per Provider)
+        self._alg_ok_cache: Dict[str, tuple] = {}
 
         if discovery_doc is None:
             discovery_doc = _http.fetch_discovery(config.issuer, self._ssl_ctx)
@@ -287,38 +292,166 @@ class Provider:
                     "raw id_token batch mode needs a keyset with "
                     "verify_batch_raw (TPUBatchKeySet/TPURemoteKeySet)")
             results = self._keyset.verify_batch_raw(raws)
-            from ..runtime.native_binding import (
-                registered_claims_from_payloads,
-            )
-
-            acc = [i for i, r in enumerate(results)
-                   if not isinstance(r, Exception)]
-            claims_sub = registered_claims_from_payloads(
-                [results[i] for i in acc])
-            claims_for = dict(zip(acc, claims_sub))
-        else:
-            results = self._keyset.verify_batch(raws)
-        out: List[Any] = []
-        for i, (raw_tok, res) in enumerate(zip(raws, results)):
+            out = [None] * len(raws)
+            acc: List[int] = []
+            for i, res in enumerate(results):
+                if isinstance(res, Exception):
+                    # same wrapping as the single-token path so callers
+                    # see one taxonomy regardless of which API they used
+                    out[i] = res if isinstance(res, InvalidSignatureError) \
+                        else InvalidSignatureError(
+                            f"failed to verify id token signature: {res}")
+                else:
+                    acc.append(i)
+            self._validate_accepted_raw(acc, raws, results, request, out)
+            return out
+        results = self._keyset.verify_batch(raws)
+        out = []
+        for raw_tok, res in zip(raws, results):
             if isinstance(res, Exception):
-                # same wrapping as the single-token path so callers see
-                # one taxonomy regardless of which API they used
                 if isinstance(res, InvalidSignatureError):
                     out.append(res)
                 else:
                     out.append(InvalidSignatureError(
                         f"failed to verify id token signature: {res}"))
                 continue
-            claims = claims_for[i] if raw else res
+            try:
+                self._check_times(res)
+                self._validate_id_claims(res, raw_tok, request)
+                out.append(res)
+            except Exception as e:  # noqa: BLE001 - per-token error channel
+                out.append(e)
+        return out
+
+    def _validate_accepted_raw(self, acc: List[int], raws: Sequence[str],
+                               results: Sequence[Any], request: Request,
+                               out: List[Any]) -> None:
+        """Claims validation for the raw batch's signature-ACCEPTED
+        tokens, filling ``out`` in place (payload bytes or exception).
+
+        One native batched rules call (claims_validate.cpp) replaces
+        the per-token Python loop — including ``_check_times`` — when
+        the engine is live; per-token ``fallback`` statuses and an
+        unavailable/disabled engine (``CAP_OIDC_NATIVE=0``, stale
+        ``.so``, layout drift) take the existing Python rule path over
+        the registered-claims tape subset, so verdicts cannot diverge
+        (``oidc.native_fallbacks`` makes every such token visible).
+        """
+        from . import claims_native
+
+        with _telemetry.span(_telemetry.SPAN_OIDC_VALIDATE):
+            statuses = None
+            alg_ok = None
+            algs: List[Any] = []
+            if acc and claims_native.enabled():
+                import numpy as _np
+
+                # Per-token allowed-alg verdicts off the header-
+                # segment cache: one (ok, alg) entry per DISTINCT
+                # compact header (an IdP has a handful), so the loop
+                # is a partition + dict hit per token. JSON-form
+                # tokens (no stable prefix) and parse surprises route
+                # through _alg_of / the Python arm per token.
+                alg_ok = _np.zeros(len(acc), _np.uint8)
+                algs = [None] * len(acc)
+                forced_fb = []
+                supported = self.config.supported_signing_algs
+                cache = self._alg_ok_cache
+                for j, i in enumerate(acc):
+                    t = raws[i]
+                    seg = t.partition(".")[0] if t[:1] != "{" else None
+                    hit = cache.get(seg) if seg is not None else None
+                    if hit is None:
+                        try:
+                            a = self._alg_of(t)
+                        except Exception:  # noqa: BLE001 - Python arm
+                            forced_fb.append(j)
+                            continue
+                        hit = (1 if a in supported else 0, a)
+                        if seg is not None:
+                            if len(cache) >= 1024:
+                                cache.clear()
+                            cache[seg] = hit
+                    alg_ok[j] = hit[0]
+                    algs[j] = hit[1]
+                try:
+                    statuses = claims_native.validate_payloads(
+                        [results[i] for i in acc], alg_ok,
+                        self.config.now(), self._policy_blob(request))
+                except Exception:  # noqa: BLE001 - degrade, never fail
+                    # e.g. a policy the blob can't express (non-string
+                    # audiences) — the Python rules remain authoritative
+                    statuses = None
+                if statuses is not None:
+                    for j in forced_fb:
+                        statuses[j] = claims_native.STATUS_FALLBACK
+            if statuses is None:
+                # whole-batch Python path (engine off or refused)
+                claims_native.count_fallbacks(len(acc))
+                self._python_validate_raw(acc, raws, results, request,
+                                          out)
+                return
+            if not statuses.any():
+                # all-accept fast path: the common serve batch — no
+                # per-token branching, one count
+                for i in acc:
+                    out[i] = results[i]
+                claims_native.count_validated(len(acc))
+                return
+            fb: List[int] = []
+            now = self.config.now()
+            client = self.config.client_id
+            for j, i in enumerate(acc):
+                st = int(statuses[j])
+                if st == claims_native.STATUS_OK:
+                    out[i] = results[i]
+                elif st == claims_native.STATUS_FALLBACK:
+                    fb.append(i)
+                else:
+                    out[i] = claims_native.status_error(
+                        st, alg=algs[j], client_id=client, now=now)
+            claims_native.count_validated(len(acc) - len(fb))
+            claims_native.count_fallbacks(len(fb))
+            if fb:
+                self._python_validate_raw(fb, raws, results, request,
+                                          out)
+
+    def _python_validate_raw(self, idx: List[int], raws: Sequence[str],
+                             results: Sequence[Any], request: Request,
+                             out: List[Any]) -> None:
+        """The Python rule path for raw-mode tokens: registered-claims
+        subset off the native tape (json.loads on its conservative
+        fallbacks), then the shared ``_check_times`` +
+        ``_validate_id_claims`` rules per token."""
+        if not idx:
+            return
+        from ..runtime.native_binding import (
+            registered_claims_from_payloads,
+        )
+
+        claims_sub = registered_claims_from_payloads(
+            [results[i] for i in idx])
+        for i, claims in zip(idx, claims_sub):
             try:
                 if isinstance(claims, Exception):
                     raise claims
                 self._check_times(claims)
-                self._validate_id_claims(claims, raw_tok, request)
-                out.append(res if raw else claims)
-            except Exception as e:  # noqa: BLE001 - per-token error channel
-                out.append(e)
-        return out
+                self._validate_id_claims(claims, raws[i], request)
+                out[i] = results[i]
+            except Exception as e:  # noqa: BLE001 - per-token channel
+                out[i] = e
+
+    def _policy_blob(self, request: Request) -> bytes:
+        """The native engine's per-batch policy (compiled once per
+        call: issuer/client/nonce/audiences/leeway + the max_age
+        rare-flag bit that keeps auth_time on the Python path)."""
+        from . import claims_native
+
+        _, auth_after = request.max_age()
+        return claims_native.pack_policy(
+            self.config.issuer, self.config.client_id, request.nonce(),
+            request.audiences() or list(self.config.audiences),
+            _VERIFY_LEEWAY, bool(auth_after))
 
     def _verify_signature_and_times(self, raw: str) -> Dict[str, Any]:
         try:
@@ -390,7 +523,15 @@ class Provider:
         if isinstance(aud_claim, str):
             aud_list = [aud_claim]
         elif isinstance(aud_claim, list):
-            aud_list = [a for a in aud_claim if isinstance(a, str)]
+            # go-jose/go-oidc parity: an aud ARRAY may only hold
+            # strings. Non-string entries used to be silently dropped,
+            # so ["client", 42] validated as a single-audience token —
+            # now they reject (pinned on both rule engines by the
+            # differential suite).
+            if any(not isinstance(a, str) for a in aud_claim):
+                raise InvalidAudienceError(
+                    "aud claim contains a non-string value")
+            aud_list = list(aud_claim)
         else:
             aud_list = []
         audiences = request.audiences() or list(self.config.audiences)

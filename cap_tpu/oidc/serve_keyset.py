@@ -1,0 +1,94 @@
+"""The serve worker's OIDC surface.
+
+``VerifyWorker`` serves whatever exposes ``verify_batch`` /
+``verify_batch_raw`` — until now that was raw SIGNATURE verification
+only, and full OIDC validation (the thing ``cap`` exists to do) lived
+outside the serve tier. :class:`OIDCRawKeySet` closes that gap: it
+wraps a :class:`~cap_tpu.oidc.provider.Provider` bound to one
+:class:`~cap_tpu.oidc.request.Request` (the RP's expected
+nonce/audience policy) and serves the FULL verify-AND-validate path —
+``verify_id_token_batch(raw=True)``, whose claims rules run in the
+native engine (claims_validate.cpp) when ``CAP_OIDC_NATIVE`` permits,
+with per-token Python fallback counted on ``oidc.native_fallbacks``
+(visible in worker STATS and obs scrapes, the graceful-degradation
+contract).
+
+Keyplane passthrough: KEYS pushes address the provider's underlying
+engine, so hot key rotation works unchanged through this wrapper.
+
+``worker_main --keyset "oidc-rp:issuer=...;client=...;nonce=...[;algs=
+ES256+RS256][;aud=a+b][;keyset=<inner spec>]"`` builds one of these in
+a fleet worker subprocess (discovery is injected, never fetched — the
+serve tier must boot without IdP round-trips; the keyplane specs
+remain the networked path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from .provider import Provider
+from .request import Request
+
+
+class OIDCRawKeySet:
+    """Serve ``Provider.verify_id_token_batch`` through a VerifyWorker.
+
+    The worker's raw-claims wrapper probes ``verify_batch_raw`` — this
+    class exposes it, so accepted tokens stream their signed payload
+    bytes straight onto the wire while every registered-claims rule
+    (iss/exp/nbf/iat/nonce/aud/azp/auth_time) has been enforced.
+    """
+
+    def __init__(self, provider: Provider, request: Request):
+        self._provider = provider
+        self._request = request
+
+    @property
+    def provider(self) -> Provider:
+        return self._provider
+
+    @property
+    def request(self) -> Request:
+        return self._request
+
+    def verify_batch(self, tokens: Sequence[str]) -> List[Any]:
+        return self._provider.verify_id_token_batch(
+            list(tokens), self._request)
+
+    def verify_batch_raw(self, tokens: Sequence[str]) -> List[Any]:
+        return self._provider.verify_id_token_batch(
+            list(tokens), self._request, raw=True)
+
+    # -- keyplane passthrough ---------------------------------------------
+
+    @property
+    def key_epoch(self):
+        return getattr(self._provider.keyset, "key_epoch", None)
+
+    def swap_keys(self, jwks, epoch=None, grace_s: float = 0.0):
+        swap = getattr(self._provider.keyset, "swap_keys", None)
+        if swap is None:
+            raise TypeError(
+                f"{type(self._provider.keyset).__name__} does not "
+                "support hot key rotation")
+        return swap(jwks, epoch=epoch, grace_s=grace_s)
+
+
+def oidc_rp_keyset_from_spec(opts: dict, inner) -> OIDCRawKeySet:
+    """Build the serve surface from parsed ``oidc-rp:`` spec options
+    (worker_main's seam; split out so tests can drive it directly)."""
+    from .config import Config
+
+    issuer = opts.get("issuer", "")
+    client = opts.get("client", "")
+    algs = [a for a in (opts.get("algs") or "ES256").split("+") if a]
+    auds = [a for a in (opts.get("aud") or "").split("+") if a]
+    cfg = Config(issuer=issuer, client_id=client,
+                 supported_signing_algs=algs,
+                 audiences=auds or None)
+    provider = Provider(cfg, keyset=inner,
+                        discovery_doc={"issuer": issuer})
+    request = Request(3600.0, opts.get("redirect", "http://127.0.0.1:1/cb"),
+                      nonce=opts.get("nonce") or None)
+    return OIDCRawKeySet(provider, request)

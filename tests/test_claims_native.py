@@ -1,0 +1,477 @@
+"""Native claims-rule engine: differential parity, fallback matrix,
+registry pins, build health, and the serve-surface wiring.
+
+The engine (runtime/native/claims_validate.cpp, bound by
+cap_tpu/oidc/claims_native.py) evaluates the pure-comparison subset
+of the OIDC registered-claims rules in C off the phase-1 tape; its
+verdicts — and exception classes, and therefore obs reason classes —
+must be indistinguishable from the Python rules for EVERY input, with
+parse corners and rare-flag arms falling back per token. Everything
+here is crypto-free (the stub signature seam) and jax-free.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from claims_parity import (  # noqa: E402
+    SIG_OK,
+    DifferentialStubKeySet,
+    make_rig,
+    run_sweep,
+    token_for,
+)
+from gen_claims_corpus import (  # noqa: E402
+    CLIENT,
+    FIXED_NOW,
+    ISSUER,
+    NONCE,
+    POLICIES,
+    SEED,
+    build_corpus,
+    corpus_sha256,
+)
+
+from cap_tpu import errors as cap_errors
+from cap_tpu import telemetry
+from cap_tpu.obs import decision
+from cap_tpu.oidc import claims_native
+
+# A generator edit that changes coverage must re-pin here, visibly
+# (the gen_go_golden byte-stability stance).
+CORPUS_SHA256 = \
+    "7a9834f33c88e27d65fddbd3cec71d6198619b714b1ac0054809eeb9edec312b"
+CORPUS_CASES = 1050
+
+
+def _b64(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+_HDR = _b64(json.dumps({"alg": "ES256"}).encode())
+
+
+def _tok(payload_text: str, hdr: str = _HDR) -> str:
+    return f"{hdr}.{_b64(payload_text.encode())}.{SIG_OK}"
+
+
+def _claims(**over):
+    c = {"iss": ISSUER, "sub": "alice", "aud": [CLIENT],
+         "exp": FIXED_NOW + 3600, "iat": FIXED_NOW - 10,
+         "nonce": NONCE}
+    for k, v in over.items():
+        if v is ...:
+            c.pop(k, None)
+        else:
+            c[k] = v
+    return json.dumps(c, separators=(",", ":"))
+
+
+@pytest.fixture()
+def rig():
+    return make_rig(POLICIES[0])
+
+
+@pytest.fixture()
+def native_on(monkeypatch):
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "1")
+    if not claims_native.enabled():
+        pytest.skip("native claims engine unavailable on this host")
+
+
+# ---------------------------------------------------------------------------
+# registries: fixed order, complete, mapped onto errors.py by NAME
+# ---------------------------------------------------------------------------
+
+def test_status_registry_shape():
+    assert claims_native.STATUS_INDEX[0] == "ok"
+    assert claims_native.STATUS_INDEX[1] == "fallback"
+    # every non-terminal status maps by NAME onto the errors taxonomy
+    for name in claims_native.STATUS_INDEX[2:]:
+        cls_name = claims_native.STATUS_ERROR_NAMES[name]
+        cls = getattr(cap_errors, cls_name)
+        assert issubclass(cls, cap_errors.CapError)
+    assert set(claims_native.STATUS_ERROR_NAMES) == \
+        set(claims_native.STATUS_INDEX[2:])
+
+
+def test_status_errors_classify_like_python():
+    """Every native reject class lands in the SAME obs reason class
+    the Python engine's exception would."""
+    want = {
+        "missing_exp": "invalid_claims",
+        "expired": "expired",
+        "not_before": "invalid_claims",
+        "wrong_issuer": "invalid_claims",
+        "unsupported_alg": "unsupported_alg",
+        "wrong_nonce": "invalid_claims",
+        "future_iat": "invalid_claims",
+        "aud_non_string": "invalid_claims",
+        "aud_mismatch": "invalid_claims",
+        "multi_aud_missing_client": "invalid_claims",
+        "azp_mismatch": "invalid_claims",
+    }
+    for idx, name in enumerate(claims_native.STATUS_INDEX):
+        if name in ("ok", "fallback"):
+            continue
+        err = claims_native.status_error(idx, alg="ES256",
+                                         client_id=CLIENT, now=0.0)
+        assert decision.classify(err) == want[name], name
+
+
+def test_layout_handshake_matches_registry(native_on):
+    import ctypes
+
+    from cap_tpu.runtime import native_binding
+
+    layout = np.zeros(2, np.int32)
+    native_binding._lib.cap_claims_layout(
+        layout.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    assert layout.tolist() == [claims_native.LAYOUT_VERSION,
+                               len(claims_native.STATUS_INDEX)]
+
+
+def test_layout_drift_disables_engine(monkeypatch):
+    """A stale .so reporting a different status-registry length must
+    refuse to enable — never misclassify."""
+    monkeypatch.setattr(claims_native, "LAYOUT_VERSION", 999)
+    monkeypatch.setattr(claims_native, "_engine", None)
+    monkeypatch.setattr(claims_native, "_engine_probed", False)
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "1")
+    assert not claims_native.enabled()
+
+
+# ---------------------------------------------------------------------------
+# corpus: byte-stable generation, three-engine differential sweep
+# ---------------------------------------------------------------------------
+
+def test_corpus_is_byte_stable():
+    cases = build_corpus(SEED)
+    assert len(cases) == CORPUS_CASES
+    assert corpus_sha256(cases) == CORPUS_SHA256, (
+        "corpus generation changed — review coverage and re-pin "
+        "CORPUS_SHA256")
+
+
+def test_corpus_differential_sweep(native_on):
+    """THE acceptance gate: ~1k adversarial cases through the dict
+    path, the raw-path Python rules, and the native engine — verdicts
+    and reason classes bit-identical, every status exercised."""
+    problems, status_counts = run_sweep()
+    assert problems == []
+    for name in claims_native.STATUS_INDEX:
+        assert status_counts.get(name, 0) > 0, \
+            f"native status {name!r} never exercised by the corpus"
+
+
+def test_thirteen_vector_suite_both_engines(rig, native_on,
+                                            monkeypatch):
+    """The r5 13-vector differential suite, crypto-free, swept with
+    the native engine ON and OFF — verdict classes pinned equal."""
+    provider, request = make_rig(POLICIES[0])
+    good = _claims()
+    vectors = [
+        ("good", _tok(good)),
+        ("expired", _tok(_claims(exp=1000))),
+        ("future-nbf", _tok(_claims(nbf=2 ** 33))),
+        ("wrong-nonce", _tok(_claims(nonce="nope"))),
+        ("wrong-aud", _tok(_claims(aud=["other"]))),
+        ("aud-string", _tok(_claims(aud=CLIENT))),
+        ("multi-aud-azp", _tok(_claims(aud=[CLIENT, "x"],
+                                       azp=CLIENT))),
+        ("multi-aud-bad-azp", _tok(_claims(aud=[CLIENT, "x"],
+                                           azp="intruder"))),
+        ("aud-object-fallback", _tok(_claims(aud={"weird": 1}))),
+        ("escaped-key-fallback",
+         _tok(good.replace('"iss"', '"i\\u0073s"'))),
+        ("wrong-issuer", _tok(_claims(iss="https://evil.example/"))),
+        ("tampered", _tok(good)[:-2] + "xx"),
+        ("not-a-jwt", "garbage"),
+    ]
+    names, toks = zip(*vectors)
+    dict_out = provider.verify_id_token_batch(list(toks), request)
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "0")
+    py_out = provider.verify_id_token_batch(list(toks), request,
+                                            raw=True)
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "1")
+    nat_out = provider.verify_id_token_batch(list(toks), request,
+                                             raw=True)
+    for name, d, py, na in zip(names, dict_out, py_out, nat_out):
+        assert isinstance(d, Exception) == isinstance(py, Exception) \
+            == isinstance(na, Exception), name
+        if isinstance(d, Exception):
+            assert type(d) is type(py) is type(na), \
+                f"{name}: {type(d)} vs {type(py)} vs {type(na)}"
+            assert decision.classify(d) == decision.classify(na), name
+        else:
+            assert py == na and json.loads(na) == d, name
+
+
+def test_multi_aud_non_string_rejects_on_both_engines(rig,
+                                                      native_on,
+                                                      monkeypatch):
+    """The satellite fix, pinned: ["client", 42] used to validate as
+    single-audience (non-strings silently dropped); now it rejects
+    with InvalidAudienceError on the dict path, the raw Python rules,
+    and the native engine."""
+    provider, request = make_rig(POLICIES[0])
+    toks = [_tok(_claims(aud=[CLIENT, 42])),
+            _tok(_claims(aud=[42])),
+            _tok(_claims(aud=[CLIENT, None])),
+            _tok(_claims(aud=[True]))]
+    for env in ("0", "1"):
+        monkeypatch.setenv("CAP_OIDC_NATIVE", env)
+        for out in (provider.verify_id_token_batch(toks, request),
+                    provider.verify_id_token_batch(toks, request,
+                                                   raw=True)):
+            for r in out:
+                assert isinstance(r, cap_errors.InvalidAudienceError), \
+                    (env, r)
+
+
+# ---------------------------------------------------------------------------
+# fallback matrix + counters (graceful degradation acceptance)
+# ---------------------------------------------------------------------------
+
+def _counters_after_raw(provider, request, toks):
+    rec = telemetry.enable()
+    rec.reset()
+    out = provider.verify_id_token_batch(toks, request, raw=True)
+    counters = {k: v for k, v in rec.counters().items()
+                if k.startswith("oidc.")}
+    telemetry.disable()
+    return out, counters
+
+
+def test_env_kill_switch_falls_back_with_counter(rig, monkeypatch):
+    provider, request = rig
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "0")
+    toks = [_tok(_claims()) for _ in range(5)]
+    out, counters = _counters_after_raw(provider, request, toks)
+    assert not any(isinstance(r, Exception) for r in out)
+    assert counters.get("oidc.native_fallbacks", 0) == 5
+    assert "oidc.native_validated" not in counters
+
+
+def test_native_arm_counts_validated(rig, native_on, monkeypatch):
+    provider, request = rig
+    toks = [_tok(_claims()) for _ in range(4)] + \
+        [_tok(_claims().replace('"iss"', '"i\\u0073s"'))]
+    out, counters = _counters_after_raw(provider, request, toks)
+    assert not any(isinstance(r, Exception) for r in out)
+    assert counters.get("oidc.native_validated", 0) == 4
+    # the escaped-key corner fell back per token, visibly
+    assert counters.get("oidc.native_fallbacks", 0) == 1
+
+
+def test_missing_engine_falls_back_gracefully(rig, monkeypatch):
+    """Stale-.so arm: the probed engine is gone → whole batch takes
+    the Python rules with the fallback counter, verdicts unchanged."""
+    provider, request = rig
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "1")
+    monkeypatch.setattr(claims_native, "_engine", None)
+    monkeypatch.setattr(claims_native, "_engine_probed", True)
+    toks = [_tok(_claims()), _tok(_claims(exp=FIXED_NOW - 5))]
+    out, counters = _counters_after_raw(provider, request, toks)
+    assert not isinstance(out[0], Exception)
+    assert isinstance(out[1], cap_errors.ExpiredTokenError)
+    assert counters.get("oidc.native_fallbacks", 0) == 2
+
+
+def test_max_age_policy_takes_python_arm(native_on, monkeypatch):
+    """The auth_time/max_age rare-flag arm: every token under a
+    max_age policy falls back (counted), verdicts still identical to
+    the dict path."""
+    provider, request = make_rig(POLICIES[3])
+    assert POLICIES[3]["max_age"] is not None
+    toks = [_tok(_claims(auth_time=FIXED_NOW - 30)),
+            _tok(_claims())]
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "1")
+    out, counters = _counters_after_raw(provider, request, toks)
+    dict_out = provider.verify_id_token_batch(toks, request)
+    for d, r in zip(dict_out, out):
+        assert isinstance(d, Exception) == isinstance(r, Exception)
+        if isinstance(d, Exception):
+            assert type(d) is type(r)
+    assert counters.get("oidc.native_fallbacks", 0) == 2
+
+
+def test_policy_blob_roundtrip(native_on):
+    """pack_policy → native parse: a same-policy batch evaluates; a
+    truncated blob makes the engine refuse (None → Python path)."""
+    pol = claims_native.pack_policy(ISSUER, CLIENT, NONCE,
+                                    ["a", "b"], 60.0, False)
+    payloads = [_claims().encode()]
+    ok = claims_native.validate_payloads(
+        payloads, np.ones(1, np.uint8), FIXED_NOW, pol)
+    assert ok is not None
+    bad = claims_native.validate_payloads(
+        payloads, np.ones(1, np.uint8), FIXED_NOW, pol[:-3])
+    assert bad is None
+
+
+# ---------------------------------------------------------------------------
+# serve surface: the worker serves verify-AND-validate
+# ---------------------------------------------------------------------------
+
+def _serve_rig():
+    from cap_tpu.fleet.worker_main import make_keyset
+
+    return make_keyset(
+        f"oidc-rp:issuer={ISSUER};client={CLIENT};nonce={NONCE}")
+
+
+def test_worker_serves_oidc_surface(native_on):
+    import time
+
+    from cap_tpu.serve.client import VerifyClient
+    from cap_tpu.serve.worker import VerifyWorker
+
+    now = time.time()
+    good = json.dumps({"iss": ISSUER, "sub": "a", "aud": [CLIENT],
+                       "exp": now + 3600, "nonce": NONCE},
+                      separators=(",", ":"))
+    bad_iss = good.replace(ISSUER, "https://evil.example/")
+    rec = telemetry.enable()
+    rec.reset()
+    ks = _serve_rig()
+    w = VerifyWorker(ks, max_wait_ms=1.0)
+    try:
+        with VerifyClient(*w.address) as cl:
+            out = cl.verify_batch([
+                f"{_HDR}.{_b64(good.encode())}.ok",
+                f"{_HDR}.{_b64(bad_iss.encode())}.ok",
+                f"{_HDR}.{_b64(good.encode())}.bad",
+            ])
+        assert json.loads(json.dumps(out[0])) == json.loads(good)
+        assert isinstance(out[1], Exception)
+        assert str(out[1]).startswith("InvalidIssuerError")
+        assert isinstance(out[2], Exception)
+        assert str(out[2]).startswith("InvalidSignatureError")
+        # the fallback/validated counters ride worker STATS — the
+        # "visible in scrapes" acceptance (stats shares the recorder
+        # the obs server scrapes)
+        stats = w.stats()
+        oidc_counters = {k: v for k, v in stats["counters"].items()
+                         if k.startswith("oidc.")}
+        assert oidc_counters.get("oidc.native_validated", 0) >= 2
+    finally:
+        w.close(deadline_s=10)
+        telemetry.disable()
+
+
+def test_worker_oidc_surface_python_arm(monkeypatch):
+    """CAP_OIDC_NATIVE=0 end-to-end: same verdicts, fallback counter
+    visible in the worker's STATS scrape."""
+    import time
+
+    from cap_tpu.serve.client import VerifyClient
+    from cap_tpu.serve.worker import VerifyWorker
+
+    monkeypatch.setenv("CAP_OIDC_NATIVE", "0")
+    now = time.time()
+    good = json.dumps({"iss": ISSUER, "sub": "a", "aud": [CLIENT],
+                       "exp": now + 3600, "nonce": NONCE},
+                      separators=(",", ":"))
+    rec = telemetry.enable()
+    rec.reset()
+    w = VerifyWorker(_serve_rig(), max_wait_ms=1.0)
+    try:
+        with VerifyClient(*w.address) as cl:
+            out = cl.verify_batch([f"{_HDR}.{_b64(good.encode())}.ok"])
+        assert not isinstance(out[0], Exception)
+        stats = w.stats()
+        assert stats["counters"].get("oidc.native_fallbacks", 0) >= 1
+        assert "oidc.native_validated" not in stats["counters"]
+    finally:
+        w.close(deadline_s=10)
+        telemetry.disable()
+
+
+def test_oidc_rp_spec_parsing():
+    from cap_tpu.fleet.worker_main import make_keyset
+    from cap_tpu.oidc.serve_keyset import OIDCRawKeySet
+
+    ks = make_keyset(
+        f"oidc-rp:issuer={ISSUER};client={CLIENT};nonce=n1;"
+        "algs=ES256+RS256;aud=a+b;keyset=stub:raw=1,echo=1")
+    assert isinstance(ks, OIDCRawKeySet)
+    assert ks.provider.config.supported_signing_algs == \
+        ["ES256", "RS256"]
+    assert ks.provider.config.audiences == ["a", "b"]
+    with pytest.raises(ValueError, match="unknown oidc-rp option"):
+        make_keyset("oidc-rp:issuer=x;bogus=1")
+
+
+def test_stub_echo_payload():
+    from cap_tpu.fleet.worker_main import StubKeySet
+
+    ks = StubKeySet(raw=1, echo=1)
+    payload = b'{"sub":"me"}'
+    tok = f"h.{_b64(payload)}.ok"
+    out = ks.verify_batch_raw([tok, "h.!!bad-b64!!.ok", "x.bad"])
+    assert out[0] == payload
+    assert out[1] == b'{"sub":"stub"}'   # undecodable → fixed payload
+    assert isinstance(out[2], Exception)
+
+
+# ---------------------------------------------------------------------------
+# build health: the r12 native-build gate extended — a silently-dead
+# claims TU is impossible (all four TUs from source to a temp .so,
+# cap_claims_* must resolve)
+# ---------------------------------------------------------------------------
+
+def test_native_build_all_four_tus_and_claims_symbols(tmp_path):
+    import ctypes
+    import shutil
+
+    from cap_tpu import _build
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler on this host")
+    out = str(tmp_path / "libcapruntime_claims_test.so")
+    _build._build_one(
+        (os.path.join("runtime", "native", "jose_native.cpp"),
+         os.path.join("runtime", "native", "serve_native.cpp"),
+         os.path.join("runtime", "native", "telemetry_native.cpp"),
+         os.path.join("runtime", "native", "claims_validate.cpp")),
+        out, False, timeout=300.0, force=True)
+    assert os.path.exists(out), "native build produced no library"
+    lib = ctypes.CDLL(out)
+    for sym in ("cap_claims_layout", "cap_claims_validate_batch"):
+        assert hasattr(lib, sym), f"symbol {sym} missing"
+    layout = np.zeros(2, np.int32)
+    lib.cap_claims_layout(
+        layout.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    assert layout.tolist() == [claims_native.LAYOUT_VERSION,
+                               len(claims_native.STATUS_INDEX)]
+
+
+# ---------------------------------------------------------------------------
+# doc pins
+# ---------------------------------------------------------------------------
+
+def test_docs_pin_status_table_and_metrics():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "docs", "PERF.md")) as f:
+        perf = f.read()
+    for name in claims_native.STATUS_INDEX:
+        assert f"`{name}`" in perf, \
+            f"status {name} missing from the PERF.md rule table"
+    with open(os.path.join(repo, "docs", "OBSERVABILITY.md")) as f:
+        obs = f.read()
+    for metric in ("oidc.native_fallbacks", "oidc.native_validated"):
+        assert metric in obs
+    with open(os.path.join(repo, "docs", "SERVE.md")) as f:
+        serve = f.read()
+    assert "CAP_OIDC_NATIVE" in serve

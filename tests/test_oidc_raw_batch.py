@@ -67,6 +67,7 @@ def _vectors(idp, req, priv, alg, kid):
                                       azp=idp.client_id))),
         ("multi-aud-bad-azp", sign(claims(aud=[idp.client_id, "x"],
                                           azp="intruder"))),
+        ("multi-aud-non-string", sign(claims(aud=[idp.client_id, 42]))),
         ("aud-object-fallback", sign(claims(aud={"weird": 1}))),
         ("escaped-key-fallback",
          sign(json.loads(json.dumps(claims()).replace(
@@ -78,8 +79,13 @@ def _vectors(idp, req, priv, alg, kid):
     ]
 
 
-def test_raw_mode_verdict_parity(rig):
+@pytest.mark.parametrize("native", ["0", "1"])
+def test_raw_mode_verdict_parity(rig, monkeypatch, native):
+    """Both rule engines (CAP_OIDC_NATIVE=0 Python, =1 the native
+    claims engine with its conservative per-token fallbacks) must
+    match the dict path vector-for-vector."""
     idp, p, req, priv, alg, kid = rig
+    monkeypatch.setenv("CAP_OIDC_NATIVE", native)
     names, toks = zip(*_vectors(idp, req, priv, alg, kid))
     dict_out = p.verify_id_token_batch(list(toks), req)
     raw_out = p.verify_id_token_batch(list(toks), req, raw=True)

@@ -76,7 +76,13 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # verdict-cache tier: end-to-end Zipf(0.9-repeat)
                  # fleet rate with the cache ON (higher is better) —
                  # the r14 memory-speed-repeats contract
-                 "zipf_cached_vps": True}
+                 "zipf_cached_vps": True,
+                 # OIDC verify-AND-validate, device-stubbed, native
+                 # claims-rule engine on (higher is better) — the r15
+                 # wire-speed-validation contract (bench_stages.py
+                 # claims row; chip-host bench.py emits the real-
+                 # ladder analog under "oidc")
+                 "oidc_native_vps": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -334,6 +340,19 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [zc[1], (15, {"serve_native_vps": 1e6})])):
         problems.append("vanished zipf_cached_vps NOT flagged")
+    # 4d. oidc_native_vps (r15): introducing must not flag; a drop
+    #     and a disappearance must
+    oc = [(14, {"serve_native_vps": 1e6}),
+          (15, {"serve_native_vps": 1e6, "oidc_native_vps": 3e5})]
+    if check_serve_series(oc):
+        problems.append("introducing oidc_native_vps flagged")
+    if not check_serve_series(
+            [oc[1], (16, {"serve_native_vps": 1e6,
+                          "oidc_native_vps": 2e5})]):
+        problems.append("oidc_native_vps regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [oc[1], (16, {"serve_native_vps": 1e6})])):
+        problems.append("vanished oidc_native_vps NOT flagged")
     # 5. the REAL series with a 15% regression injected into a copy of
     #    the newest record: must flag (the acceptance-bar case)
     real = load_series(repo)

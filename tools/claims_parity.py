@@ -1,0 +1,257 @@
+#!/usr/bin/env python3
+"""claims-parity: the native claims-rule engine differential gate.
+
+Sweeps the generated adversarial corpus (tools/gen_claims_corpus.py,
+~1k cases) through THREE rule paths and fails on any divergence:
+
+1. **dict path** — ``Provider.verify_id_token_batch`` over parsed
+   claims dicts: the pure-Python reference semantics;
+2. **raw path, Python rules** — ``raw=True`` with
+   ``CAP_OIDC_NATIVE=0``: registered-claims tape subset + the Python
+   rule loop (the pre-r15 behavior);
+3. **raw path, native rules** — ``raw=True`` with the engine on: one
+   ``cap_claims_validate_batch`` call, per-token fallback corners.
+
+Parity contract (the ISSUE acceptance): bit-identical VERDICTS
+(accept/reject, and accepted bytes are the signed payload) and
+identical exception CLASSES — which pins the obs reason classes too
+(``obs.decision.classify`` is class-driven). The sweep is crypto-free:
+signatures ride the stub seam (tokens ending in the ``sigok`` b64
+marker verify; the payload IS the middle segment), so the gate runs
+everywhere, jax-free, in seconds.
+
+Also asserts COVERAGE: every native status (every rule's reject code
+and the fallback) must be observed at least once — a corpus edit that
+silently stops exercising a rule fails the gate.
+
+Exit 0 green; 1 on divergence, missing native engine, or lost
+coverage. ``make claims-parity`` wires this into ``make check``.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from gen_claims_corpus import (  # noqa: E402
+    CLIENT,
+    FIXED_NOW,
+    ISSUER,
+    NONCE,
+    POLICIES,
+    SEED,
+    build_corpus,
+    corpus_sha256,
+)
+
+
+def _b64(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+SIG_OK = _b64(b"sigok")
+_HDRS = {alg: _b64(json.dumps({"alg": alg},
+                              separators=(",", ":")).encode())
+         for alg in ("ES256", "RS384")}
+
+
+class DifferentialStubKeySet:
+    """The crypto-free signature seam: ``<hdr>.<payload-b64>.<SIG_OK>``
+    verifies; the payload is the decoded middle segment. Rejection and
+    malformed-payload classes mirror what the real TPU raw/dict paths
+    produce, so provider-level wrapping is identical in both modes."""
+
+    def _one(self, token: str, want_raw: bool) -> Any:
+        from cap_tpu.errors import (
+            InvalidSignatureError,
+            MalformedTokenError,
+        )
+
+        parts = token.split(".")
+        if len(parts) != 3 or parts[2] != SIG_OK:
+            return InvalidSignatureError(
+                "no known key successfully validated the token "
+                "signature")
+        try:
+            pad = "=" * (-len(parts[1]) % 4)
+            payload = base64.urlsafe_b64decode(parts[1] + pad)
+        except Exception:  # noqa: BLE001
+            return MalformedTokenError("invalid base64url segment")
+        try:
+            claims = json.loads(payload)
+        except (ValueError, UnicodeDecodeError) as e:
+            return MalformedTokenError(
+                f"payload is not valid JSON: {e}")
+        if not isinstance(claims, dict):
+            return MalformedTokenError("payload is not a JSON object")
+        return payload if want_raw else claims
+
+    def verify_batch(self, tokens):
+        return [self._one(t, False) for t in tokens]
+
+    def verify_batch_raw(self, tokens):
+        return [self._one(t, True) for t in tokens]
+
+
+def token_for(case: Dict[str, Any]) -> str:
+    hdr = _HDRS[case["alg"]]
+    return f"{hdr}.{_b64(case['payload'].encode('utf-8'))}.{SIG_OK}"
+
+
+def make_rig(policy: Dict[str, Any]):
+    """(provider, request) for one corpus policy, clock pinned to
+    FIXED_NOW, stub signature seam injected."""
+    from cap_tpu.oidc import Config, Provider, Request
+
+    cfg = Config(issuer=ISSUER, client_id=CLIENT,
+                 supported_signing_algs=["ES256"],
+                 audiences=(policy["audiences"]
+                            if policy["name"] != "other-aud" else None),
+                 now_func=lambda: FIXED_NOW)
+    provider = Provider(cfg, keyset=DifferentialStubKeySet(),
+                        discovery_doc={"issuer": ISSUER})
+    request = Request(
+        3600.0, "http://127.0.0.1:1/cb", nonce=NONCE,
+        audiences=(policy["audiences"]
+                   if policy["name"] == "other-aud" else None),
+        max_age=policy["max_age"])
+    return provider, request
+
+
+def _tag(result: Any) -> str:
+    if isinstance(result, Exception):
+        return type(result).__name__
+    return "accept"
+
+
+def run_sweep(cases: List[Dict[str, Any]] | None = None
+              ) -> Tuple[List[str], Dict[str, int]]:
+    """(problems, native-status counts) over the whole corpus."""
+    from cap_tpu.obs import decision
+    from cap_tpu.oidc import claims_native
+
+    if cases is None:
+        cases = build_corpus(SEED)
+    problems: List[str] = []
+    status_counts: collections.Counter = collections.Counter()
+
+    by_policy: Dict[int, List[Dict[str, Any]]] = \
+        collections.defaultdict(list)
+    for case in cases:
+        by_policy[case["policy"]].append(case)
+
+    prev = os.environ.get("CAP_OIDC_NATIVE")
+    try:
+        for pol_idx, group in sorted(by_policy.items()):
+            provider, request = make_rig(POLICIES[pol_idx])
+            toks = [token_for(c) for c in group]
+
+            dict_out = provider.verify_id_token_batch(toks, request)
+            os.environ["CAP_OIDC_NATIVE"] = "0"
+            py_out = provider.verify_id_token_batch(toks, request,
+                                                    raw=True)
+            os.environ["CAP_OIDC_NATIVE"] = "1"
+            nat_out = provider.verify_id_token_batch(toks, request,
+                                                     raw=True)
+
+            # native status coverage (direct engine drive over the
+            # signature-accepted subset, same inputs the wired path
+            # used)
+            import numpy as np
+
+            acc = [i for i, r in enumerate(
+                provider.keyset.verify_batch_raw(toks))
+                if not isinstance(r, Exception)]
+            if acc:
+                alg_ok = np.asarray(
+                    [1 if group[i]["alg"] == "ES256" else 0
+                     for i in acc], np.uint8)
+                st = claims_native.validate_payloads(
+                    [group[i]["payload"].encode("utf-8") for i in acc],
+                    alg_ok, FIXED_NOW,
+                    provider._policy_blob(request))
+                if st is None:
+                    problems.append(
+                        f"policy {pol_idx}: native engine refused the "
+                        "batch")
+                else:
+                    for s in st:
+                        status_counts[
+                            claims_native.STATUS_INDEX[int(s)]] += 1
+
+            for case, d, py, na in zip(group, dict_out, py_out,
+                                       nat_out):
+                td, tp, tn = _tag(d), _tag(py), _tag(na)
+                if not (td == tp == tn):
+                    problems.append(
+                        f"{case['name']}: dict={td} raw-python={tp} "
+                        f"raw-native={tn}")
+                    continue
+                if td == "accept":
+                    if not (isinstance(py, bytes)
+                            and isinstance(na, bytes) and py == na
+                            and json.loads(py) == d):
+                        problems.append(
+                            f"{case['name']}: accepted bytes/claims "
+                            "diverge")
+                elif decision.classify(d) != decision.classify(na):
+                    problems.append(
+                        f"{case['name']}: obs reason class diverges "
+                        f"({decision.classify(d)} vs "
+                        f"{decision.classify(na)})")
+    finally:
+        if prev is None:
+            os.environ.pop("CAP_OIDC_NATIVE", None)
+        else:
+            os.environ["CAP_OIDC_NATIVE"] = prev
+    return problems, dict(status_counts)
+
+
+def main() -> int:
+    from cap_tpu.oidc import claims_native
+
+    cases = build_corpus(SEED)
+    print(f"claims-parity: {len(cases)} corpus cases "
+          f"(seed {SEED}, sha256 {corpus_sha256(cases)[:16]}…)")
+    if not claims_native.enabled():
+        print("claims-parity FAIL: native claims engine unavailable "
+              "(libcapruntime.so missing cap_claims_* or layout "
+              "drift)", file=sys.stderr)
+        return 1
+    t0 = time.perf_counter()
+    problems, status_counts = run_sweep(cases)
+    dt = time.perf_counter() - t0
+
+    missing = [name for name in claims_native.STATUS_INDEX
+               if status_counts.get(name, 0) == 0]
+    for name in missing:
+        problems.append(
+            f"coverage: native status {name!r} never observed — the "
+            "corpus stopped exercising its rule")
+
+    print("native status coverage: "
+          + " ".join(f"{k}={v}"
+                     for k, v in sorted(status_counts.items())))
+    if problems:
+        for p in problems[:40]:
+            print(f"claims-parity DIVERGENCE: {p}", file=sys.stderr)
+        if len(problems) > 40:
+            print(f"... and {len(problems) - 40} more",
+                  file=sys.stderr)
+        return 1
+    print(f"claims-parity OK: {len(cases)} cases × 3 engines, "
+          f"verdicts and reason classes bit-identical ({dt:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

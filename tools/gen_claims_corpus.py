@@ -1,0 +1,272 @@
+#!/usr/bin/env python3
+"""The claims-rule differential corpus: ~1k adversarial id_token
+payloads covering the full registered-claims rule cross-product.
+
+Like ``gen_go_golden.py``, generation is SEEDED and byte-stable: the
+same seed always produces the same corpus, and the sha256 of its
+canonical JSON form is pinned in ``tests/test_claims_native.py`` — a
+generator edit that changes coverage must re-pin, visibly. Unlike the
+golden signatures, the EXPECTED verdicts are not stored: the corpus
+is differential, the pure-Python dict path is the reference, and the
+raw-path Python rules and the native engine (claims_validate.cpp)
+must both match it verdict-for-verdict and class-for-class.
+
+Axes (systematic single-axis sweeps + seeded random combinations):
+
+- iss: match / mismatch / missing / non-string scalars / null
+- exp: valid / past / boundary / missing / string / bool / bigint /
+  float / container
+- nbf, iat: absent / past / inside-leeway / beyond-leeway / boundary /
+  bool / string
+- nonce: match / mismatch / missing / non-string / null / escaped
+- aud: string / list / multi / empty / missing / null / non-string
+  entries (the go-jose-parity reject) / nested containers / object
+- azp: absent / match / mismatch / non-string / null (× aud shapes —
+  the 3-rule interplay)
+- parse corners: escaped keys, duplicate keys, unicode, deep nesting,
+  long extra claims, whitespace, surrogate escapes
+- alg header: allowed / disallowed (the header-segment-cache arm)
+- policies: default, configured-audiences, multi-audience config,
+  max_age-requested (the auth_time rare-flag arm)
+
+CLI: ``python tools/gen_claims_corpus.py`` prints case count and the
+corpus sha256 (what the test pins).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from typing import Any, Dict, List, Tuple
+
+SEED = 20260805
+FIXED_NOW = 1_750_000_000.0
+ISSUER = "https://idp.example/"
+CLIENT = "client-1"
+NONCE = "n-123456"
+LEEWAY = 60.0
+
+# Policies the corpus sweeps (index referenced per case). Fields map
+# onto Config/Request construction in the sweep driver.
+POLICIES: List[Dict[str, Any]] = [
+    {"name": "default", "audiences": [], "max_age": None},
+    {"name": "conf-aud", "audiences": [CLIENT, "svc-2"], "max_age": None},
+    {"name": "other-aud", "audiences": ["svc-3"], "max_age": None},
+    {"name": "max-age", "audiences": [], "max_age": 600.0},
+]
+
+# alg header arms: (tag, alg) — "ES256" is the allowed one; the sweep
+# driver builds the compact header segment from the alg.
+ALG_ARMS = [("ok", "ES256"), ("bad", "RS384")]
+
+
+def _dump(obj: Any) -> str:
+    return json.dumps(obj, separators=(",", ":"), ensure_ascii=False)
+
+
+def _base_claims(**over: Any) -> Dict[str, Any]:
+    c: Dict[str, Any] = {
+        "iss": ISSUER, "sub": "alice", "aud": [CLIENT],
+        "exp": FIXED_NOW + 3600, "iat": FIXED_NOW - 10, "nonce": NONCE,
+    }
+    for k, v in over.items():
+        if v is ...:
+            c.pop(k, None)
+        else:
+            c[k] = v
+    return c
+
+
+def _axis_variants() -> Dict[str, List[Tuple[str, Any]]]:
+    """Per-claim variant menus: (tag, value); ``...`` removes the
+    claim. Values chosen to hit every rule status AND every
+    conservative-fallback corner on both engines."""
+    far = FIXED_NOW + 3600
+    return {
+        "iss": [
+            ("good", ISSUER), ("evil", "https://evil.example/"),
+            ("missing", ...), ("int", 123), ("null", None),
+            ("empty", ""), ("float", 1.5), ("bool", True),
+            ("prefix", ISSUER[:-1]), ("list", [ISSUER]),
+            ("obj", {"v": ISSUER}), ("big", 10 ** 30),
+        ],
+        "exp": [
+            ("ok", far), ("past", FIXED_NOW - 3600),
+            ("now", FIXED_NOW), ("now+1", FIXED_NOW + 1),
+            ("now-1", FIXED_NOW - 1), ("missing", ...),
+            ("str", "1999999999"), ("bool", True), ("null", None),
+            ("float", FIXED_NOW + 0.5), ("neg", -1),
+            ("big", 10 ** 30), ("list", [far]), ("obj", {"t": far}),
+            ("hugefloat", 1.5e308),
+        ],
+        "nbf": [
+            ("absent", ...), ("past", FIXED_NOW - 100),
+            ("in-leeway", FIXED_NOW + LEEWAY - 1),
+            ("boundary", FIXED_NOW + LEEWAY),
+            ("beyond", FIXED_NOW + LEEWAY + 1),
+            ("far", FIXED_NOW + 9e6), ("str", "soon"), ("bool", False),
+            ("null", None), ("float", FIXED_NOW + 59.5),
+        ],
+        "iat": [
+            ("past", FIXED_NOW - 10), ("absent", ...),
+            ("in-leeway", FIXED_NOW + LEEWAY - 1),
+            ("boundary", FIXED_NOW + LEEWAY),
+            ("beyond", FIXED_NOW + LEEWAY + 1), ("str", "now"),
+            ("bool", True), ("null", None), ("big", 10 ** 25),
+        ],
+        "nonce": [
+            ("good", NONCE), ("wrong", "n-zzz"), ("missing", ...),
+            ("int", 5), ("null", None), ("empty", ""),
+            ("case", NONCE.upper()), ("prefix", NONCE + "x"),
+            ("list", [NONCE]), ("obj", {"n": NONCE}),
+        ],
+        "aud": [
+            ("client-list", [CLIENT]), ("client-str", CLIENT),
+            ("other-str", "svc-2"), ("other-list", ["svc-2"]),
+            ("multi-ok", [CLIENT, "svc-2"]),
+            ("multi-other", ["svc-2", "svc-3"]),
+            ("multi-dup", [CLIENT, CLIENT]),
+            ("nonstring-int", [CLIENT, 42]), ("nonstring-only", [42]),
+            ("nonstring-null", [CLIENT, None]),
+            ("nonstring-bool", [True]),
+            ("nested", [CLIENT, ["svc-2"]]),
+            ("nested-obj", [{"aud": CLIENT}]),
+            ("empty", []), ("missing", ...), ("null", None),
+            ("obj", {"weird": 1}), ("int", 7),
+            ("conf-aud", ["svc-2", CLIENT]), ("conf-only", ["svc-3"]),
+            ("long", [f"svc-{i}" for i in range(40)] + [CLIENT]),
+        ],
+        "azp": [
+            ("absent", ...), ("client", CLIENT), ("evil", "intruder"),
+            ("int", 7), ("null", None), ("bool", False), ("empty", ""),
+            ("list", [CLIENT]), ("obj", {"azp": CLIENT}),
+        ],
+        "auth_time": [
+            ("absent", ...), ("fresh", FIXED_NOW - 30),
+            ("stale", FIXED_NOW - 9000), ("str", "then"),
+            ("bool", True), ("null", None),
+        ],
+    }
+
+
+def _text_corners() -> List[Tuple[str, str]]:
+    """Raw-TEXT payload cases (escapes, duplicates, malformed shapes)
+    that dict construction cannot express."""
+    good = _dump(_base_claims())
+    far = FIXED_NOW + 3600
+    return [
+        ("esc-key-iss", good.replace('"iss"', '"i\\u0073s"')),
+        ("esc-key-exp", good.replace('"exp"', '"e\\u0078p"')),
+        ("esc-key-extra",
+         good[:-1] + ',"e\\u0078tra":1}'),
+        ("esc-val-iss", good.replace(
+            _dump(ISSUER), '"https:\\/\\/idp.example\\/"')),
+        ("esc-val-nonce", good.replace(
+            _dump(NONCE), '"n-\\u0031\\u0032\\u0033456"')),
+        ("esc-val-aud", good.replace(
+            _dump([CLIENT]), '["client-\\u0031"]')),
+        ("dup-exp-live-then-dead",
+         good[:-1] + f',"exp":{FIXED_NOW - 100}}}'),
+        ("dup-exp-dead-then-live",
+         _dump(_base_claims(exp=FIXED_NOW - 100))[:-1]
+         + f',"exp":{far}}}'),
+        ("dup-iss", good[:-1] + ',"iss":"https://evil.example/"}'),
+        ("dup-nonce", good[:-1] + ',"nonce":"n-zzz"}'),
+        ("ws-heavy", good.replace(",", " ,\n\t").replace(":", " : ")),
+        ("unicode-extra", _dump(_base_claims(name="Zoë 😀",
+                                             org="日本語"))),
+        ("nested-extra", _dump(_base_claims(
+            ctx={"a": {"b": {"c": [1, 2, {"d": None}]}}}))),
+        ("deep-nesting",
+         '{"iss":%s,"aud":["%s"],"exp":%d,"nonce":"%s","deep":%s}'
+         % (_dump(ISSUER), CLIENT, int(FIXED_NOW + 3600), NONCE,
+            "[" * 70 + "1" + "]" * 70)),
+        ("surrogate-esc", good[:-1] + ',"x":"\\ud800"}'),
+        ("nan-literal", good[:-1] + ',"x":NaN}'),
+        ("infinity-literal", good[:-1] + ',"x":Infinity}'),
+        ("bignum-extra", good[:-1] + ',"x":' + "9" * 400 + "}"),
+        ("trailing-garbage", good + "x"),
+        ("not-object", _dump([1, 2, 3])),
+        ("not-json", "this is not json"),
+        ("empty-payload", ""),
+        ("empty-object", "{}"),
+        ("sub-object", _dump(_base_claims(sub={"id": "alice"}))),
+        ("auth-time-obj", _dump(_base_claims(auth_time={"t": 1}))),
+        ("float-exp-sci", good.replace(
+            _dump(FIXED_NOW + 3600), "1.7500036e9")),
+    ]
+
+
+def build_corpus(seed: int = SEED) -> List[Dict[str, Any]]:
+    """[{name, policy, alg, payload}] — deterministic for a seed."""
+    rng = random.Random(seed)
+    axes = _axis_variants()
+    cases: List[Dict[str, Any]] = []
+
+    def add(name: str, payload: str, policy: int = 0,
+            alg: str = "ES256") -> None:
+        cases.append({"name": name, "policy": policy, "alg": alg,
+                      "payload": payload})
+
+    # 1. single-axis sweeps: every variant of every claim, other
+    #    claims held good, across every policy
+    for pol_idx in range(len(POLICIES)):
+        for claim, variants in axes.items():
+            for tag, value in variants:
+                payload = _dump(_base_claims(**{claim: value}))
+                add(f"p{pol_idx}-{claim}-{tag}", payload, pol_idx)
+
+    # 2. alg arm: allowed vs disallowed header over good + a few bads
+    for tag, alg in ALG_ARMS:
+        add(f"alg-{tag}-good", _dump(_base_claims()), 0, alg)
+        add(f"alg-{tag}-expired",
+            _dump(_base_claims(exp=FIXED_NOW - 5)), 0, alg)
+        add(f"alg-{tag}-wrongiss",
+            _dump(_base_claims(iss="https://evil.example/")), 0, alg)
+
+    # 3. raw-text corners across two policies
+    for pol_idx in (0, 1):
+        for tag, text in _text_corners():
+            add(f"p{pol_idx}-text-{tag}", text, pol_idx)
+
+    # 4. seeded random cross-product combos (aud × azp × times ×
+    #    policy × alg) until ~1k total
+    claim_names = list(axes.keys())
+    while len(cases) < 1050:
+        over = {}
+        for claim in claim_names:
+            # bias towards good values so combos explore rule ORDER
+            # (first-failure attribution), not just all-bad payloads
+            if rng.random() < 0.55:
+                continue
+            tag, value = rng.choice(axes[claim])
+            over[claim] = value
+        extra = rng.random()
+        base = _base_claims(**over)
+        if extra < 0.2:
+            base["scope"] = "openid email profile"
+            base["jti"] = f"t-{rng.randrange(1 << 30):08x}"
+        elif extra < 0.3:
+            base["ctx"] = {"k": [rng.randrange(100) for _ in range(5)]}
+        payload = _dump(base)
+        pol_idx = rng.randrange(len(POLICIES))
+        alg = "ES256" if rng.random() < 0.8 else "RS384"
+        add(f"combo-{len(cases):04d}", payload, pol_idx, alg)
+    return cases
+
+
+def corpus_sha256(cases: List[Dict[str, Any]]) -> str:
+    blob = json.dumps(cases, separators=(",", ":"),
+                      ensure_ascii=False, sort_keys=True)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def main() -> None:
+    cases = build_corpus()
+    print(f"cases: {len(cases)}")
+    print(f"sha256: {corpus_sha256(cases)}")
+
+
+if __name__ == "__main__":
+    main()
