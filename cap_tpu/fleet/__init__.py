@@ -6,14 +6,20 @@ exclusive device group (``parallel.place.single_owner_placement``),
 supervised by :class:`WorkerPool` (health pings, crash detection,
 respawn with graceful drain), fronted by :class:`FleetClient`
 (balancing, per-worker deadlines, circuit breakers, hedged retry,
-checksummed frames, terminal CPU-oracle fallback). ``chaos`` is the
-fault-injection harness the availability contract is tested against:
-zero wrong verdicts, zero lost submissions, under kill -9, stalls,
-black holes, and corrupt frames. See docs/SERVE.md.
+checksummed frames, terminal CPU-oracle fallback). :class:`FrontDoor`
+is the tier above THAT: one router speaking CVB1 to N pools ("hosts"),
+routing every token by consistent hash over its digest so repeats land
+on the host that cached their verdict — the fleet-wide verdict tier —
+with bounded-load spill, breaker-driven re-route, keyplane fan-out and
+peer-fill cache warming. ``chaos`` is the fault-injection harness the
+availability contract is tested against: zero wrong verdicts, zero
+lost submissions, under kill -9, stalls, black holes, and corrupt
+frames. See docs/SERVE.md.
 """
 
+from .frontdoor import ConsistentHashRing, FrontDoor
 from .pool import FleetError, WorkerPool
 from .router import FleetClient, FleetExhaustedError
 
-__all__ = ["FleetClient", "FleetError", "FleetExhaustedError",
-           "WorkerPool"]
+__all__ = ["ConsistentHashRing", "FleetClient", "FleetError",
+           "FleetExhaustedError", "FrontDoor", "WorkerPool"]

@@ -1,0 +1,560 @@
+"""Digest-affinity front door: the cross-host routing tier.
+
+Everything below one :class:`~cap_tpu.fleet.pool.WorkerPool` assumes a
+single host. The front door is the tier above it: ONE router speaking
+CVB1 to N worker pools ("hosts"), turning the per-process verdict
+cache (r14's 18.5× on Zipf traffic) into a FLEET-WIDE win:
+
+- **affinity routing**: every token is routed by a consistent hash
+  over its sha256[:16] digest — the same digest the C++ serve readers
+  compute at frame-parse time (and the vcache keys on), handed down
+  through the batcher (``verify_batch_digests``) so the front door
+  never re-hashes what the reader already hashed. Every repeat of a
+  token therefore lands on the host that cached its verdict; a pool
+  joining or leaving remaps ONLY the ring segments it owned.
+- **bounded-load spill** (power-of-two-choices): when the hash target
+  is hot — its in-flight load exceeds ``spill_factor ×`` the fleet
+  average — the token spills to its SECOND ring choice, which then
+  warms its own cache for that token. Affinity bends under load, it
+  never wedges behind one hot shard.
+- **breaker-driven re-route**: a pool with no live workers (crash,
+  kill -9, every breaker open) is skipped at partition time, and a
+  dispatch that still dies (``FleetExhaustedError``) re-routes to the
+  next ring choice before the front door's own terminal CPU-oracle
+  fallback. The availability contract is unchanged: never wrong, at
+  worst slow.
+- **keyplane fan-out**: ``push_keys`` records the distribution target,
+  then fans the epoch to every pool (each pool's supervisor keeps
+  re-pushing its own stragglers); ``epoch_skew`` / ``key_epochs``
+  surface convergence across the WHOLE fleet in one place.
+
+Peer-fill (cache warming for rotated-in workers) rides the CVB1
+type-13/14 frame pair and is driven by each pool's supervisor — see
+:mod:`cap_tpu.fleet.pool` and docs/SERVE.md §Front door.
+
+Counters (exact: ``frontdoor.lookups == frontdoor.affinity_hits +
+frontdoor.affinity_misses``, obs-smoke gates it; misses further split
+into spills + reroutes + rr-routed):
+``frontdoor.lookups / affinity_hits / affinity_misses / spills /
+reroutes / fallback_tokens / keys_pushes``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
+
+from .. import telemetry
+from ..obs import decision as _decision
+from ..serve import protocol
+from ..serve import vcache as _vcache
+from .router import FleetClient, FleetExhaustedError
+
+Endpoint = Tuple[str, int]
+
+
+class ConsistentHashRing:
+    """Consistent hash ring over pool ids, with virtual nodes.
+
+    Positions are sha256-derived 64-bit points, so the keyspace each
+    pool owns is stable under membership change: removing a pool
+    remaps ONLY its own segments (pinned by test). ``vnodes`` virtual
+    nodes per pool keep the ownership split near-uniform.
+    """
+
+    def __init__(self, pool_ids: Sequence[int], vnodes: int = 64):
+        self._vnodes = vnodes
+        self._points: List[int] = []
+        self._owners: List[int] = []
+        for pid in pool_ids:
+            for v in range(vnodes):
+                h = hashlib.sha256(
+                    f"cap-frontdoor:{pid}:{v}".encode()).digest()
+                self._points.append(int.from_bytes(h[:8], "big"))
+                self._owners.append(pid)
+        order = sorted(range(len(self._points)),
+                       key=lambda i: self._points[i])
+        self._points = [self._points[i] for i in order]
+        self._owners = [self._owners[i] for i in order]
+        self._n_pools = len(set(pool_ids))
+
+    def primary(self, digest: bytes) -> int:
+        """The pool owning this digest's ring point."""
+        return self.preference(digest, 1)[0]
+
+    def preference(self, digest: bytes, n: int = 2) -> List[int]:
+        """First ``n`` DISTINCT pools walking the ring clockwise from
+        the digest's point — preference order for spill/re-route."""
+        pts = self._points
+        if not pts:
+            raise ValueError("empty ring")
+        i = bisect.bisect_right(pts, int.from_bytes(digest[:8], "big"))
+        out: List[int] = []
+        for k in range(len(pts)):
+            owner = self._owners[(i + k) % len(pts)]
+            if owner not in out:
+                out.append(owner)
+                if len(out) >= min(n, self._n_pools):
+                    break
+        return out
+
+
+class _PoolArm:
+    """One routed pool: its transport client + live-load accounting."""
+
+    def __init__(self, pool_id: int, pool: Any,
+                 client: FleetClient):
+        self.pool_id = pool_id
+        self.pool = pool              # WorkerPool or None (bare eps)
+        self.client = client
+        self.inflight = 0             # tokens currently dispatched
+        self.tokens = 0               # lifetime routed tokens
+        self.affinity_hits = 0
+        self.spills_in = 0            # tokens spilled TO this arm
+        self.reroutes_in = 0          # tokens re-routed TO this arm
+
+    def live(self) -> bool:
+        return self.client.has_live_endpoint()
+
+
+class FrontDoor:
+    """Route verify batches across N worker pools by digest affinity.
+
+    pools: a list where each element describes one "host" — a
+    ``WorkerPool``, a list of ``(host, port)`` endpoints, or a
+    callable returning endpoints (the ``FleetClient`` contract).
+    fallback: terminal local keyset (``verify_batch``) used only when
+    a token's whole preference chain is exhausted.
+    routing: ``"affinity"`` (consistent hash, the point of this tier)
+    or ``"rr"`` (round-robin whole batches across pools — the A/B
+    control arm tools/bench_serve.py measures against).
+    spill_factor: bounded-load constant ``c`` — a primary whose
+    in-flight tokens exceed ``c ×`` the fleet-average load spills to
+    the second ring choice when that choice is strictly less loaded
+    (c=1.25, the classic bounded-load consistent-hashing constant;
+    note the average includes the overloaded arm, so with N pools the
+    ratio is bounded by N — c must stay below that).
+    client_kw: passed through to each pool's ``FleetClient``.
+    """
+
+    def __init__(self, pools: Sequence[Any], fallback=None, *,
+                 routing: str = "affinity", spill_factor: float = 1.25,
+                 vnodes: int = 64,
+                 client_kw: Optional[Dict[str, Any]] = None):
+        if not pools:
+            raise ValueError("front door needs at least one pool")
+        if routing not in ("affinity", "rr"):
+            raise ValueError(f"unknown routing mode {routing!r}")
+        self._routing = routing
+        self._spill_factor = float(spill_factor)
+        self._fallback = fallback
+        kw = dict(client_kw or {})
+        kw.setdefault("attempt_timeout", 5.0)
+        kw.setdefault("total_deadline", 15.0)
+        kw.setdefault("max_rounds", 2)
+        self._arms: List[_PoolArm] = []
+        for pid, pool in enumerate(pools):
+            is_pool = hasattr(pool, "endpoints") \
+                and hasattr(pool, "push_keys")
+            client = FleetClient(pool, fallback=None,
+                                 rr_seed=pid, **kw)
+            self._arms.append(_PoolArm(pid, pool if is_pool else None,
+                                       client))
+        self._ring = ConsistentHashRing(
+            [a.pool_id for a in self._arms], vnodes=vnodes)
+        self._rr_next = 0
+        self._lock = threading.Lock()
+        # Keyplane distribution target: recorded BEFORE any pool is
+        # contacted (kill -9 mid-push converges via the pools'
+        # supervisors; bare-endpoint pools get best-effort re-push on
+        # the next push_keys call).
+        self._keys_current: Optional[Tuple[int, dict]] = None
+        self._ctr = {"frontdoor.lookups": 0,
+                     "frontdoor.affinity_hits": 0,
+                     "frontdoor.affinity_misses": 0,
+                     "frontdoor.spills": 0,
+                     "frontdoor.reroutes": 0,
+                     "frontdoor.fallback_tokens": 0,
+                     "frontdoor.keys_pushes": 0}
+
+    # -- routing ----------------------------------------------------------
+
+    def verify_batch(self, tokens: Sequence[str],
+                     digests: Optional[Sequence[Optional[bytes]]]
+                     = None) -> List[Any]:
+        """Claims per verified token, Exception per rejected — order
+        preserved, whatever pool (or the terminal fallback) produced
+        each verdict. ``digests``: optional per-token sha256[:16]
+        (reader-computed upstream); missing ones are hashed here."""
+        tokens = list(tokens)
+        if not tokens:
+            return []
+        t0 = time.perf_counter()
+        with telemetry.span(telemetry.SPAN_FRONTDOOR_ROUTE):
+            groups, group_hits = self._partition(tokens, digests)
+            out: List[Any] = [None] * len(tokens)
+            if len(groups) == 1:
+                arm_id, idxs = next(iter(groups.items()))
+                self._dispatch_group(arm_id, tokens, idxs, out,
+                                     group_hits.get(arm_id, 0))
+            else:
+                threads = []
+                for arm_id, idxs in groups.items():
+                    th = threading.Thread(
+                        target=self._dispatch_group,
+                        args=(arm_id, tokens, idxs, out,
+                              group_hits.get(arm_id, 0)),
+                        daemon=True, name="cap-tpu-frontdoor")
+                    th.start()
+                    threads.append(th)
+                for th in threads:
+                    th.join()
+        _decision.record_batch("frontdoor", out, tokens=tokens,
+                               latency_s=time.perf_counter() - t0)
+        return out
+
+    def verify_batch_digests(self, tokens: Sequence[str],
+                             digests: Optional[Sequence[
+                                 Optional[bytes]]]) -> List[Any]:
+        """The batcher-facing digest-routed entry point: what lets a
+        ``VerifyWorker(FrontDoor(...))`` reuse the native readers'
+        frame-parse-time digests instead of re-hashing."""
+        return self.verify_batch(tokens, digests=digests)
+
+    def _partition(self, tokens: List[str],
+                   digests: Optional[Sequence[Optional[bytes]]]
+                   ) -> Tuple[Dict[int, List[int]], Dict[int, int]]:
+        """token index → owning arm, by ring + bounded load (or rr);
+        also returns how many of each group's tokens were counted as
+        affinity hits (dispatch re-routes re-class exactly those)."""
+        n = len(tokens)
+        arms = self._arms
+        if self._routing == "rr" or len(arms) == 1:
+            with self._lock:
+                arm = arms[self._rr_next % len(arms)]
+                self._rr_next += 1
+            if not arm.live():
+                live = [a for a in arms if a.live()]
+                if live:
+                    arm = live[self._rr_next % len(live)]
+            hits = sum(1 for i in range(n)
+                       if self._ring.primary(self._digest(
+                           tokens[i], digests, i)) == arm.pool_id) \
+                if len(arms) > 1 else n
+            self._count({"frontdoor.lookups": n,
+                         "frontdoor.affinity_hits": hits,
+                         "frontdoor.affinity_misses": n - hits})
+            with self._lock:
+                arm.tokens += n
+                arm.affinity_hits += hits
+            return {arm.pool_id: list(range(n))}, {arm.pool_id: hits}
+        # affinity: per-token ring walk with bounded-load spill
+        groups: Dict[int, List[int]] = {}
+        loads = {a.pool_id: a.inflight for a in arms}
+        hits = reroutes = 0
+        hits_by: Dict[int, int] = {}
+        spills_by: Dict[int, int] = {}
+        reroutes_by: Dict[int, int] = {}
+        for i in range(n):
+            d = self._digest(tokens[i], digests, i)
+            pref = self._ring.preference(d, 2)
+            target = pref[0]
+            primary_live = arms[target].live()
+            if not primary_live and len(pref) > 1:
+                # breaker-driven re-route: the hash target is dead
+                nxt = next((p for p in pref[1:] if arms[p].live()),
+                           None)
+                if nxt is not None:
+                    target = nxt
+                    reroutes += 1
+                    reroutes_by[target] = \
+                        reroutes_by.get(target, 0) + 1
+                else:
+                    hits += 1      # nothing live: stay on primary,
+                    #                the dispatch fallback owns it
+                    hits_by[target] = hits_by.get(target, 0) + 1
+            elif len(pref) > 1:
+                avg = (sum(loads.values()) + n) / max(1, len(loads))
+                second = pref[1]
+                if (loads[target] > self._spill_factor * avg
+                        and loads[second] < loads[target]
+                        and arms[second].live()):
+                    target = second
+                    spills_by[target] = spills_by.get(target, 0) + 1
+                else:
+                    hits += 1
+                    hits_by[target] = hits_by.get(target, 0) + 1
+            else:
+                hits += 1
+                hits_by[target] = hits_by.get(target, 0) + 1
+            loads[target] += 1
+            groups.setdefault(target, []).append(i)
+        spills = sum(spills_by.values())
+        self._count({"frontdoor.lookups": n,
+                     "frontdoor.affinity_hits": hits,
+                     "frontdoor.affinity_misses": spills + reroutes,
+                     "frontdoor.spills": spills,
+                     "frontdoor.reroutes": reroutes})
+        with self._lock:
+            for a in arms:
+                extra = len(groups.get(a.pool_id, ()))
+                if extra:
+                    a.tokens += extra
+                a.affinity_hits += hits_by.get(a.pool_id, 0)
+                a.spills_in += spills_by.get(a.pool_id, 0)
+                a.reroutes_in += reroutes_by.get(a.pool_id, 0)
+        return groups, hits_by
+
+    @staticmethod
+    def _digest(token: str, digests, i: int) -> bytes:
+        if digests is not None:
+            d = digests[i]
+            if d:
+                return d
+        return _vcache.token_digest(token)
+
+    def _dispatch_group(self, arm_id: int, tokens: List[str],
+                        idxs: List[int], out: List[Any],
+                        hits0: int = 0) -> None:
+        """One arm's sub-batch: primary arm → ring re-route chain →
+        terminal fallback. Writes verdicts into ``out`` in place
+        (disjoint index sets per group — no lock needed)."""
+        sub = [tokens[i] for i in idxs]
+        tried = set()
+        chain = [arm_id] + [a.pool_id for a in self._arms
+                            if a.pool_id != arm_id]
+        results: Optional[List[Any]] = None
+        for hop, pid in enumerate(chain):
+            if pid in tried:
+                continue
+            tried.add(pid)
+            arm = self._arms[pid]
+            if hop > 0:
+                if not arm.live():
+                    continue
+                # A dispatch-time death discovered AFTER partition
+                # accounting: re-class exactly the tokens the
+                # partition counted as hits, so the
+                # lookups == hits + misses invariant stays exact.
+                self._count({"frontdoor.reroutes": len(sub),
+                             "frontdoor.affinity_misses": hits0,
+                             "frontdoor.affinity_hits": -hits0})
+                hits0 = 0
+                with self._lock:
+                    arm.reroutes_in += len(sub)
+            with self._lock:
+                arm.inflight += len(sub)
+            try:
+                results = arm.client.verify_batch(sub)
+                break
+            except (FleetExhaustedError, OSError,
+                    protocol.ProtocolError):
+                results = None
+            finally:
+                with self._lock:
+                    arm.inflight -= len(sub)
+        if results is None:
+            results = self._terminal_fallback(sub)
+        for j, i in enumerate(idxs):
+            out[i] = results[j]
+
+    def _terminal_fallback(self, tokens: List[str]) -> List[Any]:
+        if self._fallback is None:
+            raise FleetExhaustedError()
+        self._count({"frontdoor.fallback_tokens": len(tokens)})
+        with telemetry.span(telemetry.SPAN_ROUTER_FALLBACK):
+            return self._fallback.verify_batch(tokens)
+
+    # -- keyplane fan-out -------------------------------------------------
+
+    def push_keys(self, jwks_doc: dict, epoch: Optional[int] = None
+                  ) -> Dict[int, Any]:
+        """Fan one key epoch out to every pool; returns
+        pool_id → per-worker ack map (or per-endpoint list for bare
+        endpoints). The target is recorded BEFORE any pool is
+        contacted, so a front door asked again (or a pool supervisor)
+        can converge stragglers — kill -9 mid-push is the chaos suite's
+        bread and butter."""
+        with self._lock:
+            if epoch is None:
+                epoch = (self._keys_current[0] + 1
+                         if self._keys_current else 1)
+            epoch = int(epoch)
+            self._keys_current = (epoch, jwks_doc)
+        self._count({"frontdoor.keys_pushes": 1})
+        telemetry.gauge("keyplane.epoch", epoch)
+        out: Dict[int, Any] = {}
+        for arm in self._arms:
+            if arm.pool is not None:
+                out[arm.pool_id] = arm.pool.push_keys(jwks_doc,
+                                                      epoch=epoch)
+            else:
+                out[arm.pool_id] = self._push_keys_endpoints(
+                    arm, jwks_doc, epoch)
+        return out
+
+    def _push_keys_endpoints(self, arm: _PoolArm, jwks_doc: dict,
+                             epoch: int) -> Dict[str, Optional[int]]:
+        """Direct KEYS push to a bare-endpoint pool (no supervisor —
+        best effort, re-converged on the next push)."""
+        import json as _json
+        import socket as _socket
+
+        acked: Dict[str, Optional[int]] = {}
+        for ep in arm.client._live_endpoints():
+            key = f"{ep[0]}:{ep[1]}"
+            try:
+                with _socket.create_connection(ep, timeout=5.0) as s:
+                    s.settimeout(30.0)
+                    protocol.send_keys_push(s, jwks_doc, epoch)
+                    ftype, entries = \
+                        protocol.FrameReader(s).recv_frame()
+                if (ftype == protocol.T_KEYS_ACK and entries
+                        and entries[0][0] == 0):
+                    acked[key] = int(
+                        _json.loads(entries[0][1]).get("epoch"))
+                else:
+                    acked[key] = None
+            except (OSError, protocol.ProtocolError, ValueError,
+                    TypeError):
+                acked[key] = None
+        return acked
+
+    def swap_keys(self, jwks_doc: dict, epoch: Optional[int] = None,
+                  grace_s: float = 0.0) -> int:
+        """The engine-facing alias: lets a front door BE a
+        ``VerifyWorker`` keyset, so a KEYS push to the front-door
+        server propagates to every pool behind it."""
+        with self._lock:
+            if epoch is None:
+                epoch = (self._keys_current[0] + 1
+                         if self._keys_current else 1)
+        self.push_keys(jwks_doc, epoch=int(epoch))
+        return int(epoch)
+
+    @property
+    def key_epoch(self) -> Optional[int]:
+        """The epoch the fleet is converging on (None: never pushed)."""
+        with self._lock:
+            return self._keys_current[0] if self._keys_current else None
+
+    def key_epochs(self) -> Dict[str, Optional[int]]:
+        """``"p<pool>.w<worker>"`` → last known epoch, every pool."""
+        out: Dict[str, Optional[int]] = {}
+        for arm in self._arms:
+            if arm.pool is None:
+                continue
+            for wid, ep in arm.pool.key_epochs().items():
+                out[f"p{arm.pool_id}.w{wid}"] = ep
+        return out
+
+    def epoch_skew(self) -> int:
+        """Spread between newest and oldest worker epoch across the
+        WHOLE fleet (0 = converged) — rotation health in one number,
+        which capstat renders CONVERGED/SKEW."""
+        epochs = [e for e in self.key_epochs().values()
+                  if e is not None]
+        skew = (max(epochs) - min(epochs)) if epochs else 0
+        telemetry.gauge("keyplane.epoch_skew", skew)
+        return skew
+
+    # -- observability ----------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._ctr)
+
+    def _count(self, inc: Dict[str, int]) -> None:
+        inc = {k: v for k, v in inc.items() if v}
+        if not inc:
+            return
+        with self._lock:
+            for k, v in inc.items():
+                self._ctr[k] = self._ctr.get(k, 0) + v
+        rec = telemetry.active()
+        if rec is not None:
+            rec.count_many(inc)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The capstat-facing bundle (``capstat --frontdoor FILE``):
+        routing counters, per-pool affinity/spill/load state, breaker
+        views, and the fleet epoch map + skew."""
+        with self._lock:
+            pools = {
+                str(a.pool_id): {
+                    "tokens": a.tokens,
+                    "affinity_hits": a.affinity_hits,
+                    "spills_in": a.spills_in,
+                    "reroutes_in": a.reroutes_in,
+                    "inflight": a.inflight,
+                    "endpoints": len(a.client._live_endpoints()),
+                    "live": a.live(),
+                } for a in self._arms
+            }
+            ctr = dict(self._ctr)
+        skew = self.epoch_skew()
+        return {
+            "routing": self._routing,
+            "counters": ctr,
+            "pools": pools,
+            "key_epochs": self.key_epochs(),
+            "epoch_skew": skew,
+            "epoch": self.key_epoch,
+            "breakers": {
+                str(a.pool_id): {f"{ep[0]}:{ep[1]}": st
+                                 for ep, st in
+                                 a.client.breaker_states().items()}
+                for a in self._arms
+            },
+        }
+
+    def close(self) -> None:
+        for a in self._arms:
+            a.client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def frontdoor_from_spec(spec: str) -> FrontDoor:
+    """Build a front door from a ``--keyset frontdoor:`` spec string:
+
+        frontdoor:pool=h1:p1+h2:p2;pool=h3:p3[;routing=rr][;spill=2.0]
+
+    Pools are ``;``-separated ``pool=`` items, each a ``+``-separated
+    list of host:port endpoints; ``routing`` and ``spill`` map to the
+    constructor knobs. The resulting worker serves CVB1 on the front
+    AND speaks CVB1 to every pool behind — the deployable router-tier
+    process (docs/SERVE.md §Front door).
+    """
+    pools: List[List[Endpoint]] = []
+    routing = "affinity"
+    spill = 1.25
+    for part in spec.split(";"):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if k == "pool":
+            eps: List[Endpoint] = []
+            for hp in v.split("+"):
+                host, _, port = hp.rpartition(":")
+                eps.append((host, int(port)))
+            if not eps:
+                raise ValueError("empty pool in frontdoor spec")
+            pools.append(eps)
+        elif k == "routing":
+            routing = v
+        elif k == "spill":
+            spill = float(v)
+        else:
+            raise ValueError(f"unknown frontdoor option {k!r}")
+    if not pools:
+        raise ValueError("frontdoor spec names no pools")
+    return FrontDoor(pools, routing=routing, spill_factor=spill)

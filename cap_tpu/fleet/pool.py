@@ -82,6 +82,13 @@ class WorkerHandle:
         # and the checkpoint file the worker writes into.
         self.postmortem: Optional[dict] = None
         self.postmortem_path: Optional[str] = None
+        # Peer-fill state: a freshly (re)spawned worker boots with an
+        # EMPTY verdict cache; the supervisor keeps offering it a
+        # sibling's cache dump (CVB1 types 13/14) until one lands or
+        # the attempt budget runs out — warming comes from a peer, not
+        # from re-verifying against the IdP.
+        self.peer_fill_pending = False
+        self.peer_fill_attempts = 0
 
     @property
     def worker_id(self) -> int:
@@ -113,7 +120,9 @@ class WorkerPool:
                  postmortem_dir: Optional[str] = None,
                  postmortem_interval: float = 1.0,
                  keys_push_timeout: float = 30.0,
-                 serve_chain: Optional[str] = None):
+                 serve_chain: Optional[str] = None,
+                 peer_fill: bool = True, peer_fill_max: int = 2048,
+                 peer_fill_attempts: int = 50):
         if placements is None:
             placements = single_owner_placement(
                 n_workers, n_devices if n_devices is not None else n_workers,
@@ -155,6 +164,12 @@ class WorkerPool:
         # to finish the rotation on the respawned worker.
         self._keys_push_timeout = keys_push_timeout
         self._keys_current: Optional[Tuple[int, dict]] = None
+        # Verdict-cache peer fill (docs/SERVE.md §Front door): ON by
+        # default — correctness is clamp-guaranteed worker-side, so
+        # the only cost of offering is two small control exchanges.
+        self._peer_fill = bool(peer_fill)
+        self._peer_fill_max = int(peer_fill_max)
+        self._peer_fill_budget = int(peer_fill_attempts)
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self._handles = [WorkerHandle(p) for p in placements]
@@ -364,6 +379,86 @@ class WorkerPool:
             return 0
         return max(epochs) - min(epochs)
 
+    # -- verdict-cache peer fill ------------------------------------------
+
+    def _peer_fill_once(self, h: WorkerHandle) -> bool:
+        """Offer ``h`` one sibling's cache dump: pull an export from a
+        READY peer, push it into ``h`` as an import. Returns True when
+        at least one entry landed (the worker's own clamps decide —
+        the pool moves opaque entries, it never parses verdicts).
+
+        Every fault is survivable: a dead sibling, an empty cache, an
+        epoch mismatch at the importer all just mean "try again on the
+        next supervisor sweep" while the attempt budget lasts."""
+        import json as _json
+
+        with self._lock:
+            addr = h.address if h.state == READY else None
+            donors = [d.address for d in self._handles
+                      if d is not h and d.state == READY
+                      and d.address is not None]
+        if addr is None or not donors:
+            return False
+        telemetry.count("fleet.peer_fill_attempts")
+        for donor in donors:
+            try:
+                with socket.create_connection(
+                        donor, timeout=self._ping_timeout) as s:
+                    s.settimeout(self._keys_push_timeout)
+                    protocol.send_peer_fill(
+                        s, {"op": "export",
+                            "max": self._peer_fill_max})
+                    ftype, entries = \
+                        protocol.FrameReader(s).recv_frame()
+                if (ftype != protocol.T_PEER_ACK or not entries
+                        or entries[0][0] != 0):
+                    continue
+                doc = _json.loads(entries[0][1])
+                dump = doc.get("entries") or []
+                if not dump:
+                    continue
+                with socket.create_connection(
+                        addr, timeout=self._ping_timeout) as s:
+                    s.settimeout(self._keys_push_timeout)
+                    protocol.send_peer_fill(
+                        s, {"op": "import", "epoch": doc.get("epoch"),
+                            "entries": dump})
+                    ftype, entries = \
+                        protocol.FrameReader(s).recv_frame()
+                if (ftype != protocol.T_PEER_ACK or not entries
+                        or entries[0][0] != 0):
+                    continue
+                imported = int(
+                    _json.loads(entries[0][1]).get("imported") or 0)
+                if imported > 0:
+                    telemetry.count("fleet.peer_fill_transfers")
+                    telemetry.count("fleet.peer_fill_entries",
+                                    imported)
+                    return True
+            except (OSError, protocol.ProtocolError, ValueError,
+                    TypeError):
+                telemetry.count("fleet.peer_fill_errors")
+                continue
+        return False
+
+    def _peer_fill_sweep(self, h: WorkerHandle) -> None:
+        """One supervisor-cadence peer-fill attempt for a pending
+        worker; clears the pending flag on success or budget
+        exhaustion."""
+        with self._lock:
+            if (not self._peer_fill or not h.peer_fill_pending
+                    or h.state != READY):
+                return
+            h.peer_fill_attempts += 1
+            give_up = h.peer_fill_attempts > self._peer_fill_budget
+        if give_up:
+            with self._lock:
+                h.peer_fill_pending = False
+            return
+        if self._peer_fill_once(h):
+            with self._lock:
+                h.peer_fill_pending = False
+
     def postmortem(self, worker_id: int) -> Optional[dict]:
         """The latest postmortem collected for this slot (crash or
         drain), or — when no death was confirmed yet — whatever the
@@ -500,6 +595,8 @@ class WorkerPool:
                 h.key_epoch = epoch
                 h.serve_chain = serve_chain
                 h.state = READY
+                h.peer_fill_pending = self._peer_fill
+                h.peer_fill_attempts = 0
                 telemetry.count("fleet.workers_started")
             keys_current = self._keys_current
         if port is not None and keys_current is not None \
@@ -508,6 +605,11 @@ class WorkerPool:
             # converge it onto the fleet's current epoch immediately —
             # the kill -9-mid-push recovery path.
             self._push_keys_to(h, keys_current[1], keys_current[0])
+        if port is not None:
+            # First peer-fill offer right at ready (epochs converged
+            # above); siblings that are still cold fail soft and the
+            # supervisor keeps retrying on its sweep cadence.
+            self._peer_fill_sweep(h)
         # Drain any further output (worker stays quiet normally).
         try:
             for _ in proc.stdout:
@@ -567,6 +669,7 @@ class WorkerPool:
                             # keep re-pushing until the ack matches.
                             self._push_keys_to(h, keys_current[1],
                                                keys_current[0])
+                        self._peer_fill_sweep(h)
                     else:
                         with self._lock:
                             h.ping_failures += 1

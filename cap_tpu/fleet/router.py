@@ -146,12 +146,24 @@ class FleetClient:
         # the pool's push-target epoch per call; bare-endpoint clients
         # (no epoch visibility) get a short hard TTL instead.
         # vcache: None → CAP_CLIENT_VCACHE=1 enables; True → default
-        # cache; or pass a configured VerdictCache instance.
+        # cache; or pass a configured VerdictCache instance. Bare-
+        # endpoint clients have NO epoch visibility, so their only
+        # rotation bound is the hard TTL — configurable via
+        # CAP_CLIENT_VCACHE_TTL (seconds; default 30, unchanged), and
+        # clamped positive so "0" can't mean forever.
         if vcache is None:
             vcache = os.environ.get("CAP_CLIENT_VCACHE", "0") == "1"
         if vcache is True:
-            vcache = _vcache.VerdictCache(
-                max_ttl_s=300.0 if self._pool is not None else 30.0)
+            if self._pool is not None:
+                ttl = 300.0
+            else:
+                try:
+                    ttl = float(os.environ.get(
+                        "CAP_CLIENT_VCACHE_TTL", "30"))
+                except ValueError:
+                    ttl = 30.0
+                ttl = max(0.001, ttl)
+            vcache = _vcache.VerdictCache(max_ttl_s=ttl)
         self._vcache: Optional[_vcache.VerdictCache] = \
             vcache if isinstance(vcache, _vcache.VerdictCache) else None
         if self._vcache is not None and self._pool is not None:
@@ -200,6 +212,21 @@ class FleetClient:
                     self._rr = (self._rr + i + 1) % len(eps)
                     return ep
         return None
+
+    def has_live_endpoint(self) -> bool:
+        """Whether ANY endpoint is currently routable: at least one
+        address listed and its breaker closed (or past its reset
+        window, i.e. willing to admit a probe). The front-door tier
+        uses this as its dead-pool signal for breaker-driven
+        re-routes — cheap, lock-held only for the breaker scan."""
+        eps = self._live_endpoints()
+        if not eps:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            return any(
+                self._breakers.setdefault(ep, _Breaker()).open_until
+                <= now for ep in eps)
 
     def _on_success(self, ep: Endpoint) -> None:
         with self._lock:

@@ -38,6 +38,13 @@ Keyset specs (``--keyset``):
   ``oidc.OIDCRawKeySet`` so every served token passes signature
   verification AND registered-claims validation (native rules engine
   behind ``CAP_OIDC_NATIVE``; see docs/SERVE.md).
+- ``frontdoor:pool=h:p+h:p;pool=h:p[;routing=rr][;spill=2.0]`` — the
+  router-tier process: this worker serves CVB1 on the front and
+  routes every token to the named worker pools by consistent hash
+  over its digest (the native serve chain hands the reader-computed
+  sha256[:16] straight through the batcher — no re-hash). KEYS pushes
+  to a front-door worker fan out to every pool behind it. See
+  docs/SERVE.md §Front door.
 
 Every keyset kind accepts the fleet's KEYS pushes (CVB1 type 11):
 ``swap_keys`` swaps the live tables and the ready line / STATS /
@@ -183,6 +190,12 @@ def make_keyset(spec: str):
                     raise ValueError(f"unknown stub option {k!r}")
                 kwargs[k] = float(v)
         return StubKeySet(**kwargs)
+    if spec.startswith("frontdoor:"):
+        # Router-tier process: no device engine of its own — the
+        # "keyset" is the digest-affinity router over remote pools.
+        from .frontdoor import frontdoor_from_spec
+
+        return frontdoor_from_spec(spec[len("frontdoor:"):])
     if spec.startswith("oidc-rp:"):
         # Full OIDC verify-AND-validate serving: wrap an inner engine
         # spec in the Provider-backed serve surface. Options are

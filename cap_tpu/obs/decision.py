@@ -302,7 +302,7 @@ def latency_bucket_index(latency_s: Optional[float]) -> int:
 # recording
 # ---------------------------------------------------------------------------
 
-SURFACES = ("oracle", "tpu", "serve", "router")
+SURFACES = ("oracle", "tpu", "serve", "router", "frontdoor")
 
 # Ring sampling: always the first record of a (surface, reason) pair,
 # then every RING_SAMPLE_EVERY-th decision on that key (deterministic —

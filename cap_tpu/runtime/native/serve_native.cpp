@@ -87,6 +87,8 @@ enum {
   T_VERIFY_RESP_TRACE = 10,
   T_KEYS_PUSH = 11,
   T_KEYS_ACK = 12,
+  T_PEER_FILL = 13,
+  T_PEER_ACK = 14,
 };
 static const int64_t MAX_FRAME_ENTRIES = 1 << 20;
 static const int64_t MAX_ENTRY_BYTES = 1 << 20;
@@ -197,8 +199,11 @@ static int parse_frame(const uint8_t* b, int64_t n, Parsed& out) {
   bool checksummed =
       ftype == T_VERIFY_REQ_CRC || ftype == T_VERIFY_RESP_CRC ||
       ftype == T_VERIFY_REQ_TRACE || ftype == T_VERIFY_RESP_TRACE ||
-      ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK;
-  if ((ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK) && count != 1)
+      ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK ||
+      ftype == T_PEER_FILL || ftype == T_PEER_ACK;
+  if ((ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK ||
+       ftype == T_PEER_FILL || ftype == T_PEER_ACK) &&
+      count != 1)
     return PF_MALFORMED;
   int64_t pos = 9;
   out.trace_off = 0;
@@ -216,10 +221,11 @@ static int parse_frame(const uint8_t* b, int64_t n, Parsed& out) {
   out.count = count;
   out.entries.clear();
   bool req_shape = ftype == T_VERIFY_REQ || ftype == T_VERIFY_REQ_CRC ||
-                   ftype == T_VERIFY_REQ_TRACE || ftype == T_KEYS_PUSH;
+                   ftype == T_VERIFY_REQ_TRACE || ftype == T_KEYS_PUSH ||
+                   ftype == T_PEER_FILL;
   bool resp_shape = ftype == T_VERIFY_RESP || ftype == T_VERIFY_RESP_CRC ||
                     ftype == T_VERIFY_RESP_TRACE || ftype == T_STATS_RESP ||
-                    ftype == T_KEYS_ACK;
+                    ftype == T_KEYS_ACK || ftype == T_PEER_ACK;
   int64_t total = 0;
   if (req_shape) {
     out.entries.reserve(count < 4096 ? count : 4096);
@@ -362,7 +368,7 @@ struct Conn {
 };
 
 // Request kinds surfaced to the Python drain loop.
-enum { K_VERIFY = 0, K_STATS = 2, K_KEYS = 3 };
+enum { K_VERIFY = 0, K_STATS = 2, K_KEYS = 3, K_PEER = 4 };
 
 struct Req {
   std::shared_ptr<Conn> conn;
@@ -536,12 +542,13 @@ static void reader_main(std::shared_ptr<Conn> c) {
       h->ctr[CTR_PONGS].fetch_add(1);
     } else if (p.ftype == T_VERIFY_REQ || p.ftype == T_VERIFY_REQ_CRC ||
                p.ftype == T_VERIFY_REQ_TRACE || p.ftype == T_STATS_REQ ||
-               p.ftype == T_KEYS_PUSH) {
+               p.ftype == T_KEYS_PUSH || p.ftype == T_PEER_FILL) {
       Req* r = new Req();
       r->conn = c;
       r->ftype = p.ftype;
       r->kind = p.ftype == T_STATS_REQ ? K_STATS
                 : p.ftype == T_KEYS_PUSH ? K_KEYS
+                : p.ftype == T_PEER_FILL ? K_PEER
                                          : K_VERIFY;
       {
         std::lock_guard<std::mutex> lk(c->mu);

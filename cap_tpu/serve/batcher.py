@@ -34,13 +34,20 @@ _DISPATCHER_DONE = object()
 
 class _Pending:
     __slots__ = ("tokens", "results", "event", "ts", "trace", "t0_wall",
-                 "traces", "on_done")
+                 "traces", "on_done", "digests")
 
     def __init__(self, tokens: Sequence[str],
                  trace: Optional[str] = None,
                  traces: Optional[Sequence[str]] = None,
-                 on_done=None):
+                 on_done=None,
+                 digests: Optional[Sequence[Optional[bytes]]] = None):
         self.tokens = tokens
+        # Per-token sha256[:16] digests, when the submitter already
+        # has them (the serve cache-consult path; the native chain's
+        # C readers compute them at frame-parse time). Routed engines
+        # (``verify_batch_digests``) consume them instead of
+        # re-hashing; everyone else ignores them.
+        self.digests = digests
         self.results: Optional[List[Any]] = None
         self.event = threading.Event()
         self.ts = time.monotonic()
@@ -90,6 +97,12 @@ class AdaptiveBatcher:
 
                 dedup = enabled_from_env(True)
         self._dedup = bool(dedup)
+        # Digest-routed engines (the front-door router): the sync
+        # flush path calls ``verify_batch_digests(tokens, digests)``
+        # so reader/cache-computed digests survive the batcher instead
+        # of being re-hashed per hop. Async dispatch wins when a
+        # keyset exposes both.
+        self._wants_digests = hasattr(keyset, "verify_batch_digests")
         self._target = target_batch
         self._max_wait = max_wait_ms / 1000.0
         self._max_batch = max_batch
@@ -130,7 +143,9 @@ class AdaptiveBatcher:
         return p.results
 
     def submit_nowait(self, tokens: Sequence[str],
-                      trace: Optional[str] = None) -> "_Pending":
+                      trace: Optional[str] = None,
+                      digests: Optional[Sequence[Optional[bytes]]]
+                      = None) -> "_Pending":
         """Enqueue and return the pending handle WITHOUT waiting.
 
         The caller waits on ``pending.event`` and reads
@@ -138,12 +153,17 @@ class AdaptiveBatcher:
         READING frames while earlier submissions verify — request
         pipelining (VERDICT r3 #7). ``trace``: telemetry trace id for
         this submission (the worker passes the wire's trace-context).
+        ``digests``: optional per-token sha256[:16] for digest-routed
+        engines.
         """
-        return self._admit(_Pending(list(tokens), trace=trace))
+        return self._admit(_Pending(list(tokens), trace=trace,
+                                    digests=digests))
 
     def submit_handoff(self, tokens: Sequence[str],
                        traces: Sequence[str] = (),
-                       on_done=None) -> "_Pending":
+                       on_done=None,
+                       digests: Optional[Sequence[Optional[bytes]]]
+                       = None) -> "_Pending":
         """Batch handoff for ring-draining front ends (the native
         serve chain): enqueue one whole drained chunk, with ``traces``
         (the union of its requests' trace ids, for fill/dispatch span
@@ -152,7 +172,7 @@ class AdaptiveBatcher:
         are ready — the caller never parks a thread per submission and
         never registers per-token callbacks."""
         return self._admit(_Pending(list(tokens), traces=traces,
-                                    on_done=on_done))
+                                    on_done=on_done, digests=digests))
 
     def _admit(self, p: "_Pending") -> "_Pending":
         if not p.tokens:
@@ -263,11 +283,25 @@ class AdaptiveBatcher:
                 traces.append(tid)
                 telemetry.trace_span(tid, telemetry.SPAN_BATCHER_FILL,
                                      p.t0_wall, now_wall - p.t0_wall)
+        # Per-token digests for digest-routed engines: token-aligned,
+        # None where a submitter had none (the engine hashes those
+        # itself — digest is a pure function of the token, so a mixed
+        # list is still exact).
+        digests: Optional[List[Optional[bytes]]] = None
+        if self._wants_digests:
+            digests = []
+            for p in batch:
+                if p.digests is not None and len(p.digests) \
+                        == len(p.tokens):
+                    digests.extend(p.digests)
+                else:
+                    digests.extend([None] * len(p.tokens))
         # In-flight dedup: collapse identical tokens queued in this
         # flush to ONE dispatch slot each; the verdict fans back out
         # in _expand. Digest equality == token equality (the vcache's
         # sha256 contract), so string identity is the same key.
         send_tokens = tokens
+        send_digests = digests
         expand: Optional[List[int]] = None
         # len(set()) probe first: all-unique flushes (the common case
         # once the vcache absorbs repeats upstream) pay one C-speed
@@ -276,14 +310,19 @@ class AdaptiveBatcher:
             first: Dict[Any, int] = {}
             idx_map: List[int] = []
             uniq: List[Any] = []
-            for t in tokens:
+            uniq_dig: List[Optional[bytes]] = []
+            for i, t in enumerate(tokens):
                 j = first.get(t)
                 if j is None:
                     j = first[t] = len(uniq)
                     uniq.append(t)
+                    if digests is not None:
+                        uniq_dig.append(digests[i])
                 idx_map.append(j)
             telemetry.count("batcher.dedup_fanout", n - len(uniq))
             send_tokens = uniq
+            if digests is not None:
+                send_digests = uniq_dig
             expand = idx_map
         dispatch = getattr(self._keyset, "verify_batch_async", None)
         if dispatch is not None:
@@ -301,8 +340,12 @@ class AdaptiveBatcher:
         try:
             with telemetry.trace_scope(traces), \
                     telemetry.span(telemetry.SPAN_BATCHER_FLUSH):
-                results = self._expand(
-                    self._keyset.verify_batch(send_tokens), expand)
+                if self._wants_digests:
+                    raw = self._keyset.verify_batch_digests(
+                        send_tokens, send_digests)
+                else:
+                    raw = self._keyset.verify_batch(send_tokens)
+                results = self._expand(raw, expand)
         except Exception as e:  # noqa: BLE001 - fan the failure out
             results = [e] * n
         self._distribute(batch, results)

@@ -480,12 +480,14 @@ class NativeServeChain:
 
     def __init__(self, batcher, stats_fn: Callable[[], dict],
                  keys_fn: Callable[[dict, Any], int],
+                 peer_fill_fn: Optional[Callable[[dict], dict]] = None,
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
                  max_batch: int = 32768, vcache=None):
         self._lib = load()
         self._batcher = batcher
         self._stats_fn = stats_fn
         self._keys_fn = keys_fn
+        self._peer_fill_fn = peer_fill_fn
         self._target = max(1, target_batch)
         self._h = ctypes.c_void_p(self._lib.cap_serve_create(
             4096, 4 * max_batch))
@@ -499,12 +501,16 @@ class NativeServeChain:
         # hashing on the hot path; otherwise lookup_batch hashes in
         # Python (counted, visible).
         self._vcache = vcache
+        # A digest-routed engine underneath (the front-door router)
+        # consumes reader digests through the batcher even when this
+        # worker's own cache tier is off.
+        wants_digests = (vcache is not None
+                         or getattr(batcher, "_wants_digests", False))
         self._native_digests = False
-        if vcache is not None and getattr(self._lib, "cap_vc_ok",
-                                          False):
+        if wants_digests and getattr(self._lib, "cap_vc_ok", False):
             self._lib.cap_serve_set_digests(self._h, 1)
             self._native_digests = True
-        elif vcache is not None:
+        elif wants_digests:
             telemetry.count("serve.native.digest_fallbacks")
         # Native telemetry plane: on when telemetry is enabled, the
         # library carries the plane symbols, and CAP_SERVE_NATIVE_OBS
@@ -770,8 +776,18 @@ class NativeServeChain:
 
         vc = self._vcache
         if vc is None:
+            dig_list = None
+            if self._native_digests:
+                db = self._dig_buf[tok0 * _DIG_LEN:
+                                   (tok0 + seg_toks) * _DIG_LEN] \
+                    .tobytes()
+                dig_list = [None if (d := db[k * _DIG_LEN:
+                                             (k + 1) * _DIG_LEN])
+                            == _ZERO_DIG else d
+                            for k in range(seg_toks)]
             self._batcher.submit_handoff(
-                tokens, traces=[t for t, _ in traces], on_done=on_done)
+                tokens, traces=[t for t, _ in traces], on_done=on_done,
+                digests=dig_list)
             return
         # Verdict-cache consult BEFORE the batcher: reader-computed
         # digests when the .so carries them (all-zero rows — stale
@@ -800,7 +816,7 @@ class NativeServeChain:
 
             self._batcher.submit_handoff(
                 tokens, traces=[t for t, _ in traces],
-                on_done=on_done_fill)
+                on_done=on_done_fill, digests=digs)
             return
         epoch0 = vc.epoch
         miss_tokens = [tokens[i] for i in miss_idx]
@@ -815,7 +831,8 @@ class NativeServeChain:
 
         self._batcher.submit_handoff(
             miss_tokens, traces=[t for t, _ in traces],
-            on_done=on_done_merge)
+            on_done=on_done_merge,
+            digests=[digs[i] for i in miss_idx])
 
     def _post(self, results: List[Any], meta: np.ndarray,
               seqs: np.ndarray, traces_raw: np.ndarray, n_reqs: int,
@@ -901,6 +918,17 @@ class NativeServeChain:
             except Exception as e:  # noqa: BLE001 - never wedge the loop
                 frame = protocol.encode_stats_response(
                     {"error": f"{type(e).__name__}"})
+        elif kind == 4:  # peer fill (exactly one entry: the op JSON)
+            try:
+                doc = json.loads(blob[offs[tok0]: offs[tok0 + 1]])
+                if self._peer_fill_fn is None:
+                    raise TypeError("worker has no peer-fill handler")
+                frame = protocol.encode_peer_ack(
+                    doc=self._peer_fill_fn(doc))
+            except Exception as e:  # noqa: BLE001 - acked, like Python
+                telemetry.count("worker.peer_fill_errors")
+                frame = protocol.encode_peer_ack(
+                    error=f"{type(e).__name__}: {e}")
         else:          # keys push (exactly one entry: the payload)
             try:
                 doc = json.loads(blob[offs[tok0]: offs[tok0 + 1]])

@@ -13,7 +13,9 @@ Frame layout (all integers little-endian):
                   9 = traced verify request,
                   10 = traced verify response,
                   11 = keys push (keyplane),
-                  12 = keys ack (keyplane)
+                  12 = keys ack (keyplane),
+                  13 = peer fill (verdict-cache warming),
+                  14 = peer fill ack
     count   u32   number of entries
     trace-context (types 9/10 only, between header and entries):
       ctx_len u8   length of the trace-context field (1..64)
@@ -56,6 +58,30 @@ Types 11/12 are the keyplane's distribution pair, ADDITIVE like 9/10
 
 A corrupt push must never install half a key set — the CRC check runs
 before the payload is even decoded, same stance as types 7-10.
+
+Types 13/14 are the verdict-cache PEER-FILL pair, ADDITIVE exactly
+like the KEYS pair (types 1-12 keep their bytes — the golden vectors
+pin them):
+
+- **peer fill (13)**: checksummed, exactly ONE request-shaped entry
+  whose payload is the peer-fill JSON in canonical form. Two ops:
+  ``{"max": <int>, "op": "export"}`` asks a worker to dump (a bounded
+  slice of) its verdict cache; ``{"entries": [...], "epoch": <int>,
+  "op": "import"}`` hands a dump to a freshly (re)spawned worker.
+  Each entry is ``[digest_hex, payload_b64, valid_from, valid_until,
+  exp_or_null]`` — ACCEPTS only, and the receiver re-clamps every
+  entry (epoch equality, exp/nbf, its own TTL) so an import can only
+  ever SHORTEN a verdict's validity, never extend it
+  (:meth:`cap_tpu.serve.vcache.VerdictCache.import_entries`).
+- **peer fill ack (14)**: checksummed, exactly ONE response-shaped
+  entry: status 0 + the op's result JSON (``{"entries": ..,
+  "epoch": ..}`` for export, ``{"imported": N}`` for import), status
+  1 + an error string when the worker has no cache tier or the
+  payload is unusable.
+
+Secrets stance for 13/14: digests are one-way hashes and payloads are
+the claims JSON a verify response would carry anyway — no token ever
+crosses in either direction, and error strings stay class+message.
 
 Types 9/10 are the TRACED variant of 7/8: same checksummed envelope
 plus one additive trace-context field between the header and the
@@ -105,6 +131,8 @@ T_VERIFY_REQ_TRACE = 9
 T_VERIFY_RESP_TRACE = 10
 T_KEYS_PUSH = 11
 T_KEYS_ACK = 12
+T_PEER_FILL = 13
+T_PEER_ACK = 14
 
 _HDR = struct.Struct("<IBI")
 
@@ -287,6 +315,54 @@ def send_keys_ack(sock: socket.socket, epoch: Optional[int] = None,
     sock.sendall(encode_keys_ack(epoch=epoch, error=error))
 
 
+def peer_fill_payload(doc: Dict[str, Any]) -> bytes:
+    """Canonical peer-fill payload bytes (sorted keys + compact
+    separators — one document, one wire encoding, exactly like
+    :func:`keys_payload`)."""
+    return json.dumps(doc, separators=(",", ":"),
+                      sort_keys=True).encode()
+
+
+def send_peer_fill(sock: socket.socket, doc: Dict[str, Any]) -> None:
+    """Checksummed peer-fill frame (type 13): one entry, the op JSON
+    (``op=export`` request or ``op=import`` push)."""
+    payload = peer_fill_payload(doc)
+    if len(payload) > MAX_ENTRY_BYTES:
+        raise FrameTooLargeError(
+            f"peer-fill payload {len(payload)} bytes exceeds entry "
+            "bound")
+    parts = [_HDR.pack(MAGIC, T_PEER_FILL, 1),
+             _LEN_U32.pack(len(payload)), payload]
+    sock.sendall(b"".join(_with_crc(parts)))
+
+
+def encode_peer_ack(doc: Optional[Dict[str, Any]] = None,
+                    error: Optional[str] = None) -> bytes:
+    """Encoded checksummed peer-fill ack (type 14): status 0 + the
+    op's result JSON, status 1 + error string. Shared by the socket
+    sender and the native chain's control path."""
+    if error is None:
+        status = 0
+        payload = json.dumps(doc if doc is not None else {},
+                             separators=(",", ":"),
+                             sort_keys=True).encode()
+    else:
+        status, payload = 1, error.encode()
+    if len(payload) > MAX_ENTRY_BYTES:
+        raise FrameTooLargeError(
+            f"peer-fill ack payload {len(payload)} bytes exceeds "
+            "entry bound")
+    parts = [_HDR.pack(MAGIC, T_PEER_ACK, 1),
+             _LEN_BU32.pack(status, len(payload)), payload]
+    return b"".join(_with_crc(parts))
+
+
+def send_peer_ack(sock: socket.socket,
+                  doc: Optional[Dict[str, Any]] = None,
+                  error: Optional[str] = None) -> None:
+    sock.sendall(encode_peer_ack(doc=doc, error=error))
+
+
 def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
     """Read one frame → (type, entries), exact reads (no buffering).
 
@@ -329,11 +405,13 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
         raise FrameTooLargeError(f"frame too large: {count} entries")
     checksummed = ftype in (T_VERIFY_REQ_CRC, T_VERIFY_RESP_CRC,
                             T_VERIFY_REQ_TRACE, T_VERIFY_RESP_TRACE,
-                            T_KEYS_PUSH, T_KEYS_ACK)
-    if ftype in (T_KEYS_PUSH, T_KEYS_ACK) and count != 1:
+                            T_KEYS_PUSH, T_KEYS_ACK, T_PEER_FILL,
+                            T_PEER_ACK)
+    if ftype in (T_KEYS_PUSH, T_KEYS_ACK, T_PEER_FILL, T_PEER_ACK) \
+            and count != 1:
         raise MalformedFrameError(
-            f"type-{ftype} keys frame must carry exactly one entry, "
-            f"got {count}")
+            f"type-{ftype} control frame must carry exactly one "
+            f"entry, got {count}")
     if checksummed:
         crc_state = [zlib.crc32(hdr)]
 
@@ -356,7 +434,7 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
     u32 = _LEN_U32.unpack
     bu32 = _LEN_BU32.unpack
     if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC, T_VERIFY_REQ_TRACE,
-                 T_KEYS_PUSH):
+                 T_KEYS_PUSH, T_PEER_FILL):
         for _ in range(count):
             (ln,) = u32(take(4))
             total += ln
@@ -364,7 +442,8 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
                 raise FrameTooLargeError(f"frame too large ({total} bytes)")
             entries.append(take(ln))
     elif ftype in (T_VERIFY_RESP, T_VERIFY_RESP_CRC,
-                   T_VERIFY_RESP_TRACE, T_STATS_RESP, T_KEYS_ACK):
+                   T_VERIFY_RESP_TRACE, T_STATS_RESP, T_KEYS_ACK,
+                   T_PEER_ACK):
         for _ in range(count):
             status, ln = bu32(take(5))
             if not checksummed and status not in (0, 1):

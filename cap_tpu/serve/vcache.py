@@ -148,7 +148,10 @@ class VerdictCache:
                      "vcache.misses": 0, "vcache.inserts": 0,
                      "vcache.insert_skips": 0, "vcache.evictions": 0,
                      "vcache.epoch_bumps": 0, "vcache.clamp_drops": 0,
-                     "vcache.stale_accepts": 0}
+                     "vcache.stale_accepts": 0,
+                     "vcache.peer_fills": 0,
+                     "vcache.peer_fill_skips": 0,
+                     "vcache.peer_exports": 0}
 
     # -- epoch / invalidation ---------------------------------------------
 
@@ -356,6 +359,116 @@ class VerdictCache:
                            epoch=epoch, now=now):
                 n_in += 1
         return n_in
+
+    # -- peer fill (fleet cache warming, CVB1 frame pair 13/14) -----------
+
+    def export_entries(self, max_entries: int = 2048,
+                       max_bytes: int = 768 * 1024
+                       ) -> Tuple[List[list], Optional[int]]:
+        """Dump currently-valid ACCEPT entries for a peer-fill
+        transfer → (entries, epoch).
+
+        Each entry is ``[digest_hex, payload_b64, valid_from,
+        valid_until, exp_or_null]``. Accepts only: rejects are cheap
+        to re-verify and their exception classes don't round-trip
+        bit-exactly. Entries from a previous epoch (grace residue)
+        are skipped — an export carries exactly ONE epoch, the
+        current one, so the importer's clamp is a single equality.
+        ``max_bytes`` approximates the wire bound so the frame can
+        never exceed ``protocol.MAX_ENTRY_BYTES``."""
+        now = time.time()
+        epoch = self._epoch
+        out: List[list] = []
+        size = 0
+        for shard in self._shards:
+            if len(out) >= max_entries or size >= max_bytes:
+                break
+            # snapshot the dict (GIL-atomic list()) — exports race
+            # inserts harmlessly; we only need a consistent-ish slice
+            for digest, e in list(shard.items()):
+                if len(out) >= max_entries or size >= max_bytes:
+                    break
+                verdict = e[_E_VERDICT]
+                if isinstance(verdict, BaseException):
+                    continue
+                if e[_E_EPOCH] != epoch or not (e[_E_FROM] <= now
+                                                < e[_E_UNTIL]):
+                    continue
+                if isinstance(verdict, (bytes, bytearray, memoryview)):
+                    payload = bytes(verdict)
+                else:
+                    # exactly protocol._response_parts' encoding, so
+                    # an imported hit is byte-identical on the wire
+                    payload = json.dumps(
+                        verdict, separators=(",", ":")).encode()
+                row = [digest.hex(),
+                       base64.b64encode(payload).decode("ascii"),
+                       e[_E_FROM], e[_E_UNTIL], e[_E_EXP]]
+                size += len(payload) + len(row[0]) + 48
+                out.append(row)
+        self._count({"vcache.peer_exports": len(out)})
+        return out, epoch
+
+    def import_entries(self, entries: Sequence[Sequence[Any]],
+                       epoch: Any) -> int:
+        """Install a peer's export, under the SAME clamps a local
+        insert gets — warming can never extend a verdict's validity:
+
+        - ``epoch`` must equal the cache's CURRENT epoch (a transfer
+          racing a rotation is dropped whole — conservative);
+        - per entry, ``valid_until`` is re-bounded by this cache's own
+          ``now + max_ttl`` (min, never max) and already-expired or
+          not-yet-valid windows are skipped;
+        - the serve-time stale-accept tripwire applies to imported
+          entries exactly as to local ones (they are ordinary entries).
+
+        Returns how many entries were installed
+        (``vcache.peer_fills``); clamped drops count
+        ``vcache.peer_fill_skips``."""
+        now = time.time()
+        if epoch != self._epoch:
+            self._count({"vcache.peer_fill_skips": len(entries)})
+            return 0
+        filled = 0
+        skipped = 0
+        evicted = 0
+        for row in entries:
+            try:
+                digest = bytes.fromhex(row[0])
+                payload = base64.b64decode(row[1])
+                valid_from = float(row[2])
+                valid_until = float(row[3])
+                exp = float(row[4]) if row[4] is not None else None
+            except (ValueError, TypeError, IndexError,
+                    binascii.Error):
+                skipped += 1
+                continue
+            until = min(valid_until, now + self._max_ttl)
+            if exp is not None:
+                until = min(until, exp)
+            if len(digest) != DIGEST_LEN or now >= until:
+                skipped += 1
+                continue
+            # re-check the epoch per entry: a rotation landing mid-
+            # import invalidates the REST of the transfer, not just
+            # the next lookup
+            if epoch != self._epoch:
+                skipped += len(entries) - filled - skipped
+                break
+            e = (payload, valid_from, until, epoch, exp)
+            s = digest[0] & (self._n_shards - 1)
+            with self._locks[s]:
+                shard = self._shards[s]
+                if digest not in shard \
+                        and len(shard) >= self._cap_per_shard:
+                    shard.pop(next(iter(shard)))
+                    evicted += 1
+                shard[digest] = e
+            filled += 1
+        self._count({"vcache.peer_fills": filled,
+                     "vcache.peer_fill_skips": skipped,
+                     "vcache.evictions": evicted})
+        return filled
 
     # -- stats ------------------------------------------------------------
 

@@ -148,7 +148,9 @@ class VerifyWorker:
 
                 self._native = NativeServeChain(
                     self._batcher, stats_fn=self.stats,
-                    keys_fn=self.apply_keys, target_batch=target_batch,
+                    keys_fn=self.apply_keys,
+                    peer_fill_fn=self.peer_fill,
+                    target_batch=target_batch,
                     max_wait_ms=max_wait_ms, max_batch=max_batch,
                     vcache=self._vcache)
             except Exception:  # noqa: BLE001 - fall back, visibly
@@ -229,6 +231,32 @@ class VerifyWorker:
         telemetry.count("worker.keys_pushes")
         telemetry.gauge("keyplane.epoch", got)
         return got
+
+    def peer_fill(self, doc: dict) -> dict:
+        """Handle one peer-fill op (CVB1 type 13; see
+        :mod:`cap_tpu.serve.vcache` for the clamp contract).
+
+        ``op=export`` dumps a bounded slice of this worker's verdict
+        cache; ``op=import`` installs a sibling's dump into it. Raises
+        when this worker has no cache tier or the document is
+        unusable — the caller acks with the error, nothing is
+        half-applied."""
+        if self._vcache is None:
+            raise TypeError("worker has no verdict-cache tier "
+                            "(vcache off)")
+        op = doc.get("op")
+        if op == "export":
+            max_n = int(doc.get("max") or 2048)
+            entries, epoch = self._vcache.export_entries(
+                max_entries=max_n)
+            telemetry.count("worker.peer_exports")
+            return {"entries": entries, "epoch": epoch}
+        if op == "import":
+            n = self._vcache.import_entries(
+                doc.get("entries") or [], epoch=doc.get("epoch"))
+            telemetry.count("worker.peer_imports")
+            return {"imported": n}
+        raise ValueError(f"unknown peer-fill op {op!r}")
 
     def _obs_gauges(self) -> dict:
         d = self._batcher.depth()
@@ -424,6 +452,21 @@ class VerifyWorker:
                         respq.put(("keys_err",
                                    f"{type(e).__name__}: {e}", None))
                     continue
+                if ftype == protocol.T_PEER_FILL:
+                    # Same in-order stance as KEYS pushes: applied in
+                    # the reader thread, acked through the responder
+                    # queue — a verify read after an import sees the
+                    # warmed cache.
+                    import json as _json
+
+                    try:
+                        doc = self.peer_fill(_json.loads(entries[0]))
+                        respq.put(("peer_ack", doc, None))
+                    except Exception as e:  # noqa: BLE001 - acked
+                        telemetry.count("worker.peer_fill_errors")
+                        respq.put(("peer_err",
+                                   f"{type(e).__name__}: {e}", None))
+                    continue
                 if ftype not in (protocol.T_VERIFY_REQ,
                                  protocol.T_VERIFY_REQ_CRC,
                                  protocol.T_VERIFY_REQ_TRACE):
@@ -470,7 +513,8 @@ class VerifyWorker:
                             epoch=epoch0)
 
         inner = self._batcher.submit_nowait(
-            [entries[i] for i in miss_idx], trace=trace)
+            [entries[i] for i in miss_idx], trace=trace,
+            digests=[digests[i] for i in miss_idx])
         return _CachePending(list(entries), hits, miss_idx, inner, fill)
 
     def _respond_loop(self, conn: socket.socket, respq) -> None:
@@ -489,6 +533,10 @@ class VerifyWorker:
                     protocol.send_keys_ack(conn, epoch=pending)
                 elif kind == "keys_err":
                     protocol.send_keys_ack(conn, error=pending)
+                elif kind == "peer_ack":
+                    protocol.send_peer_ack(conn, doc=pending)
+                elif kind == "peer_err":
+                    protocol.send_peer_ack(conn, error=pending)
                 elif kind == "stats":
                     # Snapshot at RESPOND time (in-order with verifies
                     # on this connection, so a stats probe sent after a

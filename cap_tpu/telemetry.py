@@ -56,6 +56,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 # tests pin the two against each other so names cannot drift)
 # ---------------------------------------------------------------------------
 
+SPAN_FRONTDOOR_ROUTE = "frontdoor.route"    # FrontDoor partition+dispatch
 SPAN_CLIENT_SUBMIT = "client.submit"        # FleetClient.verify_batch, whole
 SPAN_ROUTER_ATTEMPT = "router.attempt"      # one wire attempt on one worker
 SPAN_ROUTER_HEDGE = "router.hedge"          # duplicate attempt on a peer
@@ -77,7 +78,7 @@ SPAN_NAMES = frozenset({
     SPAN_ROUTER_BACKOFF, SPAN_ROUTER_FALLBACK, SPAN_WORKER_DEQUEUE,
     SPAN_BATCHER_FILL, SPAN_BATCHER_FLUSH, SPAN_BATCHER_DISPATCH,
     SPAN_BATCHER_COLLECT, SPAN_KEYPLANE_SWAP, SPAN_NATIVE_DRAIN,
-    SPAN_NATIVE_POST, SPAN_OIDC_VALIDATE,
+    SPAN_NATIVE_POST, SPAN_OIDC_VALIDATE, SPAN_FRONTDOOR_ROUTE,
 })
 
 # ---------------------------------------------------------------------------

@@ -977,6 +977,96 @@ class TestKeysWireGolden:
 
 
 # ---------------------------------------------------------------------------
+# verdict-cache peer-fill frames (types 13/14): additive golden vectors
+# ---------------------------------------------------------------------------
+
+class TestPeerFillWireGolden:
+    """The peer-fill frame pair is ADDITIVE exactly like the KEYS
+    pair: its own golden files (``peer_fill.bin`` / ``peer_ack.bin``),
+    while frames 1-12 stay pinned byte-identical by TestWireGolden and
+    TestKeysWireGolden above. Fixture values mirror
+    tools/gen_go_golden.py exactly."""
+
+    PEER_FILL_DOC = {
+        "op": "import",
+        "epoch": 3,
+        "entries": [[
+            "00112233445566778899aabbccddeeff",
+            "eyJzdWIiOiJnb2xkZW4ifQ==",
+            1700000000.0,
+            4102444800.0,
+            4102444800.0,
+        ]],
+    }
+    PEER_ACK_DOC = {"imported": 1}
+
+    def test_peer_frames_match_golden(self):
+        from cap_tpu.serve import protocol
+
+        s = _CaptureSock()
+        protocol.send_peer_fill(s, self.PEER_FILL_DOC)
+        assert s.value() == _golden("peer_fill.bin"), \
+            "peer_fill.bin drifted from the committed golden bytes"
+        assert protocol.encode_peer_ack(self.PEER_ACK_DOC) \
+            == _golden("peer_ack.bin"), \
+            "peer_ack.bin drifted from the committed golden bytes"
+
+    def test_peer_frames_parse_back(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        buf = io.BytesIO(_golden("peer_fill.bin"))
+        ftype, entries, trace = protocol._parse_frame(buf.read)
+        assert ftype == protocol.T_PEER_FILL and trace is None
+        assert buf.read() == b""           # trailer fully consumed
+        doc = json.loads(entries[0])
+        assert doc["op"] == "import" and doc["epoch"] == 3
+        assert doc["entries"][0][0] == \
+            "00112233445566778899aabbccddeeff"
+
+        buf = io.BytesIO(_golden("peer_ack.bin"))
+        ftype, entries, _ = protocol._parse_frame(buf.read)
+        assert ftype == protocol.T_PEER_ACK
+        assert entries[0][0] == 0
+        assert json.loads(entries[0][1]) == self.PEER_ACK_DOC
+
+    def test_corrupt_peer_frame_detected(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        blob = bytearray(_golden("peer_fill.bin"))
+        blob[20] ^= 0x01
+        with pytest.raises(protocol.ProtocolError):
+            protocol._parse_frame(io.BytesIO(bytes(blob)).read)
+
+    def test_frames_1_to_12_still_byte_identical(self):
+        """The additive contract, explicitly: regenerating every
+        pre-peer-fill golden file yields the committed bytes — the new
+        pair changed NOTHING upstream of it."""
+        from cap_tpu.serve import protocol
+
+        for name in ("request.bin", "response.bin", "ping.bin",
+                     "pong.bin", "stats_request.bin",
+                     "stats_response.bin", "request_crc.bin",
+                     "response_crc.bin", "request_trace.bin",
+                     "response_trace.bin", "keys_push.bin",
+                     "keys_ack.bin"):
+            assert _golden(name), f"{name} missing"
+        s = _CaptureSock()
+        protocol.send_keys_push(s, TestKeysWireGolden.KEYS_JWKS,
+                                TestKeysWireGolden.KEYS_EPOCH)
+        assert s.value() == _golden("keys_push.bin")
+
+    def test_meta_pins_peer_fixture(self):
+        with open(os.path.join(_TESTDATA, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["peer_fill_doc"] == self.PEER_FILL_DOC
+        assert meta["peer_ack_doc"] == self.PEER_ACK_DOC
+
+
+# ---------------------------------------------------------------------------
 # rotation parity: the sig-conformance vectors across an epoch swap
 # ---------------------------------------------------------------------------
 

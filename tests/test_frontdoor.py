@@ -1,0 +1,595 @@
+"""Front door: digest-affinity routing + fleet-wide verdict tier.
+
+Covers the ring (stability under membership change), the routing
+partition (exact hit/miss accounting, bounded-load spill, dead-pool
+re-route), keyplane fan-out through the router, the peer-fill frame
+pair's worker handlers on both serve chains, the peer-fill parity pin
+(bit-identical verdicts and decision counters with warming on vs off,
+incl. an epoch swap and an exp crossing mid-run), and the multi-pool
+chaos acceptance: kill -9 an entire pool mid-rotation under sustained
+hot-token load.
+"""
+
+import json
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet import ConsistentHashRing, FrontDoor, WorkerPool
+from cap_tpu.fleet.frontdoor import frontdoor_from_spec
+from cap_tpu.fleet.worker_main import StubKeySet, make_keyset
+from cap_tpu.fleet.chaos import kill9
+from cap_tpu.serve import protocol as P
+from cap_tpu.serve import vcache as V
+from cap_tpu.serve.client import VerifyClient
+from cap_tpu.serve.worker import VerifyWorker
+
+
+def _digests(tokens):
+    return [V.token_digest(t) for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# unit: the consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_deterministic_and_covers_all_pools():
+    ring = ConsistentHashRing([0, 1, 2])
+    toks = [f"ring-{i}" for i in range(600)]
+    owners = [ring.primary(d) for d in _digests(toks)]
+    assert owners == [ring.primary(d) for d in _digests(toks)]
+    assert set(owners) == {0, 1, 2}
+    # near-uniform split: no pool owns more than ~2/3 of the keys
+    for pid in (0, 1, 2):
+        assert owners.count(pid) < 400
+
+
+def test_ring_membership_change_remaps_only_lost_segments():
+    """THE consistent-hash property: dropping pool 2 moves ONLY the
+    tokens pool 2 owned; everything else keeps its owner."""
+    full = ConsistentHashRing([0, 1, 2])
+    reduced = ConsistentHashRing([0, 1])
+    moved = 0
+    for d in _digests([f"stable-{i}" for i in range(500)]):
+        before, after = full.primary(d), reduced.primary(d)
+        if before == 2:
+            assert after in (0, 1)
+            moved += 1
+        else:
+            assert after == before, "unowned segment remapped"
+    assert moved > 0
+
+
+def test_ring_preference_distinct_pools():
+    ring = ConsistentHashRing([0, 1, 2])
+    for d in _digests([f"pref-{i}" for i in range(50)]):
+        pref = ring.preference(d, 2)
+        assert len(pref) == 2 and pref[0] != pref[1]
+        assert ring.preference(d, 1) == [pref[0]]
+
+
+# ---------------------------------------------------------------------------
+# unit: partition accounting (bare endpoints, no dispatch)
+# ---------------------------------------------------------------------------
+
+
+def _bare_frontdoor(n_pools=2, **kw):
+    # unreachable-but-listed endpoints: partition-level tests never
+    # dispatch, and has_live_endpoint() treats a listed endpoint with
+    # a closed breaker as live
+    return FrontDoor([[("127.0.0.1", 1 + i)] for i in range(n_pools)],
+                     **kw)
+
+
+def test_partition_exact_hit_accounting_and_reuses_digests():
+    fd = _bare_frontdoor()
+    toks = [f"part-{i}.ok" for i in range(64)]
+    groups, hits_by = fd._partition(toks, None)
+    assert sorted(i for g in groups.values() for i in g) \
+        == list(range(64))
+    c = fd.counters()
+    assert c["frontdoor.lookups"] == 64
+    assert c["frontdoor.affinity_hits"] \
+        + c["frontdoor.affinity_misses"] == 64
+    assert c["frontdoor.spills"] == 0
+    # caller-supplied digests are authoritative: a crafted digest
+    # changes the route, proving no re-hash happened
+    d0 = V.token_digest(toks[0])
+    groups1, _ = fd._partition([toks[0]], [d0])
+    fake = bytes(16)
+    groups2, _ = fd._partition([toks[0]], [fake])
+    assert next(iter(groups1)) == fd._ring.primary(d0)
+    assert next(iter(groups2)) == fd._ring.primary(fake)
+
+
+def test_partition_bounded_load_spills_to_second_choice():
+    fd = _bare_frontdoor()            # default bounded-load c=1.25
+    tok = "hot-spill.ok"
+    d = V.token_digest(tok)
+    primary, second = fd._ring.preference(d, 2)
+    # primary is drowning, second idle → power-of-two spill
+    fd._arms[primary].inflight = 10_000
+    groups, _ = fd._partition([tok], [d])
+    assert list(groups) == [second]
+    c = fd.counters()
+    assert c["frontdoor.spills"] == 1
+    assert c["frontdoor.affinity_hits"] \
+        + c["frontdoor.affinity_misses"] == c["frontdoor.lookups"]
+
+
+def test_partition_reroutes_off_dead_pool():
+    fd = _bare_frontdoor()
+    tok = "dead-pool.ok"
+    d = V.token_digest(tok)
+    primary, second = fd._ring.preference(d, 2)
+    # open every breaker on the primary arm → not live
+    cl = fd._arms[primary].client
+    for ep in cl._live_endpoints():
+        for _ in range(5):
+            cl._on_failure(ep)
+    assert not fd._arms[primary].live()
+    groups, _ = fd._partition([tok], [d])
+    assert list(groups) == [second]
+    assert fd.counters()["frontdoor.reroutes"] == 1
+
+
+def test_rr_mode_round_robins_whole_batches():
+    fd = _bare_frontdoor(routing="rr")
+    seen = []
+    for _ in range(4):
+        groups, _ = fd._partition(["rr-a.ok", "rr-b.ok"], None)
+        seen.append(next(iter(groups)))
+    assert seen == [0, 1, 0, 1]
+    c = fd.counters()
+    assert c["frontdoor.lookups"] == 8
+    assert c["frontdoor.affinity_hits"] \
+        + c["frontdoor.affinity_misses"] == 8
+
+
+def test_frontdoor_spec_parses():
+    fd = frontdoor_from_spec(
+        "pool=127.0.0.1:19001+127.0.0.1:19002;pool=127.0.0.1:19003;"
+        "routing=rr;spill=3.5")
+    assert len(fd._arms) == 2
+    assert fd._routing == "rr" and fd._spill_factor == 3.5
+    assert len(fd._arms[0].client._live_endpoints()) == 2
+    with pytest.raises(ValueError):
+        frontdoor_from_spec("routing=affinity")      # no pools
+    with pytest.raises(ValueError):
+        frontdoor_from_spec("pool=a:1;bogus=1")
+    fd2 = make_keyset("frontdoor:pool=127.0.0.1:19001")
+    assert isinstance(fd2, FrontDoor)
+
+
+# ---------------------------------------------------------------------------
+# integration: routing + re-route + fallback over live workers
+# ---------------------------------------------------------------------------
+
+
+def _two_workers(**kw):
+    w0 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      **kw)
+    w1 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      **kw)
+    return w0, w1
+
+
+def test_routing_end_to_end_and_affinity_repeats_hit_worker_cache():
+    rec = telemetry.enable()
+    rec.reset()
+    w0, w1 = _two_workers(vcache=True)
+    try:
+        fd = FrontDoor([[w0.address], [w1.address]],
+                       fallback=StubKeySet(),
+                       client_kw={"attempt_timeout": 5.0,
+                                  "total_deadline": 10.0})
+        toks = [f"e2e-{i}.ok" for i in range(24)] + ["e2e-bad"]
+        for rep in range(3):
+            out = fd.verify_batch(toks)
+            assert len(out) == 25
+            for t, r in zip(toks, out):
+                if t.endswith(".ok"):
+                    assert r == {"sub": t}, (t, r)
+                else:
+                    assert isinstance(r, Exception)
+        c = rec.counters()
+        # repeats hit the worker-tier cache because affinity pinned
+        # them to the same worker
+        assert c.get("vcache.hits", 0) >= 25
+        assert c.get("vcache.stale_accepts", 0) == 0
+        assert c["frontdoor.lookups"] == 75
+        # decision records on the frontdoor surface
+        assert c.get("decision.frontdoor.accept", 0) == 72
+        fd.close()
+    finally:
+        w0.close(5)
+        w1.close(5)
+        telemetry.disable()
+
+
+def test_dead_pool_reroutes_then_terminal_fallback():
+    rec = telemetry.enable()
+    rec.reset()
+    w0, w1 = _two_workers()
+    addr1 = w1.address
+    w1.close(5)                       # pool 1 is dead from the start
+    try:
+        fd = FrontDoor([[w0.address], [addr1]],
+                       fallback=StubKeySet(),
+                       client_kw={"attempt_timeout": 1.0,
+                                  "total_deadline": 3.0,
+                                  "max_rounds": 1,
+                                  "breaker_threshold": 1})
+        toks = [f"rr-{i}.ok" for i in range(32)]
+        out = fd.verify_batch(toks)
+        assert [r == {"sub": t} for t, r in zip(toks, out)] \
+            == [True] * 32, "verdicts survived the dead pool"
+        c = fd.counters()
+        assert c["frontdoor.lookups"] == 32
+        assert c["frontdoor.affinity_hits"] \
+            + c["frontdoor.affinity_misses"] == 32
+        # pool 1's share either re-routed (breaker view) or fell back
+        assert c["frontdoor.reroutes"] > 0 \
+            or c["frontdoor.fallback_tokens"] > 0
+        # every later call routes around the dead pool at partition
+        out = fd.verify_batch(toks)
+        assert all(r == {"sub": t} for t, r in zip(toks, out))
+        fd.close()
+    finally:
+        w0.close(5)
+        telemetry.disable()
+
+
+def test_keys_fanout_to_bare_endpoint_pools():
+    w0, w1 = _two_workers()
+    try:
+        fd = FrontDoor([[w0.address], [w1.address]])
+        acks = fd.push_keys({"keys": []})
+        assert fd.key_epoch == 1
+        for pool_acks in acks.values():
+            assert set(pool_acks.values()) == {1}
+        assert w0.key_epoch == 1 and w1.key_epoch == 1
+        # swap_keys alias: the engine-facing surface a front-door
+        # VerifyWorker exposes to KEYS pushes
+        assert fd.swap_keys({"keys": []}) == 2
+        assert w0.key_epoch == 2
+        fd.close()
+    finally:
+        w0.close(5)
+        w1.close(5)
+
+
+# ---------------------------------------------------------------------------
+# peer fill: worker handlers on both chains + clamp behavior
+# ---------------------------------------------------------------------------
+
+
+def _peer_exchange(src_addr, dst_addr, max_entries=100):
+    """Pull an export from src, push it into dst; returns imported."""
+    with socket.create_connection(src_addr, timeout=5) as s:
+        P.send_peer_fill(s, {"op": "export", "max": max_entries})
+        ftype, entries = P.FrameReader(s).recv_frame()
+    assert ftype == P.T_PEER_ACK and entries[0][0] == 0
+    doc = json.loads(entries[0][1])
+    with socket.create_connection(dst_addr, timeout=5) as s:
+        P.send_peer_fill(s, {"op": "import", "epoch": doc["epoch"],
+                             "entries": doc["entries"]})
+        ftype, entries = P.FrameReader(s).recv_frame()
+    assert ftype == P.T_PEER_ACK and entries[0][0] == 0
+    return json.loads(entries[0][1])["imported"], doc
+
+
+@pytest.mark.parametrize("serve_native", [False, True])
+def test_peer_fill_wire_roundtrip_warms_sibling(serve_native):
+    rec = telemetry.enable()
+    rec.reset()
+    w0 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      serve_native=serve_native, vcache=True)
+    if serve_native and w0.serve_chain != "native":
+        w0.close(5)
+        pytest.skip("native serve chain unavailable")
+    w1 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      serve_native=serve_native, vcache=True)
+    try:
+        with VerifyClient(*w0.address) as c:
+            c.verify_batch(["pf-a.ok", "pf-b.ok", "pf-bad"])
+        imported, doc = _peer_exchange(w0.address, w1.address)
+        assert imported == 2            # accepts only, never rejects
+        assert all(len(row) == 5 for row in doc["entries"])
+        # the warmed worker serves the verdict at memory speed: its
+        # OWN engine never sees the token
+        with VerifyClient(*w1.address) as c:
+            out = c.verify_batch(["pf-a.ok"])
+        assert out[0] == {"sub": "pf-a.ok"}
+        c2 = rec.counters()
+        assert c2.get("vcache.peer_fills", 0) == 2
+        assert c2.get("vcache.stale_accepts", 0) == 0
+    finally:
+        w0.close(5)
+        w1.close(5)
+        telemetry.disable()
+
+
+def test_peer_fill_errors_are_acked_not_fatal():
+    w = VerifyWorker(StubKeySet(), target_batch=8, max_wait_ms=1.0,
+                     vcache=False)          # no cache tier
+    try:
+        with socket.create_connection(w.address, timeout=5) as s:
+            P.send_peer_fill(s, {"op": "export", "max": 10})
+            ftype, entries = P.FrameReader(s).recv_frame()
+        assert ftype == P.T_PEER_ACK
+        assert entries[0][0] == 1           # status-1 error ack
+        assert b"TypeError" in entries[0][1]
+        # the connection (and worker) survive: verify still works
+        with VerifyClient(*w.address) as c:
+            assert c.verify_batch(["after.ok"])[0] == {"sub":
+                                                       "after.ok"}
+    finally:
+        w.close(5)
+
+
+def test_import_cannot_extend_validity():
+    """The clamp acceptance: whatever the wire claims, an imported
+    entry's validity is re-bounded by the IMPORTER's TTL and exp —
+    warming can never extend a verdict's life."""
+    vc = V.VerdictCache(max_ttl_s=0.3)
+    vc.set_epoch(7)
+    d = V.token_digest("clamp-t")
+    far = time.time() + 3600
+    # wire entry claims a huge window
+    n = vc.import_entries(
+        [[d.hex(), "eyJzdWIiOiJ4In0=", 0.0, far, far]], epoch=7)
+    assert n == 1
+    assert vc.get(d) is not V.MISS
+    time.sleep(0.35)
+    assert vc.get(d) is V.MISS, "import outlived the importer's TTL"
+    # expired-on-arrival and wrong-epoch entries never install
+    assert vc.import_entries(
+        [[d.hex(), "eyJzdWIiOiJ4In0=", 0.0, time.time() - 1,
+          None]], epoch=7) == 0
+    assert vc.import_entries(
+        [[d.hex(), "eyJzdWIiOiJ4In0=", 0.0, far, None]], epoch=8) == 0
+    st = vc.stats()
+    assert st["vcache.peer_fill_skips"] == 2
+
+
+# ---------------------------------------------------------------------------
+# parity pin: peer-fill on vs off (the acceptance sweep)
+# ---------------------------------------------------------------------------
+
+
+def _mixed_sequence(n_batches=18, seed=11):
+    import base64
+    import random
+
+    def tok(name, ok=True, **claims):
+        mid = base64.urlsafe_b64encode(
+            json.dumps(claims).encode()).rstrip(b"=").decode() \
+            if claims else "e30"
+        return f"{name}.{mid}.{'ok' if ok else 'bad'}"
+
+    rng = random.Random(seed)
+    pool = ([tok(f"hot{i}", ok=True, exp=time.time() + 3600)
+             for i in range(5)]
+            + [tok(f"bad{i}", ok=False) for i in range(2)]
+            + [tok("expiring", ok=True, exp=time.time() + 0.9)])
+    return [[rng.choice(pool) for _ in range(rng.randrange(1, 5))]
+            for _ in range(n_batches)]
+
+
+def _run_peer_sweep(serve_native, peer_fill, seq, rotate_at=9):
+    """Warm worker A, optionally transfer its cache into fresh worker
+    B over the wire, then drive the sweep at B (epoch swap mid-run,
+    expiring token crossing exp). Returns B's normalized verdicts +
+    serve decision counters."""
+    wa = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      serve_native=serve_native, vcache=True)
+    if serve_native and wa.serve_chain != "native":
+        wa.close(5)
+        pytest.skip("native serve chain unavailable")
+    rec = telemetry.enable()
+    wb = None
+    try:
+        warm = sorted({t for batch in seq for t in batch})
+        with VerifyClient(*wa.address) as c:
+            c.verify_batch(warm)
+        rec.reset()                  # B's run counts from zero
+        wb = VerifyWorker(StubKeySet(), target_batch=64,
+                          max_wait_ms=1.0, serve_native=serve_native,
+                          vcache=True)
+        if peer_fill:
+            imported, _ = _peer_exchange(wa.address, wb.address)
+            assert imported > 0
+        out = []
+        with VerifyClient(*wb.address) as c:
+            for i, batch in enumerate(seq):
+                if i == rotate_at:
+                    wb.apply_keys({}, 2)
+                out.append(c.verify_batch(batch))
+        verdicts = [[str(r).split(":", 1)[0]
+                     if isinstance(r, Exception) else
+                     (json.loads(r) if isinstance(r, bytes) else r)
+                     for r in batch] for batch in out]
+        dec = {k: v for k, v in rec.counters().items()
+               if k.startswith("decision.serve.")}
+        stale = rec.counters().get("vcache.stale_accepts", 0)
+        fills = rec.counters().get("vcache.peer_fills", 0)
+        return verdicts, dec, stale, fills
+    finally:
+        wa.close(5)
+        if wb is not None:
+            wb.close(5)
+        telemetry.disable()
+
+
+@pytest.mark.parametrize("serve_native", [False, True])
+def test_peer_fill_parity_on_vs_off(serve_native):
+    """The acceptance pin: bit-identical verdicts AND serve decision
+    counters with peer-fill warming on vs off, across an epoch swap
+    and an exp crossing mid-run — warming changes speed, never
+    verdicts."""
+    seq = _mixed_sequence()
+    on_v, on_d, on_stale, on_fills = _run_peer_sweep(
+        serve_native, True, seq)
+    off_v, off_d, off_stale, off_fills = _run_peer_sweep(
+        serve_native, False, seq)
+    assert on_fills > 0 and off_fills == 0
+    assert on_v == off_v
+    assert on_d == off_d
+    assert on_stale == 0 and off_stale == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill -9 an entire pool mid-rotation under hot-token load
+# ---------------------------------------------------------------------------
+
+HARD_TIMEOUT_S = 150
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"frontdoor test exceeded hard {HARD_TIMEOUT_S}s timeout")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _expected_ok(t):
+    return t.endswith(".ok")
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("serve_chain", ["python", "native"])
+def test_pool_kill9_mid_rotation_under_hot_load(serve_chain):
+    """Kill -9 an ENTIRE pool mid-rotation while hot-token load flows:
+    zero wrong verdicts, zero lost submissions, zero stale accepts
+    fleet-wide, epoch convergence after respawn, and a peer-filled
+    replacement worker shows ``vcache.peer_fills`` > 0 in its
+    postmortem."""
+    native = serve_chain == "native"
+    pools = [WorkerPool(2, keyset_spec="stub:batch_ms=25",
+                        ping_interval=0.2, max_restarts=20,
+                        max_wait_ms=1.0,
+                        env_extra={"CAP_SERVE_NATIVE":
+                                   "1" if native else "0"})
+             for _ in range(2)]
+    fd = None
+    try:
+        for p in pools:
+            assert p.wait_all_ready(30), "fleet did not come up"
+        chains = {c for p in pools
+                  for c in p.serve_chains().values()}
+        if native and chains != {"native"}:
+            pytest.skip(f"native chain unavailable ({chains})")
+        fd = FrontDoor(pools, fallback=StubKeySet(),
+                       client_kw={"attempt_timeout": 2.0,
+                                  "total_deadline": 20.0,
+                                  "breaker_reset_s": 0.5})
+        hot = [f"hot-{i}.ok" for i in range(10)] + ["hot-bad"]
+        stop = threading.Event()
+        failures = []
+        served = [0]
+
+        def drive():
+            while not stop.is_set():
+                try:
+                    out = fd.verify_batch(hot)
+                except Exception as e:  # noqa: BLE001 - recorded
+                    failures.append(f"raised: {e!r}")
+                    return
+                if len(out) != len(hot):
+                    failures.append("lost submissions")
+                    return
+                for t, r in zip(hot, out):
+                    if _expected_ok(t) != (not isinstance(r,
+                                                          Exception)):
+                        failures.append(f"WRONG verdict {t!r}: {r!r}")
+                        return
+                    if _expected_ok(t) and r != {"sub": t}:
+                        failures.append(f"WRONG claims {t!r}: {r!r}")
+                        return
+                served[0] += len(out)
+
+        drivers = [threading.Thread(target=drive, daemon=True)
+                   for _ in range(3)]
+        for d in drivers:
+            d.start()
+        time.sleep(1.0)               # warm caches under load
+
+        # rotation + the kill land together: the push is mid-flight
+        # when the whole victim pool dies
+        victim = pools[1]
+        victim_pids = [victim.pid(w) for w in (0, 1)]
+        push = threading.Thread(
+            target=lambda: fd.push_keys({"keys": []}), daemon=True)
+        push.start()
+        time.sleep(0.01)
+        for pid in victim_pids:
+            if pid:
+                kill9(pid)
+        push.join(timeout=60)
+
+        # sustained load through death, respawn, and re-warm
+        time.sleep(6.0)
+        stop.set()
+        for d in drivers:
+            d.join(timeout=30)
+            assert not d.is_alive(), "driver wedged"
+        assert not failures, failures
+        assert served[0] > 0
+
+        # epoch convergence after respawn, fleet-wide, via the router
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if fd.epoch_skew() == 0 and None not in \
+                    fd.key_epochs().values():
+                break
+            time.sleep(0.2)
+        assert fd.epoch_skew() == 0, fd.key_epochs()
+        assert set(fd.key_epochs().values()) == {1}
+
+        # zero stale accepts fleet-wide + a peer-filled replacement
+        deadline = time.monotonic() + 30
+        filled_wid = None
+        while time.monotonic() < deadline and filled_wid is None:
+            stats = victim.stats()
+            for wid, st in stats.items():
+                ctr = (st or {}).get("counters") or {}
+                if ctr.get("vcache.stale_accepts", 0):
+                    failures.append(f"stale accept on victim w{wid}")
+                if ctr.get("vcache.peer_fills", 0) > 0:
+                    filled_wid = wid
+            if filled_wid is None:
+                time.sleep(0.5)
+        assert not failures, failures
+        for p in pools:
+            agg = p.stats_merged()["aggregate"]["counters"]
+            assert agg.get("vcache.stale_accepts", 0) == 0
+        assert filled_wid is not None, \
+            "no respawned worker was peer-filled"
+
+        # the acceptance artifact: the peer fill shows up in the
+        # worker's POSTMORTEM (graceful restart writes a fresh doc)
+        victim.restart(filled_wid, graceful=True)
+        doc = victim.postmortem(filled_wid)
+        assert doc is not None
+        pm_counters = (doc.get("stats") or {}).get("counters") or {}
+        assert pm_counters.get("vcache.peer_fills", 0) > 0, \
+            pm_counters
+    finally:
+        if fd is not None:
+            fd.close()
+        for p in pools:
+            p.close()

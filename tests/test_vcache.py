@@ -486,6 +486,46 @@ def test_fleet_client_tier_short_circuits_before_wire():
         telemetry.disable()
 
 
+def test_fleet_client_bare_endpoint_ttl_configurable(monkeypatch):
+    """Bare-endpoint clients have NO pool-epoch visibility: the hard
+    TTL is their only rotation bound. CAP_CLIENT_VCACHE_TTL makes it
+    configurable (default 30 s unchanged); past the TTL the epoch-less
+    entry EXPIRES and the next call goes back to the engine."""
+    from cap_tpu.fleet import FleetClient
+    from cap_tpu.fleet.worker_main import StubKeySet as FleetStub
+
+    ks = CountingStub()
+    w = VerifyWorker(ks, max_wait_ms=2.0, vcache=False)
+    try:
+        # default path: 30 s (unchanged from r14)
+        cl = FleetClient([w.address], fallback=FleetStub(),
+                         vcache=True)
+        assert cl._vcache._max_ttl == 30.0
+        # pool-backed clients keep their long TTL (epoch clamp covers
+        # them) — the env knob must not touch that path
+        monkeypatch.setenv("CAP_CLIENT_VCACHE_TTL", "0.3")
+        cl = FleetClient([w.address], fallback=FleetStub(),
+                         vcache=True)
+        assert cl._vcache._max_ttl == 0.3
+        cl.verify_batch(["ttl.x.ok"])
+        cl.verify_batch(["ttl.x.ok"])
+        assert ks.seen.count("ttl.x.ok") == 1     # hit inside TTL
+        time.sleep(0.35)
+        cl.verify_batch(["ttl.x.ok"])             # epoch-less expiry
+        assert ks.seen.count("ttl.x.ok") == 2
+        st = cl.snapshot()["vcache"]
+        assert st["vcache.stale_accepts"] == 0
+        # a broken value falls back to the default, never to forever
+        monkeypatch.setenv("CAP_CLIENT_VCACHE_TTL", "bogus")
+        cl = FleetClient([w.address], vcache=True)
+        assert cl._vcache._max_ttl == 30.0
+        monkeypatch.setenv("CAP_CLIENT_VCACHE_TTL", "0")
+        cl = FleetClient([w.address], vcache=True)
+        assert cl._vcache._max_ttl > 0
+    finally:
+        w.close(10)
+
+
 def test_fleet_client_tier_parity_on_vs_off():
     from cap_tpu.fleet import FleetClient
     from cap_tpu.fleet.worker_main import StubKeySet as FleetStub

@@ -38,6 +38,17 @@ SERVE-CHAIN COMPARISON (fleet mode, ``CAP_SERVE_CHAINS=
 ``serve_native_vps`` / ``serve_python_vps`` and their ratio — the
 host-saturation A/B docs/PERF.md §Round 12 records.
 
+MULTI-POOL FRONT-DOOR MODE (``CAP_SERVE_POOLS=N``): N fresh
+``WorkerPool`` "hosts" behind :class:`cap_tpu.fleet.FrontDoor`
+drivers, one run per routing arm in ``CAP_SERVE_ROUTING``
+("affinity,rr" — consistent-hash digest affinity vs round-robin),
+arms interleaved over ``CAP_SERVE_REPS``. ``CAP_SERVE_POOL_WORKERS``
+sizes each pool, ``CAP_SERVE_VCACHE_CAP`` bounds each worker's
+verdict cache (the fleet-scale regime: corpus >> one worker's cache),
+``CAP_SERVE_SPILL`` sets the bounded-load constant. Headline:
+``fleet_affinity_vps`` / ``fleet_rr_vps`` + ratio (§Round 16,
+tracked by bench_trend).
+
 FLEET MODE (``CAP_SERVE_FLEET="1,2"``): instead of one in-process
 worker, spin a ``WorkerPool`` per listed size under the single-owner
 placement model (one worker process per device group — NO chip
@@ -491,6 +502,236 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
     return pt
 
 
+def _frontdoor_client_proc(groups, routing, spill, tokens, req_tokens,
+                           start_at, seconds, seed, outq, zipf=None,
+                           pool_idx=None):
+    """One closed-loop FrontDoor driver PROCESS (own interpreter):
+    routes over the pool endpoint groups by digest affinity (or rr,
+    the control arm) and ships its routing counters back with the
+    throughput numbers."""
+    from cap_tpu.fleet.frontdoor import FrontDoor
+
+    fd = FrontDoor(groups, routing=routing, spill_factor=spill,
+                   client_kw={"attempt_timeout": 30.0,
+                              "total_deadline": 120.0})
+    lats = []
+    done = 0
+    sent = 0
+    used = set()
+    picker = _zipf_picker(tokens, req_tokens, seed, zipf,
+                          pool_idx=pool_idx) if zipf else None
+    rng = seed * 7919 + 17
+    while time.time() < start_at:
+        time.sleep(0.005)
+    deadline = time.time() + seconds
+    err = None
+    try:
+        while time.time() < deadline:
+            if picker is not None:
+                toks, idx = picker()
+                used.update(idx.tolist())
+            else:
+                rng = (rng * 1103515245 + 12345) & 0x7FFFFFFF
+                lo = rng % max(1, len(tokens) - req_tokens)
+                toks = tokens[lo: lo + req_tokens]
+                used.update(range(lo, lo + req_tokens))
+            sent += len(toks)
+            t0 = time.perf_counter()
+            out = fd.verify_batch(toks)
+            lats.append(time.perf_counter() - t0)
+            bad = sum(1 for r in out if isinstance(r, Exception))
+            assert bad == 0, f"unexpected failures: {bad}"
+            done += len(out)
+    except BaseException as e:  # noqa: BLE001 - reported to the parent
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        outq.put((done, lats, err, sent, used, fd.counters()))
+        fd.close()
+
+
+def run_frontdoor_point(n_pools: int, pool_workers: int, routing: str,
+                        keyset_spec: str, tokens, n_clients: int,
+                        req_tokens: int, seconds: float,
+                        max_wait_ms: float, target_batch: int,
+                        env_extra=None) -> dict:
+    """Throughput of an n_pools × pool_workers fleet behind the
+    digest-affinity front door (or the rr control arm). Fresh pools
+    per point: cache state must NOT leak between routing arms."""
+    import multiprocessing as mp
+
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import WorkerPool
+
+    pools = [WorkerPool(pool_workers, keyset_spec=keyset_spec,
+                        target_batch=target_batch,
+                        max_wait_ms=max_wait_ms, ping_interval=1.0,
+                        env_extra=dict(env_extra or {}))
+             for _ in range(n_pools)]
+    try:
+        for i, p in enumerate(pools):
+            if not p.wait_all_ready(120.0):
+                raise RuntimeError(f"pool {i} did not come up")
+        groups = [sorted(p.endpoints().values()) for p in pools]
+        zipf = _zipf_cfg()
+        pool_idx = _zipf_pool_indices(len(tokens), zipf)
+        spill = float(os.environ.get("CAP_SERVE_SPILL", "2.0"))
+        ctx = mp.get_context("spawn")
+        outq = ctx.Queue()
+        start_at = time.time() + max(4.0, n_clients * 0.15)
+        procs = [ctx.Process(
+            target=_frontdoor_client_proc,
+            args=(groups, routing, spill, tokens, req_tokens, start_at,
+                  seconds, i, outq, zipf, pool_idx), daemon=True)
+            for i in range(n_clients)]
+        for p in procs:
+            p.start()
+        total, lats, errors = 0, [], []
+        sent_total = 0
+        used_union: set = set()
+        fd_counters: dict = {}
+        for _ in procs:
+            d, ls, err, sent, used, ctr = outq.get(
+                timeout=seconds + 300)
+            total += d
+            lats.extend(ls)
+            sent_total += sent
+            used_union |= used
+            for k, v in ctr.items():
+                fd_counters[k] = fd_counters.get(k, 0) + v
+            if err:
+                errors.append(err)
+        for p in procs:
+            p.join(timeout=30)
+        if errors:
+            raise RuntimeError(f"frontdoor clients failed: "
+                               f"{errors[:3]}")
+        merged = telemetry.merge_snapshots(
+            [(s or {}).get("snapshot")
+             for pool in pools for s in pool.stats().values()])
+        agg_counters = merged.get("counters") or {}
+    finally:
+        for p in pools:
+            p.close()
+    lats.sort()
+    lookups = fd_counters.get("frontdoor.lookups", 0)
+    hits = fd_counters.get("frontdoor.affinity_hits", 0)
+    pt = {
+        "n_pools": n_pools,
+        "pool_workers": pool_workers,
+        "routing": routing,
+        "keyset_spec": keyset_spec,
+        "clients": n_clients,
+        "req_tokens": req_tokens,
+        "throughput": round(total / seconds, 1),
+        "requests": len(lats),
+        "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
+        "p99_ms": round(_quantile(lats, 0.99) * 1e3, 1),
+        "frontdoor": {
+            "lookups": lookups,
+            "affinity_hits": hits,
+            "affinity_hit_rate": (round(hits / lookups, 4)
+                                  if lookups else None),
+            "spills": fd_counters.get("frontdoor.spills", 0),
+            "reroutes": fd_counters.get("frontdoor.reroutes", 0),
+            "fallback_tokens": fd_counters.get(
+                "frontdoor.fallback_tokens", 0),
+        },
+        "cache": {
+            "lookups": agg_counters.get("vcache.lookups", 0),
+            "hits": agg_counters.get("vcache.hits", 0),
+            "misses": agg_counters.get("vcache.misses", 0),
+            "evictions": agg_counters.get("vcache.evictions", 0),
+            "stale_accepts": agg_counters.get("vcache.stale_accepts",
+                                              0),
+            "peer_fills": agg_counters.get("vcache.peer_fills", 0),
+        },
+    }
+    pt.update(_mix_fields(_zipf_cfg(), sent_total, used_union))
+    return pt
+
+
+def frontdoor_main() -> None:
+    """Multi-pool front-door mode (``CAP_SERVE_POOLS=N``): N fresh
+    WorkerPools ("hosts") behind FrontDoor drivers, one run per
+    routing arm in ``CAP_SERVE_ROUTING`` (default "affinity,rr"),
+    arms INTERLEAVED over ``CAP_SERVE_REPS`` repetitions so same-day
+    weather hits both arms equally. Headline:
+    ``fleet_affinity_vps`` / ``fleet_rr_vps`` and their ratio — the
+    §Round 16 affinity-vs-round-robin A/B (the per-worker verdict
+    cache is ON in both arms; only the routing policy differs)."""
+    n_pools = int(os.environ["CAP_SERVE_POOLS"])
+    pool_workers = int(os.environ.get("CAP_SERVE_POOL_WORKERS", 1))
+    keyset_spec = os.environ.get("CAP_SERVE_FLEET_KEYSET",
+                                 "stub:batch_ms=1,token_us=300")
+    n_clients = int(os.environ.get("CAP_SERVE_CLIENTS", 4))
+    req_tokens = int(os.environ.get("CAP_SERVE_REQ_TOKENS", 64))
+    seconds = float(os.environ.get("CAP_SERVE_SECONDS", 12))
+    max_wait_ms = float(os.environ.get("CAP_SERVE_WAITS",
+                                       "2").split(",")[0])
+    target_batch = int(os.environ.get("CAP_SERVE_TARGET_BATCH", 8192))
+    routings = [r for r in os.environ.get(
+        "CAP_SERVE_ROUTING", "affinity,rr").split(",") if r]
+    reps = int(os.environ.get("CAP_SERVE_REPS", 2))
+    # Per-worker cache capacity: the fleet-scale regime is token
+    # corpus >> one worker's cache (millions of users), which is
+    # exactly when routing policy decides whether the fleet caches
+    # the corpus ONCE (affinity: each host holds its ring share) or
+    # N× with thrash (rr: every host needs everything).
+    env_extra = {}
+    if os.environ.get("CAP_SERVE_VCACHE_CAP"):
+        env_extra["CAP_SERVE_VCACHE_CAP"] = \
+            os.environ["CAP_SERVE_VCACHE_CAP"]
+    if keyset_spec.startswith("stub"):
+        tokens = [f"bench.{i:06d}.ok" for i in range(16384)]
+    else:
+        from cap_tpu import testing as T
+
+        _, tokens = T.headline_fixtures(16384)
+
+    points = []
+    for rep in range(reps):
+        for routing in routings:      # interleaved: a,rr,a,rr,…
+            pt = run_frontdoor_point(
+                n_pools, pool_workers, routing, keyset_spec, tokens,
+                n_clients, req_tokens, seconds, max_wait_ms,
+                target_batch, env_extra=env_extra)
+            pt["rep"] = rep
+            points.append(pt)
+            fdc = pt["frontdoor"]
+            print(f"frontdoor pools={n_pools} routing={routing:<8} "
+                  f"rep={rep}  thr={pt['throughput']:>9.0f}/s  "
+                  f"p50={pt['p50_ms']:6.1f}ms "
+                  f"p99={pt['p99_ms']:7.1f}ms  "
+                  f"aff_hit={fdc['affinity_hit_rate']}  "
+                  f"vc_hit="
+                  f"{pt['cache']['hits']}/{pt['cache']['lookups']}",
+                  file=sys.stderr)
+
+    def _best(routing):
+        vals = [p["throughput"] for p in points
+                if p["routing"] == routing]
+        return max(vals) if vals else None
+
+    affinity_vps = _best("affinity")
+    rr_vps = _best("rr")
+    stale = sum(p["cache"]["stale_accepts"] for p in points)
+    print(json.dumps({
+        "metric": "fleet_affinity_verifies_per_sec",
+        "value": affinity_vps,
+        "unit": "verifies/sec",
+        "fleet_affinity_vps": affinity_vps,
+        "fleet_rr_vps": rr_vps,
+        "affinity_speedup_vs_rr": (round(affinity_vps / rr_vps, 3)
+                                   if affinity_vps and rr_vps
+                                   else None),
+        "n_pools": n_pools,
+        "pool_workers": pool_workers,
+        "vcache_cap": env_extra.get("CAP_SERVE_VCACHE_CAP"),
+        "stale_accepts_total": stale,
+        "points": points,
+    }))
+
+
 def fleet_main() -> None:
     from cap_tpu import telemetry
 
@@ -632,6 +873,10 @@ def fleet_main() -> None:
 
 
 def main() -> None:
+    if os.environ.get("CAP_SERVE_POOLS"):
+        # Multi-pool front-door mode: the affinity-vs-rr routing A/B.
+        frontdoor_main()
+        return
     if os.environ.get("CAP_SERVE_FLEET"):
         # Fleet mode builds no in-process engine: workers own their
         # devices exclusively (single-owner placement).

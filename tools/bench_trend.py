@@ -82,7 +82,12 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # wire-speed-validation contract (bench_stages.py
                  # claims row; chip-host bench.py emits the real-
                  # ladder analog under "oidc")
-                 "oidc_native_vps": True}
+                 "oidc_native_vps": True,
+                 # front-door tier: end-to-end multi-pool fleet rate
+                 # on the Zipf 90%-repeat mix with digest-affinity
+                 # routing (higher is better) — the r16 fleet-wide
+                 # verdict-tier contract (bench_serve multi-pool mode)
+                 "fleet_affinity_vps": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -353,6 +358,19 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [oc[1], (16, {"serve_native_vps": 1e6})])):
         problems.append("vanished oidc_native_vps NOT flagged")
+    # 4e. fleet_affinity_vps (r16): introducing must not flag; a drop
+    #     and a disappearance must
+    fa = [(15, {"serve_native_vps": 1e6}),
+          (16, {"serve_native_vps": 1e6, "fleet_affinity_vps": 4e4})]
+    if check_serve_series(fa):
+        problems.append("introducing fleet_affinity_vps flagged")
+    if not check_serve_series(
+            [fa[1], (17, {"serve_native_vps": 1e6,
+                          "fleet_affinity_vps": 2e4})]):
+        problems.append("fleet_affinity_vps regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [fa[1], (17, {"serve_native_vps": 1e6})])):
+        problems.append("vanished fleet_affinity_vps NOT flagged")
     # 5. the REAL series with a 15% regression injected into a copy of
     #    the newest record: must flag (the acceptance-bar case)
     real = load_series(repo)

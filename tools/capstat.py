@@ -291,6 +291,55 @@ def _decision_rows(counters: Dict[str, Any]) -> List[str]:
     return rows
 
 
+def render_frontdoor(snap: Dict[str, Any]) -> str:
+    """The front-door router-tier view (``--frontdoor FILE``, the
+    JSON of ``FrontDoor.snapshot()``): per-host affinity hit%, spill /
+    re-route counts, load, and the fleet epoch CONVERGED/SKEW state
+    across every pool — rotation health for the WHOLE fleet in one
+    block. (When a front door runs as a worker process, its
+    ``frontdoor.*`` counters also ride the ordinary scrape, so the
+    ``--watch`` generic delta view covers them with no special
+    casing.)"""
+    c = snap.get("counters") or {}
+    lookups = int(c.get("frontdoor.lookups", 0) or 0)
+    hits = int(c.get("frontdoor.affinity_hits", 0) or 0)
+    rate = f"{100.0 * hits / lookups:.1f}%" if lookups else "0.0%"
+    lines = [
+        f"front door  routing={snap.get('routing', '?')}  "
+        f"lookups={lookups}  affinity_hit={rate}  "
+        f"spills={c.get('frontdoor.spills', 0)}  "
+        f"reroutes={c.get('frontdoor.reroutes', 0)}  "
+        f"fallback_tokens={c.get('frontdoor.fallback_tokens', 0)}  "
+        f"keys_pushes={c.get('frontdoor.keys_pushes', 0)}"
+    ]
+    for pid, p in sorted((snap.get("pools") or {}).items()):
+        toks = int(p.get("tokens", 0) or 0)
+        p_hits = int(p.get("affinity_hits", 0) or 0)
+        p_rate = f"{100.0 * p_hits / toks:.1f}%" if toks else "0.0%"
+        lines.append(
+            f"  pool {pid}  {'live' if p.get('live') else 'DEAD':<5}"
+            f" endpoints={p.get('endpoints', 0)}"
+            f"  tokens={toks}  affinity_hit={p_rate}"
+            f"  spills_in={p.get('spills_in', 0)}"
+            f"  reroutes_in={p.get('reroutes_in', 0)}"
+            f"  inflight={p.get('inflight', 0)}")
+    skew = snap.get("epoch_skew")
+    if skew is not None:
+        state = "CONVERGED" if skew == 0 else f"SKEW={skew}"
+        eps = "  ".join(f"{k}={v}" for k, v in
+                        sorted((snap.get("key_epochs") or {}).items()))
+        lines.append(f"  fleet epochs: {state}"
+                     + (f"  target={snap['epoch']}"
+                        if snap.get("epoch") is not None else "")
+                     + (f"  ({eps})" if eps else ""))
+    peer = {k: v for k, v in c.items() if "peer_fill" in k}
+    if peer:
+        lines.append("  peer fill: " + "  ".join(
+            f"{k.split('.', 1)[1]}={v}" for k, v in sorted(
+                peer.items())))
+    return "\n".join(lines)
+
+
 def counter_deltas(prev: Dict[str, Any],
                    cur: Dict[str, Any]) -> Dict[str, int]:
     """Per-interval counter increases between two merged scrapes.
@@ -383,6 +432,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--slo-rules", metavar="FILE",
                     help="rules file for --slo (cap_tpu.obs.slo "
                          "syntax); implies --slo")
+    ap.add_argument("--frontdoor", metavar="FILE",
+                    help="JSON file with FrontDoor.snapshot() for the "
+                         "router-tier view (per-host affinity hit%%, "
+                         "spill/re-route counts, fleet epoch state)")
     ap.add_argument("--postmortem", metavar="FILE",
                     help="render a collected crash postmortem file "
                          "(no endpoints scraped)")
@@ -401,8 +454,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(obs_postmortem.render_postmortem(doc))
         return 0
 
+    frontdoor = None
+    if args.frontdoor:
+        with open(args.frontdoor) as f:
+            frontdoor = json.load(f)
+        if not args.endpoints:
+            print(render_frontdoor(frontdoor))
+            return 0
+
     if not args.endpoints:
-        ap.error("endpoints are required unless --postmortem is used")
+        ap.error("endpoints are required unless --postmortem or "
+                 "--frontdoor is used")
 
     client = None
     if args.client:
@@ -437,6 +499,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "series": telemetry.summarize_snapshot(merged)},
             }, indent=1))
         else:
+            if frontdoor is not None:
+                print(render_frontdoor(frontdoor))
             print(render_fleet(worker_data, client))
             if args.watch:
                 # burn view: cumulative counters hide movement at a

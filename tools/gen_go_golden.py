@@ -62,6 +62,24 @@ KEYS_JWKS = {"keys": [
      "x": "AQAB", "y": "AQAB"},
 ]}
 
+# Pinned peer-fill fixture for the verdict-cache warming pair (types
+# 13/14): one import op carrying one accept entry — digest, payload
+# (base64 of a fixed claims JSON), validity window, exp. All values
+# fixed; send_peer_fill canonicalizes the JSON (sorted keys, compact
+# separators), so regeneration is byte-stable.
+PEER_FILL_DOC = {
+    "op": "import",
+    "epoch": 3,
+    "entries": [[
+        "00112233445566778899aabbccddeeff",
+        "eyJzdWIiOiJnb2xkZW4ifQ==",      # b64({"sub":"golden"})
+        1700000000.0,
+        4102444800.0,
+        4102444800.0,
+    ]],
+}
+PEER_ACK_DOC = {"imported": 1}
+
 
 class _Sock:
     """Duck-typed socket capturing sendall output."""
@@ -412,11 +430,24 @@ def main():
     with open(os.path.join(OUT, "keys_ack.bin"), "wb") as f:
         f.write(s.buf.getvalue())
 
+    # Peer-fill frame pair (types 13/14): additive like the KEYS pair —
+    # everything written above stays byte-identical.
+    s = _Sock()
+    protocol.send_peer_fill(s, PEER_FILL_DOC)
+    with open(os.path.join(OUT, "peer_fill.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    s.sendall(protocol.encode_peer_ack(PEER_ACK_DOC))
+    with open(os.path.join(OUT, "peer_ack.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
     meta = {
         "tokens": TOKENS,
         "trace_id": TRACE_ID,
         "keys_epoch": KEYS_EPOCH,
         "keys_jwks": KEYS_JWKS,
+        "peer_fill_doc": PEER_FILL_DOC,
+        "peer_ack_doc": PEER_ACK_DOC,
         "results": [
             {"claims": r} if isinstance(r, dict) else
             {"error": f"{type(r).__name__}: {r}"}

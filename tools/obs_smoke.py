@@ -185,6 +185,72 @@ def run_fleet(serve_chain):
     return ([f"{serve_chain}: {f}" for f in failures], info)
 
 
+def run_frontdoor_gate():
+    """The 2-pool front-door gate: a repeated-token burst routed by
+    digest affinity must (a) show ``frontdoor.affinity_hits`` > 0 with
+    the EXACT ``lookups == hits + misses`` accounting, (b) leave
+    ``vcache.stale_accepts`` untouched on every worker, and (c) render
+    through capstat's front-door view."""
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import FrontDoor, WorkerPool
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from tools import capstat
+
+    failures = []
+    pools = [WorkerPool(1, keyset_spec="stub", ping_interval=0.3)
+             for _ in range(2)]
+    fd = None
+    try:
+        for i, p in enumerate(pools):
+            if not p.wait_all_ready(30):
+                return [f"frontdoor: pool {i} did not come up"]
+        telemetry.enable()
+        telemetry.active().reset()
+        fd = FrontDoor(pools, fallback=StubKeySet())
+        # spread + repeat: every distinct token lands on its ring
+        # owner; repeats must land on the SAME owner (that worker's
+        # vcache then hits)
+        toks = [f"fd-smoke-{i}.ok" for i in range(16)]
+        for _ in range(5):
+            out = fd.verify_batch(toks)
+            assert len(out) == len(toks)
+        c = fd.counters()
+        if c.get("frontdoor.affinity_hits", 0) <= 0:
+            failures.append("front door: zero affinity hits after a "
+                            "repeated-token burst")
+        if c.get("frontdoor.lookups", 0) != \
+                c.get("frontdoor.affinity_hits", 0) \
+                + c.get("frontdoor.affinity_misses", 0):
+            failures.append(
+                f"front door: lookups {c.get('frontdoor.lookups')} != "
+                f"hits {c.get('frontdoor.affinity_hits')} + misses "
+                f"{c.get('frontdoor.affinity_misses')} "
+                "(accounting drift)")
+        worker_counters = {}
+        for p in pools:
+            for wid, (host, port) in sorted(p.obs_endpoints().items()):
+                data = capstat.scrape(f"{host}:{port}")
+                wc = (data["snapshot"] or {}).get("counters") or {}
+                for k, v in wc.items():
+                    worker_counters[k] = worker_counters.get(k, 0) + v
+                if wc.get("vcache.stale_accepts", 0):
+                    failures.append(
+                        f"front door: stale_accepts moved on "
+                        f"{host}:{port}")
+        if worker_counters.get("vcache.hits", 0) <= 0:
+            failures.append("front door: repeats produced no worker "
+                            "vcache hits (affinity broken?)")
+        rendered = capstat.render_frontdoor(fd.snapshot())
+        if "affinity_hit" not in rendered or "pool 0" not in rendered:
+            failures.append("capstat.render_frontdoor missing fields")
+    finally:
+        if fd is not None:
+            fd.close()
+        for p in pools:
+            p.close()
+    return failures
+
+
 def main() -> int:
     failures, py_info = run_fleet("python")
     if py_info["chains"] != {"python"}:
@@ -214,6 +280,10 @@ def main() -> int:
         print("obs-smoke NOTE: native serve runtime unavailable — "
               "native-chain gate skipped", file=sys.stderr)
 
+    # 2-pool front-door gate (routing-tier accounting + worker-side
+    # cache integrity under affinity routing)
+    failures.extend(run_frontdoor_gate())
+
     if failures:
         for f in failures:
             print(f"obs-smoke FAIL: {f}", file=sys.stderr)
@@ -221,7 +291,9 @@ def main() -> int:
     print("obs-smoke OK: python fleet scraped clean (gauges, trace "
           "reassembly, decision counters, SLO engine)"
           + (", native fleet scraped clean with counter parity to "
-             "the python run" if native_ok else ""))
+             "the python run" if native_ok else "")
+          + ", 2-pool front door routed clean (affinity hits, exact "
+            "lookup accounting, zero stale accepts)")
     return 0
 
 
