@@ -26,7 +26,7 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp $(NATIVE_DIR)/claims_tape.h
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat claims-parity check
+.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat slhdsa-kat pallas-smoke claims-parity check
 
 all: native
 
@@ -102,6 +102,20 @@ bench-trend:
 mldsa-kat:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/mldsa_kat.py
 
+# SLH-DSA known-answer gate: the pinned FIPS 205 KATs through the same
+# four surfaces plus >=1k randomized engine-vs-oracle verifies per
+# parameter set (CAP_SLHDSA_KAT_N overrides). Dependency-free; the
+# heaviest check target (SLH-DSA verify is ~2-6k hashes/token).
+slhdsa-kat:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/slhdsa_kat.py
+
+# Kernel liveness gate: compile the fused Pallas NTT + Keccak kernels
+# in interpret mode on the CPU backend and bit-check them against
+# their refs (the native-build silent-death lesson applied to
+# kernels). Missing Pallas stack -> loud skip with a counter.
+pallas-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/pallas_smoke.py
+
 # Claims-rule differential gate: the generated ~1k adversarial corpus
 # through the dict path, the raw-path Python rules, and the native
 # claims engine (claims_validate.cpp) — verdicts and reason classes
@@ -111,6 +125,6 @@ claims-parity: native
 	JAX_PLATFORMS=cpu $(PYTHON) tools/claims_parity.py
 
 # The default local CI gate: observability smoke + keyplane rotation
-# smoke + perf-trend sentinel + post-quantum KAT gate + claims-rule
-# differential gate.
-check: obs-smoke keyplane-smoke bench-trend mldsa-kat claims-parity
+# smoke + perf-trend sentinel + post-quantum KAT gates (both
+# families) + kernel liveness gate + claims-rule differential gate.
+check: obs-smoke keyplane-smoke bench-trend mldsa-kat slhdsa-kat pallas-smoke claims-parity

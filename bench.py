@@ -86,6 +86,57 @@ def _resident_mixed_vps(ks, tokens):
     return resident_slope_vps(n, fns, details=True)
 
 
+def _resident_slhdsa128s_vps(n_tokens: int):
+    """Second PQ engine number: SLH-DSA-SHAKE-128s verifies/sec with
+    the decoded hash-forest lanes (FORS values, WOTS chains, auth
+    paths, precomputed ADRS words) device-resident.
+
+    Same slope methodology (shared ``resident_slope_vps``); the
+    verdict — the on-device root compare — IS the accept-sum
+    integrity check. Host 128s signing costs ~4 s/signature, so the
+    batch cycles a 4-signature pool (``slhdsa_unique_tokens`` in the
+    record keeps that honest): unlike a cache tier, the engine does
+    the FULL hash forest for every lane, so duplicates measure
+    exactly what unique tokens would.
+    """
+    import json as _json
+
+    from cap_tpu.jwt.jose import b64url_encode
+    from cap_tpu.jwt.jwk import parse_jwks, serialize_public_key
+    from cap_tpu.jwt.tpu_keyset import (
+        TPUBatchKeySet,
+        resident_dispatchers,
+        resident_slope_vps,
+    )
+    from cap_tpu.tpu import slhdsa
+
+    n_unique = 4
+    privs, jwk_dicts = [], []
+    for s in (61, 62):
+        priv, pub = slhdsa.keygen("SLH-DSA-SHAKE-128s",
+                                  bytes([s]) * 32)
+        privs.append(priv)
+        jwk_dicts.append(serialize_public_key(pub,
+                                              kid=f"bench-slh{s}"))
+    base = []
+    for i in range(n_unique):
+        header = {"alg": "SLH-DSA-SHAKE-128s",
+                  "kid": f"bench-slh{61 + i % 2}"}
+        h = b64url_encode(_json.dumps(
+            header, separators=(",", ":")).encode())
+        p = b64url_encode(_json.dumps(
+            {"sub": f"slh-{i}", "jti": f"t{i}"},
+            separators=(",", ":")).encode())
+        si = (h + "." + p).encode()
+        base.append(h + "." + p + "."
+                    + b64url_encode(privs[i % 2].sign(si)))
+    tokens = [base[i % n_unique] for i in range(n_tokens)]
+    ks = TPUBatchKeySet(parse_jwks({"keys": jwk_dicts}))
+    n, fns = resident_dispatchers(ks, tokens)
+    vps, trials = resident_slope_vps(n, fns, details=True)
+    return vps, trials, n_unique
+
+
 def _resident_mldsa44_vps(n_tokens: int):
     """Post-quantum engine number: ML-DSA-44 verifies/sec with the
     decoded lanes (z/c/hints + key tables) device-resident.
@@ -126,8 +177,26 @@ def _resident_mldsa44_vps(n_tokens: int):
         tokens.append(h + "." + p + "."
                       + b64url_encode(privs[i % 2].sign(si)))
     ks = TPUBatchKeySet(parse_jwks({"keys": jwk_dicts}))
-    n, fns = resident_dispatchers(ks, tokens)
-    return resident_slope_vps(n, fns, details=True)
+    # Fused-vs-unfused A/B, interleaved on the same resident keyset
+    # (the r14 weather rule): the FUSED arm is the single-round-trip
+    # engine (device μ/SampleInBall/w1Encode/c̃) and the headline
+    # resident_mldsa44_vps; the UNFUSED arm is the r11 two-phase
+    # split. On a CPU-only host the honest verdict may favor either —
+    # hashlib's native Keccak competes with XLA:CPU lanes — and the
+    # record publishes both.
+    arms = {}
+    prev = os.environ.get("CAP_TPU_MLDSA_FUSED")
+    try:
+        for arm, flag in (("fused", "1"), ("unfused", "0")):
+            os.environ["CAP_TPU_MLDSA_FUSED"] = flag
+            n, fns = resident_dispatchers(ks, tokens)
+            arms[arm] = resident_slope_vps(n, fns, details=True)
+    finally:
+        if prev is None:
+            os.environ.pop("CAP_TPU_MLDSA_FUSED", None)
+        else:
+            os.environ["CAP_TPU_MLDSA_FUSED"] = prev
+    return arms
 
 
 def _rotation_fields(ks, jwks, tokens) -> dict:
@@ -479,11 +548,24 @@ def main() -> None:
 
     mldsa_n = int(os.environ.get("CAP_BENCH_MLDSA", "256") or 0)
     mldsa_vps, mldsa_trials = None, []
+    mldsa_unfused_vps, mldsa_unfused_trials = None, []
     if mldsa_n:
         try:
-            mldsa_vps, mldsa_trials = _resident_mldsa44_vps(mldsa_n)
+            arms = _resident_mldsa44_vps(mldsa_n)
+            mldsa_vps, mldsa_trials = arms["fused"]
+            mldsa_unfused_vps, mldsa_unfused_trials = arms["unfused"]
         except Exception as e:  # noqa: BLE001 - advisory metric
             print(f"resident_mldsa44_vps failed: {e!r}",
+                  file=sys.stderr)
+
+    slh_n = int(os.environ.get("CAP_BENCH_SLHDSA", "128") or 0)
+    slh_vps, slh_trials, slh_unique = None, [], 0
+    if slh_n:
+        try:
+            slh_vps, slh_trials, slh_unique = \
+                _resident_slhdsa128s_vps(slh_n)
+        except Exception as e:  # noqa: BLE001 - advisory metric
+            print(f"resident_slhdsa128s_vps failed: {e!r}",
                   file=sys.stderr)
 
     mesh_fields = {}
@@ -567,13 +649,28 @@ def main() -> None:
         # resident_trials_vps (slower trials ate a tunnel stall).
         "resident_mixed_vps": round(resident, 1) if resident else None,
         "resident_trials_vps": [round(v, 1) for v in resident_trials],
-        # Post-quantum engine rate (ML-DSA-44 resident lanes; same
-        # slope/min-of-3 semantics and weather caveats as the mixed
-        # number — tools/bench_trend.py tracks it from round 11 on).
+        # Post-quantum engine rates (resident lanes; same slope/min-
+        # of-3 semantics and weather caveats as the mixed number —
+        # tools/bench_trend.py tracks them). resident_mldsa44_vps is
+        # the FUSED single-round-trip arm from r17 on; the unfused
+        # (r11 two-phase) arm rides along as the interleaved A/B.
         "resident_mldsa44_vps": round(mldsa_vps, 1) if mldsa_vps
         else None,
         "resident_mldsa44_trials_vps": [round(v, 1)
                                         for v in mldsa_trials],
+        "resident_mldsa44_unfused_vps":
+            round(mldsa_unfused_vps, 1) if mldsa_unfused_vps else None,
+        "resident_mldsa44_unfused_trials_vps":
+            [round(v, 1) for v in mldsa_unfused_trials],
+        # SLH-DSA-SHAKE-128s resident hash-forest rate (the second PQ
+        # family; slhdsa_unique_tokens keeps the signing-pool reuse
+        # honest — see _resident_slhdsa128s_vps).
+        "resident_slhdsa128s_vps": round(slh_vps, 1) if slh_vps
+        else None,
+        "resident_slhdsa128s_trials_vps": [round(v, 1)
+                                           for v in slh_trials],
+        "slhdsa_tokens": slh_n,
+        "slhdsa_unique_tokens": slh_unique,
         # CAP_BENCH_MESH=N only: the same resident mix under shard_map
         # (resident_mesh_vps, per-record sorted per-device shard rows).
         **mesh_fields,

@@ -63,8 +63,11 @@ def _build_one(sources, out: str, py_headers: bool,
     deps = srcs + [h for s in srcs
                    for h in [os.path.splitext(s)[0] + ".h"]
                    if os.path.exists(h)]
+    # telemetry_native.h is likewise cross-TU (serve_native.cpp feeds
+    # the plane it declares — an N_FAM/ABI bump must rebuild both)
     deps += [h for d in src_dirs
-             for h in [os.path.join(d, "claims_tape.h")]
+             for name in ("claims_tape.h", "telemetry_native.h")
+             for h in [os.path.join(d, name)]
              if os.path.exists(h) and h not in deps]
     if not force and os.path.exists(out) and \
             os.path.getmtime(out) >= max(os.path.getmtime(s)

@@ -36,12 +36,26 @@ MLDSA87: Alg = "ML-DSA-87"  # ML-DSA-87 (NIST category 5)
 
 MLDSA_ALGORITHMS = frozenset({MLDSA44, MLDSA65, MLDSA87})
 
+# Post-quantum hash family (FIPS 205, SPHINCS+; JOSE names per
+# draft-ietf-cose-sphincs-plus). Pure-hash: the scheme absorbs the
+# whole message internally via SHAKE256 — no HASH_FOR_ALG entry,
+# exactly like ML-DSA.
+SLHDSA128S: Alg = "SLH-DSA-SHAKE-128s"  # small/slow, NIST category 1
+SLHDSA128F: Alg = "SLH-DSA-SHAKE-128f"  # fast, NIST category 1
+
+SLHDSA_ALGORITHMS = frozenset({SLHDSA128S, SLHDSA128F})
+
+# The AKP (kty) families: parameter-set-named algs whose key object
+# carries ``parameter_set`` — one membership test for the JWK /
+# verify routing shared by both lattice and hash families.
+PQ_ALGORITHMS = MLDSA_ALGORITHMS | SLHDSA_ALGORITHMS
+
 SUPPORTED_ALGORITHMS = frozenset({
     RS256, RS384, RS512,
     ES256, ES384, ES512,
     PS256, PS384, PS512,
     EdDSA,
-}) | MLDSA_ALGORITHMS
+}) | PQ_ALGORITHMS
 
 # Hash function name (hashlib) per algorithm (prehash families only:
 # ML-DSA hashes internally via SHAKE and is deliberately absent).

@@ -150,20 +150,27 @@ def _parse_ec_host(data: Dict[str, Any], crv: str):
 
 
 def _parse_akp(data: Dict[str, Any]):
-    """kty=AKP (ML-DSA): the parameter set rides the REQUIRED alg
-    member and the public key is the FIPS 204 pk encoding in ``pub``
-    (draft-ietf-cose-dilithium JOSE serialization)."""
+    """kty=AKP (ML-DSA / SLH-DSA): the parameter set rides the
+    REQUIRED alg member and the public key is the FIPS 204/205 pk
+    encoding in ``pub`` (draft-ietf-cose-dilithium /
+    draft-ietf-cose-sphincs-plus JOSE serialization)."""
     from ..tpu.mldsa import MLDSA_ALGS, MLDSAPublicKey
+    from ..tpu.slhdsa import SLHDSA_ALGS, SLHDSAPublicKey
 
     alg = data.get("alg")
-    if alg not in MLDSA_ALGS:
+    if alg in MLDSA_ALGS:
+        key_cls = MLDSAPublicKey
+    elif alg in SLHDSA_ALGS:
+        key_cls = SLHDSAPublicKey
+    else:
         raise InvalidJWKSError(
-            f"AKP jwk requires alg in {sorted(MLDSA_ALGS)}, got {alg!r}")
+            f"AKP jwk requires alg in "
+            f"{sorted(MLDSA_ALGS) + sorted(SLHDSA_ALGS)}, got {alg!r}")
     raw = data.get("pub")
     if not isinstance(raw, str):
         raise InvalidJWKSError("AKP jwk missing field 'pub'")
     try:
-        key = MLDSAPublicKey(alg, b64url_decode(raw))
+        key = key_cls(alg, b64url_decode(raw))
     except ValueError as err:
         raise InvalidJWKSError(f"invalid AKP jwk: {err}") from err
     return key

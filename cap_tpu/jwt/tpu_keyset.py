@@ -352,6 +352,41 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             raise InvalidParameterError("bucket exceeds max_chunk")
         sigs = [pb.signature(int(j)) for j in idx]
         msgs = [pb.signing_input(int(j)) for j in idx]
+        if tpumldsa.fused_enabled():
+            # Fused arm: the WHOLE single-round-trip program (Keccak
+            # μ/c̃ + SampleInBall + NTT network + w1Encode + compare)
+            # re-dispatches on resident lanes; the accept-bit sum IS
+            # the integrity check, exactly like the classical
+            # families (the verdict is computed on-device).
+            fprep = tpumldsa._FusedPrep(table, sigs, msgs,
+                                        rows.astype(np.int32), pad)
+            if not fprep.valid[: len(idx)].all():
+                raise InvalidParameterError(
+                    f"{pset}: resident bench tokens must decode "
+                    "cleanly")
+            pair = tpumldsa._W1_PAD.get(pset)
+            if pair is None:
+                pair = tpumldsa._W1_PAD[pset] = \
+                    tpumldsa._w1_pad_lanes(table.params)
+            import jax
+
+            devs = [dev_put(a) for a in
+                    (fprep.mu_blocks, fprep.mu_nblk, fprep.ct_block,
+                     fprep.ct_cmp, fprep.z, fprep.h, fprep.key_idx,
+                     fprep.valid)]
+            # constant pad tensor: never tiled/sharded (not batched)
+            w1p = jax.device_put(pair[1])
+            p = table.params
+
+            def fn(devs=devs, w1p=w1p, table=table, p=p,
+                   tpumldsa=tpumldsa):
+                ok, _exh = tpumldsa._fused_jit()(
+                    table.a_mont, table.t1_mont, *devs, w1p,
+                    p.gamma2, p.tau, p.w1_bits)
+                return jnp.sum(ok.astype(jnp.int32))
+
+            fns.append((len(idx), fn))
+            continue
         prep = tpumldsa._PreppedChunk(table, sigs, msgs,
                                       rows.astype(np.int32), pad)
         if not prep.valid[: len(idx)].all():
@@ -383,6 +418,48 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             w1 = tpumldsa.w1_resident(table, zd, cd, hd, kd)
             eq = jnp.all(w1 == ed, axis=(1, 2)) & (md != 0)
             return jnp.sum(eq.astype(jnp.int32))
+
+        fns.append((len(idx), fn))
+
+    for pset in sorted(getattr(ks._tables, "slhdsa_tables", {})):
+        from ..tpu import slhdsa as tpuslh
+
+        table = ks._tables.slhdsa_tables[pset]
+        idx = _mldsa_alg_indices(pb, pb.status == 0, pset)
+        if len(idx) == 0:
+            continue
+        rows = pb.kid_rows(idx, ks._kid_slhdsa_row[pset])
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        if (rows < 0).any():
+            raise InvalidParameterError(
+                f"{pset}: tokens with unknown kid")
+        covered[idx] = True
+        pad = _pad_size(len(idx), ks._max_chunk)
+        if len(idx) > pad:
+            raise InvalidParameterError("bucket exceeds max_chunk")
+        sigs = [pb.signature(int(j)) for j in idx]
+        msgs = [pb.signing_input(int(j)) for j in idx]
+        if repeat > 1 or ks._mesh is not None:
+            # The hypertree arrays are layer-major ([d, B, ...]) —
+            # batch-axis tiling/sharding would hit the wrong axis.
+            raise InvalidParameterError(
+                f"{pset}: scaled/mesh resident mode is not supported "
+                "for the SLH-DSA records")
+        sprep = tpuslh._SLHPrep(table, sigs, msgs,
+                                rows.astype(np.int32), pad)
+        if not sprep.valid[: len(idx)].all():
+            raise InvalidParameterError(
+                f"{pset}: resident bench tokens must decode cleanly")
+        # The verdict (hash-forest root compare) is computed entirely
+        # on-device, so the accept-bit sum IS the integrity check —
+        # same contract as the classical families.
+        sdevs = [dev_put(a) for a in sprep.arrays()]
+
+        def fn(sdevs=sdevs, table=table, tpuslh=tpuslh):
+            ok = tpuslh._slh_jit()(table.pk_seed_l, table.pk_root_l,
+                                   *sdevs)
+            return jnp.sum(ok.astype(jnp.int32))
 
         fns.append((len(idx), fn))
 
@@ -484,7 +561,9 @@ class _KeyTables(object):
                  "n_rsa_keys", "ec_tables", "ed_table", "rsa_rows",
                  "ec_rows", "ed_rows", "kid_rsa_row", "kid_ec_row",
                  "kid_ed_row", "ec_keys", "ed_keys", "mldsa_keys",
-                 "mldsa_rows", "mldsa_tables", "kid_mldsa_row")
+                 "mldsa_rows", "mldsa_tables", "kid_mldsa_row",
+                 "slhdsa_keys", "slhdsa_rows", "slhdsa_tables",
+                 "kid_slhdsa_row")
 
     def __init__(self, jwks: Sequence[JWK], epoch: int = 0):
         # The OpenSSL-backed key types need the ``cryptography``
@@ -517,15 +596,25 @@ class _KeyTables(object):
         self.ec_keys: Dict[str, list] = {}
         self.ec_rows: Dict[str, Dict[int, int]] = {}
         self.ed_keys, self.ed_rows = [], {}
-        # ML-DSA: one table per parameter set (alg name = set name),
-        # mirroring the per-curve EC layout.
+        # Post-quantum: one table per parameter set (alg name = set
+        # name), mirroring the per-curve EC layout. ML-DSA and
+        # SLH-DSA keys both carry ``parameter_set``; the set name
+        # routes the family.
+        from ..tpu.slhdsa import PARAMS as _SLH_PARAMS
+
         self.mldsa_keys: Dict[str, list] = {}
         self.mldsa_rows: Dict[str, Dict[int, int]] = {}
+        self.slhdsa_keys: Dict[str, list] = {}
+        self.slhdsa_rows: Dict[str, Dict[int, int]] = {}
         for i, jwk in enumerate(self.jwks):
             key = jwk.key
             pset = getattr(key, "parameter_set", None)
             host_crv = getattr(key, "curve_name", None)
-            if pset is not None:                 # MLDSAPublicKey
+            if pset is not None and pset in _SLH_PARAMS:
+                rows = self.slhdsa_rows.setdefault(pset, {})
+                rows[i] = len(self.slhdsa_keys.setdefault(pset, []))
+                self.slhdsa_keys[pset].append(key)
+            elif pset is not None:               # MLDSAPublicKey
                 rows = self.mldsa_rows.setdefault(pset, {})
                 rows[i] = len(self.mldsa_keys.setdefault(pset, []))
                 self.mldsa_keys[pset].append(key)
@@ -583,6 +672,13 @@ class _KeyTables(object):
                 self.mldsa_tables[pset] = MLDSAKeyTable(pset, keys)
             except ImportError:
                 pass  # ML-DSA engine unavailable → CPU oracle
+        self.slhdsa_tables: Dict[str, Any] = {}
+        for pset, keys in self.slhdsa_keys.items():
+            try:
+                from ..tpu.slhdsa import SLHDSAKeyTable
+                self.slhdsa_tables[pset] = SLHDSAKeyTable(pset, keys)
+            except ImportError:
+                pass  # SLH-DSA engine unavailable → CPU oracle
 
         self.by_kid: Dict[str, List[int]] = {}
         for i, jwk in enumerate(self.jwks):
@@ -598,6 +694,8 @@ class _KeyTables(object):
         self.kid_ed_row: Dict[str, int] = {}
         self.kid_mldsa_row: Dict[str, Dict[str, int]] = {
             p: {} for p in self.mldsa_rows}
+        self.kid_slhdsa_row: Dict[str, Dict[str, int]] = {
+            p: {} for p in self.slhdsa_rows}
         for kid, idxs in self.by_kid.items():
             if len(idxs) != 1:
                 continue
@@ -612,6 +710,9 @@ class _KeyTables(object):
             for pset, rows in self.mldsa_rows.items():
                 if i in rows:
                     self.kid_mldsa_row[pset][kid] = rows[i]
+            for pset, rows in self.slhdsa_rows.items():
+                if i in rows:
+                    self.kid_slhdsa_row[pset][kid] = rows[i]
 
 
 class TPUBatchKeySet(KeySet):
@@ -805,6 +906,14 @@ class TPUBatchKeySet(KeySet):
     @property
     def _kid_mldsa_row(self):
         return self._tables.kid_mldsa_row
+
+    @property
+    def _slhdsa_tables(self):
+        return self._tables.slhdsa_tables
+
+    @property
+    def _kid_slhdsa_row(self):
+        return self._tables.kid_slhdsa_row
 
     # -- single-token path (CPU oracle) -----------------------------------
 
@@ -1011,9 +1120,15 @@ class TPUBatchKeySet(KeySet):
             self._run_ed_packed(idx, pb, packed_parts, packed_meta,
                                 pending, slow, results, stats, tables)
 
-        # ML-DSA first: the deepest device program (NTT network) goes
-        # on the wire before the cheaper families, so its device time
-        # overlaps their packing + transfers.
+        # Post-quantum first: the deepest device programs (the
+        # SLH-DSA hash forest, then the ML-DSA NTT network) go on the
+        # wire before the cheaper families, so their device time
+        # overlaps the later families' packing + transfers.
+        for pset in sorted(tables.slhdsa_tables):
+            idx = _mldsa_alg_indices(pb, ok, pset)
+            if len(idx):
+                self._run_slhdsa_packed(pset, idx, pb, pending, slow,
+                                        stats, tables)
         for pset in sorted(tables.mldsa_tables):
             idx = _mldsa_alg_indices(pb, ok, pset)
             if len(idx):
@@ -1346,13 +1461,17 @@ class TPUBatchKeySet(KeySet):
                           tables: Optional[_KeyTables] = None) -> None:
         """One ML-DSA parameter set through the two-phase device path.
 
-        Host work per token (signature decode + range/hint gates, μ
-        SHAKE, SampleInBall) happens at dispatch; the NTT network is
-        queued on the device; the verdict closure finishes with the
-        w1Encode + μ/c̃ hash compare when the batch-wide sync drains.
-        Tokens whose kid cannot be routed fall to the CPU oracle —
-        which for ML-DSA is the same pure-int ``py_verify`` math, so
-        verdict parity is structural.
+        Default (``mldsa.fused_enabled()``): the FUSED single-round-
+        trip engine — host work per token is byte decode ONLY (length/
+        range/hint gates, lane packing); μ, SampleInBall, the NTT
+        network, w1Encode, and the c̃ compare all run in one device
+        dispatch (batched Keccak lanes), and the verdict closure just
+        materializes bits. Zero per-token host SHAKE — span/counter-
+        pinned by tests/test_mldsa_fused.py. With the fused path off
+        (CAP_TPU_MLDSA_FUSED=0) the r11 two-phase split applies: host
+        μ/c̃ hashing around the device NTT. Tokens whose kid cannot be
+        routed fall to the CPU oracle — which for ML-DSA is the same
+        pure-int ``py_verify`` math, so verdict parity is structural.
         """
         from ..tpu import mldsa as tpumldsa
 
@@ -1385,7 +1504,64 @@ class TPUBatchKeySet(KeySet):
             telemetry.count("h2d.bytes", h2d)
             stats["h2d"] += h2d
             with telemetry.span(f"dispatch.mldsa.{pset}"):
-                fin = tpumldsa.verify_mldsa_pending(
+                verify = (tpumldsa.verify_mldsa_fused_pending
+                          if tpumldsa.fused_enabled()
+                          else tpumldsa.verify_mldsa_pending)
+                fin = verify(table, sigs, msgs, crows, pad=pad,
+                             mesh=self._mesh)
+            pending.append((chunk, m, fin))
+
+    def _run_slhdsa_packed(self, pset: str, idx: np.ndarray, pb,
+                           pending: List[tuple],
+                           slow: List[int], stats: dict,
+                           tables: Optional[_KeyTables] = None) -> None:
+        """One SLH-DSA parameter set through the two-phase device
+        path: host decode (sig split + the single H_msg SHAKE + ADRS
+        lane precompute) at dispatch, the whole FORS/hypertree hash
+        forest queued on the device, verdict bits at the batch-wide
+        sync. Unroutable kids fall to the CPU oracle — the same
+        hashlib math, so verdict parity is structural."""
+        from ..tpu import slhdsa as tpuslh
+
+        t = self._tables if tables is None else tables
+        table = t.slhdsa_tables[pset]
+        p = table.params
+        rows = pb.kid_rows(idx, t.kid_slhdsa_row[pset])
+        if len(table.keys) == 1:
+            rows = np.where(rows == -1, 0, rows)
+        fast = rows >= 0
+        slow.extend(int(i) for i in idx[~fast])
+        idx = idx[fast]
+        rows = rows[fast].astype(np.int32)
+        if len(idx) == 0:
+            return
+        # Per-token device bytes ≈ the signature's hash values plus
+        # ~500 precomputed 32-byte ADRS words as interleaved lanes.
+        bpt = p.sig_size + 32 * (p.k * (p.a + 1) + 1
+                                 + p.d * (p.wlen + p.hp + 1))
+        chunk_n = self._chunk_tokens(max(1, bpt // 2))
+        for lo in range(0, len(idx), chunk_n):
+            chunk = idx[lo: lo + chunk_n]
+            crows = rows[lo: lo + chunk_n]
+            m = len(chunk)
+            # Pow-2 padding with a 16-row floor instead of the global
+            # _MIN_BUCKET: one SLH-DSA lane-row is ~300x the device
+            # work of a classical record, so at small batches the
+            # fill-ratio waste dominates what recompile amortization
+            # saves (device.slhdsa.fill_ratio tells the story).
+            pad = 16
+            while pad < m:
+                pad *= 2
+            pad = min(pad, chunk_n)
+            sigs = [pb.signature(int(j)) for j in chunk]
+            msgs = [pb.signing_input(int(j)) for j in chunk]
+            telemetry.count("device.slhdsa.tokens", m)
+            _pad_telemetry("slhdsa", m, pad)
+            h2d = pad * bpt
+            telemetry.count("h2d.bytes", h2d)
+            stats["h2d"] += h2d
+            with telemetry.span(f"dispatch.slhdsa.{pset}"):
+                fin = tpuslh.verify_slhdsa_pending(
                     table, sigs, msgs, crows, pad=pad, mesh=self._mesh)
             pending.append((chunk, m, fin))
 
@@ -1650,6 +1826,8 @@ class TPUBatchKeySet(KeySet):
                 buckets.setdefault(("ed",), []).append(j)
             elif p.alg in tables.mldsa_tables:
                 buckets.setdefault(("mldsa", p.alg), []).append(j)
+            elif p.alg in tables.slhdsa_tables:
+                buckets.setdefault(("slhdsa", p.alg), []).append(j)
             else:
                 buckets.setdefault(("cpu",), []).append(j)
 
@@ -1665,6 +1843,9 @@ class TPUBatchKeySet(KeySet):
             elif kind[0] == "mldsa":
                 self._run_mldsa(kind[1], idxs, parsed_list, key_for,
                                 results, tables)
+            elif kind[0] == "slhdsa":
+                self._run_slhdsa(kind[1], idxs, parsed_list, key_for,
+                                 results, tables)
             else:
                 self._run_ed(idxs, parsed_list, key_for, results,
                              tables)
@@ -1801,10 +1982,37 @@ class TPUBatchKeySet(KeySet):
             telemetry.count("device.mldsa.tokens", len(chunk))
             _pad_telemetry("mldsa", len(chunk), pad)
             with telemetry.span(f"dispatch.mldsa.{alg}"):
-                ok = tpumldsa.verify_mldsa_pending(
-                    table, sigs, msgs,
-                    np.asarray(rows, np.int32), pad=pad,
-                    mesh=self._mesh)()
+                verify = (tpumldsa.verify_mldsa_fused_pending
+                          if tpumldsa.fused_enabled()
+                          else tpumldsa.verify_mldsa_pending)
+                ok = verify(table, sigs, msgs,
+                            np.asarray(rows, np.int32), pad=pad,
+                            mesh=self._mesh)()
+            self._finish(chunk, parsed_list, ok[: len(chunk)], results)
+
+    def _run_slhdsa(self, alg, idxs, parsed_list, key_for, results,
+                    tables=None):
+        from ..tpu import slhdsa as tpuslh
+
+        t = self._tables if tables is None else tables
+        table = t.slhdsa_tables[alg]
+        p = table.params
+        chunk_n = self._chunk_tokens(max(1, p.sig_size // 2))
+        for lo in range(0, len(idxs), chunk_n):
+            chunk = idxs[lo: lo + chunk_n]
+            pad = 16
+            while pad < len(chunk):
+                pad *= 2
+            pad = min(pad, chunk_n)
+            sigs = [parsed_list[j].signature for j in chunk]
+            msgs = [parsed_list[j].signing_input for j in chunk]
+            rows = [t.slhdsa_rows[alg][key_for[j]] for j in chunk]
+            telemetry.count("device.slhdsa.tokens", len(chunk))
+            _pad_telemetry("slhdsa", len(chunk), pad)
+            with telemetry.span(f"dispatch.slhdsa.{alg}"):
+                ok = tpuslh.verify_slhdsa_pending(
+                    table, sigs, msgs, np.asarray(rows, np.int32),
+                    pad=pad, mesh=self._mesh)()
             self._finish(chunk, parsed_list, ok[: len(chunk)], results)
 
     def _run_ed(self, idxs, parsed_list, key_for, results,

@@ -44,7 +44,9 @@ def _hash_cls(alg: str):
 
 def key_matches_alg(key, alg: str) -> bool:
     """Whether the key type is usable with the given JOSE alg."""
-    if alg in algs.MLDSA_ALGORITHMS:
+    if alg in algs.PQ_ALGORITHMS:
+        # AKP families (ML-DSA, SLH-DSA): the alg name IS the
+        # parameter-set name the key object carries.
         return getattr(key, "parameter_set", None) == alg
     host_crv = getattr(key, "curve_name", None)
     if host_crv is not None:                  # HostECPublicKey
@@ -107,6 +109,16 @@ def verify_parsed(parsed: ParsedJWS, key) -> None:
         # validity, z range) — all rejects are signature-layer rejects,
         # matching the raw-r||s gates of the ES* branch below.
         if not py_verify(key, parsed.signature, parsed.signing_input):
+            raise InvalidSignatureError("signature verification failed")
+        return
+    if alg in algs.SLHDSA_ALGORITHMS:
+        from ..tpu.slhdsa import py_verify as slh_py_verify
+
+        # SLH-DSA's only non-root reject gate is the signature
+        # length; everything else lands in the hash-root compare —
+        # all rejects are signature-layer, like ML-DSA's.
+        if not slh_py_verify(key, parsed.signature,
+                             parsed.signing_input):
             raise InvalidSignatureError("signature verification failed")
         return
     if getattr(key, "curve_name", None) is not None:

@@ -187,16 +187,23 @@ def classify(err: BaseException) -> str:
 # family + kid extraction (bounded, cached — hot-path safe)
 # ---------------------------------------------------------------------------
 
+# Fixed-order family registry — like REASON_INDEX, the ORDER is part
+# of the native telemetry plane's ABI (telemetry_native.h N_FAM /
+# FAM_UNKNOWN): new families insert BEFORE "other"/"unknown" with a
+# matching header bump + rebuild, and the cap_tel_layout handshake
+# disables the plane on any drift.
 FAMILIES = ("rs", "ps", "es", "ed", "mldsa44", "mldsa65", "mldsa87",
-            "other", "unknown")
+            "slhdsa128s", "slhdsa128f", "other", "unknown")
 
 _FAMILY_FOR_ALG_PREFIX = {"RS": "rs", "PS": "ps", "ES": "es"}
 
-# Post-quantum family: one registered family per parameter set so a
-# hybrid-migration rollout can watch ES256 traffic drain and ML-DSA
-# traffic ramp as separate counter series (docs/KEYPLANE.md).
+# Post-quantum families: one registered family per parameter set so a
+# hybrid-migration rollout can watch ES256 traffic drain and ML-DSA /
+# SLH-DSA traffic ramp as separate counter series (docs/KEYPLANE.md).
 _MLDSA_FAMILY = {"ML-DSA-44": "mldsa44", "ML-DSA-65": "mldsa65",
-                 "ML-DSA-87": "mldsa87"}
+                 "ML-DSA-87": "mldsa87",
+                 "SLH-DSA-SHAKE-128s": "slhdsa128s",
+                 "SLH-DSA-SHAKE-128f": "slhdsa128f"}
 
 # JOSE headers repeat massively across a token stream (one IdP = a
 # handful of distinct headers), so (family, kid-hash) is cached by the

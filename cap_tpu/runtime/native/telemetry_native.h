@@ -19,9 +19,10 @@ namespace cap_tel {
 // obs/decision.py REASON_INDEX order (11 registered reason classes).
 enum {
   N_REASON = 11,
-  // obs/decision.py FAMILIES order; index 8 is "unknown".
-  N_FAM = 9,
-  FAM_UNKNOWN = 8,
+  // obs/decision.py FAMILIES order; index 10 is "unknown" (r17 added
+  // slhdsa128s/slhdsa128f before "other" — layout handshake bumped).
+  N_FAM = 11,
+  FAM_UNKNOWN = 10,
   // obs/decision.py LAT_BUCKET_INDEX order; index 5 is "na".
   N_LAT = 6,
   LAT_NA = 5,

@@ -3,10 +3,12 @@
 The performance layer of cap_tpu: batched big-number and elliptic-curve
 arithmetic as JAX programs XLA-compiled for TPU, plus the
 batching/bucketing runtime that feeds it. Hand-written Pallas kernels
-cover the EC/Ed hot loops and default ON for TPU backends — the fused
-mixed-add (pallas_madd.py, CAP_TPU_PALLAS_MADD) and the fused REDC
-(pallas_redc.py, CAP_TPU_PALLAS); round-4 A/Bs in docs/PERF.md, CPU
-keeps the XLA path as the parity reference. The reference has no
+cover the EC/Ed and post-quantum hot loops and default ON for TPU
+backends — the fused mixed-add (pallas_madd.py, CAP_TPU_PALLAS_MADD),
+the fused REDC (pallas_redc.py, CAP_TPU_PALLAS), the fused 8-stage
+NTT (pallas_ntt.py, CAP_TPU_PALLAS_NTT), and the Keccak-f[1600] lane
+kernel (pallas_keccak.py, CAP_TPU_PALLAS_KECCAK); A/Bs in
+docs/PERF.md, CPU keeps the XLA path as the parity reference. The reference has no
 native/accelerated components
 (SURVEY.md §2) — this subsystem is the new framework's replacement for
 the Go stdlib crypto inner loops (crypto/rsa, crypto/ecdsa,

@@ -33,6 +33,7 @@ crypto-less, accelerator-less hosts.
 from __future__ import annotations
 
 import hashlib
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,6 +43,13 @@ from . import ntt as _ntt
 Q = _ntt.Q
 N = 256
 D = 13                               # dropped t bits (all parameter sets)
+
+# Host SHAKE accounting: every hashlib absorb-squeeze the module does
+# bumps this counter when telemetry records — the fused packed path's
+# "zero per-token host SHAKE" contract is pinned against it
+# (tests/test_mldsa_fused.py). Key-scoped hashing (tr, ExpandA at
+# table build) still counts; it is per KEY, not per token.
+HOST_SHAKE_COUNTER = "mldsa.host_shake_calls"
 
 
 class ParameterSet:
@@ -77,11 +85,20 @@ PARAMS: Dict[str, ParameterSet] = {
 MLDSA_ALGS = tuple(PARAMS)           # the JOSE alg names ARE the set names
 
 
+def _count_host_shake() -> None:
+    from .. import telemetry
+
+    if telemetry.active() is not None:
+        telemetry.count(HOST_SHAKE_COUNTER)
+
+
 def _shake256(data: bytes, outlen: int) -> bytes:
+    _count_host_shake()
     return hashlib.shake_256(data).digest(outlen)
 
 
 def _shake128(data: bytes, outlen: int) -> bytes:
+    _count_host_shake()
     return hashlib.shake_128(data).digest(outlen)
 
 
@@ -675,6 +692,277 @@ def verify_mldsa_batch(table: MLDSAKeyTable, sigs: Sequence[bytes],
     """[N] bool verdicts for one ML-DSA bucket (blocking interface)."""
     return verify_mldsa_pending(table, sigs, msgs, key_idx,
                                 mesh=mesh)()
+
+
+# ---------------------------------------------------------------------------
+# FUSED single-round-trip verify: μ, SampleInBall, the NTT network,
+# w1Encode, and the final c̃ compare ALL on-device (batched Keccak via
+# pallas_keccak) — the host decodes bytes and never hashes per token.
+# ---------------------------------------------------------------------------
+
+# SampleInBall squeeze budget: 3 SHAKE256 blocks = 408 bytes. The
+# oracle's grow-and-retry loop needs ~8+1.1·τ bytes in expectation
+# (≤ 76 even for τ=60), so overflow probability is astronomically
+# small — but parity is structural, not probabilistic: a token whose
+# sampling walks past the budget raises an ``exhausted`` flag and
+# re-verifies on the pure-int host oracle (the EC degeneracy-probe
+# contract).
+_SIB_BLOCKS = 3
+_SIB_BYTES = _SIB_BLOCKS * 136
+
+
+def fused_enabled() -> bool:
+    """Fused device verify: CAP_TPU_MLDSA_FUSED=1/0 (default ON).
+
+    ON makes a packed ML-DSA batch a SINGLE host round-trip: one
+    dispatch, one materializing sync, zero per-token host SHAKE.
+    OFF restores the r11 two-phase path (host μ/c̃ hashing around the
+    device NTT) — kept as the A/B arm and the conservative fallback.
+    """
+    return os.environ.get("CAP_TPU_MLDSA_FUSED", "1") \
+        not in ("0", "false", "no")
+
+
+def _w1_pad_lanes(p: ParameterSet) -> Tuple[int, np.ndarray]:
+    """(n_blocks, XOR pad tensor [n_blocks, 25, 2]) for the fixed-
+    length SHAKE256(μ ‖ w1enc) absorb of one parameter set."""
+    from . import pallas_keccak as _kk
+
+    total = 64 + N * p.k * p.w1_bits // 8
+    nb = total // 136 + 1                 # pad10*1 always adds a byte
+    buf = np.zeros(nb * 136, np.uint8)
+    buf[total] = _kk.DOMAIN_SHAKE
+    buf[nb * 136 - 1] ^= 0x80
+    lanes = _kk.interleave(buf.view("<u8")).reshape(nb, 17, 2)
+    out = np.zeros((nb, 25, 2), np.uint32)
+    out[:, :17] = lanes
+    return nb, out
+
+
+_W1_PAD: Dict[str, Tuple[int, np.ndarray]] = {}
+
+
+def _fused_core(a_mont, t1_mont, mu_blocks, mu_nblk, ct_block,
+                ct_cmp, z, h, key_idx, valid, w1_pad,
+                gamma2: int, tau: int, w1_bits: int):
+    """The one-dispatch device graph: [B] accept bits + exhausted
+    flags from decoded byte lanes. Everything between the H2D of the
+    prepped lanes and the D2H of two bit vectors happens here."""
+    import jax.numpy as jnp
+
+    from . import pallas_keccak as _kk
+
+    b = z.shape[0]
+    # μ = SHAKE256(tr ‖ 0x00 ‖ 0x00 ‖ M, 64): masked variable-length
+    # absorb; the first 8 lanes of the final state are μ's 64 bytes.
+    mu_state = _kk.absorb(mu_blocks, mu_nblk)
+    mu_lanes = mu_state[:, :8, :]                        # [B, 8, 2]
+
+    # SampleInBall: SHAKE256(c̃) squeezed to the fixed budget, then
+    # the Fisher-Yates walk as a τ-step scan (j-draws via first-
+    # acceptable-byte argmax, exactly the oracle's trajectory).
+    sib_state = _kk.absorb_fixed(ct_block)
+    sib_bytes = _kk.lanes_to_bytes(
+        _kk.squeeze_lanes(sib_state, 136, _SIB_BLOCKS)) \
+        .astype(jnp.int32)                               # [B, 408]
+    lane0 = sib_state[:, 0, :]                           # signs u64
+    sh = np.arange(32, dtype=np.uint32)
+    sign_bits = jnp.stack(
+        [(lane0[:, 0, None] >> sh) & np.uint32(1),
+         (lane0[:, 1, None] >> sh) & np.uint32(1)],
+        axis=-1).reshape(b, 64)                          # bit t of u64
+    idx408 = np.arange(_SIB_BYTES, dtype=np.int32)
+    coeff_idx = np.arange(N, dtype=np.int32)
+
+    import jax
+
+    def sib_step(carry, it):
+        c, pos, exhausted = carry
+        i, t = it
+        ok_pos = (idx408[None, :] >= pos[:, None]) & (sib_bytes <= i)
+        found = ok_pos.any(axis=1)
+        p_sel = jnp.argmax(ok_pos, axis=1).astype(jnp.int32)
+        j = jnp.take_along_axis(sib_bytes, p_sel[:, None],
+                                axis=1)[:, 0]            # byte value
+        sign = jnp.take_along_axis(sign_bits, jnp.full((b, 1), t),
+                                   axis=1)[:, 0]
+        cj = jnp.take_along_axis(c, j[:, None].astype(jnp.int32),
+                                 axis=1)[:, 0]
+        c = jnp.where(coeff_idx[None, :] == i, cj[:, None], c)
+        pm1 = jnp.where(sign != 0, jnp.uint32(Q - 1), jnp.uint32(1))
+        c = jnp.where(coeff_idx[None, :] == j[:, None].astype(jnp.int32),
+                      pm1[:, None], c)
+        pos = jnp.where(found, p_sel + 1, pos)
+        return (c, pos, exhausted | ~found), None
+
+    i_vals = jnp.arange(N - tau, N, dtype=jnp.int32)
+    t_vals = jnp.arange(tau, dtype=jnp.int32)
+    c0 = jnp.zeros((b, N), jnp.uint32)
+    pos0 = jnp.full(b, 8, jnp.int32)
+    (c, _pos, exhausted), _ = jax.lax.scan(
+        sib_step, (c0, pos0, jnp.zeros(b, bool)), (i_vals, t_vals))
+
+    # the r11 NTT network, unchanged (pallas-fused when enabled)
+    z_hat = _ntt.ntt(z)
+    c_hat = _ntt.ntt(c)
+    a = a_mont[key_idx]
+    t1 = t1_mont[key_idx]
+    prod = _ntt.mont_mul(a, z_hat[:, None, :, :])
+    acc = jnp.sum(prod, axis=2, dtype=jnp.uint32) % np.uint32(Q)
+    acc = _ntt.sub_q(acc, _ntt.mont_mul(c_hat[:, None, :], t1))
+    w = _ntt.intt(acc)
+    w1 = _ntt.use_hint(h, w, gamma2)                     # [B, k, 256]
+
+    # w1Encode on-device: LSB-first bits -> interleaved lanes directly
+    bit_sh = np.arange(w1_bits, dtype=np.uint32)
+    bits = ((w1[..., None] >> bit_sh) & np.uint32(1)).reshape(b, -1)
+    w1_lanes = _kk.bits_to_lanes(bits)                   # [B, nw, 2]
+
+    # SHAKE256(μ ‖ w1enc, λ/4) ?= c̃ — fixed-shape absorb; the pad
+    # rides a precomputed XOR tensor.
+    nb2 = w1_pad.shape[0]
+    content = jnp.concatenate(
+        [mu_lanes, w1_lanes,
+         jnp.zeros((b, nb2 * 17 - 8 - w1_lanes.shape[1], 2),
+                   jnp.uint32)], axis=1).reshape(b, nb2, 17, 2)
+    blocks2 = jnp.zeros((b, nb2, 25, 2), jnp.uint32)
+    blocks2 = blocks2.at[:, :, :17].set(content) ^ w1_pad[None]
+    st2 = _kk.absorb_fixed(blocks2)
+    nc = ct_cmp.shape[1]
+    match = (st2[:, :nc, :] == ct_cmp).all(axis=(1, 2))
+    return match & valid & ~exhausted, exhausted & valid
+
+
+_FUSED_JIT = None
+
+
+def _fused_jit():
+    global _FUSED_JIT
+    if _FUSED_JIT is None:
+        import jax
+
+        _FUSED_JIT = jax.jit(_fused_core,
+                             static_argnums=(11, 12, 13))
+    return _FUSED_JIT
+
+
+class _FusedPrep:
+    """Host-side decode of one chunk for the fused path: byte
+    shuffling ONLY — signature gates, z/hint unpack, μ-input block
+    packing, c̃ lane conversion. No hashlib anywhere."""
+
+    __slots__ = ("z", "h", "key_idx", "valid", "mu_blocks", "mu_nblk",
+                 "ct_block", "ct_cmp", "m", "sigs", "msgs")
+
+    def __init__(self, table: MLDSAKeyTable, sigs: Sequence[bytes],
+                 msgs: Sequence[bytes], key_idx: np.ndarray, pad: int):
+        from . import pallas_keccak as _kk
+
+        p = table.params
+        m = len(sigs)
+        self.m = m
+        self.sigs = [bytes(s) for s in sigs]
+        self.msgs = [bytes(x) for x in msgs]
+        self.z = np.zeros((pad, p.l, N), np.uint32)
+        self.h = np.zeros((pad, p.k, N), np.uint8)
+        self.key_idx = np.zeros(pad, np.int32)
+        self.key_idx[:m] = np.asarray(key_idx, np.int32)[:m]
+        self.valid = np.zeros(pad, bool)
+        mu_msgs: List[bytes] = [b""] * pad
+        ct = np.zeros((pad, p.lam // 4), np.uint8)
+        for i in range(m):
+            dec = _decode_checked(self.sigs[i], p)
+            if dec is None:
+                continue
+            c_tilde, zi, hi = dec
+            key = table.keys[int(self.key_idx[i])]
+            self.z[i] = (zi % Q).astype(np.uint32)
+            self.h[i] = hi
+            self.valid[i] = True
+            mu_msgs[i] = key.tr + b"\x00\x00" + self.msgs[i]
+            ct[i] = np.frombuffer(c_tilde, np.uint8)
+        # bucket the μ block count to a power of two so message-length
+        # jitter cannot fan out into per-batch recompiles
+        blocks, nblk = _kk.pack_blocks(mu_msgs, 136)
+        nb = 4
+        while nb < blocks.shape[1]:
+            nb *= 2
+        if blocks.shape[1] < nb:
+            blocks = np.concatenate(
+                [blocks, np.zeros((pad, nb - blocks.shape[1], 25, 2),
+                                  np.uint32)], axis=1)
+        self.mu_blocks = blocks
+        self.mu_nblk = nblk
+        # c̃: one absorb block + whole-lane compare target
+        ctb = np.zeros((pad, 1, 25, 2), np.uint32)
+        pad_buf = np.zeros((pad, 136), np.uint8)
+        pad_buf[:, : p.lam // 4] = ct
+        pad_buf[:, p.lam // 4] = _kk.DOMAIN_SHAKE
+        pad_buf[:, 135] ^= 0x80
+        ctb[:, 0, :17] = _kk.interleave(
+            pad_buf.view("<u8").reshape(pad, 17))
+        self.ct_block = ctb
+        self.ct_cmp = _kk.interleave(
+            ct.view("<u8").reshape(pad, p.lam // 32))
+
+
+def verify_mldsa_fused_pending(table: MLDSAKeyTable,
+                               sigs: Sequence[bytes],
+                               msgs: Sequence[bytes],
+                               key_idx: np.ndarray,
+                               pad: Optional[int] = None, mesh=None):
+    """Single-round-trip batched verify: decode + ONE device dispatch
+    now; the returned ``fin()`` materializes [pad] bool verdicts.
+
+    Invalid-at-decode tokens finish False without touching the
+    device-side hash chain; budget-exhausted SampleInBall tokens
+    (probability ≈ 0, flagged on-device) re-verify on the pure-int
+    oracle so verdict parity with ``py_verify`` stays structural.
+    """
+    from .. import telemetry
+
+    if pad is None:
+        pad = len(sigs)
+    p = table.params
+    prep = _FusedPrep(table, sigs, msgs, key_idx, pad)
+    pair = _W1_PAD.get(table.parameter_set)
+    if pair is None:
+        pair = _W1_PAD[table.parameter_set] = _w1_pad_lanes(p)
+    _nb2, w1_pad = pair
+    if prep.valid.any():
+        import jax
+
+        arrs = [prep.mu_blocks, prep.mu_nblk, prep.ct_block,
+                prep.ct_cmp, prep.z, prep.h, prep.key_idx, prep.valid,
+                w1_pad]
+        if mesh is not None:
+            from ..parallel.place import shard_batch
+
+            put = [shard_batch(mesh, a) for a in arrs[:-1]]
+            put.append(jax.device_put(arrs[-1]))
+        else:
+            put = [jax.device_put(a) for a in arrs]
+        out = _fused_jit()(table.a_mont, table.t1_mont, *put,
+                           p.gamma2, p.tau, p.w1_bits)
+    else:
+        out = None
+
+    def fin() -> np.ndarray:
+        if out is None:
+            return np.zeros(pad, bool)
+        ok = np.asarray(out[0])
+        exhausted = np.asarray(out[1])
+        if exhausted.any():
+            telemetry.count("mldsa.fused.exhausted",
+                            int(exhausted.sum()))
+            key = table.keys
+            for i in np.nonzero(exhausted)[0]:
+                if i < prep.m:
+                    ok[i] = py_verify(key[int(prep.key_idx[i])],
+                                      prep.sigs[i], prep.msgs[i])
+        return ok
+
+    return fin
 
 
 def host_w1(table: MLDSAKeyTable, prep: "_PreppedChunk") -> np.ndarray:

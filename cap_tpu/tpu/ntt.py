@@ -128,9 +128,19 @@ def sub_q(a, b):
 
 def ntt(x):
     """Forward NTT, plain domain in → plain domain out (CRYSTALS
-    bit-reversed frequency order). x: uint32 [..., 256] in [0, q)."""
+    bit-reversed frequency order). x: uint32 [..., 256] in [0, q).
+
+    Dispatches to the FUSED Pallas kernel (``pallas_ntt.ntt_fused``,
+    all 8 stages on one VMEM tile) when that path is enabled; the
+    stagewise jnp graph below is the CPU/XLA fallback and the parity
+    reference — bit-identical either way (tests/test_pallas_ntt.py).
+    """
     import jax.numpy as jnp
 
+    from . import pallas_ntt
+
+    if pallas_ntt.enabled():
+        return pallas_ntt.ntt_fused(x)
     shape = x.shape
     lead = shape[:-1]
     for s in range(8):                # len = 128 >> s
@@ -147,9 +157,14 @@ def ntt(x):
 
 def intt(x):
     """Inverse NTT (Gentleman-Sande), including the 256⁻¹ scaling.
-    Plain domain in/out; exact inverse of :func:`ntt`."""
+    Plain domain in/out; exact inverse of :func:`ntt`. Same fused-
+    kernel dispatch as :func:`ntt`."""
     import jax.numpy as jnp
 
+    from . import pallas_ntt
+
+    if pallas_ntt.enabled():
+        return pallas_ntt.intt_fused(x)
     shape = x.shape
     lead = shape[:-1]
     for s in range(8):                # len = 1 << s

@@ -1,0 +1,160 @@
+"""Fused layered-butterfly NTT/INTT over Z_8380417 as Pallas kernels.
+
+The stagewise jnp graph in ``ntt.py`` materializes the whole
+``[..., 256]`` lane array to HBM between each of the 8 butterfly
+stages — the same per-layer traffic tax the RNS REDC layers paid
+before ``pallas_madd`` (docs/PERF.md round-3: measured ~6x the pure
+read+write traffic per layer). This module runs ALL 8 stages (forward
+or inverse, including the folded 256⁻¹ scaling) on one VMEM tile per
+row block: HBM is touched once for inputs and once for outputs, the
+shape of the win the GPU Dilithium engine (PAPERS.md, arxiv
+2211.12265) demonstrates for exactly this transform.
+
+Arithmetic is ``ntt.py``'s verbatim: uint32 Montgomery lanes, 16-bit
+limb ``_mulhi32`` REDC, no int64 anywhere (``mont_mul``/``add_q``/
+``sub_q`` are imported and used unchanged, so the two paths cannot
+drift). Twiddles ride in Montgomery form as kernel constants.
+
+Numerical contract: bit-identical to ``ntt.ntt``/``ntt.intt`` and the
+int64 ``ntt_ref``/``intt_ref`` host references — pinned by
+tests/test_pallas_ntt.py in interpret mode on CPU and by
+``make pallas-smoke``. Enabled via CAP_TPU_PALLAS_NTT (default ON for
+TPU backends; CPU keeps the XLA path — interpret mode is a
+correctness harness, and the bench_stages kernel rows publish the
+honest CPU A/B).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from . import ntt as _ntt
+
+_TILE_R = int(os.environ.get("CAP_TPU_NTT_TILE", 256))    # rows/step
+N = _ntt.N
+
+
+def enabled() -> bool:
+    """Fused Pallas NTT: CAP_TPU_PALLAS_NTT=1/0 overrides; default ON
+    only for accelerator backends (the pallas_madd stance)."""
+    v = os.environ.get("CAP_TPU_PALLAS_NTT")
+    if v is not None:
+        return v not in ("0", "false", "no")
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _ntt_stages(x, zetas):
+    """All 8 forward Cooley-Tukey stages on a [R, 256] VALUE (VMEM
+    array in-kernel). Butterfly-for-butterfly ntt.ntt's loop body."""
+    import jax.numpy as jnp
+
+    r = x.shape[0]
+    for s in range(8):
+        ln = 128 >> s
+        nblk = N // (2 * ln)
+        z = zetas[nblk: 2 * nblk]                    # [nblk]
+        v = x.reshape(r, nblk, 2, ln)
+        lo_, hi_ = v[:, :, 0, :], v[:, :, 1, :]
+        t = _ntt.mont_mul(z[None, :, None], hi_)
+        x = jnp.stack([_ntt.add_q(lo_, t), _ntt.sub_q(lo_, t)],
+                      axis=2).reshape(r, N)
+    return x
+
+
+def _intt_stages(x, neg_zetas, inv256):
+    """All 8 Gentleman-Sande inverse stages + the folded 256⁻¹ scale
+    on a [R, 256] value; ntt.intt's loop body verbatim."""
+    import jax.numpy as jnp
+
+    r = x.shape[0]
+    for s in range(8):
+        ln = 1 << s
+        nblk = N // (2 * ln)
+        z = neg_zetas[nblk: 2 * nblk][::-1]
+        v = x.reshape(r, nblk, 2, ln)
+        lo_, hi_ = v[:, :, 0, :], v[:, :, 1, :]
+        t = lo_
+        lo_ = _ntt.add_q(t, hi_)
+        hi_ = _ntt.mont_mul(z[None, :, None], _ntt.sub_q(t, hi_))
+        x = jnp.stack([lo_, hi_], axis=2).reshape(r, N)
+    return _ntt.mont_mul(inv256[0, 0], x)
+
+
+def _ntt_kernel(x_ref, z_ref, o_ref):
+    o_ref[:] = _ntt_stages(x_ref[:], z_ref[:][0])
+
+
+def _intt_kernel(x_ref, z_ref, inv_ref, o_ref):
+    o_ref[:] = _intt_stages(x_ref[:], z_ref[:][0], inv_ref[:])
+
+
+def _call(x2, inverse: bool, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    @partial(jax.jit, static_argnames=("inverse", "interpret"))
+    def run(x2, zetas, inv, inverse: bool, interpret: bool):
+        rows = x2.shape[0]
+        grid = rows // _TILE_R
+        spec = pl.BlockSpec((_TILE_R, N), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        z_spec = pl.BlockSpec((1, N), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+        inv_spec = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)
+        out = jax.ShapeDtypeStruct((rows, N), jnp.uint32)
+        if inverse:
+            return pl.pallas_call(
+                _intt_kernel, out_shape=out, grid=(grid,),
+                in_specs=[spec, z_spec, inv_spec], out_specs=spec,
+                interpret=interpret)(x2, zetas, inv)
+        return pl.pallas_call(
+            _ntt_kernel, out_shape=out, grid=(grid,),
+            in_specs=[spec, z_spec], out_specs=spec,
+            interpret=interpret)(x2, zetas)
+
+    zetas = jnp.asarray((_ntt.NEG_ZETAS_MONT if inverse
+                         else _ntt.ZETAS_MONT)[None, :])
+    inv = jnp.asarray(np.array([[_ntt.INV256_MONT]], np.uint32))
+    return run(x2, zetas, inv, inverse, interpret)
+
+
+def _apply(x, inverse: bool, interpret: Optional[bool]):
+    import jax.numpy as jnp
+
+    if interpret is None:
+        import jax
+
+        interpret = jax.default_backend() != "tpu"
+    shape = x.shape
+    rows = 1
+    for s in shape[:-1]:
+        rows *= s
+    x2 = x.reshape(rows, N)
+    pad = (-rows) % _TILE_R
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = _call(x2, inverse, interpret)
+    if pad:
+        out = out[:rows]
+    return out.reshape(shape)
+
+
+def ntt_fused(x, interpret: Optional[bool] = None):
+    """Forward NTT on ``[..., 256]`` uint32 lanes in [0, q) — one
+    kernel, bit-identical to ``ntt.ntt``."""
+    return _apply(x, False, interpret)
+
+
+def intt_fused(x, interpret: Optional[bool] = None):
+    """Inverse NTT (scaling folded) — one kernel, bit-identical to
+    ``ntt.intt``."""
+    return _apply(x, True, interpret)

@@ -7,17 +7,22 @@ from cap_tpu.jwt.algs import supported_signing_algorithm
 
 def test_registry_pinned():
     # The reference's ten asymmetric algorithms (jwt/algs.go:6-22)
-    # plus the post-quantum ML-DSA family (FIPS 204, docs/PQC.md) —
-    # and NOTHING else.
+    # plus the post-quantum families — ML-DSA (FIPS 204) and SLH-DSA
+    # (FIPS 205), docs/PQC.md — and NOTHING else.
     assert algs.SUPPORTED_ALGORITHMS == {
         "RS256", "RS384", "RS512",
         "ES256", "ES384", "ES512",
         "PS256", "PS384", "PS512",
         "EdDSA",
         "ML-DSA-44", "ML-DSA-65", "ML-DSA-87",
+        "SLH-DSA-SHAKE-128s", "SLH-DSA-SHAKE-128f",
     }
     assert algs.MLDSA_ALGORITHMS == {"ML-DSA-44", "ML-DSA-65",
                                      "ML-DSA-87"}
+    assert algs.SLHDSA_ALGORITHMS == {"SLH-DSA-SHAKE-128s",
+                                      "SLH-DSA-SHAKE-128f"}
+    assert algs.PQ_ALGORITHMS == (algs.MLDSA_ALGORITHMS
+                                  | algs.SLHDSA_ALGORITHMS)
     supported_signing_algorithm(*algs.SUPPORTED_ALGORITHMS)
 
 

@@ -773,6 +773,127 @@ class TestMLDSAEncodingGolden:
                 (v["verdict"] == "accept"), v["name"]
 
 
+class TestSLHDSAEncodingGolden:
+    """Adversarial SLH-DSA encoding vectors (pinned in
+    sig_conformance.json): truncated/extended signatures + trailing
+    garbage (the scheme's only structural gate is length), a
+    bit-flipped randomizer R, a corrupted FORS auth path (the
+    out-of-range-index analog — FORS indices are digest-derived,
+    never encoded), and a corrupted hypertree auth node.
+    Dependency-free and swept across all four verify surfaces with
+    reason-class parity, like the ML-DSA suite above."""
+
+    @pytest.fixture(scope="class")
+    def slh_vectors(self, sig_golden):
+        vecs = [v for v in sig_golden["vectors"]
+                if v["alg"].startswith("SLH-DSA")]
+        assert vecs, "SLH-DSA vectors missing from sig_conformance.json"
+        return vecs
+
+    @pytest.fixture(scope="class")
+    def slh_jwks(self, sig_golden):
+        from cap_tpu.jwt.jwk import parse_jwk
+
+        return [parse_jwk(k) for k in sig_golden["keys"]["keys"]
+                if k.get("alg", "").startswith("SLH-DSA")]
+
+    def test_vector_inventory(self, slh_vectors):
+        names = {v["name"] for v in slh_vectors}
+        for required in ("slhdsa128f-valid", "slhdsa128f-sig-truncated",
+                         "slhdsa128f-sig-extended",
+                         "slhdsa128f-trailing-garbage",
+                         "slhdsa128f-r-bitflip",
+                         "slhdsa128f-fors-path-corrupt",
+                         "slhdsa128f-ht-auth-corrupt"):
+            assert required in names, required
+        verdicts = {v["name"]: v["verdict"] for v in slh_vectors}
+        assert verdicts["slhdsa128f-valid"] == "accept"
+
+    def test_classical_and_mldsa_entries_untouched(self, sig_golden):
+        """The append was additive: every pre-r17 vector family is
+        still present under its pinned name (byte-stability of the
+        existing entries is covered by the generator's determinism;
+        this guards against an accidental re-keying)."""
+        names = {v["name"] for v in sig_golden["vectors"]}
+        for required in ("es256-valid", "es256-high-s", "rs256-valid",
+                         "rs256-leading-zero-stripped",
+                         "mldsa44-valid", "mldsa44-ctilde-bitflip"):
+            assert required in names, required
+
+    def test_oracle_matches_pinned_verdicts(self, slh_vectors,
+                                            slh_jwks):
+        from cap_tpu.jwt.jose import b64url_decode
+        from cap_tpu.tpu import slhdsa
+
+        key = slh_jwks[0].key
+        for v in slh_vectors:
+            h, p, s = v["token"].split(".")
+            got = slhdsa.py_verify(key, b64url_decode(s),
+                                   (h + "." + p).encode())
+            assert got == (v["verdict"] == "accept"), v["name"]
+
+    def test_engine_matches_pinned_verdicts(self, slh_vectors,
+                                            slh_jwks):
+        import numpy as np
+
+        from cap_tpu.jwt.jose import b64url_decode
+        from cap_tpu.tpu import slhdsa
+
+        key = slh_jwks[0].key
+        table = slhdsa.SLHDSAKeyTable(key.parameter_set, [key])
+        sigs, msgs = [], []
+        for v in slh_vectors:
+            h, p, s = v["token"].split(".")
+            sigs.append(b64url_decode(s))
+            msgs.append((h + "." + p).encode())
+        got = slhdsa.verify_slhdsa_batch(
+            table, sigs, msgs, np.zeros(len(sigs), np.int32))
+        for v, ok in zip(slh_vectors, got):
+            assert bool(ok) == (v["verdict"] == "accept"), v["name"]
+
+    def test_reject_reason_class_parity_four_surfaces(self,
+                                                      slh_vectors,
+                                                      slh_jwks):
+        from cap_tpu.fleet import FleetClient
+        from cap_tpu.jwt.keyset import StaticKeySet as _SKS
+        from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+        from cap_tpu.obs import decision as obs_decision
+        from cap_tpu.serve.client import VerifyClient
+        from cap_tpu.serve.worker import VerifyWorker
+
+        tokens = [v["token"] for v in slh_vectors]
+        out = {}
+        out["oracle"] = _SKS([j.key for j in slh_jwks]).verify_batch(
+            tokens)
+        ks = TPUBatchKeySet(slh_jwks)
+        out["tpu"] = ks.verify_batch(tokens)
+        out["tpu_objects"] = ks._verify_batch_objects(tokens)
+        w = VerifyWorker(TPUBatchKeySet(slh_jwks), target_batch=8,
+                         max_wait_ms=5.0)
+        try:
+            host, port = w.address
+            with VerifyClient(host, port, timeout=600.0) as c:
+                out["serve"] = c.verify_batch(tokens)
+            out["router"] = FleetClient([(host, port)],
+                                        rr_seed=0).verify_batch(tokens)
+        finally:
+            w.close()
+
+        for i, v in enumerate(slh_vectors):
+            per_surface = {}
+            for surf, results in out.items():
+                r = results[i]
+                if isinstance(r, Exception):
+                    per_surface[surf] = ("reject",
+                                         obs_decision.classify(r))
+                else:
+                    per_surface[surf] = ("accept", None)
+            assert len(set(per_surface.values())) == 1, \
+                f"{v['name']}: {per_surface}"
+            assert (per_surface["tpu"][0] == "accept") == \
+                (v["verdict"] == "accept"), v["name"]
+
+
 @needs_crypto
 def test_sig_encoding_four_surface_parity(sig_golden):
     """Golden vectors through the full stack: CPU oracle, TPU batch,

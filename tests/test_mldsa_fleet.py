@@ -86,22 +86,35 @@ def tenant():
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     pq_priv, pq_pub = mldsa.keygen("ML-DSA-44", bytes([42]) * 32)
+    from cap_tpu.tpu import slhdsa
+
+    slh_priv, slh_pub = slhdsa.keygen("SLH-DSA-SHAKE-128f",
+                                      bytes([43]) * 32)
 
     es_jwk = serialize_public_key(es_key, kid="tenant-es")
     pq_jwk = serialize_public_key(pq_pub, kid="tenant-pq")
+    slh_jwk = serialize_public_key(slh_pub, kid="tenant-slh")
 
     es_toks = [_jws("ES256", "tenant-es", {"sub": f"es-{i}"}, es_sign)
                for i in range(4)]
     pq_toks = [_jws("ML-DSA-44", "tenant-pq", {"sub": f"pq-{i}"},
                     pq_priv.sign) for i in range(4)]
+    slh_toks = [_jws("SLH-DSA-SHAKE-128f", "tenant-slh",
+                     {"sub": f"slh-{i}"}, slh_priv.sign)
+                for i in range(4)]
     return {
         "es_jwks": {"keys": [es_jwk]},
         "hybrid_jwks": {"keys": [es_jwk, pq_jwk]},
         "pq_jwks": {"keys": [pq_jwk]},
+        "pq_slh_jwks": {"keys": [pq_jwk, slh_jwk]},
+        "slh_jwks": {"keys": [slh_jwk]},
+        "union_jwks": {"keys": [es_jwk, pq_jwk, slh_jwk]},
         "es_toks": es_toks,
         "pq_toks": pq_toks,
+        "slh_toks": slh_toks,
         "es_bad": [_tamper(t) for t in es_toks],
         "pq_bad": [_tamper(t) for t in pq_toks],
+        "slh_bad": [_tamper(t) for t in slh_toks],
     }
 
 
@@ -245,3 +258,129 @@ class _FallbackKeySet:
 
     def verify_batch(self, tokens):
         return self._ks.verify_batch(tokens)
+
+
+@pytest.mark.chaos
+def test_hybrid_migration_mldsa_to_slhdsa_under_load(tenant, tmp_path):
+    """The r17 second leg: ES256 → ML-DSA → SLH-DSA, kill -9 landing
+    mid-FINAL-push (the SLH-DSA-only cutover). Same invariants as the
+    classical→lattice migration above — zero wrong verdicts, zero
+    lost submissions, convergence after respawn — now across a second
+    family boundary where the replacement engine is the batched
+    Keccak hash forest."""
+    jwks_path = tmp_path / "tenant_hybrid.json"
+    jwks_path.write_text(json.dumps(tenant["hybrid_jwks"]))
+
+    rec = telemetry.enable()
+    pool = WorkerPool(2, keyset_spec=f"jwks:{jwks_path}",
+                      ping_interval=0.5, max_restarts=20,
+                      spawn_timeout=120, max_wait_ms=2.0)
+    try:
+        assert pool.wait_all_ready(120), "real-engine fleet not ready"
+        fallback = _FallbackKeySet(tenant["union_jwks"])
+        # Generous per-attempt budget: a worker's FIRST SLH-DSA batch
+        # compiles the hash-forest graph (tens of seconds on this
+        # 1-core host) — slow is acceptable, wrong is not.
+        cl = FleetClient(pool, fallback=fallback, attempt_timeout=60.0,
+                         total_deadline=180.0, rr_seed=0)
+
+        slh_pushed = threading.Event()
+        slh_converged = threading.Event()
+        stop = threading.Event()
+        failures = []
+        batches = []
+
+        def driver(d):
+            i = 0
+            while not stop.is_set() and not failures:
+                toks = [tenant["pq_toks"][i % 4],
+                        tenant["pq_bad"][i % 4],
+                        tenant["slh_toks"][(i + d) % 4],
+                        tenant["slh_bad"][(i + d) % 4]]
+                after_conv = slh_converged.is_set()
+                try:
+                    res = cl.verify_batch(toks)
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"driver {d}: {e!r}")
+                    return
+                now_pushed = slh_pushed.is_set()
+                if len(res) != len(toks):
+                    failures.append(f"driver {d}: lost submissions")
+                    return
+                pq_ok, pq_bad, slh_ok, slh_bad = [
+                    not isinstance(r, Exception) for r in res]
+                if not pq_ok:
+                    failures.append(
+                        f"driver {d}: valid ML-DSA token rejected")
+                if pq_bad or slh_bad:
+                    failures.append(
+                        f"driver {d}: FORGED token accepted")
+                if slh_ok and not now_pushed:
+                    failures.append(
+                        f"driver {d}: SLH-DSA accepted before any "
+                        "SLH-DSA key was pushed")
+                if not slh_ok and after_conv:
+                    failures.append(
+                        f"driver {d}: valid SLH-DSA token rejected "
+                        "after fleet convergence")
+                if slh_ok and res[2] != {"sub": f"slh-{(i + d) % 4}"}:
+                    failures.append(f"driver {d}: wrong SLH claims")
+                batches.append(len(toks))
+                i += 1
+
+        threads = [threading.Thread(target=driver, args=(d,))
+                   for d in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)               # hybrid ES+ML traffic flows
+
+        # Phase 2: ML-DSA + SLH-DSA hybrid (the second hybrid window).
+        slh_pushed.set()
+        pool.push_keys(tenant["pq_slh_jwks"], epoch=2)
+        assert _wait_epochs(pool, 2, timeout=120), \
+            f"no convergence on pq+slh epoch: {pool.key_epochs()}"
+        # Warm the SLH engines (compile) before declaring convergence
+        # to the drivers — slow-compile rejects would be a test
+        # artifact, not a correctness signal.
+        warm = cl.verify_batch(tenant["slh_toks"])
+        assert all(not isinstance(r, Exception) for r in warm), warm
+        slh_converged.set()
+        time.sleep(1.0)
+
+        # Phase 3: SLH-DSA only, kill -9 mid-push; grace keeps the
+        # retired ML-DSA kid resolving through the cutover.
+        victim = pool.pid(0)
+        push_started = threading.Event()
+
+        def killer():
+            push_started.wait(timeout=10)
+            kill9(victim)
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        push_started.set()
+        acks = pool.push_keys(tenant["slh_jwks"], epoch=3)
+        kt.join(timeout=10)
+        assert pool.keys_epoch() == 3
+        assert 3 in acks.values(), "no worker acked the final push"
+        assert _wait_epochs(pool, 3, timeout=180), \
+            f"no convergence after kill -9 mid-push: {pool.key_epochs()}"
+        assert pool.pid(0) != victim, "victim was not respawned"
+        assert pool.epoch_skew() == 0
+        time.sleep(1.0)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "driver wedged"
+        assert not failures, failures
+        assert sum(batches) > 0
+        c = rec.counters()
+        assert c.get("decision.router.family.mldsa44", 0) > 0
+        assert c.get("decision.router.family.slhdsa128f", 0) > 0
+        results = {r["name"]: r
+                   for r in obs_slo.evaluate_once(rec.snapshot())}
+        assert results["rotation_lag"]["ok"], results["rotation_lag"]
+    finally:
+        pool.close()
+        telemetry.disable()

@@ -1,0 +1,136 @@
+"""Keccak reference + device-path pinning (the ntt_ref contract,
+extended to the hash plane).
+
+Three layers, each pinned against the one below:
+
+1. the numpy uint64 reference vs stdlib ``hashlib.shake_128/256``
+   (FIPS 202) — pinned one-shot vectors plus randomized arbitrary
+   absorb/squeeze lengths;
+2. the jnp uint32 bit-interleaved permutation vs the reference;
+3. the Pallas kernel (interpret mode on CPU) vs both.
+
+Everything here is deterministic and CPU-only; ``make pallas-smoke``
+re-runs the kernel-liveness subset as a CI gate.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from cap_tpu.tpu import pallas_keccak as KK
+
+RNG = np.random.default_rng(0x202)
+
+# FIPS 202 SHAKE one-shot vectors (empty + short messages; digests are
+# the stdlib's, which IS the FIPS 202 reference implementation here —
+# pinned as literals so a hashlib regression would also be caught).
+PINNED = [
+    ("shake_128", b"", 16, "7f9c2ba4e88f827d616045507605853e"),
+    ("shake_256", b"", 16, "46b9dd2b0ba88d13233b3feb743eeb24"),
+    ("shake_128", b"abc", 16, "5881092dd818bf5cf8a3ddb793fbcba7"),
+    ("shake_256", b"abc", 16, "483366601360a8771c6863080cc4114d"),
+]
+
+
+@pytest.mark.parametrize("algo,msg,outlen,hexdigest", PINNED)
+def test_ref_pinned_vectors(algo, msg, outlen, hexdigest):
+    fn = KK.shake128_ref if algo == "shake_128" else KK.shake256_ref
+    assert fn(msg, outlen).hex() == hexdigest
+    h = getattr(hashlib, algo)(msg).digest(outlen)
+    assert fn(msg, outlen) == h
+
+
+def test_ref_matches_hashlib_arbitrary_lengths():
+    """Randomized absorb/squeeze sweep: lengths straddling every rate
+    boundary (0, rate-1, rate, rate+1, multi-block) both ways."""
+    lens = [0, 1, 135, 136, 137, 167, 168, 169, 200, 271, 272, 273]
+    lens += [int(RNG.integers(0, 600)) for _ in range(20)]
+    outs = [1, 16, 32, 135, 136, 137, 200,
+            int(RNG.integers(1, 500))]
+    for ln in lens:
+        data = RNG.integers(0, 256, ln, dtype=np.uint8).tobytes()
+        for out in outs:
+            assert KK.shake128_ref(data, out) == \
+                hashlib.shake_128(data).digest(out), (ln, out)
+            assert KK.shake256_ref(data, out) == \
+                hashlib.shake_256(data).digest(out), (ln, out)
+
+
+def test_interleave_roundtrip():
+    x = RNG.integers(0, 2 ** 64, (11, 25), dtype=np.uint64)
+    il = KK.interleave(x)
+    assert il.dtype == np.uint32 and il.shape == (11, 25, 2)
+    assert (KK.deinterleave(il) == x).all()
+
+
+def test_jnp_f1600_matches_ref():
+    import jax.numpy as jnp
+
+    st = RNG.integers(0, 2 ** 64, (6, 25), dtype=np.uint64)
+    got = KK.deinterleave(np.asarray(KK.f1600(
+        jnp.asarray(KK.interleave(st)))))
+    assert (got == KK.f1600_ref(st)).all()
+
+
+def test_pallas_kernel_matches_ref_interpret():
+    """The fused-kernel contract: bit-equal to the numpy reference in
+    interpret mode on the CPU backend (the only mode this host can
+    run; compiled-mode parity rides the chip-blocked list)."""
+    import jax.numpy as jnp
+
+    st = RNG.integers(0, 2 ** 64, (7, 25), dtype=np.uint64)
+    got = KK.deinterleave(np.asarray(KK.f1600_pallas(
+        jnp.asarray(KK.interleave(st)), interpret=True)))
+    assert (got == KK.f1600_ref(st)).all()
+
+
+def test_absorb_squeeze_driver_matches_hashlib():
+    """The masked variable-length batch absorb + multi-block squeeze
+    — the exact driver the fused ML-DSA μ path runs."""
+    import jax.numpy as jnp
+
+    msgs = [RNG.integers(0, 256, int(RNG.integers(0, 320)),
+                         dtype=np.uint8).tobytes() for _ in range(9)]
+    msgs.append(b"")                     # empty-message edge
+    blocks, nblk = KK.pack_blocks(msgs, KK.RATE_SHAKE256)
+    state = KK.absorb(jnp.asarray(blocks), jnp.asarray(nblk))
+    by = np.asarray(KK.lanes_to_bytes(KK.squeeze_lanes(
+        state, KK.RATE_SHAKE256, 3))).astype(np.uint8)
+    for i, msg in enumerate(msgs):
+        assert by[i].tobytes() == hashlib.shake_256(msg).digest(
+            3 * 136), i
+
+
+def test_bits_to_lanes():
+    import jax.numpy as jnp
+
+    bits = RNG.integers(0, 2, (5, 192), dtype=np.uint32)
+    lanes = np.asarray(KK.bits_to_lanes(jnp.asarray(bits)))
+    back = KK.deinterleave(lanes)
+    want = np.zeros((5, 3), np.uint64)
+    for r in range(5):
+        for b in range(192):
+            if bits[r, b]:
+                want[r, b // 64] |= np.uint64(1) << np.uint64(b % 64)
+    assert (back == want).all()
+
+
+def test_lanes_to_bytes_roundtrip():
+    import jax.numpy as jnp
+
+    raw = RNG.integers(0, 256, (4, 40), dtype=np.uint8)
+    il = KK.interleave(np.ascontiguousarray(raw).view("<u8"))
+    by = np.asarray(KK.lanes_to_bytes(jnp.asarray(il)))
+    assert (by.astype(np.uint8) == raw).all()
+
+
+def test_enabled_gate_env(monkeypatch):
+    monkeypatch.setenv("CAP_TPU_PALLAS_KECCAK", "1")
+    assert KK.enabled()
+    monkeypatch.setenv("CAP_TPU_PALLAS_KECCAK", "0")
+    assert not KK.enabled()
+    monkeypatch.delenv("CAP_TPU_PALLAS_KECCAK")
+    import jax
+
+    assert KK.enabled() == (jax.default_backend() == "tpu")

@@ -1,0 +1,332 @@
+"""SLH-DSA (FIPS 205) core: codecs, sign/verify roundtrips, JWK
+plumbing, and the engine-vs-oracle bit-exactness sweep.
+
+Everything is dependency-free: the host oracle is pure hashlib, the
+device engine is the batched Keccak-lane JAX graph, fixtures come
+from the deterministic in-repo signer. The ≥1k-per-set parity bar
+runs in ``make slhdsa-kat`` (tools/slhdsa_kat.py); here a smaller
+randomized sweep keeps tier-1 inside its time budget while covering
+the same mutation classes.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cap_tpu.errors import InvalidJWKSError, InvalidSignatureError
+from cap_tpu.jwt import algs
+from cap_tpu.jwt.jose import b64url_encode
+from cap_tpu.jwt.jwk import parse_jwk, serialize_public_key
+from cap_tpu.jwt.verify import key_matches_alg, verify_parsed
+from cap_tpu.tpu import slhdsa as S
+
+RNG = np.random.default_rng(0x205)
+FAST = "SLH-DSA-SHAKE-128f"
+SMALL = "SLH-DSA-SHAKE-128s"
+
+
+@pytest.fixture(scope="module")
+def fast_keys():
+    priv, pub = S.keygen(FAST, bytes([90]) * 32)
+    priv2, pub2 = S.keygen(FAST, bytes([91]) * 32)
+    return (priv, pub), (priv2, pub2)
+
+
+# ---------------------------------------------------------------------------
+# codecs + parameter derivations
+# ---------------------------------------------------------------------------
+
+def test_parameter_sizes():
+    s = S.PARAMS[SMALL]
+    f = S.PARAMS[FAST]
+    assert (s.pk_size, s.sig_size) == (32, 7856)
+    assert (f.pk_size, f.sig_size) == (32, 17088)
+    assert s.wlen == f.wlen == 35
+
+
+def test_base_2b_msb_first():
+    # 0xDE 0xAD = 1101 1110 1010 1101 (MSB-first)
+    assert S.base_2b(b"\xde\xad", 4, 4) == [0xD, 0xE, 0xA, 0xD]
+    assert S.base_2b(b"\xde\xad", 2, 8) == [3, 1, 3, 2, 2, 2, 3, 1]
+    assert S.base_2b(b"\xde\xad", 12, 1) == [0xDEA]
+    assert S.base_2b(b"\x80" + b"\x00" * 2, 6, 4) == [32, 0, 0, 0]
+
+
+def test_wots_digits_checksum():
+    p = S.PARAMS[FAST]
+    msg = bytes(16)                     # all-zero digits
+    digits = S._wots_digits(msg, p)
+    assert digits[:32] == [0] * 32
+    csum = 32 * 15                      # 480 = 0b1_1110_0000
+    assert digits[32:] == [csum >> 8, (csum >> 4) & 15, csum & 15]
+    msg = b"\xff" * 16                  # all-15 digits -> csum 0
+    assert S._wots_digits(msg, p)[32:] == [0, 0, 0]
+
+
+def test_digest_split_widths():
+    p = S.PARAMS[SMALL]
+    digest = bytes(range(p.m))
+    md, idx_tree, idx_leaf = S._digest_split(digest, p)
+    assert len(md) == (p.k * p.a + 7) // 8 == 21
+    assert idx_tree < (1 << (p.h - p.hp))
+    assert idx_leaf < (1 << p.hp)
+
+
+def test_adrs_layout():
+    a = S.ADRS()
+    a.set_layer(3)
+    a.set_tree((1 << 40) + 5)
+    a.set_type_and_clear(S._TREE)
+    a.set_tree_height(2)
+    a.set_tree_index(9)
+    b = a.bytes()
+    assert b[0:4] == (3).to_bytes(4, "big")
+    assert b[4:16] == ((1 << 40) + 5).to_bytes(12, "big")
+    assert b[16:20] == (2).to_bytes(4, "big")
+    assert b[24:28] == (2).to_bytes(4, "big")
+    assert b[28:32] == (9).to_bytes(4, "big")
+    a.set_type_and_clear(S._WOTS_HASH)
+    assert a.bytes()[20:32] == bytes(12)
+
+
+# ---------------------------------------------------------------------------
+# sign / verify roundtrips (host oracle)
+# ---------------------------------------------------------------------------
+
+def test_sign_verify_roundtrip_fast(fast_keys):
+    (priv, pub), (_, pub2) = fast_keys
+    p = pub.params
+    sig = priv.sign(b"roundtrip")
+    assert len(sig) == p.sig_size
+    assert S.py_verify(pub, sig, b"roundtrip")
+    assert not S.py_verify(pub, sig, b"roundtriq")
+    assert not S.py_verify(pub, sig[:-1], b"roundtrip")
+    assert not S.py_verify(pub, sig + b"\x00", b"roundtrip")
+    flip = bytearray(sig)
+    flip[3] ^= 0x10
+    assert not S.py_verify(pub, bytes(flip), b"roundtrip")
+    assert not S.py_verify(pub2, sig, b"roundtrip")
+    # deterministic signer: same key, same message, same signature
+    assert priv.sign(b"roundtrip") == sig
+
+
+@pytest.mark.slow
+def test_sign_verify_roundtrip_small():
+    """128s roundtrip — ~20s of host signing, so it rides the slow
+    marker; the pinned KAT file covers 128s in tier-1."""
+    priv, pub = S.keygen(SMALL, bytes([92]) * 32)
+    sig = priv.sign(b"small-set")
+    assert len(sig) == pub.params.sig_size
+    assert S.py_verify(pub, sig, b"small-set")
+    assert not S.py_verify(pub, sig, b"small-sex")
+
+
+def test_reject_surface_is_length_plus_root(fast_keys):
+    """Every non-length mutation still verifies STRUCTURALLY (no
+    parse error is possible) and rejects on the root compare."""
+    (priv, pub), _ = fast_keys
+    sig = priv.sign(b"m")
+    for cut in (0, 1, 100, len(sig) - 1):
+        assert not S.py_verify(pub, sig[:cut], b"m")
+    for pos in (0, 16, 40, len(sig) // 2, len(sig) - 1):
+        b = bytearray(sig)
+        b[pos] ^= 0x01
+        assert not S.py_verify(pub, bytes(b), b"m"), pos
+
+
+# ---------------------------------------------------------------------------
+# JWK / verify plumbing
+# ---------------------------------------------------------------------------
+
+def test_akp_jwk_roundtrip_and_negatives(fast_keys):
+    (_, pub), _ = fast_keys
+    jwk_dict = serialize_public_key(pub, kid="slh")
+    assert jwk_dict["kty"] == "AKP"
+    assert jwk_dict["alg"] == FAST
+    jwk = parse_jwk(jwk_dict)
+    assert jwk.key.pk == pub.pk
+    with pytest.raises(InvalidJWKSError):
+        parse_jwk({"kty": "AKP", "alg": "SLH-DSA-SHAKE-999",
+                   "pub": "AQAB"})
+    with pytest.raises(InvalidJWKSError):
+        parse_jwk({"kty": "AKP", "alg": FAST})
+    with pytest.raises(InvalidJWKSError):
+        parse_jwk({"kty": "AKP", "alg": FAST, "pub": "AQAB"})
+
+
+def test_key_matches_alg_slhdsa(fast_keys):
+    (_, pub), _ = fast_keys
+    assert key_matches_alg(pub, algs.SLHDSA128F)
+    assert not key_matches_alg(pub, algs.SLHDSA128S)
+    assert not key_matches_alg(pub, algs.MLDSA44)
+    assert not key_matches_alg(pub, algs.ES256)
+    assert algs.SLHDSA128S in algs.SUPPORTED_ALGORITHMS
+    assert algs.SLHDSA128F in algs.SUPPORTED_ALGORITHMS
+    assert algs.SLHDSA128F not in algs.HASH_FOR_ALG
+    assert algs.SLHDSA128F in algs.PQ_ALGORITHMS
+
+
+def test_verify_parsed_slhdsa(fast_keys):
+    from cap_tpu.jwt.jose import parse_jws
+
+    (priv, pub), _ = fast_keys
+    h = b64url_encode(json.dumps({"alg": FAST}).encode())
+    pl = b64url_encode(json.dumps({"sub": "x"}).encode())
+    si = (h + "." + pl).encode()
+    tok = h + "." + pl + "." + b64url_encode(priv.sign(si))
+    parsed = parse_jws(tok)
+    verify_parsed(parsed, pub)          # must not raise
+    bad = parse_jws(tok[:-6] + ("AAAAAA" if not tok.endswith("AAAAAA")
+                                else "BBBBBB"))
+    with pytest.raises(InvalidSignatureError):
+        verify_parsed(bad, pub)
+
+
+def test_decision_family_for_slhdsa():
+    from cap_tpu.obs import decision
+
+    assert decision.family_for_alg(SMALL) == "slhdsa128s"
+    assert decision.family_for_alg(FAST) == "slhdsa128f"
+    for fam in ("slhdsa128s", "slhdsa128f"):
+        assert fam in decision.FAMILIES
+    # registry order contract: the native plane indexes by position
+    assert decision.FAMILIES[-2:] == ("other", "unknown")
+
+
+# ---------------------------------------------------------------------------
+# engine vs oracle parity (the tier-1-sized sweep)
+# ---------------------------------------------------------------------------
+
+def _mutate(sig: bytes, msg: bytes, i: int, p):
+    mode = i % 8
+    if mode in (0, 1, 2):
+        return sig, msg
+    if mode == 3:                       # R flip
+        b = bytearray(sig)
+        b[i % p.n] ^= 1 << (i % 8)
+        return bytes(b), msg
+    if mode == 4:                       # FORS region
+        b = bytearray(sig)
+        b[p.n + (i * 131) % (p.k * (1 + p.a) * p.n)] ^= 0x20
+        return bytes(b), msg
+    if mode == 5:                       # wrong length
+        return (sig[:-1] if i % 2 else sig + b"\x00"), msg
+    if mode == 6:                       # hypertree
+        b = bytearray(sig)
+        b[-(1 + (i * 53) % 512)] ^= 0xFF
+        return bytes(b), msg
+    return sig, msg + b"!"
+
+
+def test_engine_oracle_parity_fast(fast_keys):
+    (priv, pub), (priv2, pub2) = fast_keys
+    p = pub.params
+    pubs = [pub, pub2]
+    table = S.SLHDSAKeyTable(FAST, pubs)
+    base = []
+    for i in range(4):
+        msg = f"par-{i}".encode()
+        base.append(([priv, priv2][i % 2].sign(msg), msg, i % 2))
+    n = 64
+    sigs, msgs, rows = [], [], []
+    for i in range(n):
+        sig, msg, row = base[i % 4]
+        sig, msg = _mutate(sig, msg, i, p)
+        sigs.append(sig)
+        msgs.append(msg)
+        rows.append(row)
+    # batches of 16: the pad-16 graph is the shape every other SLH
+    # test and the serve path compile, so this sweep adds no compiles
+    got = np.concatenate([
+        S.verify_slhdsa_batch(table, sigs[lo: lo + 16],
+                              msgs[lo: lo + 16],
+                              np.asarray(rows[lo: lo + 16], np.int32))
+        for lo in range(0, n, 16)])
+    want = np.array([S.py_verify(pubs[rows[i]], sigs[i], msgs[i])
+                     for i in range(n)])
+    mism = np.nonzero(got[:n] != want)[0]
+    assert len(mism) == 0, f"verdict mismatch at {mism[:10]}"
+    assert 0 < int(want.sum()) < n
+
+
+def test_engine_matches_kat_small_set():
+    """128s engine parity WITHOUT host signing: the pinned KAT file
+    supplies the signatures (tier-1 cannot afford 128s signs)."""
+    import os
+
+    from cap_tpu.jwt.jose import b64url_decode
+
+    kat_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "slhdsa_kat.json")
+    with open(kat_path) as f:
+        kat = json.load(f)
+    vecs = [v for v in kat["vectors"] if v["alg"] == SMALL]
+    assert vecs
+    key = parse_jwk([k for k in kat["keys"]["keys"]
+                     if k["alg"] == SMALL][0]).key
+    table = S.SLHDSAKeyTable(SMALL, [key])
+    sigs = [b64url_decode(v["signature_b64"]) for v in vecs]
+    msgs = [b64url_decode(v["message_b64"]) for v in vecs]
+    got = S.verify_slhdsa_batch(table, sigs, msgs,
+                                np.zeros(len(vecs), np.int32))
+    for i, v in enumerate(vecs):
+        assert bool(got[i]) == v["testPassed"], v["name"]
+
+
+# ---------------------------------------------------------------------------
+# official ACVP cross-check (skip-if-offline; the ML-DSA pattern)
+# ---------------------------------------------------------------------------
+
+_ACVP_SIGVER_URL = ("https://raw.githubusercontent.com/usnistgov/"
+                    "ACVP-Server/master/gen-val/json-files/"
+                    "SLH-DSA-sigVer-FIPS205/internalProjection.json")
+
+
+def _fetch_acvp_sigver():
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(_ACVP_SIGVER_URL,
+                                    timeout=15) as r:
+            return json.load(r)
+    except Exception as e:  # noqa: BLE001 - offline / proxy / DNS
+        pytest.skip(f"NIST ACVP vectors unreachable (offline host): "
+                    f"{type(e).__name__}")
+
+
+@pytest.mark.slow
+def test_acvp_official_sigver_crosscheck():
+    """Pure-mode (external interface, empty context) official ACVP
+    SLH-DSA sigVer cases through py_verify — the provenance
+    cross-check for the pinned KAT file on a networked host."""
+    doc = _fetch_acvp_sigver()
+    checked = {}
+    for group in doc.get("testGroups", []):
+        pset = group.get("parameterSet")
+        if pset not in S.PARAMS:
+            continue
+        if group.get("signatureInterface") == "internal":
+            continue
+        if group.get("preHash") not in (None, "pure"):
+            continue
+        for case in group.get("tests", []):
+            ctx = case.get("context") or group.get("context") or ""
+            if ctx:
+                continue
+            pk = bytes.fromhex(case.get("pk") or group.get("pk"))
+            msg = bytes.fromhex(case["message"])
+            sig = bytes.fromhex(case["signature"])
+            try:
+                pub = S.SLHDSAPublicKey(pset, pk)
+                got = S.py_verify(pub, sig, msg)
+            except ValueError:
+                got = False
+            want = bool(case["testPassed"])
+            assert got == want, (
+                f"{pset} tcId={case.get('tcId')}: py_verify={got}, "
+                f"NIST testPassed={want}")
+            checked[pset] = checked.get(pset, 0) + 1
+    assert checked and all(v > 0 for v in checked.values()), (
+        f"no pure-mode cases found: {checked} — ACVP file shape "
+        "changed? update the filter")

@@ -65,7 +65,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 THRESHOLD = 0.10          # >10% below best-of-window = regression
 WINDOW = 3                # best of the last 3 preceding rounds
 TRACKED = ("value", "value_peak", "resident_mixed_vps", "serve_fleet",
-           "resident_mldsa44_vps")
+           "resident_mldsa44_vps",
+           # second PQ family (r17): the resident SLH-DSA hash-forest
+           # rate — higher is better, tracked like the ML-DSA number
+           "resident_slhdsa128s_vps")
 # serve-chain series (BENCH_SERVE_r*.json): metric → higher_is_better
 SERVE_TRACKED = {"serve_native_vps": True,
                  "stage_python_us_per_token": False,
@@ -371,6 +374,24 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [fa[1], (17, {"serve_native_vps": 1e6})])):
         problems.append("vanished fleet_affinity_vps NOT flagged")
+    # 4f. resident_slhdsa128s_vps (r17, BENCH series): introducing
+    #     must not flag; a drop and a disappearance must
+    def _pq(vals):
+        return [(i + 16, ({} if v is None else
+                          {"value": 100.0,
+                           "resident_slhdsa128s_vps": v})
+                 if v != "absent" else {"value": 100.0})
+                for i, v in enumerate(vals)]
+
+    if check_series(_pq(["absent", 5000.0])):
+        problems.append("introducing resident_slhdsa128s_vps flagged")
+    if not check_series(_pq(["absent", 5000.0, 3000.0])):
+        problems.append(
+            "resident_slhdsa128s_vps regression NOT flagged")
+    if not any("disappeared" in f
+               for f in check_series(_pq(["absent", 5000.0,
+                                          "absent"]))):
+        problems.append("vanished resident_slhdsa128s_vps NOT flagged")
     # 5. the REAL series with a 15% regression injected into a copy of
     #    the newest record: must flag (the acceptance-bar case)
     real = load_series(repo)

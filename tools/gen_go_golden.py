@@ -293,6 +293,80 @@ def _mldsa_vectors():
     return jwk, vectors
 
 
+def _slhdsa_vectors():
+    """SLH-DSA-SHAKE-128f adversarial ENCODING vectors; (jwk, vectors).
+
+    The hash-based analog of the ML-DSA suite. SLH-DSA's only
+    structural gate is the signature LENGTH — there is no malleable
+    algebraic encoding — so the adversarial surface is: truncation,
+    extension/trailing garbage, a bit-flipped randomizer R (re-steers
+    H_msg, so every FORS index — including what would be an
+    "out-of-range" index under a fixed digest — resolves to a
+    different leaf and the root compare fails), a corrupted FORS
+    value, and a corrupted hypertree auth node. Keys come from a
+    PINNED keygen seed and the signer is deterministic (opt_rand =
+    PK.seed), so regeneration is byte-stable. 128f keeps generation
+    fast; the KAT file covers 128s the same way.
+    """
+    from cap_tpu.jwt.jwk import serialize_public_key
+    from cap_tpu.tpu import slhdsa
+
+    pset = "SLH-DSA-SHAKE-128f"
+    p = slhdsa.PARAMS[pset]
+    priv, pub = slhdsa.keygen(pset, bytes(range(32, 64)))
+    jwk = serialize_public_key(pub, kid="sig-slh")
+
+    si = _signing_input(pset, "sig-slh")
+    sig = priv.sign(si.encode())
+
+    def tok(sig_bytes: bytes) -> str:
+        return si + "." + _b64u(sig_bytes)
+
+    n = p.n
+    r_flip = bytearray(sig)
+    r_flip[3] ^= 0x10                    # inside R
+    fors_idx = bytearray(sig)
+    # First auth node of FORS tree 0: the path the (digest-pinned)
+    # leaf index walks no longer commits to the right root.
+    fors_idx[n + n] ^= 0x01
+    ht_auth = bytearray(sig)
+    ht_auth[-1] ^= 0x80
+
+    vectors = [
+        {"name": "slhdsa128f-valid", "alg": pset, "token": tok(sig),
+         "verdict": "accept",
+         "note": "control: well-formed FIPS 205 signature"},
+        {"name": "slhdsa128f-sig-truncated", "alg": pset,
+         "token": tok(sig[:-1]), "verdict": "reject",
+         "note": f"last byte truncated: length != {p.sig_size}"},
+        {"name": "slhdsa128f-sig-extended", "alg": pset,
+         "token": tok(sig + b"\x00"), "verdict": "reject",
+         "note": "one trailing zero byte: wrong length"},
+        {"name": "slhdsa128f-trailing-garbage", "alg": pset,
+         "token": tok(sig + b"\xde\xad"), "verdict": "reject",
+         "note": "two trailing garbage bytes: wrong length"},
+        {"name": "slhdsa128f-r-bitflip", "alg": pset,
+         "token": tok(bytes(r_flip)), "verdict": "reject",
+         "note": "one bit of the randomizer R flipped: H_msg "
+                 "re-steers every FORS/hypertree index"},
+        {"name": "slhdsa128f-fors-path-corrupt", "alg": pset,
+         "token": tok(bytes(fors_idx)), "verdict": "reject",
+         "note": "FORS auth node corrupted: the digest-selected leaf "
+                 "index walks to a wrong root (the out-of-range-"
+                 "index analog — indices are digest-derived, never "
+                 "encoded)"},
+        {"name": "slhdsa128f-ht-auth-corrupt", "alg": pset,
+         "token": tok(bytes(ht_auth)), "verdict": "reject",
+         "note": "last hypertree auth node corrupted"},
+        {"name": "slhdsa128f-tampered-payload", "alg": pset,
+         "token": _signing_input(pset, "sig-slh",
+                                 dict(CLAIMS, sub="evil"))
+         + "." + _b64u(sig),
+         "verdict": "reject", "note": "valid sig, different payload"},
+    ]
+    return jwk, vectors
+
+
 def _rsa_vectors():
     n = RSA_P * RSA_Q
     d = pow(RSA_E, -1, (RSA_P - 1) * (RSA_Q - 1))
@@ -347,16 +421,17 @@ def write_sig_conformance(out_dir: str) -> str:
     ec_jwk, ec_vecs = _ec_vectors()
     rsa_jwk, rsa_vecs = _rsa_vectors()
     pq_jwk, pq_vecs = _mldsa_vectors()
+    slh_jwk, slh_vecs = _slhdsa_vectors()
     doc = {
         "comment": "Adversarial signature-encoding conformance "
                    "vectors. Verdicts pin go-jose -> Go stdlib "
-                   "semantics (classical families) and FIPS 204 "
-                   "decode/verify gates (ML-DSA); every cap_tpu "
-                   "verify surface must match them bit-for-bit. "
-                   "Keys are fixed TEST fixtures (never real "
-                   "credentials).",
-        "keys": {"keys": [ec_jwk, rsa_jwk, pq_jwk]},
-        "vectors": ec_vecs + rsa_vecs + pq_vecs,
+                   "semantics (classical families) and FIPS 204/205 "
+                   "decode/verify gates (ML-DSA, SLH-DSA); every "
+                   "cap_tpu verify surface must match them "
+                   "bit-for-bit. Keys are fixed TEST fixtures "
+                   "(never real credentials).",
+        "keys": {"keys": [ec_jwk, rsa_jwk, pq_jwk, slh_jwk]},
+        "vectors": ec_vecs + rsa_vecs + pq_vecs + slh_vecs,
     }
     path = os.path.join(out_dir, "sig_conformance.json")
     with open(path, "w") as f:

@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""make slhdsa-kat: the SLH-DSA known-answer + parity gate.
+
+Two checks, exit nonzero on any mismatch (the mldsa-kat pattern):
+
+1. **KAT sweep** — every pinned vector in tests/data/slhdsa_kat.json
+   through all four verify surfaces (CPU oracle KeySet, TPU batch
+   native + object paths, serve worker, fleet router); every verdict
+   must equal the pinned one on every surface.
+2. **oracle/engine parity** — ≥1k randomized batched verifies per
+   parameter set (valid + mutated signatures over a base-signature
+   pool), device hash-forest engine vs the pure-hashlib oracle,
+   bit-exact. CAP_SLHDSA_KAT_N overrides the per-set count.
+
+Dependency-free (no ``cryptography``), stub-free (real engine).
+Heavier than mldsa-kat — SLH-DSA verify is ~2-6k hashes/token — so
+the parity sweep batches large and reuses a small signing pool.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KAT_PATH = os.path.join(REPO, "tests", "data", "slhdsa_kat.json")
+
+
+def kat_sweep() -> int:
+    from cap_tpu.fleet import FleetClient
+    from cap_tpu.jwt.jwk import parse_jwks
+    from cap_tpu.jwt.keyset import StaticKeySet
+    from cap_tpu.jwt.tpu_keyset import TPUBatchKeySet
+    from cap_tpu.serve.client import VerifyClient
+    from cap_tpu.serve.worker import VerifyWorker
+
+    with open(KAT_PATH) as f:
+        kat = json.load(f)
+    jwks = parse_jwks(kat["keys"])
+    tokens = [v["token"] for v in kat["vectors"]]
+    wants = [v["verdict"] == "accept" for v in kat["vectors"]]
+
+    out = {}
+    out["oracle"] = StaticKeySet([j.key for j in jwks]).verify_batch(
+        tokens)
+    ks = TPUBatchKeySet(jwks)
+    out["tpu"] = ks.verify_batch(tokens)
+    out["tpu_objects"] = ks._verify_batch_objects(tokens)
+    w = VerifyWorker(TPUBatchKeySet(jwks), target_batch=16,
+                     max_wait_ms=5.0)
+    try:
+        host, port = w.address
+        with VerifyClient(host, port, timeout=600.0) as c:
+            out["serve"] = c.verify_batch(tokens)
+        out["router"] = FleetClient([(host, port)],
+                                    rr_seed=0).verify_batch(tokens)
+    finally:
+        w.close()
+
+    bad = 0
+    for i, (v, want) in enumerate(zip(kat["vectors"], wants)):
+        for surf, res in out.items():
+            got = not isinstance(res[i], Exception)
+            if got != want:
+                print(f"slhdsa-kat FAIL: {v['name']} on {surf}: "
+                      f"{'accept' if got else 'reject'} != pinned "
+                      f"{v['verdict']}", file=sys.stderr)
+                bad += 1
+    print(f"slhdsa-kat: {len(tokens)} vectors x "
+          f"{len(out)} surfaces swept")
+    return bad
+
+
+def _mutate(sig: bytes, msg: bytes, i: int, p):
+    mode = i % 8
+    if mode in (0, 1, 2):                  # 3/8 valid
+        return sig, msg
+    if mode == 3:                          # R flip
+        b = bytearray(sig)
+        b[i % p.n] ^= 1 << (i % 8)
+        return bytes(b), msg
+    if mode == 4:                          # FORS region corruption
+        b = bytearray(sig)
+        b[p.n + (i * 131) % (p.k * (1 + p.a) * p.n)] ^= 0x20
+        return bytes(b), msg
+    if mode == 5:                          # wrong length
+        return (sig[:-1] if i % 2 else sig + b"\x00"), msg
+    if mode == 6:                          # hypertree corruption
+        b = bytearray(sig)
+        b[-(1 + (i * 53) % 1024)] ^= 0xFF
+        return bytes(b), msg
+    return sig, msg + b"!"                 # tampered message
+
+
+def parity_selftest() -> int:
+    from cap_tpu.tpu import slhdsa
+
+    per_set = int(os.environ.get("CAP_SLHDSA_KAT_N", "1024"))
+    batch = 256
+    bad = 0
+    for pset in sorted(slhdsa.PARAMS):
+        p = slhdsa.PARAMS[pset]
+        privs, pubs = [], []
+        for s in (70, 71):
+            pr, pu = slhdsa.keygen(pset, bytes([s]) * 32)
+            privs.append(pr)
+            pubs.append(pu)
+        table = slhdsa.SLHDSAKeyTable(pset, pubs)
+        base = []
+        for i in range(4):
+            msg = f"kat-{pset}-{i}".encode()
+            base.append((privs[i % 2].sign(msg), msg, i % 2))
+        n_acc = n_done = 0
+        for lo in range(0, per_set, batch):
+            m = min(batch, per_set - lo)
+            sigs, msgs, rows = [], [], []
+            for i in range(lo, lo + m):
+                sig, msg, row = base[i % len(base)]
+                sig, msg = _mutate(sig, msg, i, p)
+                sigs.append(sig)
+                msgs.append(msg)
+                rows.append(row)
+            got = slhdsa.verify_slhdsa_batch(
+                table, sigs, msgs, np.asarray(rows, np.int32))
+            want = [slhdsa.py_verify(pubs[rows[i]], sigs[i], msgs[i])
+                    for i in range(m)]
+            mism = [i for i in range(m) if bool(got[i]) != want[i]]
+            if mism:
+                print(f"slhdsa-kat PARITY FAIL: {pset} at "
+                      f"{[lo + i for i in mism[:8]]}", file=sys.stderr)
+                bad += len(mism)
+            n_acc += sum(want)
+            n_done += m
+        print(f"slhdsa-kat: {pset} engine/oracle parity on {n_done} "
+              f"randomized verifies ({n_acc} accept / "
+              f"{n_done - n_acc} reject)")
+    return bad
+
+
+def main() -> int:
+    bad = kat_sweep() + parity_selftest()
+    if bad:
+        print(f"slhdsa-kat: {bad} mismatches", file=sys.stderr)
+        return 1
+    print("slhdsa-kat OK: four-surface KAT sweep + engine/oracle "
+          "parity green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
