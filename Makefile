@@ -26,7 +26,7 @@ $(CLAIMS_SO): $(NATIVE_DIR)/claims_ext.cpp $(NATIVE_DIR)/claims_tape.h
 	$(CXX) $(CXXFLAGS) -I$(PY_INCLUDE) -o $@ $<
 endif
 
-.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat slhdsa-kat pallas-smoke claims-parity check
+.PHONY: all native native-build test bench clean obs-smoke keyplane-smoke bench-trend mldsa-kat slhdsa-kat pallas-smoke claims-parity shm-smoke go-conformance check
 
 all: native
 
@@ -34,7 +34,8 @@ native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 
 $(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp $(NATIVE_DIR)/serve_native.cpp \
 		$(NATIVE_DIR)/telemetry_native.cpp $(NATIVE_DIR)/telemetry_native.h \
-		$(NATIVE_DIR)/claims_validate.cpp $(NATIVE_DIR)/claims_tape.h
+		$(NATIVE_DIR)/claims_validate.cpp $(NATIVE_DIR)/claims_tape.h \
+		$(NATIVE_DIR)/shm_ring.cpp $(NATIVE_DIR)/shm_ring.h
 	$(CXX) $(CXXFLAGS) -o $@ $(filter %.cpp,$^)
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
@@ -72,6 +73,19 @@ test-all: native
 
 golden-go:
 	python tools/gen_go_golden.py
+
+# Go conformance: the table-driven golden-frame sweep + the
+# live-stub-worker suite (clients/go/captpu/conformance_test.go) when
+# a Go toolchain exists; a LOUD skip otherwise — this image has none,
+# so the committed golden vectors remain the cross-language pin
+# (tests/test_conformance.py regenerates + byte-compares them).
+go-conformance:
+	@if command -v go >/dev/null 2>&1; then \
+	  cd clients/go/captpu && go vet ./... && go test -v ./...; \
+	else \
+	  echo "SKIP go-conformance: no Go toolchain on this host -- install go >= 1.15 and re-run 'make go-conformance'"; \
+	  echo "     (framing stays pinned by the golden vectors: tests/test_conformance.py + tools/gen_go_golden.py)"; \
+	fi
 
 # Observability smoke: boot a 2-worker stub fleet, scrape /metrics +
 # /snapshot + /flight, fail on missing/NaN required gauges or a traced
@@ -116,6 +130,13 @@ slhdsa-kat:
 pallas-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/pallas_smoke.py
 
+# Shared-memory transport smoke: boot one worker per available serve
+# chain with transport=shm, drive it over the ring from the Python shm
+# client, gate the serve.shm.* counters/gauges (attach negotiated,
+# frames served, ZERO protocol errors) and the socket-fallback path.
+shm-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/shm_smoke.py
+
 # Claims-rule differential gate: the generated ~1k adversarial corpus
 # through the dict path, the raw-path Python rules, and the native
 # claims engine (claims_validate.cpp) — verdicts and reason classes
@@ -126,5 +147,7 @@ claims-parity: native
 
 # The default local CI gate: observability smoke + keyplane rotation
 # smoke + perf-trend sentinel + post-quantum KAT gates (both
-# families) + kernel liveness gate + claims-rule differential gate.
-check: obs-smoke keyplane-smoke bench-trend mldsa-kat slhdsa-kat pallas-smoke claims-parity
+# families) + kernel liveness gate + claims-rule differential gate +
+# shm-transport smoke + Go conformance (loud skip without a Go
+# toolchain).
+check: obs-smoke keyplane-smoke bench-trend mldsa-kat slhdsa-kat pallas-smoke claims-parity shm-smoke go-conformance

@@ -30,16 +30,18 @@ _FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
 EXT_NAME = "_capclaims" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
 
 # (sources, output, needs_python_headers) — paths relative to
-# cap_tpu/. libcapruntime.so is built from FOUR translation units:
+# cap_tpu/. libcapruntime.so is built from FIVE translation units:
 # jose_native.cpp (batch JOSE prep), serve_native.cpp (the GIL-free
 # serve chain), telemetry_native.cpp (the native telemetry plane),
-# and claims_validate.cpp (the OIDC claims-rule engine) — one .so, so
-# every binding loads the same library.
+# claims_validate.cpp (the OIDC claims-rule engine), and shm_ring.cpp
+# (the zero-copy shared-memory transport) — one .so, so every binding
+# loads the same library.
 _TARGETS = [
     ((os.path.join("runtime", "native", "jose_native.cpp"),
       os.path.join("runtime", "native", "serve_native.cpp"),
       os.path.join("runtime", "native", "telemetry_native.cpp"),
-      os.path.join("runtime", "native", "claims_validate.cpp")),
+      os.path.join("runtime", "native", "claims_validate.cpp"),
+      os.path.join("runtime", "native", "shm_ring.cpp")),
      os.path.join("runtime", "native", "libcapruntime.so"), False),
     ((os.path.join("serve", "native", "client_native.cpp"),),
      os.path.join("serve", "native", "libcapclient.so"), False),
@@ -63,10 +65,12 @@ def _build_one(sources, out: str, py_headers: bool,
     deps = srcs + [h for s in srcs
                    for h in [os.path.splitext(s)[0] + ".h"]
                    if os.path.exists(h)]
-    # telemetry_native.h is likewise cross-TU (serve_native.cpp feeds
-    # the plane it declares — an N_FAM/ABI bump must rebuild both)
+    # telemetry_native.h and shm_ring.h are likewise cross-TU
+    # (serve_native.cpp feeds the plane and consumes the shm rings —
+    # an ABI/layout bump must rebuild every consumer)
     deps += [h for d in src_dirs
-             for name in ("claims_tape.h", "telemetry_native.h")
+             for name in ("claims_tape.h", "telemetry_native.h",
+                          "shm_ring.h")
              for h in [os.path.join(d, name)]
              if os.path.exists(h) and h not in deps]
     if not force and os.path.exists(out) and \

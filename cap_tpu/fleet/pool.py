@@ -78,6 +78,10 @@ class WorkerHandle:
         # Which serve chain the worker announced on its ready line
         # ("native" / "python"; None before the first ready line).
         self.serve_chain: Optional[str] = None
+        # Transport capability from the ready line ("shm" / "socket";
+        # None while starting) — what actually runs, stale-.so
+        # fallback included.
+        self.transport: Optional[str] = None
         # Latest collected crash/drain postmortem (obs.postmortem doc)
         # and the checkpoint file the worker writes into.
         self.postmortem: Optional[dict] = None
@@ -121,6 +125,7 @@ class WorkerPool:
                  postmortem_interval: float = 1.0,
                  keys_push_timeout: float = 30.0,
                  serve_chain: Optional[str] = None,
+                 transport: Optional[str] = None,
                  peer_fill: bool = True, peer_fill_max: int = 2048,
                  peer_fill_attempts: int = 50):
         if placements is None:
@@ -141,6 +146,10 @@ class WorkerPool:
             # explicit chain selection ("native"/"python"/"auto") —
             # the ready line still reports what actually came up
             self._worker_args += ["--serve-chain", serve_chain]
+        if transport is not None:
+            # transport capability ("shm"/"socket"/"auto") — same
+            # report-what-runs stance as the serve chain
+            self._worker_args += ["--transport", transport]
         self._ping_interval = ping_interval
         self._ping_timeout = ping_timeout
         self._hung_after = hung_after
@@ -278,6 +287,8 @@ class WorkerPool:
                              for h in self._handles},
                 "key_epochs": self.key_epochs(),
                 "epoch_skew": self.epoch_skew(),
+                "serve_chains": self.serve_chains(),
+                "transports": self.transports(),
             },
         }
 
@@ -365,6 +376,13 @@ class WorkerPool:
         bench_serve/capstat see which chain each worker runs."""
         with self._lock:
             return {h.worker_id: h.serve_chain for h in self._handles}
+
+    def transports(self) -> Dict[int, Optional[str]]:
+        """worker_id → transport capability from the ready line
+        ("shm" / "socket"; None while starting) — fleet transport
+        state in one place, like :meth:`serve_chains`."""
+        with self._lock:
+            return {h.worker_id: h.transport for h in self._handles}
 
     def keys_epoch(self) -> Optional[int]:
         """The epoch the fleet is converging on (None: never pushed)."""
@@ -563,6 +581,7 @@ class WorkerPool:
         obs_port = None
         epoch = None
         serve_chain = None
+        transport = None
         try:
             while time.monotonic() < deadline:
                 line = proc.stdout.readline()
@@ -579,6 +598,8 @@ class WorkerPool:
                             epoch = int(v)
                         elif k == "serve_chain":
                             serve_chain = v
+                        elif k == "transport":
+                            transport = v
                     break
         except (OSError, ValueError):
             port = None
@@ -594,6 +615,7 @@ class WorkerPool:
                                  if obs_port else None)
                 h.key_epoch = epoch
                 h.serve_chain = serve_chain
+                h.transport = transport
                 h.state = READY
                 h.peer_fill_pending = self._peer_fill
                 h.peer_fill_attempts = 0

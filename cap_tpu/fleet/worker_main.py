@@ -280,6 +280,14 @@ def main(argv=None) -> int:
     # tools/bench_stages.py measures the obs-overhead table with).
     ap.add_argument("--native-obs", default="auto",
                     choices=["auto", "off"])
+    # Transport capability: "shm" honors per-connection shared-memory
+    # attach negotiations (CVB1 type 15, docs/SERVE.md §Transports) on
+    # whichever serve chain runs; "socket" refuses them (counted
+    # serve.shm_fallbacks); "auto" defers to CAP_SERVE_TRANSPORT in
+    # the environment. The ready line's transport= field reports what
+    # actually runs (a stale native library degrades shm → socket).
+    ap.add_argument("--transport", default="auto",
+                    choices=["auto", "socket", "shm"])
     # Verdict cache: "auto" (on unless CAP_SERVE_VCACHE=0 in the
     # environment) or "off" (force the cache tier — worker cache,
     # native digest handoff, batcher in-flight dedup — off; the
@@ -319,7 +327,9 @@ def main(argv=None) -> int:
                           max_batch=args.max_batch,
                           obs_port=(None if args.obs_port < 0
                                     else args.obs_port),
-                          serve_native=serve_native)
+                          serve_native=serve_native,
+                          transport=(None if args.transport == "auto"
+                                     else args.transport))
     pm = None
     if args.postmortem_path:
         from ..obs.postmortem import PostmortemWriter
@@ -337,7 +347,8 @@ def main(argv=None) -> int:
     print(f"CAP_FLEET_READY port={port} pid={os.getpid()}"
           + (f" obs={obs[1]}" if obs is not None else "")
           + (f" epoch={epoch}" if epoch is not None else "")
-          + f" serve_chain={worker.serve_chain}",
+          + f" serve_chain={worker.serve_chain}"
+          + f" transport={worker.transport}",
           flush=True)
 
     stop = threading.Event()

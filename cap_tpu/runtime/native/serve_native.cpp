@@ -54,8 +54,10 @@
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <sys/types.h>
+#include <sys/un.h>
 #include <unistd.h>
 
+#include "shm_ring.h"
 #include "telemetry_native.h"
 
 // SHA-256 from jose_native.cpp (same .so, SHA-NI dispatched): the
@@ -89,6 +91,8 @@ enum {
   T_KEYS_ACK = 12,
   T_PEER_FILL = 13,
   T_PEER_ACK = 14,
+  T_SHM_ATTACH = 15,
+  T_SHM_ACK = 16,
 };
 static const int64_t MAX_FRAME_ENTRIES = 1 << 20;
 static const int64_t MAX_ENTRY_BYTES = 1 << 20;
@@ -200,9 +204,11 @@ static int parse_frame(const uint8_t* b, int64_t n, Parsed& out) {
       ftype == T_VERIFY_REQ_CRC || ftype == T_VERIFY_RESP_CRC ||
       ftype == T_VERIFY_REQ_TRACE || ftype == T_VERIFY_RESP_TRACE ||
       ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK ||
-      ftype == T_PEER_FILL || ftype == T_PEER_ACK;
+      ftype == T_PEER_FILL || ftype == T_PEER_ACK ||
+      ftype == T_SHM_ATTACH || ftype == T_SHM_ACK;
   if ((ftype == T_KEYS_PUSH || ftype == T_KEYS_ACK ||
-       ftype == T_PEER_FILL || ftype == T_PEER_ACK) &&
+       ftype == T_PEER_FILL || ftype == T_PEER_ACK ||
+       ftype == T_SHM_ATTACH || ftype == T_SHM_ACK) &&
       count != 1)
     return PF_MALFORMED;
   int64_t pos = 9;
@@ -222,10 +228,11 @@ static int parse_frame(const uint8_t* b, int64_t n, Parsed& out) {
   out.entries.clear();
   bool req_shape = ftype == T_VERIFY_REQ || ftype == T_VERIFY_REQ_CRC ||
                    ftype == T_VERIFY_REQ_TRACE || ftype == T_KEYS_PUSH ||
-                   ftype == T_PEER_FILL;
+                   ftype == T_PEER_FILL || ftype == T_SHM_ATTACH;
   bool resp_shape = ftype == T_VERIFY_RESP || ftype == T_VERIFY_RESP_CRC ||
                     ftype == T_VERIFY_RESP_TRACE || ftype == T_STATS_RESP ||
-                    ftype == T_KEYS_ACK || ftype == T_PEER_ACK;
+                    ftype == T_KEYS_ACK || ftype == T_PEER_ACK ||
+                    ftype == T_SHM_ACK;
   int64_t total = 0;
   if (req_shape) {
     out.entries.reserve(count < 4096 ? count : 4096);
@@ -365,6 +372,16 @@ struct Conn {
   bool reader_done = false;
   bool dead = false;         // send failed: discard, never block
   std::atomic<int> finished{0};  // 2 = both threads exited
+  // shm transport (negotiated per connection via T_SHM_ATTACH): once
+  // attached, requests arrive through the region's request ring and
+  // responses with seq >= shm_from_seq leave through its response
+  // ring; the SOCKET stays open purely as the liveness channel (EOF =
+  // client gone → detach + reclaim). The attach ack itself rides the
+  // socket (seq < shm_from_seq), so the client can confirm the switch
+  // before it starts producing.
+  cap_shm::Region* shm_region = nullptr;
+  int64_t shm_from_seq = INT64_MAX;  // under mu
+  std::atomic<bool> peer_gone{false};
 };
 
 // Request kinds surfaced to the Python drain loop.
@@ -399,7 +416,14 @@ enum {
   CTR_PONGS = 4,
   CTR_DROPPED_POSTS = 5,
   CTR_CONNS_CLOSED = 6,
-  CTR_N = 8,
+  // shm transport (slots additive — a stale binding reading only 0-6
+  // keeps its exact meanings)
+  CTR_SHM_ATTACHES = 7,
+  CTR_SHM_FALLBACKS = 8,
+  CTR_SHM_FRAMES = 9,
+  CTR_SHM_STALE_GEN = 10,
+  CTR_SHM_DETACHES = 11,
+  CTR_N = 12,
 };
 
 struct Handle {
@@ -416,6 +440,10 @@ struct Handle {
   // cap_serve_drain_aux copies them out; single-consumer like carry.
   std::vector<int8_t> last_fams;
   std::vector<uint8_t> last_kids;
+  // shm transport armed (cap_serve_set_shm): attach requests are
+  // honored; off → acked status 1 + CTR_SHM_FALLBACKS (the socket
+  // chain keeps serving, the r12 graceful-fallback contract)
+  std::atomic<int32_t> shm_on{0};
   // verdict-cache digests (cap_serve_set_digests arms the readers;
   // cap_serve_drain_digests copies the last drain's out)
   std::atomic<int32_t> digests_on{0};
@@ -464,6 +492,16 @@ static void enqueue_response(const std::shared_ptr<Conn>& c, int64_t seq,
   c->cv.notify_all();
 }
 
+// response-frame encoding helpers (mirror protocol._with_crc)
+static void put_u32(std::string& s, uint32_t v) {
+  s.append((const char*)&v, 4);
+}
+
+static void append_crc(std::string& s) {
+  uint32_t crc = crc32_update(0, (const uint8_t*)s.data(), s.size());
+  put_u32(s, crc);
+}
+
 // blockingly push one request into the ring (token watermark +
 // ring-capacity backpressure; false only on shutdown)
 static bool push_req(Handle* h, Req* r, int64_t ntok) {
@@ -490,8 +528,206 @@ static bool push_req(Handle* h, Req* r, int64_t ntok) {
 }
 
 // ---------------------------------------------------------------------------
-// reader thread: buffered recv → parse → ring (or native pong)
+// reader thread: buffered recv → parse → ring (or native pong); an
+// attached shm region swaps the byte source from recv to the mapped
+// request ring (zero syscalls, zero copy before the Req blob).
 // ---------------------------------------------------------------------------
+
+// Both-threads-done teardown: the LAST thread out closes the fd and
+// reclaims the shm region (unmap + unlink — a client killed by -9
+// left the file behind; the worker is the reliable janitor). Nothing
+// here touches the Handle: cap_serve_destroy may free it as soon as
+// every conn shows finished == 2.
+static void finish_conn(const std::shared_ptr<Conn>& c) {
+  if (c->finished.fetch_add(1) + 1 == 2) {
+    if (c->shm_region) cap_shm::close_region(c->shm_region, true);
+    ::close(c->fd);
+  }
+}
+
+// Handle one PF_OK frame exactly as the socket reader always has:
+// native pong, or a Req pushed into the MPSC ring (verify tokens and
+// in-order control records alike). Returns false when the connection
+// must drop (wrong-direction frame, shutdown during push).
+static bool handle_frame(const std::shared_ptr<Conn>& c,
+                         const uint8_t* base, const Parsed& p) {
+  Handle* h = c->h;
+  if (p.ftype == T_PING) {
+    int64_t seq;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      seq = c->assigned++;
+    }
+    std::string pong(9, '\0');
+    uint32_t zero = 0;
+    std::memcpy(&pong[0], &MAGIC, 4);
+    pong[4] = (char)T_PONG;
+    std::memcpy(&pong[5], &zero, 4);
+    enqueue_response(c, seq, std::move(pong));
+    h->ctr[CTR_PONGS].fetch_add(1);
+    return true;
+  }
+  if (p.ftype == T_VERIFY_REQ || p.ftype == T_VERIFY_REQ_CRC ||
+      p.ftype == T_VERIFY_REQ_TRACE || p.ftype == T_STATS_REQ ||
+      p.ftype == T_KEYS_PUSH || p.ftype == T_PEER_FILL) {
+    Req* r = new Req();
+    r->conn = c;
+    r->ftype = p.ftype;
+    r->kind = p.ftype == T_STATS_REQ ? K_STATS
+              : p.ftype == T_KEYS_PUSH ? K_KEYS
+              : p.ftype == T_PEER_FILL ? K_PEER
+                                       : K_VERIFY;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      r->seq = c->assigned++;
+    }
+    r->t_recv = wall_now();
+    r->trace_len = (uint8_t)p.trace_len;
+    if (p.trace_len)
+      std::memcpy(r->trace, base + p.trace_off, (size_t)p.trace_len);
+    size_t nent = p.entries.size();
+    r->offs.resize(nent + 1);
+    r->offs[0] = 0;
+    int64_t tot = 0;
+    for (size_t i = 0; i < nent; i++) {
+      tot += p.entries[i].len;
+      r->offs[i + 1] = tot;
+    }
+    r->blob.resize((size_t)tot);
+    for (size_t i = 0; i < nent; i++)
+      std::memcpy(&r->blob[(size_t)r->offs[i]], base + p.entries[i].off,
+                  (size_t)p.entries[i].len);
+    if (r->kind == K_VERIFY &&
+        h->digests_on.load(std::memory_order_relaxed)) {
+      // verdict-cache digest per token, while the bytes are hot
+      // (SHA-NI where the CPU has it — ~0.1 µs for a typical token)
+      r->digests.resize(nent * DIG_LEN);
+      uint8_t d32[32];
+      for (size_t i = 0; i < nent; i++) {
+        sha2::sha256(base + p.entries[i].off,
+                     (size_t)p.entries[i].len, d32);
+        std::memcpy(&r->digests[i * DIG_LEN], d32, DIG_LEN);
+      }
+    }
+    if (h->tel && r->kind == K_VERIFY) {
+      // classify each token's family here, GIL-free, while the
+      // frame bytes are cache-hot: header segment = bytes before
+      // the first '.' (token.split(".", 1)[0], byte-for-byte)
+      r->fams.resize(nent);
+      r->kids.assign(nent * cap_tel::KID_LEN, '\0');
+      for (size_t i = 0; i < nent; i++) {
+        const uint8_t* tok = base + p.entries[i].off;
+        int64_t tlen = p.entries[i].len;
+        const uint8_t* dot =
+            (const uint8_t*)std::memchr(tok, '.', (size_t)tlen);
+        int64_t slen = dot ? (int64_t)(dot - tok) : tlen;
+        int32_t kid_len = 0;
+        r->fams[i] = (int8_t)cap_tel::classify(
+            h->tel, tok, slen,
+            (uint8_t*)&r->kids[i * cap_tel::KID_LEN], &kid_len);
+      }
+    }
+    int64_t ntok = r->kind == K_VERIFY ? (int64_t)nent : 1;
+    if (r->kind == K_VERIFY) h->ctr[CTR_TOKENS].fetch_add(nent);
+    if (!push_req(h, r, ntok)) {
+      delete r;
+      return false;
+    }
+    return true;
+  }
+  // valid frame, wrong direction (a response type at the server — or
+  // a second SHM attach): protocol violation → drop the connection.
+  return false;
+}
+
+// extract the "path" string out of the attach payload JSON — the one
+// field the native side needs; escaped paths are rejected (the
+// clients never emit them, and un-escaping here would invite drift)
+static std::string attach_path(const uint8_t* payload, int64_t len) {
+  static const char key[] = "\"path\":\"";
+  std::string s((const char*)payload, (size_t)len);
+  size_t at = s.find(key);
+  if (at == std::string::npos) return "";
+  size_t start = at + sizeof(key) - 1;
+  size_t end = s.find('"', start);
+  if (end == std::string::npos) return "";
+  std::string path = s.substr(start, end - start);
+  if (path.find('\\') != std::string::npos) return "";
+  return path;
+}
+
+// checksummed SHM ack (type 16, one entry) — byte-identical to
+// protocol.encode_shm_ack
+static std::string shm_ack_frame(const std::string& error) {
+  std::string payload =
+      error.empty() ? std::string("{\"transport\":\"shm\"}") : error;
+  std::string f;
+  put_u32(f, MAGIC);
+  f.push_back((char)T_SHM_ACK);
+  put_u32(f, 1);
+  f.push_back(error.empty() ? '\0' : '\x01');
+  put_u32(f, (uint32_t)payload.size());
+  f += payload;
+  append_crc(f);
+  return f;
+}
+
+// Serve one attached connection from its mapped request ring. The
+// socket is polled (non-blocking) as the liveness channel: EOF means
+// the client is gone — including kill -9 mid-write, whose partial
+// record was never published and is simply reclaimed with the ring.
+static void shm_reader_loop(const std::shared_ptr<Conn>& c) {
+  Handle* h = c->h;
+  cap_shm::Region* r = c->shm_region;
+  int idle = 0;
+  for (;;) {
+    if (h->stop.load(std::memory_order_relaxed)) break;
+    const uint8_t* rec;
+    uint64_t len;
+    int st = cap_shm::poll_record(r, cap_shm::RING_REQ, &rec, &len);
+    if (st == cap_shm::SHM_EMPTY) {
+      if (++idle >= 32) {
+        idle = 0;
+        char probe[64];
+        ssize_t n = ::recv(c->fd, probe, sizeof(probe), MSG_DONTWAIT);
+        if (n == 0) break;  // EOF: client gone → detach + reclaim
+        if (n > 0) {
+          // bytes on the socket after the attach: protocol violation
+          h->ctr[CTR_PROTO_ERR].fetch_add(1);
+          break;
+        }
+        if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) break;
+      }
+      ::usleep(100);
+      continue;
+    }
+    if (st != cap_shm::SHM_RECORD) {
+      // poisoned ring: overrun cursor / impossible length / foreign
+      // generation — the shm analog of a malformed socket frame
+      if (st == cap_shm::SHM_STALE_GEN)
+        h->ctr[CTR_SHM_STALE_GEN].fetch_add(1);
+      h->ctr[CTR_PROTO_ERR].fetch_add(1);
+      break;
+    }
+    idle = 0;
+    Parsed p;
+    int pst = parse_frame(rec, (int64_t)len, p);
+    if (pst != PF_OK || (uint64_t)p.consumed != len ||
+        p.ftype == T_SHM_ATTACH) {
+      h->ctr[CTR_PROTO_ERR].fetch_add(1);
+      break;
+    }
+    h->ctr[CTR_FRAMES].fetch_add(1);
+    h->ctr[CTR_SHM_FRAMES].fetch_add(1);
+    bool ok = handle_frame(c, rec, p);
+    // consume AFTER handle_frame copied the entry bytes out — the
+    // producer may reuse the space the moment the tail moves
+    cap_shm::consume_record(r, cap_shm::RING_REQ);
+    if (!ok) break;
+  }
+  c->peer_gone.store(true);
+  h->ctr[CTR_SHM_DETACHES].fetch_add(1);
+}
 
 static void reader_main(std::shared_ptr<Conn> c) {
   Handle* h = c->h;
@@ -527,88 +763,49 @@ static void reader_main(std::shared_ptr<Conn> c) {
     }
     h->ctr[CTR_FRAMES].fetch_add(1);
     const uint8_t* base = buf.data() + start;
-    if (p.ftype == T_PING) {
+    if (p.ftype == T_SHM_ATTACH) {
+      // transport negotiation: map the client's region and switch
+      // this connection's frame source to its request ring; anything
+      // unsupported acks status 1 and the socket chain keeps serving
+      // (serve.shm_fallbacks — the graceful-fallback contract)
       int64_t seq;
       {
         std::lock_guard<std::mutex> lk(c->mu);
         seq = c->assigned++;
       }
-      std::string pong(9, '\0');
-      uint32_t zero = 0;
-      std::memcpy(&pong[0], &MAGIC, 4);
-      pong[4] = (char)T_PONG;
-      std::memcpy(&pong[5], &zero, 4);
-      enqueue_response(c, seq, std::move(pong));
-      h->ctr[CTR_PONGS].fetch_add(1);
-    } else if (p.ftype == T_VERIFY_REQ || p.ftype == T_VERIFY_REQ_CRC ||
-               p.ftype == T_VERIFY_REQ_TRACE || p.ftype == T_STATS_REQ ||
-               p.ftype == T_KEYS_PUSH || p.ftype == T_PEER_FILL) {
-      Req* r = new Req();
-      r->conn = c;
-      r->ftype = p.ftype;
-      r->kind = p.ftype == T_STATS_REQ ? K_STATS
-                : p.ftype == T_KEYS_PUSH ? K_KEYS
-                : p.ftype == T_PEER_FILL ? K_PEER
-                                         : K_VERIFY;
-      {
-        std::lock_guard<std::mutex> lk(c->mu);
-        r->seq = c->assigned++;
-      }
-      r->t_recv = wall_now();
-      r->trace_len = (uint8_t)p.trace_len;
-      if (p.trace_len)
-        std::memcpy(r->trace, base + p.trace_off, (size_t)p.trace_len);
-      size_t nent = p.entries.size();
-      r->offs.resize(nent + 1);
-      r->offs[0] = 0;
-      int64_t tot = 0;
-      for (size_t i = 0; i < nent; i++) {
-        tot += p.entries[i].len;
-        r->offs[i + 1] = tot;
-      }
-      r->blob.resize((size_t)tot);
-      for (size_t i = 0; i < nent; i++)
-        std::memcpy(&r->blob[(size_t)r->offs[i]], base + p.entries[i].off,
-                    (size_t)p.entries[i].len);
-      if (r->kind == K_VERIFY &&
-          h->digests_on.load(std::memory_order_relaxed)) {
-        // verdict-cache digest per token, while the bytes are hot
-        // (SHA-NI where the CPU has it — ~0.1 µs for a typical token)
-        r->digests.resize(nent * DIG_LEN);
-        uint8_t d32[32];
-        for (size_t i = 0; i < nent; i++) {
-          sha2::sha256(base + p.entries[i].off,
-                       (size_t)p.entries[i].len, d32);
-          std::memcpy(&r->digests[i * DIG_LEN], d32, DIG_LEN);
+      std::string path = attach_path(base + p.entries[0].off,
+                                     p.entries[0].len);
+      if (!h->shm_on.load(std::memory_order_relaxed) || path.empty() ||
+          c->shm_region) {
+        h->ctr[CTR_SHM_FALLBACKS].fetch_add(1);
+        enqueue_response(
+            c, seq,
+            shm_ack_frame("TypeError: worker has no shm transport "
+                          "(transport=socket)"));
+      } else {
+        char err[128];
+        cap_shm::Region* region =
+            cap_shm::map_region(path.c_str(), err, sizeof(err));
+        if (!region) {
+          h->ctr[CTR_SHM_FALLBACKS].fetch_add(1);
+          enqueue_response(
+              c, seq,
+              shm_ack_frame(std::string("ValueError: shm region "
+                                        "unusable: ") + err));
+        } else {
+          {
+            std::lock_guard<std::mutex> lk(c->mu);
+            c->shm_region = region;
+            c->shm_from_seq = seq + 1;  // the ack rides the socket
+          }
+          h->ctr[CTR_SHM_ATTACHES].fetch_add(1);
+          enqueue_response(c, seq, shm_ack_frame(""));
+          start += (size_t)p.consumed;
+          shm_reader_loop(c);
+          break;
         }
       }
-      if (h->tel && r->kind == K_VERIFY) {
-        // classify each token's family here, GIL-free, while the
-        // frame bytes are cache-hot: header segment = bytes before
-        // the first '.' (token.split(".", 1)[0], byte-for-byte)
-        r->fams.resize(nent);
-        r->kids.assign(nent * cap_tel::KID_LEN, '\0');
-        for (size_t i = 0; i < nent; i++) {
-          const uint8_t* tok = base + p.entries[i].off;
-          int64_t tlen = p.entries[i].len;
-          const uint8_t* dot =
-              (const uint8_t*)std::memchr(tok, '.', (size_t)tlen);
-          int64_t slen = dot ? (int64_t)(dot - tok) : tlen;
-          int32_t kid_len = 0;
-          r->fams[i] = (int8_t)cap_tel::classify(
-              h->tel, tok, slen,
-              (uint8_t*)&r->kids[i * cap_tel::KID_LEN], &kid_len);
-        }
-      }
-      int64_t ntok = r->kind == K_VERIFY ? (int64_t)nent : 1;
-      if (r->kind == K_VERIFY) h->ctr[CTR_TOKENS].fetch_add(nent);
-      if (!push_req(h, r, ntok)) {
-        delete r;
-        break;
-      }
-    } else {
-      // valid frame, wrong direction (a response type at the server):
-      // protocol violation → drop the connection, same as Python.
+    } else if (!handle_frame(c, base, p)) {
       break;
     }
     start += (size_t)p.consumed;
@@ -622,15 +819,21 @@ static void reader_main(std::shared_ptr<Conn> c) {
     c->reader_done = true;
     c->cv.notify_all();
   }
-  // NOTHING may touch the Handle after the finished publish below:
-  // cap_serve_destroy frees it as soon as every conn shows 2 (the
-  // closed-conn counter is maintained by sweep_conns instead).
-  if (c->finished.fetch_add(1) + 1 == 2) ::close(c->fd);
+  finish_conn(c);
 }
 
 // ---------------------------------------------------------------------------
 // writer thread: strict seq-order sends, discards once the peer broke
 // ---------------------------------------------------------------------------
+
+// write_record abort hook: give up when the worker is shutting down
+// or the client is gone (a dead client stops consuming the response
+// ring — blocking forever would wedge the writer thread).
+static bool shm_write_abort(void* ctx) {
+  Conn* c = (Conn*)ctx;
+  return c->h->stop.load(std::memory_order_relaxed) ||
+         c->peer_gone.load(std::memory_order_relaxed);
+}
 
 static void writer_main(std::shared_ptr<Conn> c) {
   Handle* h = c->h;
@@ -638,12 +841,25 @@ static void writer_main(std::shared_ptr<Conn> c) {
   for (;;) {
     auto it = c->outq.find(c->next_send);
     if (it != c->outq.end()) {
+      int64_t seq = c->next_send;
       std::string data = std::move(it->second);
       c->outq.erase(it);
       c->next_send++;
       bool dead = c->dead;
+      bool to_shm = c->shm_region != nullptr && seq >= c->shm_from_seq;
       lk.unlock();
-      if (!dead && !send_all(c->fd, data)) {
+      bool sent;
+      if (dead) {
+        sent = true;  // discarding
+      } else if (to_shm) {
+        sent = cap_shm::write_record(
+                   c->shm_region, cap_shm::RING_RESP,
+                   (const uint8_t*)data.data(), data.size(),
+                   shm_write_abort, c.get()) == 0;
+      } else {
+        sent = send_all(c->fd, data);
+      }
+      if (!sent) {
         // Broken mid-response: wake the reader out of recv, then keep
         // DRAINING queued entries so in-flight posts never pile up.
         ::shutdown(c->fd, SHUT_RDWR);
@@ -661,7 +877,7 @@ static void writer_main(std::shared_ptr<Conn> c) {
   }
   lk.unlock();
   (void)h;
-  if (c->finished.fetch_add(1) + 1 == 2) ::close(c->fd);
+  finish_conn(c);
 }
 
 // remove fully-finished connections (both threads exited → every
@@ -676,19 +892,6 @@ static void sweep_conns(Handle* h) {
       ++it;
     }
   }
-}
-
-// ---------------------------------------------------------------------------
-// response encoding (mirrors protocol.send_response / _with_crc)
-// ---------------------------------------------------------------------------
-
-static void put_u32(std::string& s, uint32_t v) {
-  s.append((const char*)&v, 4);
-}
-
-static void append_crc(std::string& s) {
-  uint32_t crc = crc32_update(0, (const uint8_t*)s.data(), s.size());
-  put_u32(s, crc);
 }
 
 }  // namespace serve_native
@@ -1009,6 +1212,13 @@ void cap_serve_set_digests(void* hv, int32_t on) {
   ((Handle*)hv)->digests_on.store(on, std::memory_order_relaxed);
 }
 
+// Arm (or disarm) the shm transport: attach requests (CVB1 type 15)
+// are honored when on; off acks them status 1 (socket keeps serving)
+// and counts CTR_SHM_FALLBACKS.
+void cap_serve_set_shm(void* hv, int32_t on) {
+  ((Handle*)hv)->shm_on.store(on, std::memory_order_relaxed);
+}
+
 // Per-token sha256[:16] digests of the LAST cap_serve_drain call,
 // token-aligned with its tok_off ordering (zero rows = compute in
 // Python). Single-consumer, like cap_serve_drain_aux.
@@ -1124,24 +1334,41 @@ struct DriveShared {
   std::atomic<int32_t> errors{0};
 };
 
+// port >= 0 → TCP host:port; port < 0 → host is a UDS path (the
+// bench_stages transport column's uds arm).
 static void drive_one(const char* host, int32_t port, const uint8_t* blob,
                       const int64_t* offs, int32_t n_tokens,
                       int32_t req_tokens, int32_t depth, double seconds,
                       uint32_t seed, DriveShared* sh) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) { sh->errors.fetch_add(1); return; }
-  struct sockaddr_in addr;
-  std::memset(&addr, 0, sizeof(addr));
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons((uint16_t)port);
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
-      ::connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
-    ::close(fd);
-    sh->errors.fetch_add(1);
-    return;
+  int fd;
+  if (port >= 0) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) { sh->errors.fetch_add(1); return; }
+    struct sockaddr_in addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+        ::connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+      ::close(fd);
+      sh->errors.fetch_add(1);
+      return;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  } else {
+    fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) { sh->errors.fetch_add(1); return; }
+    struct sockaddr_un addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    std::strncpy(addr.sun_path, host, sizeof(addr.sun_path) - 1);
+    if (::connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+      ::close(fd);
+      sh->errors.fetch_add(1);
+      return;
+    }
   }
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   // pre-encode a handful of distinct request frames, reused round-robin
   std::vector<std::string> frames;
   uint32_t rng = seed * 2654435761u + 12345u;

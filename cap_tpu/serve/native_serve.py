@@ -65,6 +65,14 @@ _TEL_SYMBOLS = ("cap_tel_layout", "cap_tel_create", "cap_tel_destroy",
 # riding the reader threads' sha256 (serve.native.digest_fallbacks).
 _VC_SYMBOLS = ("cap_serve_set_digests", "cap_serve_drain_digests")
 
+# Shared-memory transport symbols (shm_ring.cpp) are OPTIONAL the
+# same way: a stale .so still serves sockets; an shm-transport request
+# then degrades with a serve.shm_fallbacks count and attach frames
+# get refused (or, truly stale, dropped — the clients redial).
+_SHM_SYMBOLS = ("cap_serve_set_shm", "cap_shm_create", "cap_shm_open",
+                "cap_shm_close", "cap_shm_probe", "cap_shm_write",
+                "cap_shm_read", "cap_shm_drive")
+
 # exemplar record stride (telemetry_native.h EX_STRIDE)
 _EX_STRIDE = 88
 _KID_LEN = 12
@@ -79,6 +87,11 @@ CTR_PROTO_ERR = 3
 CTR_PONGS = 4
 CTR_DROPPED_POSTS = 5
 CTR_CONNS_CLOSED = 6
+CTR_SHM_ATTACHES = 7
+CTR_SHM_FALLBACKS = 8
+CTR_SHM_FRAMES = 9
+CTR_SHM_STALE_GEN = 10
+CTR_SHM_DETACHES = 11
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i8p = ctypes.POINTER(ctypes.c_int8)
@@ -139,8 +152,38 @@ def load() -> ctypes.CDLL:
             ctypes.c_int32, _i64p, _i64p]
         lib.cap_tel_ok = _setup_tel(lib)
         lib.cap_vc_ok = _setup_vc(lib)
+        lib.cap_shm_ok = _setup_shm(lib)
         _lib = lib
         return lib
+
+
+def _setup_shm(lib: ctypes.CDLL) -> bool:
+    """Type the shm-transport symbols; False (socket-only serving,
+    attach requests refused) on a stale .so."""
+    if not all(hasattr(lib, s) for s in _SHM_SYMBOLS):
+        return False
+    lib.cap_serve_set_shm.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.cap_shm_create.restype = ctypes.c_void_p
+    lib.cap_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.c_int64, ctypes.c_int32]
+    lib.cap_shm_open.restype = ctypes.c_void_p
+    lib.cap_shm_open.argtypes = [ctypes.c_char_p]
+    lib.cap_shm_close.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.cap_shm_probe.restype = ctypes.c_int32
+    lib.cap_shm_probe.argtypes = [ctypes.c_char_p]
+    lib.cap_shm_write.restype = ctypes.c_int64
+    lib.cap_shm_write.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                  _u8p, ctypes.c_int64,
+                                  ctypes.c_double]
+    lib.cap_shm_read.restype = ctypes.c_int64
+    lib.cap_shm_read.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                 _u8p, ctypes.c_int64, ctypes.c_double]
+    lib.cap_shm_drive.restype = ctypes.c_int32
+    lib.cap_shm_drive.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p, _u8p, _i64p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_int32, ctypes.c_int64, _i64p, _i64p]
+    return True
 
 
 def _setup_vc(lib: ctypes.CDLL) -> bool:
@@ -482,7 +525,8 @@ class NativeServeChain:
                  keys_fn: Callable[[dict, Any], int],
                  peer_fill_fn: Optional[Callable[[dict], dict]] = None,
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
-                 max_batch: int = 32768, vcache=None):
+                 max_batch: int = 32768, vcache=None,
+                 shm: bool = False):
         self._lib = load()
         self._batcher = batcher
         self._stats_fn = stats_fn
@@ -493,6 +537,16 @@ class NativeServeChain:
             4096, 4 * max_batch))
         if not self._h:
             raise ImportError("cap_serve_create failed")
+        # Shared-memory transport: arm attach negotiation in the C++
+        # readers when requested AND the library carries the shm TU; a
+        # stale .so degrades to socket-only serving with a counted
+        # fallback (the clients negotiate the same degradation).
+        self.shm_armed = False
+        if shm and getattr(self._lib, "cap_shm_ok", False):
+            self._lib.cap_serve_set_shm(self._h, 1)
+            self.shm_armed = True
+        elif shm:
+            telemetry.count("serve.shm_fallbacks")
         # Verdict cache (the worker's instance — one cache serves both
         # chains, so the worker's apply_keys invalidation hook covers
         # this chain too). When the library carries the digest symbols
@@ -601,7 +655,7 @@ class NativeServeChain:
 
     def _read_counters(self, h) -> dict:
         c = self._lib.cap_serve_counter
-        return {
+        out = {
             "serve.native.connections": int(c(h, CTR_CONNS)),
             "serve.native.frames": int(c(h, CTR_FRAMES)),
             "serve.native.tokens": int(c(h, CTR_TOKENS)),
@@ -609,6 +663,15 @@ class NativeServeChain:
             "serve.native.pongs": int(c(h, CTR_PONGS)),
             "serve.native.dropped_posts": int(c(h, CTR_DROPPED_POSTS)),
         }
+        if getattr(self._lib, "cap_shm_ok", False):
+            # shm-transport slots exist in this .so (additive; a stale
+            # library would return -1 for them)
+            out["serve.shm.attaches"] = int(c(h, CTR_SHM_ATTACHES))
+            out["serve.shm_fallbacks"] = int(c(h, CTR_SHM_FALLBACKS))
+            out["serve.shm.frames"] = int(c(h, CTR_SHM_FRAMES))
+            out["serve.shm.stale_gen"] = int(c(h, CTR_SHM_STALE_GEN))
+            out["serve.shm.detaches"] = int(c(h, CTR_SHM_DETACHES))
+        return out
 
     # -- drain loop --------------------------------------------------------
 

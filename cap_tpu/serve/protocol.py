@@ -15,7 +15,9 @@ Frame layout (all integers little-endian):
                   11 = keys push (keyplane),
                   12 = keys ack (keyplane),
                   13 = peer fill (verdict-cache warming),
-                  14 = peer fill ack
+                  14 = peer fill ack,
+                  15 = shm attach (shared-memory transport),
+                  16 = shm attach ack
     count   u32   number of entries
     trace-context (types 9/10 only, between header and entries):
       ctx_len u8   length of the trace-context field (1..64)
@@ -83,6 +85,29 @@ Secrets stance for 13/14: digests are one-way hashes and payloads are
 the claims JSON a verify response would carry anyway — no token ever
 crosses in either direction, and error strings stay class+message.
 
+Types 15/16 negotiate the ZERO-COPY shared-memory transport (docs/
+SERVE.md §Transports), ADDITIVE exactly like the KEYS pair (types
+1-14 keep their bytes — the golden vectors pin them):
+
+- **shm attach (15)**: checksummed, exactly ONE request-shaped entry
+  whose payload is the canonical JSON ``{"op": "attach", "path":
+  <region file>, "version": 1}``. The CLIENT creates and maps the
+  region file (header + request ring + response ring — layout in
+  cap_tpu/serve/shm_ring.py, mirrored by runtime/native/shm_ring.h);
+  the worker maps the same file and, from the next frame on, consumes
+  requests from the request ring and posts responses into the
+  response ring. The socket stays open as the LIVENESS channel only.
+- **shm attach ack (16)**: checksummed, exactly ONE response-shaped
+  entry, sent over the SOCKET (the client confirms the switch before
+  producing): status 0 + ``{"transport":"shm"}`` when the worker
+  mapped the region, status 1 + an error string when the transport is
+  off or the region is unusable — the connection then keeps serving
+  over the socket unchanged (``serve.shm_fallbacks``), which is the
+  whole fallback contract: a client NEVER loses a connection to a
+  refused attach. Workers whose library predates the pair drop the
+  connection on the unknown type instead; clients treat that exactly
+  like a refusal and redial socket-only.
+
 Types 9/10 are the TRACED variant of 7/8: same checksummed envelope
 plus one additive trace-context field between the header and the
 entries, so a request's 16-hex trace id crosses the process boundary
@@ -133,6 +158,8 @@ T_KEYS_PUSH = 11
 T_KEYS_ACK = 12
 T_PEER_FILL = 13
 T_PEER_ACK = 14
+T_SHM_ATTACH = 15
+T_SHM_ACK = 16
 
 _HDR = struct.Struct("<IBI")
 
@@ -363,6 +390,48 @@ def send_peer_ack(sock: socket.socket,
     sock.sendall(encode_peer_ack(doc=doc, error=error))
 
 
+def shm_attach_payload(path: str) -> bytes:
+    """Canonical shm-attach payload bytes (sorted keys + compact
+    separators — one request, one wire encoding, exactly like
+    :func:`keys_payload`). The native driver and the Go client build
+    the same string by hand; this function is the reference."""
+    return json.dumps({"op": "attach", "path": path, "version": 1},
+                      separators=(",", ":"), sort_keys=True).encode()
+
+
+def send_shm_attach(sock: socket.socket, path: str) -> None:
+    """Checksummed shm-attach frame (type 15): one entry, the region
+    path JSON. The region file must already exist and carry a valid
+    header — the worker maps it before acking."""
+    payload = shm_attach_payload(path)
+    if len(payload) > MAX_ENTRY_BYTES:
+        raise FrameTooLargeError(
+            f"shm-attach payload {len(payload)} bytes exceeds entry "
+            "bound")
+    parts = [_HDR.pack(MAGIC, T_SHM_ATTACH, 1),
+             _LEN_U32.pack(len(payload)), payload]
+    sock.sendall(b"".join(_with_crc(parts)))
+
+
+def encode_shm_ack(error: Optional[str] = None) -> bytes:
+    """Encoded checksummed shm ack (type 16): status 0 +
+    {"transport":"shm"} when the worker mapped the region, status 1 +
+    error string otherwise. Shared by the socket sender and the native
+    chain (serve_native.cpp shm_ack_frame mirrors it byte-for-byte)."""
+    if error is None:
+        status, payload = 0, b'{"transport":"shm"}'
+    else:
+        status, payload = 1, error.encode()
+    parts = [_HDR.pack(MAGIC, T_SHM_ACK, 1),
+             _LEN_BU32.pack(status, len(payload)), payload]
+    return b"".join(_with_crc(parts))
+
+
+def send_shm_ack(sock: socket.socket,
+                 error: Optional[str] = None) -> None:
+    sock.sendall(encode_shm_ack(error=error))
+
+
 def recv_frame(sock: socket.socket) -> Tuple[int, List[Any]]:
     """Read one frame → (type, entries), exact reads (no buffering).
 
@@ -406,9 +475,9 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
     checksummed = ftype in (T_VERIFY_REQ_CRC, T_VERIFY_RESP_CRC,
                             T_VERIFY_REQ_TRACE, T_VERIFY_RESP_TRACE,
                             T_KEYS_PUSH, T_KEYS_ACK, T_PEER_FILL,
-                            T_PEER_ACK)
-    if ftype in (T_KEYS_PUSH, T_KEYS_ACK, T_PEER_FILL, T_PEER_ACK) \
-            and count != 1:
+                            T_PEER_ACK, T_SHM_ATTACH, T_SHM_ACK)
+    if ftype in (T_KEYS_PUSH, T_KEYS_ACK, T_PEER_FILL, T_PEER_ACK,
+                 T_SHM_ATTACH, T_SHM_ACK) and count != 1:
         raise MalformedFrameError(
             f"type-{ftype} control frame must carry exactly one "
             f"entry, got {count}")
@@ -434,7 +503,7 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
     u32 = _LEN_U32.unpack
     bu32 = _LEN_BU32.unpack
     if ftype in (T_VERIFY_REQ, T_VERIFY_REQ_CRC, T_VERIFY_REQ_TRACE,
-                 T_KEYS_PUSH, T_PEER_FILL):
+                 T_KEYS_PUSH, T_PEER_FILL, T_SHM_ATTACH):
         for _ in range(count):
             (ln,) = u32(take(4))
             total += ln
@@ -443,7 +512,7 @@ def _parse_frame(take) -> Tuple[int, List[Any], Optional[str]]:
             entries.append(take(ln))
     elif ftype in (T_VERIFY_RESP, T_VERIFY_RESP_CRC,
                    T_VERIFY_RESP_TRACE, T_STATS_RESP, T_KEYS_ACK,
-                   T_PEER_ACK):
+                   T_PEER_ACK, T_SHM_ACK):
         for _ in range(count):
             status, ln = bu32(take(5))
             if not checksummed and status not in (0, 1):

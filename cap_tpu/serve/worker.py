@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 from .. import telemetry
 from ..obs import decision as _decision
 from . import protocol
+from . import shm_ring as _shm
 from . import vcache as _vcache
 from .batcher import AdaptiveBatcher
 
@@ -97,7 +98,20 @@ class VerifyWorker:
                  obs_port: Optional[int] = None,
                  serve_native: Optional[bool] = None,
                  vcache: Optional[bool] = None,
-                 vcache_capacity: int = 0):
+                 vcache_capacity: int = 0,
+                 transport: Optional[str] = None):
+        # Transport capability (docs/SERVE.md §Transports): "shm"
+        # accepts per-connection shared-memory attach negotiations
+        # (CVB1 type 15) on BOTH serve chains; "socket" (default) acks
+        # them status 1 — the connection keeps serving over the socket
+        # and serve.shm_fallbacks counts the refusal. The worker
+        # always serves the socket either way; shm is negotiated per
+        # connection, never assumed.
+        if transport is None:
+            transport = os.environ.get("CAP_SERVE_TRANSPORT", "socket")
+        if transport not in ("socket", "shm"):
+            raise ValueError(f"unknown transport {transport!r}")
+        self._shm_enabled = transport == "shm"
         # The unwrapped engine: keyplane operations (KEYS pushes,
         # epoch reporting) address it directly, whatever raw-claims
         # wrapper the batcher ends up routed through.
@@ -152,7 +166,8 @@ class VerifyWorker:
                     peer_fill_fn=self.peer_fill,
                     target_batch=target_batch,
                     max_wait_ms=max_wait_ms, max_batch=max_batch,
-                    vcache=self._vcache)
+                    vcache=self._vcache,
+                    shm=self._shm_enabled)
             except Exception:  # noqa: BLE001 - fall back, visibly
                 telemetry.count("serve.native_fallbacks")
                 self._native = None
@@ -208,6 +223,18 @@ class VerifyWorker:
         """Which serve chain this worker runs: "native" (C++ frame I/O
         + lock-free ring) or "python" (reader/responder threads)."""
         return "native" if self._native is not None else "python"
+
+    @property
+    def transport(self) -> str:
+        """Transport capability actually live: "shm" when this worker
+        honors shared-memory attach negotiations, "socket" otherwise
+        (including the stale-library fallback on the native chain —
+        the ready line reports what RUNS, not what was asked)."""
+        if not self._shm_enabled:
+            return "socket"
+        if self._native is not None and not self._native.shm_armed:
+            return "socket"
+        return "shm"
 
     def apply_keys(self, jwks_doc: dict, epoch) -> int:
         """Apply one keyplane KEYS push; returns the installed epoch.
@@ -265,7 +292,11 @@ class VerifyWorker:
                "worker.pid": os.getpid(),
                # 1.0 when the native chain serves this worker — the
                # numeric form capstat renders as chain=native
-               "serve.native.active": 1.0 if self._native else 0.0}
+               "serve.native.active": 1.0 if self._native else 0.0,
+               # 1.0 when shm attach negotiation is live — capstat
+               # renders it as tr=shm
+               "serve.shm.active": 1.0 if self.transport == "shm"
+               else 0.0}
         if self._native is not None:
             out["serve.native.ring_depth"] = float(
                 self._native.ring_depth())
@@ -328,6 +359,7 @@ class VerifyWorker:
             **self._batcher.depth(),
             "key_epoch": self.key_epoch,
             "serve_chain": self.serve_chain,
+            "transport": self.transport,
             **({"ring_depth": self._native.ring_depth()}
                if self._native is not None else {}),
             "obs_port": obs[1] if obs is not None else None,
@@ -427,69 +459,184 @@ class VerifyWorker:
                     # exception escape the thread as stderr noise.
                     telemetry.count("worker.protocol_errors")
                     return
-                if ftype == protocol.T_PING:
-                    respq.put(("pong", None, None))
-                    continue
-                if ftype == protocol.T_STATS_REQ:
-                    respq.put(("stats", None, None))
-                    continue
-                if ftype == protocol.T_KEYS_PUSH:
-                    # Applied HERE, in the reader thread (the pool
-                    # pushes on a dedicated connection): the table
-                    # build blocks only this connection, and by frame
-                    # order every verify request read AFTER the push
-                    # dispatches on the new epoch. The ack rides the
-                    # responder queue so in-order delivery holds.
-                    import json as _json
-
-                    try:
-                        doc = _json.loads(entries[0])
-                        got = self.apply_keys(doc.get("jwks") or {},
-                                              doc.get("epoch"))
-                        respq.put(("keys_ack", got, None))
-                    except Exception as e:  # noqa: BLE001 - acked
-                        telemetry.count("worker.keys_push_errors")
-                        respq.put(("keys_err",
-                                   f"{type(e).__name__}: {e}", None))
-                    continue
-                if ftype == protocol.T_PEER_FILL:
-                    # Same in-order stance as KEYS pushes: applied in
-                    # the reader thread, acked through the responder
-                    # queue — a verify read after an import sees the
-                    # warmed cache.
-                    import json as _json
-
-                    try:
-                        doc = self.peer_fill(_json.loads(entries[0]))
-                        respq.put(("peer_ack", doc, None))
-                    except Exception as e:  # noqa: BLE001 - acked
-                        telemetry.count("worker.peer_fill_errors")
-                        respq.put(("peer_err",
-                                   f"{type(e).__name__}: {e}", None))
-                    continue
-                if ftype not in (protocol.T_VERIFY_REQ,
-                                 protocol.T_VERIFY_REQ_CRC,
-                                 protocol.T_VERIFY_REQ_TRACE):
+                if ftype == protocol.T_SHM_ATTACH:
+                    # Transport negotiation: map the client's region
+                    # and swap this connection's frame source to its
+                    # request ring (responses follow through the
+                    # responder's sink switch). Anything unsupported
+                    # acks status 1 and the SOCKET keeps serving —
+                    # the attach can never cost the client its
+                    # connection.
+                    shm_state = self._shm_attach(entries, respq)
+                    if shm_state is None:
+                        continue
+                    region, consumer = shm_state
+                    self._serve_shm_conn(conn, respq, region, consumer)
+                    return
+                if not self._dispatch_frame(ftype, entries, trace,
+                                            respq, t_recv):
                     return  # protocol violation → drop the connection
-                telemetry.count("worker.requests")
-                telemetry.count("worker.tokens", len(entries))
-                # A checksummed request gets a checksummed response, a
-                # traced one a traced response echoing its trace id —
-                # the fleet router's end-to-end integrity envelope.
-                if ftype == protocol.T_VERIFY_REQ_TRACE:
-                    pending = self._cached_submit(entries, trace=trace)
-                    telemetry.trace_span(
-                        trace, telemetry.SPAN_WORKER_DEQUEUE, t_recv,
-                        time.time() - t_recv)
-                    respq.put(("batch_trace", pending, trace))
-                    continue
-                crc = ftype == protocol.T_VERIFY_REQ_CRC
-                respq.put(("batch_crc" if crc else "batch",
-                           self._cached_submit(entries), None))
         finally:
             respq.put(None)
             try:
                 conn.close()
+            except OSError:
+                pass
+
+    def _dispatch_frame(self, ftype, entries, trace, respq,
+                        t_recv) -> bool:
+        """Handle one parsed frame (both transports feed this): queue
+        the response kind in order; False = protocol violation."""
+        if ftype == protocol.T_PING:
+            respq.put(("pong", None, None))
+            return True
+        if ftype == protocol.T_STATS_REQ:
+            respq.put(("stats", None, None))
+            return True
+        if ftype == protocol.T_KEYS_PUSH:
+            # Applied HERE, in the reader thread (the pool pushes on a
+            # dedicated connection): the table build blocks only this
+            # connection, and by frame order every verify request read
+            # AFTER the push dispatches on the new epoch. The ack
+            # rides the responder queue so in-order delivery holds.
+            import json as _json
+
+            try:
+                doc = _json.loads(entries[0])
+                got = self.apply_keys(doc.get("jwks") or {},
+                                      doc.get("epoch"))
+                respq.put(("keys_ack", got, None))
+            except Exception as e:  # noqa: BLE001 - acked
+                telemetry.count("worker.keys_push_errors")
+                respq.put(("keys_err",
+                           f"{type(e).__name__}: {e}", None))
+            return True
+        if ftype == protocol.T_PEER_FILL:
+            # Same in-order stance as KEYS pushes: applied in the
+            # reader thread, acked through the responder queue — a
+            # verify read after an import sees the warmed cache.
+            import json as _json
+
+            try:
+                doc = self.peer_fill(_json.loads(entries[0]))
+                respq.put(("peer_ack", doc, None))
+            except Exception as e:  # noqa: BLE001 - acked
+                telemetry.count("worker.peer_fill_errors")
+                respq.put(("peer_err",
+                           f"{type(e).__name__}: {e}", None))
+            return True
+        if ftype not in (protocol.T_VERIFY_REQ,
+                         protocol.T_VERIFY_REQ_CRC,
+                         protocol.T_VERIFY_REQ_TRACE):
+            return False
+        telemetry.count("worker.requests")
+        telemetry.count("worker.tokens", len(entries))
+        # A checksummed request gets a checksummed response, a traced
+        # one a traced response echoing its trace id — the fleet
+        # router's end-to-end integrity envelope.
+        if ftype == protocol.T_VERIFY_REQ_TRACE:
+            pending = self._cached_submit(entries, trace=trace)
+            telemetry.trace_span(
+                trace, telemetry.SPAN_WORKER_DEQUEUE, t_recv,
+                time.time() - t_recv)
+            respq.put(("batch_trace", pending, trace))
+            return True
+        crc = ftype == protocol.T_VERIFY_REQ_CRC
+        respq.put(("batch_crc" if crc else "batch",
+                   self._cached_submit(entries), None))
+        return True
+
+    def _shm_attach(self, entries, respq):
+        """Negotiate one shm attach: returns (region, consumer) on
+        success (ack queued), None on a status-1 refusal (socket keeps
+        serving)."""
+        import json as _json
+
+        with telemetry.span(telemetry.SPAN_SHM_ATTACH):
+            try:
+                if not self._shm_enabled:
+                    raise TypeError("worker has no shm transport "
+                                    "(transport=socket)")
+                doc = _json.loads(entries[0])
+                if doc.get("op") != "attach" \
+                        or doc.get("version") != 1:
+                    raise ValueError(
+                        f"unsupported attach op/version: "
+                        f"{doc.get('op')!r}/{doc.get('version')!r}")
+                region = _shm.ShmRegion.open(str(doc.get("path")))
+            except Exception as e:  # noqa: BLE001 - acked, never fatal
+                telemetry.count("serve.shm_fallbacks")
+                respq.put(("shm_err",
+                           f"{type(e).__name__}: {e}", None))
+                return None
+            telemetry.count("serve.shm.attaches")
+            # short write timeout: a client killed mid-read stops
+            # consuming the response ring; the responder must give up
+            # and discard, not wedge for the default 30s per frame
+            producer = _shm.RingProducer(region, "resp", timeout=5.0)
+            consumer = _shm.RingConsumer(region, "req")
+            # the ack rides the SOCKET; every later response rides the
+            # ring (the responder switches sinks on this marker)
+            respq.put(("shm_ack", producer, None))
+            return region, consumer
+
+    def _serve_shm_conn(self, conn, respq, region, consumer) -> None:
+        """Serve one attached connection from its request ring; the
+        socket is polled as the liveness channel only. A poisoned ring
+        (overrun / stale generation / malformed frame) detaches, the
+        worker survives — the shm analog of dropping a bad socket."""
+        import select
+
+        try:
+            while True:
+                try:
+                    rec = consumer.read(timeout=0.05)
+                except _shm.StaleGenerationError:
+                    telemetry.count("serve.shm.stale_gen")
+                    telemetry.count("worker.protocol_errors")
+                    return
+                except (protocol.ProtocolError, ValueError):
+                    telemetry.count("worker.protocol_errors")
+                    return
+                if rec is None:
+                    if self._closed:
+                        return
+                    try:
+                        readable, _, _ = select.select([conn], [], [], 0)
+                        if readable:
+                            if conn.recv(4096) == b"":
+                                return       # EOF: client gone
+                            # bytes on the socket after the attach:
+                            # protocol violation
+                            telemetry.count("worker.protocol_errors")
+                            return
+                    except (OSError, ValueError):
+                        return
+                    continue
+                t_recv = time.time()
+                try:
+                    ftype, entries, trace, used = \
+                        protocol.parse_frame_bytes(rec)
+                    if used != len(rec):
+                        raise protocol.MalformedFrameError(
+                            "shm record carries trailing bytes")
+                except (protocol.ProtocolError, UnicodeDecodeError,
+                        ConnectionError):
+                    telemetry.count("worker.protocol_errors")
+                    return
+                telemetry.count("serve.shm.frames")
+                if not self._dispatch_frame(ftype, entries, trace,
+                                            respq, t_recv):
+                    return
+        finally:
+            telemetry.count("serve.shm.detaches")
+            # the worker is the reliable janitor: unlink reclaims the
+            # file even after the client died to kill -9 (its own
+            # mapping dies with it); the responder may still hold the
+            # mmap through its producer — close(unlink) only unlinks
+            # the name, the mapping stays valid until close
+            try:
+                os.unlink(region.path)
             except OSError:
                 pass
 
@@ -519,6 +666,13 @@ class VerifyWorker:
 
     def _respond_loop(self, conn: socket.socket, respq) -> None:
         broken = False
+        # Responses go to `sink`: the socket, until an shm attach
+        # swaps in the region's response-ring producer (which
+        # duck-types sendall — every protocol.send_* call emits one
+        # complete frame in one sendall). The attach ACK itself still
+        # rides the socket, so the client confirms the switch before
+        # it starts reading the ring.
+        sink = conn
         while True:
             item = respq.get()
             if item is None:
@@ -528,20 +682,25 @@ class VerifyWorker:
             kind, pending, trace = item
             try:
                 if kind == "pong":
-                    protocol.send_pong(conn)
+                    protocol.send_pong(sink)
+                elif kind == "shm_ack":
+                    protocol.send_shm_ack(conn)
+                    sink = pending    # the RingProducer
+                elif kind == "shm_err":
+                    protocol.send_shm_ack(conn, error=pending)
                 elif kind == "keys_ack":
-                    protocol.send_keys_ack(conn, epoch=pending)
+                    protocol.send_keys_ack(sink, epoch=pending)
                 elif kind == "keys_err":
-                    protocol.send_keys_ack(conn, error=pending)
+                    protocol.send_keys_ack(sink, error=pending)
                 elif kind == "peer_ack":
-                    protocol.send_peer_ack(conn, doc=pending)
+                    protocol.send_peer_ack(sink, doc=pending)
                 elif kind == "peer_err":
-                    protocol.send_peer_ack(conn, error=pending)
+                    protocol.send_peer_ack(sink, error=pending)
                 elif kind == "stats":
                     # Snapshot at RESPOND time (in-order with verifies
                     # on this connection, so a stats probe sent after a
                     # batch reflects that batch's accounting).
-                    protocol.send_stats_response(conn, self.stats())
+                    protocol.send_stats_response(sink, self.stats())
                 else:
                     pending.event.wait()
                     # Serve-surface decision records: every verdict that
@@ -551,14 +710,16 @@ class VerifyWorker:
                         "serve", pending.results, tokens=pending.tokens,
                         latency_s=time.monotonic() - pending.ts,
                         trace=trace)
-                    protocol.send_response(conn, pending.results,
+                    protocol.send_response(sink, pending.results,
                                            crc=kind == "batch_crc",
                                            trace=trace)
-            except (ConnectionError, OSError):
-                # Connection broke mid-response: close it so the reader
-                # unblocks out of recv, then keep DRAINING until the
-                # reader's final None — exiting early would leave the
-                # reader wedged in a full-queue put().
+            except (ConnectionError, OSError, TimeoutError,
+                    protocol.ProtocolError):
+                # Connection broke mid-response (socket) or the peer
+                # stopped consuming the response ring (shm): close the
+                # socket so the reader unblocks, then keep DRAINING
+                # until the reader's final None — exiting early would
+                # leave the reader wedged in a full-queue put().
                 broken = True
                 try:
                     conn.close()

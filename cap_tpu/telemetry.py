@@ -70,6 +70,7 @@ SPAN_BATCHER_COLLECT = "batcher.collect"    # async device drain
 SPAN_KEYPLANE_SWAP = "keyplane.swap"        # key-table build + hot swap
 SPAN_NATIVE_DRAIN = "serve.native.drain"    # ring drain -> batcher submit
 SPAN_NATIVE_POST = "serve.native.post"      # verdicts -> native writers
+SPAN_SHM_ATTACH = "serve.shm.attach"        # shm region map + negotiate
 SPAN_OIDC_VALIDATE = "oidc.claims_validate"  # raw-batch claims rules
 SPAN_ENGINE_PREFIX = "dispatch."            # dispatch.<family>.<detail>
 
@@ -78,7 +79,8 @@ SPAN_NAMES = frozenset({
     SPAN_ROUTER_BACKOFF, SPAN_ROUTER_FALLBACK, SPAN_WORKER_DEQUEUE,
     SPAN_BATCHER_FILL, SPAN_BATCHER_FLUSH, SPAN_BATCHER_DISPATCH,
     SPAN_BATCHER_COLLECT, SPAN_KEYPLANE_SWAP, SPAN_NATIVE_DRAIN,
-    SPAN_NATIVE_POST, SPAN_OIDC_VALIDATE, SPAN_FRONTDOOR_ROUTE,
+    SPAN_NATIVE_POST, SPAN_SHM_ATTACH, SPAN_OIDC_VALIDATE,
+    SPAN_FRONTDOOR_ROUTE,
 })
 
 # ---------------------------------------------------------------------------

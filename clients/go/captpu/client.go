@@ -1,0 +1,626 @@
+package captpu
+
+// Production-grade pooled client: cap's KeySet seam over a fleet of
+// cap_tpu verify workers, mirroring the Python FleetClient's
+// availability contract — per-attempt deadlines, endpoint rotation,
+// hedged retry on a healthy peer, and a terminal pure-Go fallback
+// (never wrong, at worst slow). Underneath, each connection
+// negotiates the zero-copy shared-memory transport (CVB1 type 15)
+// when Options.Transport allows and silently keeps the socket when
+// the worker refuses or predates it.
+
+import (
+	"bufio"
+	"context"
+	"encoding/json"
+	"errors"
+	"fmt"
+	"math/rand"
+	"net"
+	"os"
+	"strings"
+	"sync"
+	"sync/atomic"
+	"time"
+)
+
+// Options configures Client. The zero value of every field gets a
+// production default in NewClient.
+type Options struct {
+	// Addrs lists worker endpoints: "host:port" (TCP) or
+	// "unix:///path/to.sock". At least one is required.
+	Addrs []string
+
+	// PoolSize is the number of pooled connections per endpoint
+	// (default 2). Calls beyond the pool dial extra connections that
+	// are discarded when the pool is full — the worker's batcher
+	// coalesces concurrent callers regardless.
+	PoolSize int
+
+	// CRC selects the checksummed frame pair (types 7/8): byte
+	// corruption anywhere on the path surfaces as ErrCorrupt instead
+	// of a wrong verdict. The fleet router always sets this.
+	CRC bool
+
+	// Transport: "auto" (default — negotiate shm, fall back to the
+	// socket), "socket" (never negotiate), or "shm" (negotiate and
+	// FAIL dial when refused; for tests and benchmarks that must not
+	// silently measure the wrong transport).
+	Transport string
+
+	// ShmDir is where per-connection region files live (default
+	// CAP_SHM_DIR, then /dev/shm when present, then os.TempDir()).
+	ShmDir string
+
+	// RingBytes sizes each ring (request and response; default 1 MiB,
+	// rounded up to a power of two). The largest single frame a ring
+	// carries is RingBytes/2.
+	RingBytes int
+
+	// AttemptTimeout bounds ONE wire exchange on one worker (default
+	// 5s). DialTimeout bounds connection establishment (default 10s).
+	AttemptTimeout time.Duration
+	DialTimeout    time.Duration
+
+	// HedgeAfter launches a duplicate attempt on the next endpoint
+	// when the primary has not answered yet (default 250ms; negative
+	// disables; needs >= 2 endpoints). First success wins — the
+	// FleetClient hedge contract.
+	HedgeAfter time.Duration
+
+	// Retries is the number of extra full endpoint rounds after the
+	// first (default 2), with Backoff sleep between rounds (default
+	// 50ms, doubled per round, ±50% jitter).
+	Retries int
+	Backoff time.Duration
+
+	// Fallback, when set, is the terminal availability tier: if every
+	// endpoint round fails, tokens are verified through it one by one
+	// (e.g. the pure-Go reference library wrapped as a KeySet).
+	Fallback KeySet
+}
+
+type endpoint struct{ network, addr string }
+
+func parseAddr(a string) endpoint {
+	if strings.HasPrefix(a, "unix://") {
+		return endpoint{"unix", strings.TrimPrefix(a, "unix://")}
+	}
+	return endpoint{"tcp", a}
+}
+
+// wireConn is one connection: the socket plus, when negotiated, its
+// shm region. Owned by one goroutine at a time (the pool enforces it).
+type wireConn struct {
+	nc        net.Conn
+	br        *bufio.Reader
+	shm       *shmRegion
+	transport string
+}
+
+func (w *wireConn) close() {
+	w.nc.Close()
+	if w.shm != nil {
+		w.shm.close(true)
+	}
+}
+
+// exchange sends one encoded frame and reads one response frame over
+// whichever transport this connection negotiated.
+func (w *wireConn) exchange(frame []byte, deadline time.Time) (*respFrame, error) {
+	if w.shm == nil {
+		w.nc.SetDeadline(deadline)
+		defer w.nc.SetDeadline(time.Time{})
+		if _, err := w.nc.Write(frame); err != nil {
+			return nil, fmt.Errorf("captpu: send: %w", err)
+		}
+		return readFrame(w.br)
+	}
+	if err := w.shm.writeRecord(ringReq, frame, deadline); err != nil {
+		return nil, err
+	}
+	rec, err := w.shm.readRecord(ringResp, deadline, w.workerAlive)
+	if err != nil {
+		return nil, err
+	}
+	return parseFrameBytes(rec)
+}
+
+// workerAlive probes the liveness socket without consuming data: a
+// dead worker means the shm response will never come.
+func (w *wireConn) workerAlive() error {
+	w.nc.SetReadDeadline(time.Now().Add(time.Millisecond))
+	defer w.nc.SetReadDeadline(time.Time{})
+	one := make([]byte, 1)
+	n, err := w.nc.Read(one)
+	if n > 0 {
+		return errors.New("captpu: unexpected bytes on shm liveness socket")
+	}
+	if err == nil {
+		return nil
+	}
+	var ne net.Error
+	if errors.As(err, &ne) && ne.Timeout() {
+		return nil // no data — worker alive
+	}
+	return fmt.Errorf("captpu: worker gone: %w", err)
+}
+
+type connPool struct {
+	ep   endpoint
+	o    *Options
+	mu   sync.Mutex
+	idle []*wireConn
+}
+
+func (p *connPool) get() (*wireConn, error) {
+	p.mu.Lock()
+	if n := len(p.idle); n > 0 {
+		w := p.idle[n-1]
+		p.idle = p.idle[:n-1]
+		p.mu.Unlock()
+		return w, nil
+	}
+	p.mu.Unlock()
+	return dialWire(p.ep, p.o)
+}
+
+func (p *connPool) put(w *wireConn) {
+	p.mu.Lock()
+	if len(p.idle) < p.o.PoolSize {
+		p.idle = append(p.idle, w)
+		p.mu.Unlock()
+		return
+	}
+	p.mu.Unlock()
+	w.close()
+}
+
+func (p *connPool) closeAll() {
+	p.mu.Lock()
+	idle := p.idle
+	p.idle = nil
+	p.mu.Unlock()
+	for _, w := range idle {
+		w.close()
+	}
+}
+
+func shmDir(o *Options) string {
+	if o.ShmDir != "" {
+		return o.ShmDir
+	}
+	if d := os.Getenv("CAP_SHM_DIR"); d != "" {
+		return d
+	}
+	if st, err := os.Stat("/dev/shm"); err == nil && st.IsDir() {
+		return "/dev/shm"
+	}
+	return os.TempDir()
+}
+
+func dialSocket(ep endpoint, o *Options) (net.Conn, error) {
+	d := net.Dialer{Timeout: o.DialTimeout}
+	nc, err := d.Dial(ep.network, ep.addr)
+	if err != nil {
+		return nil, fmt.Errorf("captpu: dial %s %s: %w", ep.network, ep.addr, err)
+	}
+	if tc, ok := nc.(*net.TCPConn); ok {
+		tc.SetNoDelay(true)
+	}
+	return nc, nil
+}
+
+// dialWire connects and, when the transport allows, negotiates the
+// shm attach. The fallback contract: a status-1 ack keeps the SAME
+// socket; a dropped connection (stale worker that never learned frame
+// type 15) redials socket-only. Transport "shm" turns both into dial
+// errors instead — callers asked for exactly that transport.
+func dialWire(ep endpoint, o *Options) (*wireConn, error) {
+	nc, err := dialSocket(ep, o)
+	if err != nil {
+		return nil, err
+	}
+	w := &wireConn{nc: nc, br: bufio.NewReaderSize(nc, 1<<16), transport: "socket"}
+	mode := o.Transport
+	if mode == "" {
+		mode = "auto"
+	}
+	if mode == "socket" {
+		return w, nil
+	}
+	size := uint64(shmMinRing)
+	want := uint64(o.RingBytes)
+	if want == 0 {
+		want = 1 << 20
+	}
+	for size < want && size < shmMaxRing {
+		size <<= 1
+	}
+	path := fmt.Sprintf("%s/cap-shm-go-%d-%08x", shmDir(o), os.Getpid(), rand.Uint32())
+	region, err := createShmRegion(path, size, size, rand.Uint32()|1)
+	if err != nil {
+		if mode == "shm" {
+			nc.Close()
+			return nil, err
+		}
+		return w, nil // no shared memory here: keep the socket
+	}
+	payload := []byte(`{"op":"attach","path":"` + path + `","version":1}`)
+	frame, err := encodeControl(typeShmAttach, payload)
+	if err != nil {
+		region.close(true)
+		if mode == "shm" {
+			nc.Close()
+			return nil, err
+		}
+		return w, nil
+	}
+	deadline := time.Now().Add(o.AttemptTimeout)
+	w.nc.SetDeadline(deadline)
+	_, werr := w.nc.Write(frame)
+	var rf *respFrame
+	if werr == nil {
+		rf, err = readFrame(w.br)
+	} else {
+		err = werr
+	}
+	w.nc.SetDeadline(time.Time{})
+	if err != nil {
+		// stale worker dropped the unknown frame (or died): redial
+		// socket-only — negotiation must never cost a working client
+		region.close(true)
+		nc.Close()
+		if mode == "shm" {
+			return nil, fmt.Errorf("captpu: shm attach failed: %w", err)
+		}
+		nc2, err2 := dialSocket(ep, o)
+		if err2 != nil {
+			return nil, err2
+		}
+		return &wireConn{nc: nc2, br: bufio.NewReaderSize(nc2, 1<<16), transport: "socket"}, nil
+	}
+	if rf.ftype != typeShmAck || len(rf.entries) != 1 || rf.entries[0].status != 0 {
+		// negotiated refusal: the worker keeps serving this very
+		// connection over the socket
+		region.close(true)
+		if mode == "shm" {
+			nc.Close()
+			msg := "refused"
+			if rf != nil && len(rf.entries) == 1 {
+				msg = string(rf.entries[0].payload)
+			}
+			return nil, fmt.Errorf("captpu: shm attach refused: %s", msg)
+		}
+		return w, nil
+	}
+	w.shm = region
+	w.transport = "shm"
+	return w, nil
+}
+
+// Client is a production BatchKeySet over one or more verify workers.
+type Client struct {
+	o      Options
+	pools  []*connPool
+	rr     uint64
+	closed int32
+}
+
+// NewClient validates options, applies defaults, and verifies that at
+// least one endpoint is dialable (the rest may join later).
+func NewClient(o Options) (*Client, error) {
+	if len(o.Addrs) == 0 {
+		return nil, errors.New("captpu: Options.Addrs is required")
+	}
+	if o.PoolSize <= 0 {
+		o.PoolSize = 2
+	}
+	if o.AttemptTimeout <= 0 {
+		o.AttemptTimeout = 5 * time.Second
+	}
+	if o.DialTimeout <= 0 {
+		o.DialTimeout = 10 * time.Second
+	}
+	if o.HedgeAfter < 0 {
+		o.HedgeAfter = 0
+	} else if o.HedgeAfter == 0 {
+		o.HedgeAfter = 250 * time.Millisecond
+	}
+	if o.Retries < 0 {
+		o.Retries = 0
+	} else if o.Retries == 0 {
+		o.Retries = 2
+	}
+	if o.Backoff <= 0 {
+		o.Backoff = 50 * time.Millisecond
+	}
+	switch o.Transport {
+	case "", "auto", "socket", "shm":
+	default:
+		return nil, fmt.Errorf("captpu: unknown transport %q", o.Transport)
+	}
+	c := &Client{o: o}
+	for _, a := range o.Addrs {
+		c.pools = append(c.pools, &connPool{ep: parseAddr(a), o: &c.o})
+	}
+	w, err := c.pools[0].get()
+	if err != nil {
+		return nil, err
+	}
+	c.pools[0].put(w)
+	return c, nil
+}
+
+// Transport reports the transport a pooled connection to the first
+// endpoint negotiated ("shm" or "socket").
+func (c *Client) Transport() (string, error) {
+	w, err := c.pools[0].get()
+	if err != nil {
+		return "", err
+	}
+	tr := w.transport
+	c.pools[0].put(w)
+	return tr, nil
+}
+
+// Close drops every pooled connection. In-flight calls finish.
+func (c *Client) Close() error {
+	atomic.StoreInt32(&c.closed, 1)
+	for _, p := range c.pools {
+		p.closeAll()
+	}
+	return nil
+}
+
+// VerifySignature implements cap's KeySet seam for one token.
+func (c *Client) VerifySignature(ctx context.Context, token string) (map[string]interface{}, error) {
+	res, err := c.VerifyBatch(ctx, []string{token})
+	if err != nil {
+		return nil, err
+	}
+	if res[0].Err != nil {
+		return nil, res[0].Err
+	}
+	return res[0].Claims, nil
+}
+
+// VerifyBatch verifies every token with per-attempt deadlines,
+// endpoint rotation, hedged retry, and the terminal fallback.
+func (c *Client) VerifyBatch(ctx context.Context, tokens []string) ([]Result, error) {
+	if atomic.LoadInt32(&c.closed) != 0 {
+		return nil, ErrClosed
+	}
+	if len(tokens) == 0 {
+		return []Result{}, nil
+	}
+	frame, err := encodeRequestEx(tokens, c.o.CRC, "")
+	if err != nil {
+		return nil, err
+	}
+	start := int(atomic.AddUint64(&c.rr, 1))
+	var lastErr error
+	backoff := c.o.Backoff
+	for round := 0; round <= c.o.Retries; round++ {
+		for i := 0; i < len(c.pools); i++ {
+			if ctx.Err() != nil {
+				return nil, ctx.Err()
+			}
+			primary := c.pools[(start+i)%len(c.pools)]
+			hedge := c.pools[(start+i+1)%len(c.pools)]
+			if len(c.pools) == 1 {
+				hedge = nil
+			}
+			res, err := c.attempt(ctx, primary, hedge, frame, len(tokens))
+			if err == nil {
+				return res, nil
+			}
+			lastErr = err
+		}
+		if round < c.o.Retries {
+			jitter := time.Duration(rand.Int63n(int64(backoff))) - backoff/2
+			select {
+			case <-time.After(backoff + jitter):
+			case <-ctx.Done():
+				return nil, ctx.Err()
+			}
+			backoff *= 2
+		}
+	}
+	if c.o.Fallback != nil {
+		return c.fallbackVerify(ctx, tokens)
+	}
+	return nil, fmt.Errorf("captpu: all endpoints failed: %w", lastErr)
+}
+
+type attemptResult struct {
+	res []Result
+	err error
+}
+
+// attempt runs one exchange on the primary endpoint, hedging onto the
+// peer when the primary is slow. First success wins; the losing
+// attempt finishes in the background and returns its conn to its pool.
+func (c *Client) attempt(ctx context.Context, primary, hedge *connPool, frame []byte, want int) ([]Result, error) {
+	ch := make(chan attemptResult, 2)
+	launched := 1
+	go c.oneAttempt(primary, frame, want, ch)
+	var hedgeTimer <-chan time.Time
+	if hedge != nil && c.o.HedgeAfter > 0 {
+		hedgeTimer = time.After(c.o.HedgeAfter)
+	}
+	var lastErr error
+	for {
+		select {
+		case r := <-ch:
+			if r.err == nil {
+				return r.res, nil
+			}
+			lastErr = r.err
+			launched--
+			if launched == 0 {
+				return nil, lastErr
+			}
+		case <-hedgeTimer:
+			hedgeTimer = nil
+			launched++
+			go c.oneAttempt(hedge, frame, want, ch)
+		case <-ctx.Done():
+			return nil, ctx.Err()
+		}
+	}
+}
+
+func (c *Client) oneAttempt(p *connPool, frame []byte, want int, ch chan<- attemptResult) {
+	w, err := p.get()
+	if err != nil {
+		ch <- attemptResult{nil, err}
+		return
+	}
+	rf, err := w.exchange(frame, time.Now().Add(c.o.AttemptTimeout))
+	if err != nil {
+		w.close() // unread bytes may be on the wire: poison
+		ch <- attemptResult{nil, err}
+		return
+	}
+	res, err := c.decodeVerify(rf, want)
+	if err != nil {
+		w.close()
+		ch <- attemptResult{nil, err}
+		return
+	}
+	p.put(w)
+	ch <- attemptResult{res, nil}
+}
+
+func (c *Client) decodeVerify(rf *respFrame, want int) ([]Result, error) {
+	wantType := byte(typeVerifyRsp)
+	if c.o.CRC {
+		// integrity must not be silently downgradable
+		wantType = typeVerifyRspCRC
+	}
+	if rf.ftype != wantType {
+		return nil, fmt.Errorf("captpu: expected response type %d, got %d", wantType, rf.ftype)
+	}
+	if len(rf.entries) != want {
+		return nil, fmt.Errorf("captpu: response count %d != request %d", len(rf.entries), want)
+	}
+	out := make([]Result, want)
+	for i, e := range rf.entries {
+		if e.status == 0 {
+			var claims map[string]interface{}
+			if err := json.Unmarshal(e.payload, &claims); err != nil {
+				return nil, fmt.Errorf("captpu: claims decode: %w", err)
+			}
+			out[i] = Result{Claims: claims}
+		} else {
+			out[i] = Result{Err: &RemoteVerifyError{Msg: string(e.payload)}}
+		}
+	}
+	return out, nil
+}
+
+func (c *Client) fallbackVerify(ctx context.Context, tokens []string) ([]Result, error) {
+	out := make([]Result, len(tokens))
+	for i, t := range tokens {
+		if ctx.Err() != nil {
+			return nil, ctx.Err()
+		}
+		claims, err := c.o.Fallback.VerifySignature(ctx, t)
+		if err != nil {
+			out[i] = Result{Err: err}
+		} else {
+			out[i] = Result{Claims: claims}
+		}
+	}
+	return out, nil
+}
+
+// controlExchange runs one pre-encoded control frame against the
+// first reachable endpoint and returns the parsed response frame.
+func (c *Client) controlExchange(frame []byte) (*respFrame, error) {
+	var lastErr error
+	for _, p := range c.pools {
+		w, err := p.get()
+		if err != nil {
+			lastErr = err
+			continue
+		}
+		rf, err := w.exchange(frame, time.Now().Add(c.o.AttemptTimeout))
+		if err != nil {
+			w.close()
+			lastErr = err
+			continue
+		}
+		p.put(w)
+		return rf, nil
+	}
+	return nil, lastErr
+}
+
+// Ping reports whether any endpoint answers a CVB1 ping.
+func (c *Client) Ping() bool {
+	rf, err := c.controlExchange(encodePing())
+	return err == nil && rf.ftype == typePong
+}
+
+// Stats fetches one worker's STATS snapshot (counts and timings only).
+func (c *Client) Stats() (map[string]interface{}, error) {
+	rf, err := c.controlExchange(encodeStatsReq())
+	if err != nil {
+		return nil, err
+	}
+	if rf.ftype != typeStatsRsp || len(rf.entries) != 1 {
+		return nil, fmt.Errorf("captpu: expected stats response, got type %d", rf.ftype)
+	}
+	var stats map[string]interface{}
+	if err := json.Unmarshal(rf.entries[0].payload, &stats); err != nil {
+		return nil, fmt.Errorf("captpu: stats decode: %w", err)
+	}
+	return stats, nil
+}
+
+// PushKeys distributes one key epoch (KEYS push, type 11) to EVERY
+// endpoint; returns the acked epoch (all endpoints must ack it).
+func (c *Client) PushKeys(jwks map[string]interface{}, epoch int) (int, error) {
+	payload, err := json.Marshal(map[string]interface{}{
+		"epoch": epoch, "jwks": jwks,
+	})
+	if err != nil {
+		return 0, err
+	}
+	frame, err := encodeControl(typeKeysPush, payload)
+	if err != nil {
+		return 0, err
+	}
+	acked := 0
+	for _, p := range c.pools {
+		w, err := p.get()
+		if err != nil {
+			return acked, err
+		}
+		rf, err := w.exchange(frame, time.Now().Add(c.o.AttemptTimeout))
+		if err != nil {
+			w.close()
+			return acked, err
+		}
+		p.put(w)
+		if rf.ftype != typeKeysAck || len(rf.entries) != 1 || rf.entries[0].status != 0 {
+			msg := "keys push refused"
+			if len(rf.entries) == 1 {
+				msg = string(rf.entries[0].payload)
+			}
+			return acked, &RemoteVerifyError{Msg: msg}
+		}
+		var ack struct {
+			Epoch int `json:"epoch"`
+		}
+		if err := json.Unmarshal(rf.entries[0].payload, &ack); err != nil {
+			return acked, err
+		}
+		acked = ack.Epoch
+	}
+	return acked, nil
+}
+
+var _ BatchKeySet = (*Client)(nil)

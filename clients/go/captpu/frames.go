@@ -1,0 +1,245 @@
+package captpu
+
+// Full CVB1 frame codec: the checksummed (7/8), traced (9/10), keys
+// (11/12), peer-fill (13/14), stats (5/6) and shm (15/16) frame pairs
+// on top of the plain pair captpu.go has always spoken. Byte layouts
+// mirror cap_tpu/serve/protocol.py exactly; the committed golden
+// vectors in testdata/ pin every encoder and decoder here against the
+// Python implementation (the worker's source of truth).
+
+import (
+	"bufio"
+	"bytes"
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"hash/crc32"
+	"io"
+)
+
+const (
+	typeStatsReq      = 5
+	typeStatsRsp      = 6
+	typeVerifyReqCRC  = 7
+	typeVerifyRspCRC  = 8
+	typeVerifyReqTr   = 9
+	typeVerifyRspTr   = 10
+	typeKeysPush      = 11
+	typeKeysAck       = 12
+	typePeerFill      = 13
+	typePeerAck       = 14
+	typeShmAttach     = 15
+	typeShmAck        = 16
+	maxFrameEntries   = 1 << 20
+	maxTraceBytes     = 64
+)
+
+// ErrCorrupt is returned when a checksummed frame's CRC32 trailer
+// does not match its bytes (the Python side raises FrameCorruptError).
+var ErrCorrupt = errors.New("captpu: frame crc mismatch")
+
+func appendU32(b []byte, v uint32) []byte {
+	var u [4]byte
+	binary.LittleEndian.PutUint32(u[:], v)
+	return append(b, u[:]...)
+}
+
+func appendCRC(b []byte) []byte {
+	return appendU32(b, crc32.ChecksumIEEE(b))
+}
+
+func validTrace(trace string) bool {
+	if len(trace) == 0 || len(trace) > maxTraceBytes {
+		return false
+	}
+	for i := 0; i < len(trace); i++ {
+		c := trace[i]
+		if !(c >= '0' && c <= '9' || c >= 'a' && c <= 'f') {
+			return false
+		}
+	}
+	return true
+}
+
+// encodeRequestEx builds one verify-request frame: plain (type 1),
+// checksummed (type 7, crc=true) or traced (type 9, trace != "" —
+// traced frames are always checksummed, matching protocol.py).
+func encodeRequestEx(tokens []string, crc bool, trace string) ([]byte, error) {
+	ftype := byte(typeVerifyReq)
+	if trace != "" {
+		if !validTrace(trace) {
+			return nil, fmt.Errorf("captpu: invalid trace id %q", trace)
+		}
+		ftype = typeVerifyReqTr
+	} else if crc {
+		ftype = typeVerifyReqCRC
+	}
+	size := 9 + len(trace) + 1
+	for _, t := range tokens {
+		if len(t) > maxEntryBytes {
+			return nil, fmt.Errorf("captpu: token exceeds %d bytes", maxEntryBytes)
+		}
+		size += 4 + len(t)
+	}
+	if size > maxFrameBytes {
+		return nil, fmt.Errorf("captpu: frame exceeds %d bytes", maxFrameBytes)
+	}
+	frame := make([]byte, 0, size+4)
+	frame = appendU32(frame, magic)
+	frame = append(frame, ftype)
+	frame = appendU32(frame, uint32(len(tokens)))
+	if trace != "" {
+		frame = append(frame, byte(len(trace)))
+		frame = append(frame, trace...)
+	}
+	for _, t := range tokens {
+		frame = appendU32(frame, uint32(len(t)))
+		frame = append(frame, t...)
+	}
+	if ftype != typeVerifyReq {
+		frame = appendCRC(frame)
+	}
+	return frame, nil
+}
+
+// encodeControl builds a checksummed one-entry request-shaped frame
+// (keys push / peer fill / shm attach): the r10 control-frame shape.
+func encodeControl(ftype byte, payload []byte) ([]byte, error) {
+	if len(payload) > maxEntryBytes {
+		return nil, fmt.Errorf("captpu: control payload exceeds %d bytes", maxEntryBytes)
+	}
+	frame := make([]byte, 0, 9+4+len(payload)+4)
+	frame = appendU32(frame, magic)
+	frame = append(frame, ftype)
+	frame = appendU32(frame, 1)
+	frame = appendU32(frame, uint32(len(payload)))
+	frame = append(frame, payload...)
+	return appendCRC(frame), nil
+}
+
+func encodePing() []byte {
+	f := make([]byte, 0, 9)
+	f = appendU32(f, magic)
+	f = append(f, typePing)
+	return appendU32(f, 0)
+}
+
+func encodeStatsReq() []byte {
+	f := make([]byte, 0, 9)
+	f = appendU32(f, magic)
+	f = append(f, typeStatsReq)
+	return appendU32(f, 0)
+}
+
+// respEntry is one response-shaped entry: status 0 = verified (payload
+// is claims JSON), 1 = rejected (payload is the error class+message).
+type respEntry struct {
+	status  byte
+	payload []byte
+}
+
+// respFrame is one parsed response-direction frame.
+type respFrame struct {
+	ftype   byte
+	trace   string
+	entries []respEntry
+}
+
+// readFrame reads and validates one response-direction frame (verify
+// response in all three flavors, pong, stats, keys/peer/shm acks).
+// Checksummed types verify the CRC trailer before anything else is
+// trusted, exactly like the Python parser.
+func readFrame(r *bufio.Reader) (*respFrame, error) {
+	hdr := make([]byte, 9)
+	if _, err := io.ReadFull(r, hdr); err != nil {
+		return nil, fmt.Errorf("captpu: recv header: %w", err)
+	}
+	if binary.LittleEndian.Uint32(hdr[0:4]) != magic {
+		return nil, errors.New("captpu: bad magic in response")
+	}
+	ftype := hdr[4]
+	count := binary.LittleEndian.Uint32(hdr[5:9])
+	if count > maxFrameEntries {
+		return nil, errors.New("captpu: response entry count exceeds bound")
+	}
+	checksummed := ftype == typeVerifyRspCRC || ftype == typeVerifyRspTr ||
+		ftype == typeKeysAck || ftype == typePeerAck || ftype == typeShmAck
+	body := hdr[:] // every byte the CRC covers
+	out := &respFrame{ftype: ftype}
+	switch ftype {
+	case typePong:
+		if count != 0 {
+			return nil, errors.New("captpu: pong with nonzero count")
+		}
+		return out, nil
+	case typeVerifyRsp, typeVerifyRspCRC, typeVerifyRspTr,
+		typeStatsRsp, typeKeysAck, typePeerAck, typeShmAck:
+	default:
+		return nil, fmt.Errorf("captpu: unexpected frame type %d", ftype)
+	}
+	if ftype == typeVerifyRspTr {
+		tl := make([]byte, 1)
+		if _, err := io.ReadFull(r, tl); err != nil {
+			return nil, err
+		}
+		if tl[0] == 0 || int(tl[0]) > maxTraceBytes {
+			return nil, errors.New("captpu: bad trace-context length")
+		}
+		tb := make([]byte, tl[0])
+		if _, err := io.ReadFull(r, tb); err != nil {
+			return nil, err
+		}
+		body = append(body, tl[0])
+		body = append(body, tb...)
+		out.trace = string(tb)
+	}
+	total := 0
+	entry := make([]byte, 5)
+	out.entries = make([]respEntry, 0, count)
+	for i := uint32(0); i < count; i++ {
+		if _, err := io.ReadFull(r, entry); err != nil {
+			return nil, fmt.Errorf("captpu: recv entry: %w", err)
+		}
+		status := entry[0]
+		ln := binary.LittleEndian.Uint32(entry[1:5])
+		if !checksummed && status > 1 {
+			return nil, fmt.Errorf("captpu: bad status byte %d", status)
+		}
+		total += int(ln)
+		if ln > maxEntryBytes || total > maxFrameBytes {
+			return nil, errors.New("captpu: oversized response entry")
+		}
+		payload := make([]byte, ln)
+		if _, err := io.ReadFull(r, payload); err != nil {
+			return nil, fmt.Errorf("captpu: recv payload: %w", err)
+		}
+		body = append(body, entry...)
+		body = append(body, payload...)
+		out.entries = append(out.entries, respEntry{status, payload})
+	}
+	if checksummed {
+		trailer := make([]byte, 4)
+		if _, err := io.ReadFull(r, trailer); err != nil {
+			return nil, fmt.Errorf("captpu: recv crc: %w", err)
+		}
+		if binary.LittleEndian.Uint32(trailer) != crc32.ChecksumIEEE(body) {
+			return nil, ErrCorrupt
+		}
+		// deferred status validation, matching the Python parser
+		for _, e := range out.entries {
+			if e.status > 1 {
+				return nil, fmt.Errorf("captpu: bad status byte %d", e.status)
+			}
+		}
+		if out.trace != "" && !validTrace(out.trace) {
+			return nil, errors.New("captpu: trace-context not lowercase hex")
+		}
+	}
+	return out, nil
+}
+
+// parseFrameBytes parses one complete frame held in a byte slice (the
+// shm ring hands whole records across) via the same reader.
+func parseFrameBytes(b []byte) (*respFrame, error) {
+	return readFrame(bufio.NewReader(bytes.NewReader(b)))
+}

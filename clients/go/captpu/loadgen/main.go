@@ -1,0 +1,83 @@
+// Command loadgen is the Go-driver closed loop tools/bench_serve.py
+// shells out to for the `go_client_vps` number: N goroutines, each
+// pipelining verify batches through a captpu.Client against a live
+// worker, printing one JSON line with the sustained rate.
+//
+//	go run ./loadgen -addr 127.0.0.1:PORT -seconds 5 -batch 64 \
+//	    -conns 4 -transport auto
+package main
+
+import (
+	"context"
+	"encoding/json"
+	"flag"
+	"fmt"
+	"os"
+	"sync"
+	"sync/atomic"
+	"time"
+
+	captpu "github.com/cap-tpu/clients/go/captpu"
+)
+
+func main() {
+	addr := flag.String("addr", "127.0.0.1:9000", "worker host:port or unix:///path")
+	seconds := flag.Float64("seconds", 5, "measurement window")
+	batch := flag.Int("batch", 64, "tokens per verify frame")
+	conns := flag.Int("conns", 4, "concurrent drivers")
+	transport := flag.String("transport", "auto", "auto | socket | shm")
+	crc := flag.Bool("crc", false, "checksummed frames (types 7/8)")
+	flag.Parse()
+
+	client, err := captpu.NewClient(captpu.Options{
+		Addrs:     []string{*addr},
+		Transport: *transport,
+		CRC:       *crc,
+		PoolSize:  *conns,
+	})
+	if err != nil {
+		fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
+		os.Exit(1)
+	}
+	defer client.Close()
+	tr, _ := client.Transport()
+
+	tokens := make([]string, *batch)
+	for i := range tokens {
+		tokens[i] = fmt.Sprintf("eyJhbGciOiJFUzI1NiJ9.go-load-%04d.ok", i)
+	}
+	var total int64
+	var errs int64
+	deadline := time.Now().Add(time.Duration(*seconds * float64(time.Second)))
+	ctx := context.Background()
+	var wg sync.WaitGroup
+	start := time.Now()
+	for c := 0; c < *conns; c++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			for time.Now().Before(deadline) {
+				res, err := client.VerifyBatch(ctx, tokens)
+				if err != nil {
+					atomic.AddInt64(&errs, 1)
+					return
+				}
+				atomic.AddInt64(&total, int64(len(res)))
+			}
+		}()
+	}
+	wg.Wait()
+	elapsed := time.Since(start).Seconds()
+	out := map[string]interface{}{
+		"go_client_vps": float64(atomic.LoadInt64(&total)) / elapsed,
+		"tokens":        atomic.LoadInt64(&total),
+		"seconds":       elapsed,
+		"transport":     tr,
+		"errors":        atomic.LoadInt64(&errs),
+	}
+	b, _ := json.Marshal(out)
+	fmt.Println(string(b))
+	if atomic.LoadInt64(&errs) > 0 {
+		os.Exit(1)
+	}
+}

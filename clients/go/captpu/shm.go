@@ -1,0 +1,229 @@
+// +build linux darwin
+
+package captpu
+
+// Shared-memory ring transport, pure Go (syscall.Mmap — no cgo).
+// Region layout and record format mirror cap_tpu/serve/shm_ring.py /
+// runtime/native/shm_ring.h byte for byte:
+//
+//	header (4096 B): magic u64 "CAPSHMR1" | version u32 | gen u32 |
+//	    req_off u64 | req_size u64 | resp_off u64 | resp_size u64 |
+//	    req_head @64 | req_tail @128 | resp_head @192 | resp_tail @256
+//	record: [len u32][gen u32][payload … pad8]; len 0xFFFFFFFF = wrap
+//
+// The producer writes payload bytes first and publishes with an
+// atomic store of head last, so a writer killed mid-record never
+// publishes a torn frame. Cursors are 8-byte aligned into the page-
+// aligned mapping, so sync/atomic on them is valid on amd64/arm64.
+
+import (
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"os"
+	"sync/atomic"
+	"syscall"
+	"time"
+	"unsafe"
+)
+
+const (
+	shmMagic   = 0x31524D4853504143 // "CAPSHMR1"
+	shmVersion = 1
+	shmHdrSize = 4096
+	shmMinRing = 4096
+	shmMaxRing = 1 << 30
+	shmWrap    = 0xFFFFFFFF
+
+	ringReq  = 0
+	ringResp = 1
+)
+
+var (
+	errShmStale     = errors.New("captpu: shm record from a foreign generation")
+	errShmMalformed = errors.New("captpu: shm ring cursor/record malformed")
+	errShmTimeout   = errors.New("captpu: shm ring timed out")
+	errShmTooLarge  = errors.New("captpu: frame exceeds shm ring capacity")
+)
+
+type shmRegion struct {
+	path     string
+	data     []byte
+	gen      uint32
+	ringOff  [2]uint64
+	ringSize [2]uint64
+}
+
+func (r *shmRegion) cursor(off uint64) *uint64 {
+	return (*uint64)(unsafe.Pointer(&r.data[off]))
+}
+
+func headOff(ring int) uint64 {
+	if ring == ringReq {
+		return 64
+	}
+	return 192
+}
+
+func tailOff(ring int) uint64 {
+	if ring == ringReq {
+		return 128
+	}
+	return 256
+}
+
+func pow2InBounds(v uint64) bool {
+	return v >= shmMinRing && v <= shmMaxRing && v&(v-1) == 0
+}
+
+// createShmRegion creates + initializes a region file (client side).
+func createShmRegion(path string, reqSize, respSize uint64, gen uint32) (*shmRegion, error) {
+	if !pow2InBounds(reqSize) || !pow2InBounds(respSize) || gen == 0 {
+		return nil, errors.New("captpu: bad shm region parameters")
+	}
+	f, err := os.OpenFile(path, os.O_RDWR|os.O_CREATE|os.O_TRUNC, 0600)
+	if err != nil {
+		return nil, fmt.Errorf("captpu: shm create: %w", err)
+	}
+	total := int64(shmHdrSize + reqSize + respSize)
+	if err := f.Truncate(total); err != nil {
+		f.Close()
+		os.Remove(path)
+		return nil, fmt.Errorf("captpu: shm truncate: %w", err)
+	}
+	data, err := syscall.Mmap(int(f.Fd()), 0, int(total),
+		syscall.PROT_READ|syscall.PROT_WRITE, syscall.MAP_SHARED)
+	f.Close()
+	if err != nil {
+		os.Remove(path)
+		return nil, fmt.Errorf("captpu: shm mmap: %w", err)
+	}
+	binary.LittleEndian.PutUint32(data[8:], shmVersion)
+	binary.LittleEndian.PutUint32(data[12:], gen)
+	binary.LittleEndian.PutUint64(data[16:], shmHdrSize)
+	binary.LittleEndian.PutUint64(data[24:], reqSize)
+	binary.LittleEndian.PutUint64(data[32:], shmHdrSize+reqSize)
+	binary.LittleEndian.PutUint64(data[40:], respSize)
+	r := &shmRegion{path: path, data: data, gen: gen}
+	r.ringOff = [2]uint64{shmHdrSize, shmHdrSize + reqSize}
+	r.ringSize = [2]uint64{reqSize, respSize}
+	// magic last: a racing reader never sees a half-written header
+	atomic.StoreUint64(r.cursor(0), shmMagic)
+	return r, nil
+}
+
+func (r *shmRegion) close(unlink bool) {
+	if r.data != nil {
+		syscall.Munmap(r.data)
+		r.data = nil
+	}
+	if unlink {
+		os.Remove(r.path)
+	}
+}
+
+func (r *shmRegion) maxRecord(ring int) uint64 { return r.ringSize[ring] / 2 }
+
+// writeRecord appends one record (blocking while the ring is full).
+func (r *shmRegion) writeRecord(ring int, b []byte, deadline time.Time) error {
+	size := r.ringSize[ring]
+	base := r.ringOff[ring]
+	n := uint64(len(b))
+	if n > size/2 {
+		return errShmTooLarge
+	}
+	adv := 8 + (n+7)&^uint64(7)
+	spins := 0
+	for {
+		head := atomic.LoadUint64(r.cursor(headOff(ring)))
+		tail := atomic.LoadUint64(r.cursor(tailOff(ring)))
+		off := head & (size - 1)
+		var wrapSkip uint64
+		if size-off < adv {
+			wrapSkip = size - off
+		}
+		if size-(head-tail) >= wrapSkip+adv {
+			if wrapSkip != 0 {
+				binary.LittleEndian.PutUint32(r.data[base+off:], shmWrap)
+				binary.LittleEndian.PutUint32(r.data[base+off+4:], r.gen)
+				head += wrapSkip
+				off = 0
+				atomic.StoreUint64(r.cursor(headOff(ring)), head)
+			}
+			binary.LittleEndian.PutUint32(r.data[base+off:], uint32(n))
+			binary.LittleEndian.PutUint32(r.data[base+off+4:], r.gen)
+			copy(r.data[base+off+8:base+off+8+n], b)
+			atomic.StoreUint64(r.cursor(headOff(ring)), head+adv)
+			return nil
+		}
+		if !deadline.IsZero() && time.Now().After(deadline) {
+			return errShmTimeout
+		}
+		spins++
+		if spins < 64 {
+			// busy ring: brief yield
+			time.Sleep(0)
+		} else {
+			time.Sleep(200 * time.Microsecond)
+		}
+	}
+}
+
+// readRecord copies the next record's payload out of the ring (the
+// producer may reuse the space as soon as the tail moves).
+func (r *shmRegion) readRecord(ring int, deadline time.Time, alive func() error) ([]byte, error) {
+	size := r.ringSize[ring]
+	base := r.ringOff[ring]
+	spins := 0
+	for {
+		head := atomic.LoadUint64(r.cursor(headOff(ring)))
+		tail := atomic.LoadUint64(r.cursor(tailOff(ring)))
+		if head != tail {
+			if head-tail > size || tail&7 != 0 || head-tail < 8 {
+				return nil, errShmMalformed
+			}
+			off := tail & (size - 1)
+			recLen := binary.LittleEndian.Uint32(r.data[base+off:])
+			recGen := binary.LittleEndian.Uint32(r.data[base+off+4:])
+			if recLen == shmWrap {
+				if recGen != r.gen {
+					return nil, errShmStale
+				}
+				skip := size - off
+				if head-tail < skip {
+					return nil, errShmMalformed
+				}
+				atomic.StoreUint64(r.cursor(tailOff(ring)), tail+skip)
+				continue
+			}
+			if uint64(recLen) > size/2 {
+				return nil, errShmMalformed
+			}
+			adv := 8 + (uint64(recLen)+7)&^uint64(7)
+			if adv > size-off || head-tail < adv {
+				return nil, errShmMalformed
+			}
+			if recGen != r.gen {
+				return nil, errShmStale
+			}
+			out := make([]byte, recLen)
+			copy(out, r.data[base+off+8:base+off+8+uint64(recLen)])
+			atomic.StoreUint64(r.cursor(tailOff(ring)), tail+adv)
+			return out, nil
+		}
+		if alive != nil && spins%256 == 255 {
+			if err := alive(); err != nil {
+				return nil, err
+			}
+		}
+		if !deadline.IsZero() && time.Now().After(deadline) {
+			return nil, errShmTimeout
+		}
+		spins++
+		if spins < 64 {
+			time.Sleep(0)
+		} else {
+			time.Sleep(200 * time.Microsecond)
+		}
+	}
+}

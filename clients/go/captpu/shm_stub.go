@@ -1,0 +1,38 @@
+// +build !linux,!darwin
+
+package captpu
+
+// Non-unix stub: the shm transport negotiates only where mmap'd
+// shared memory exists; everywhere else the client silently keeps the
+// socket transport (the same fallback contract a refusing worker
+// triggers).
+
+import (
+	"errors"
+	"time"
+)
+
+const (
+	ringReq  = 0
+	ringResp = 1
+)
+
+var errShmUnsupported = errors.New("captpu: shm transport unsupported on this platform")
+
+type shmRegion struct{ path string }
+
+func createShmRegion(path string, reqSize, respSize uint64, gen uint32) (*shmRegion, error) {
+	return nil, errShmUnsupported
+}
+
+func (r *shmRegion) close(unlink bool) {}
+
+func (r *shmRegion) maxRecord(ring int) uint64 { return 0 }
+
+func (r *shmRegion) writeRecord(ring int, b []byte, deadline time.Time) error {
+	return errShmUnsupported
+}
+
+func (r *shmRegion) readRecord(ring int, deadline time.Time, alive func() error) ([]byte, error) {
+	return nil, errShmUnsupported
+}

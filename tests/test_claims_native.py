@@ -444,7 +444,8 @@ def test_native_build_all_four_tus_and_claims_symbols(tmp_path):
         (os.path.join("runtime", "native", "jose_native.cpp"),
          os.path.join("runtime", "native", "serve_native.cpp"),
          os.path.join("runtime", "native", "telemetry_native.cpp"),
-         os.path.join("runtime", "native", "claims_validate.cpp")),
+         os.path.join("runtime", "native", "claims_validate.cpp"),
+         os.path.join("runtime", "native", "shm_ring.cpp")),
         out, False, timeout=300.0, force=True)
     assert os.path.exists(out), "native build produced no library"
     lib = ctypes.CDLL(out)

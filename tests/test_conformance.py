@@ -1187,6 +1187,96 @@ class TestPeerFillWireGolden:
         assert meta["peer_ack_doc"] == self.PEER_ACK_DOC
 
 
+class TestShmWireGolden:
+    """The shm-transport negotiation pair (types 15/16) is ADDITIVE
+    exactly like the peer-fill pair: its own golden files
+    (``shm_attach.bin`` / ``shm_ack.bin``), while frames 1-14 stay
+    pinned byte-identical by the classes above. Fixture values mirror
+    tools/gen_go_golden.py exactly."""
+
+    SHM_PATH = "/dev/shm/cap-shm-golden"
+
+    def test_shm_frames_match_golden(self):
+        from cap_tpu.serve import protocol
+
+        s = _CaptureSock()
+        protocol.send_shm_attach(s, self.SHM_PATH)
+        assert s.value() == _golden("shm_attach.bin"), \
+            "shm_attach.bin drifted from the committed golden bytes"
+        assert protocol.encode_shm_ack() == _golden("shm_ack.bin"), \
+            "shm_ack.bin drifted from the committed golden bytes"
+
+    def test_shm_frames_parse_back(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        buf = io.BytesIO(_golden("shm_attach.bin"))
+        ftype, entries, trace = protocol._parse_frame(buf.read)
+        assert ftype == protocol.T_SHM_ATTACH and trace is None
+        assert buf.read() == b""           # trailer fully consumed
+        doc = json.loads(entries[0])
+        assert doc == {"op": "attach", "path": self.SHM_PATH,
+                       "version": 1}
+
+        buf = io.BytesIO(_golden("shm_ack.bin"))
+        ftype, entries, _ = protocol._parse_frame(buf.read)
+        assert ftype == protocol.T_SHM_ACK
+        assert entries[0][0] == 0
+        assert json.loads(entries[0][1]) == {"transport": "shm"}
+
+    def test_native_ack_byte_identical_to_python(self):
+        """The native chain builds its OWN ack (serve_native.cpp
+        shm_ack_frame); a Python-side client must not be able to tell
+        which chain acked — pinned via the shared golden."""
+        from cap_tpu.serve import protocol
+
+        assert protocol.encode_shm_ack() == _golden("shm_ack.bin")
+
+    def test_corrupt_shm_frame_detected(self):
+        import io
+
+        from cap_tpu.serve import protocol
+
+        blob = bytearray(_golden("shm_attach.bin"))
+        blob[15] ^= 0x01
+        with pytest.raises(protocol.ProtocolError):
+            protocol._parse_frame(io.BytesIO(bytes(blob)).read)
+
+    def test_two_entry_attach_rejected(self):
+        import struct
+        import zlib
+
+        from cap_tpu.serve import protocol
+
+        body = (struct.pack("<IBI", protocol.MAGIC,
+                            protocol.T_SHM_ATTACH, 2)
+                + struct.pack("<I", 1) + b"x"
+                + struct.pack("<I", 1) + b"y")
+        frame = body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+        with pytest.raises(protocol.MalformedFrameError):
+            protocol.parse_frame_bytes(frame)
+
+    def test_frames_1_to_14_still_byte_identical(self):
+        """The additive contract, explicitly: regenerating the
+        peer-fill push yields the committed bytes — the shm pair
+        changed NOTHING upstream of it (the classes above cover
+        frames 1-12 the same way)."""
+        from cap_tpu.serve import protocol
+
+        for name in ("peer_fill.bin", "peer_ack.bin"):
+            assert _golden(name), f"{name} missing"
+        s = _CaptureSock()
+        protocol.send_peer_fill(
+            s, TestPeerFillWireGolden.PEER_FILL_DOC)
+        assert s.value() == _golden("peer_fill.bin")
+
+    def test_meta_pins_shm_fixture(self):
+        with open(os.path.join(_TESTDATA, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["shm_path"] == self.SHM_PATH
+
+
 # ---------------------------------------------------------------------------
 # rotation parity: the sig-conformance vectors across an epoch swap
 # ---------------------------------------------------------------------------

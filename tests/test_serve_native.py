@@ -99,6 +99,18 @@ def malformed_corpus():
             _hdr(P.T_KEYS_PUSH, 2) + struct.pack("<I", 1) + b"x"
             + struct.pack("<I", 1) + b"y" + b"\0\0\0\0"),
          P.MalformedFrameError),
+        ("shm-attach-two-entries", _crc_fix(
+            _hdr(P.T_SHM_ATTACH, 2) + struct.pack("<I", 1) + b"x"
+            + struct.pack("<I", 1) + b"y" + b"\0\0\0\0"),
+         P.MalformedFrameError),
+        ("shm-ack-two-entries", _crc_fix(
+            _hdr(P.T_SHM_ACK, 2) + struct.pack("<BI", 0, 1) + b"x"
+            + struct.pack("<BI", 0, 1) + b"y" + b"\0\0\0\0"),
+         P.MalformedFrameError),
+        ("shm-attach-bad-crc",
+         (lambda f: f[:-5] + bytes([f[-5] ^ 0x01]) + f[-4:])(
+             _capture(P.send_shm_attach, "/dev/shm/corpus")),
+         P.FrameCorruptError),
         # -- status bytes --------------------------------------------------
         ("bad-status-plain",
          _hdr(P.T_VERIFY_RESP, 1) + struct.pack("<BI", 7, 1) + b"z",
@@ -401,7 +413,8 @@ def test_native_build_from_source_and_symbols_resolve(tmp_path):
     _build._build_one(
         (os.path.join("runtime", "native", "jose_native.cpp"),
          os.path.join("runtime", "native", "serve_native.cpp"),
-         os.path.join("runtime", "native", "telemetry_native.cpp")),
+         os.path.join("runtime", "native", "telemetry_native.cpp"),
+         os.path.join("runtime", "native", "shm_ring.cpp")),
         out, False, timeout=300.0, force=True)
     assert os.path.exists(out), "native build produced no library"
     lib = ctypes.CDLL(out)
@@ -418,7 +431,11 @@ def test_native_build_from_source_and_symbols_resolve(tmp_path):
                 "cap_tel_counters", "cap_tel_hist_state",
                 "cap_tel_drain_exemplars", "cap_tel_reset",
                 "cap_serve_set_telemetry", "cap_serve_drain_aux",
-                "cap_serve_post_results_tel", "cap_serve_ring_hwm"):
+                "cap_serve_post_results_tel", "cap_serve_ring_hwm",
+                # the shm transport (ISSUE 13: zero-copy ingest)
+                "cap_serve_set_shm", "cap_shm_create", "cap_shm_open",
+                "cap_shm_close", "cap_shm_probe", "cap_shm_write",
+                "cap_shm_read", "cap_shm_drive"):
         assert hasattr(lib, sym), f"symbol {sym} missing"
 
 

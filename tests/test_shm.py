@@ -1,0 +1,529 @@
+"""Shared-memory CVB1 transport: ring invariants, both-chain e2e,
+fallback matrix, and the kill -9 chaos contract.
+
+The contract under test (ISSUE 13): a client killed at ANY point —
+mid-write, mid-read — can never wedge or corrupt the worker; torn
+records are structurally invisible (payload first, head published
+last); everything a hostile producer CAN make visible (overrun
+cursors, impossible lengths, foreign generations) maps onto the
+socket parser's malformed classes and detaches the transport, while
+surviving socket clients lose nothing.
+"""
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.serve import protocol as P
+from cap_tpu.serve import shm_ring as R
+from cap_tpu.serve.client import VerifyClient
+from cap_tpu.serve.shm_client import ShmVerifyClient
+from cap_tpu.serve.worker import VerifyWorker
+
+try:
+    from cap_tpu.serve import native_serve
+    HAVE_NATIVE = bool(getattr(native_serve.load(), "cap_shm_ok",
+                               False))
+except Exception:  # noqa: BLE001 - no compiler
+    HAVE_NATIVE = False
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHAINS = ["python"] + (["native"] if HAVE_NATIVE else [])
+
+
+# ---------------------------------------------------------------------------
+# ring invariants (pure Python, no worker)
+# ---------------------------------------------------------------------------
+
+
+def test_region_create_open_roundtrip(tmp_path):
+    path = str(tmp_path / "region")
+    r = R.ShmRegion.create(path, req_size=8192, resp_size=4096)
+    try:
+        r2 = R.ShmRegion.open(path)
+        assert r2.gen == r.gen
+        assert r2.ring_size == {"req": 8192, "resp": 4096}
+        assert r2.ring_off == {"req": R.HDR_SIZE,
+                               "resp": R.HDR_SIZE + 8192}
+        r2.close()
+    finally:
+        r.close(unlink=True)
+    assert not os.path.exists(path)
+
+
+def test_region_open_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00" * 16384)
+    with pytest.raises(R.ShmFormatError):
+        R.ShmRegion.open(str(bad))
+    short = tmp_path / "short"
+    short.write_bytes(b"\x00" * 64)
+    with pytest.raises(R.ShmFormatError):
+        R.ShmRegion.open(str(short))
+    # valid magic, inconsistent ring geometry
+    path = str(tmp_path / "geom")
+    r = R.ShmRegion.create(path, req_size=4096, resp_size=4096)
+    r.close()
+    with open(path, "r+b") as f:
+        f.seek(R.OFF_REQ_SIZE)
+        f.write(struct.pack("<Q", 12345))        # not a power of two
+    with pytest.raises(R.ShmFormatError):
+        R.ShmRegion.open(path)
+    os.unlink(path)
+
+
+def test_ring_roundtrip_with_wraparound(tmp_path):
+    r = R.ShmRegion.create(str(tmp_path / "ring"), req_size=4096,
+                           resp_size=4096)
+    try:
+        prod = R.RingProducer(r, "req")
+        cons = R.RingConsumer(r, "req")
+        for i in range(300):                    # >> ring capacity
+            msg = bytes([i & 0xFF]) * (1 + (i * 37) % 900)
+            prod.write(msg)
+            assert cons.read(timeout=1.0) == msg, i
+        assert cons.read(timeout=0.01) is None
+    finally:
+        r.close(unlink=True)
+
+
+def test_torn_write_invisible(tmp_path):
+    """A producer killed mid-write never published: bytes past the
+    head are garbage by definition and the consumer must see NOTHING
+    — the kill -9 mid-write contract at the record level."""
+    r = R.ShmRegion.create(str(tmp_path / "torn"), req_size=4096,
+                           resp_size=4096)
+    try:
+        # simulate the partial write: record header + half a payload,
+        # head NOT advanced
+        mm = r._mm
+        struct.pack_into("<II", mm, R.HDR_SIZE, 100, r.gen)
+        mm[R.HDR_SIZE + 8: R.HDR_SIZE + 58] = b"T" * 50
+        cons = R.RingConsumer(r, "req")
+        assert cons.read(timeout=0.05) is None
+        # a later, complete write is served normally
+        R.RingProducer(r, "req").write(b"after-torn")
+        assert cons.read(timeout=1.0) == b"after-torn"
+    finally:
+        r.close(unlink=True)
+
+
+def test_stale_generation_detected(tmp_path):
+    r = R.ShmRegion.create(str(tmp_path / "stale"), req_size=4096,
+                           resp_size=4096, gen=7)
+    try:
+        mm = r._mm
+        struct.pack_into("<II", mm, R.HDR_SIZE, 5, 999)  # foreign gen
+        mm[R.HDR_SIZE + 8: R.HDR_SIZE + 13] = b"stale"
+        struct.pack_into("<Q", mm, 64, 16)               # publish
+        with pytest.raises(R.StaleGenerationError):
+            R.RingConsumer(r, "req").read(timeout=0.5)
+    finally:
+        r.close(unlink=True)
+
+
+def test_overrun_cursor_detected(tmp_path):
+    r = R.ShmRegion.create(str(tmp_path / "over"), req_size=4096,
+                           resp_size=4096)
+    try:
+        struct.pack_into("<Q", r._mm, 64, 4096 + 64)  # head >> tail+size
+        with pytest.raises(P.MalformedFrameError):
+            R.RingConsumer(r, "req").read(timeout=0.5)
+    finally:
+        r.close(unlink=True)
+
+
+def test_oversized_frame_rejected_client_side(tmp_path):
+    r = R.ShmRegion.create(str(tmp_path / "big"), req_size=4096,
+                           resp_size=4096)
+    try:
+        with pytest.raises(P.FrameTooLargeError):
+            R.RingProducer(r, "req").write(b"x" * 3000)  # > size/2
+    finally:
+        r.close(unlink=True)
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native shm TU not built")
+def test_python_c_ring_interop(tmp_path):
+    """The Python ring and the C ring speak the same bytes: records
+    written by either side are read intact by the other."""
+    import ctypes
+
+    import numpy as np
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib = native_serve.load()
+    path = str(tmp_path / "interop")
+    r = R.ShmRegion.create(path, req_size=8192, resp_size=8192)
+    try:
+        cr = lib.cap_shm_open(path.encode())
+        assert cr
+        buf = np.zeros(8192, np.uint8)
+        try:
+            prod = R.RingProducer(r, "req")
+            for i in range(200):                # forces wraparound
+                msg = (b"py->c-%03d-" % i) + b"z" * (i % 500)
+                prod.write(msg)
+                n = int(lib.cap_shm_read(
+                    ctypes.c_void_p(cr), 0,
+                    buf.ctypes.data_as(u8p), 8192, 1.0))
+                assert n == len(msg) and buf[:n].tobytes() == msg, i
+            cons = R.RingConsumer(r, "resp")
+            for i in range(200):
+                msg = (b"c->py-%03d-" % i) + b"q" * (i % 500)
+                arr = np.frombuffer(msg, np.uint8)
+                assert int(lib.cap_shm_write(
+                    ctypes.c_void_p(cr), 1,
+                    arr.ctypes.data_as(u8p), len(msg), 1.0)) == 0
+                assert cons.read(timeout=1.0) == msg, i
+        finally:
+            lib.cap_shm_close(ctypes.c_void_p(cr), 0)
+    finally:
+        r.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end, both serve chains
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=CHAINS)
+def shm_worker(request):
+    telemetry.enable()
+    w = VerifyWorker(StubKeySet(), serve_native=request.param == "native",
+                     max_wait_ms=1.0, transport="shm")
+    assert w.serve_chain == request.param
+    assert w.transport == "shm"
+    yield w
+    w.close(deadline_s=10)
+
+
+def test_shm_verify_ping_stats(shm_worker):
+    host, port = shm_worker.address
+    with ShmVerifyClient(host, port) as cl:
+        assert cl.transport == "shm", cl.attach_error
+        out = cl.verify_batch(["s1.ok", "s2.bad", "s3.ok"])
+        assert out[0] == {"sub": "s1.ok"}
+        assert isinstance(out[1], Exception)
+        assert out[2] == {"sub": "s3.ok"}
+        assert cl.ping()
+        st = cl.stats()
+        assert st["transport"] == "shm"
+        assert st["counters"].get("serve.shm.attaches", 0) >= 1
+        assert st["counters"].get("serve.shm.frames", 0) >= 3
+
+
+def test_shm_crc_and_traced_frames(shm_worker):
+    host, port = shm_worker.address
+    with ShmVerifyClient(host, port, crc=True) as cl:
+        assert cl.transport == "shm"
+        assert cl.verify_batch(["crc.ok"])[0] == {"sub": "crc.ok"}
+    with ShmVerifyClient(host, port) as cl:
+        out = cl.verify_batch(["tr.ok"], trace="ab12cd34ab12cd34")
+        assert out[0] == {"sub": "tr.ok"}
+
+
+def test_shm_keys_push_in_order(shm_worker):
+    host, port = shm_worker.address
+    with ShmVerifyClient(host, port) as cl:
+        assert cl.verify_batch(["k1.ok"])[0] == {"sub": "k1.ok"}
+        assert cl.push_keys({"keys": []}, epoch=5) == 5
+        assert cl.verify_batch(["k2.ok"])[0] == {"sub": "k2.ok"}
+    assert shm_worker.key_epoch == 5
+
+
+def test_shm_sustained_pipelined_load(shm_worker):
+    host, port = shm_worker.address
+    with ShmVerifyClient(host, port) as cl:
+        for i in range(60):
+            toks = [f"load-{i}-{j}.ok" for j in range(32)]
+            out = cl.verify_batch(toks)
+            assert [r["sub"] for r in out] == toks
+    st = _socket_stats(shm_worker)
+    assert _proto_errors(st) == 0
+
+
+def _socket_stats(worker) -> dict:
+    host, port = worker.address
+    with VerifyClient(host, port) as cl:
+        return cl.stats()
+
+
+def _proto_errors(st: dict) -> int:
+    c = st.get("counters") or {}
+    return (c.get("worker.protocol_errors", 0)
+            + c.get("serve.native.protocol_errors", 0))
+
+
+def test_gauges_and_capstat_cell(shm_worker):
+    gauges = shm_worker._obs_gauges()
+    assert gauges["serve.shm.active"] == 1.0
+    from tools import capstat
+
+    text = capstat.render_fleet(
+        {"w0": {"snapshot": {"v": 1, "counters": {}, "gauges": {},
+                             "series": {}},
+                "extra": gauges}})
+    assert "tr=shm" in text
+
+
+# ---------------------------------------------------------------------------
+# fallback matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=CHAINS)
+def socket_worker(request):
+    telemetry.enable()
+    w = VerifyWorker(StubKeySet(), serve_native=request.param == "native",
+                     max_wait_ms=1.0, transport="socket")
+    assert w.serve_chain == request.param
+    yield w
+    w.close(deadline_s=10)
+
+
+def test_attach_refused_keeps_socket_serving(socket_worker):
+    """The graceful-fallback contract: a transport=socket worker acks
+    status 1 and the SAME connection keeps serving; the refusal is
+    counted serve.shm_fallbacks on whichever chain refused."""
+    host, port = socket_worker.address
+    with ShmVerifyClient(host, port) as cl:
+        assert cl.transport == "socket"
+        assert cl.attach_error and "TypeError" in cl.attach_error
+        assert cl.verify_batch(["fb.ok"])[0] == {"sub": "fb.ok"}
+        assert cl.ping()
+    st = _socket_stats(socket_worker)
+    assert (st["counters"].get("serve.shm_fallbacks", 0) >= 1
+            or telemetry.active().counters().get(
+                "serve.shm_fallbacks", 0) >= 1)
+    assert st["transport"] == "socket"
+
+
+def test_stale_worker_drop_redials_socket_only():
+    """A worker whose parser predates frame type 15 DROPS the
+    connection on the unknown type; the client must absorb that and
+    redial socket-only — negotiation can never cost a working
+    client."""
+    import socket as _socket
+    import threading
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    host, port = srv.getsockname()
+    accepted = []
+
+    def stale_worker():
+        # first conn: read a little, then slam it shut (the stale
+        # parser's unknown-type drop); second conn: answer one plain
+        # verify frame like an old worker would
+        c1, _ = srv.accept()
+        accepted.append(1)
+        c1.recv(4096)
+        c1.close()
+        c2, _ = srv.accept()
+        accepted.append(2)
+        rd = P.FrameReader(c2)
+        ftype, entries = rd.recv_frame()
+        assert ftype == P.T_VERIFY_REQ
+        P.send_response(c2, [{"sub": t} for t in entries])
+        c2.close()
+
+    t = threading.Thread(target=stale_worker, daemon=True)
+    t.start()
+    try:
+        with ShmVerifyClient(host, port, timeout=10) as cl:
+            assert cl.transport == "socket"
+            assert cl.attach_error is not None
+            out = cl.verify_batch(["stale.ok"])
+            assert out[0] == {"sub": "stale.ok"}
+    finally:
+        srv.close()
+    assert accepted == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill -9 an shm client mid-write / mid-read, both chains
+# ---------------------------------------------------------------------------
+
+_CHAOS_CLIENT = r"""
+import sys, time
+from cap_tpu.serve import protocol
+from cap_tpu.serve.shm_client import ShmVerifyClient
+
+mode, host, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
+# read mode: a TINY response ring, so the worker's producer actually
+# fills it and must give up (not wedge) when we die without reading
+cl = ShmVerifyClient(host, port,
+                     ring_bytes=4096 if mode == "read" else 1 << 20)
+assert cl.transport == "shm", cl.attach_error
+print("ATTACHED", cl._region.path, flush=True)
+if mode == "write":
+    i = 0
+    while True:                      # hammer writes until killed
+        i += 1
+        cl.verify_batch([f"chaos-{i}-{j}.ok" for j in range(64)])
+elif mode == "read":
+    # submit work, then never consume the response ring — the worker
+    # writes responses until the ring fills, then we get killed
+    for i in range(4):
+        cl._send(protocol.send_request,
+                 [f"mid-read-{i}-{j}.ok" for j in range(64)])
+    print("UNREAD", flush=True)
+    time.sleep(60)
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("chain", CHAINS)
+@pytest.mark.parametrize("mode", ["write", "read"])
+def test_kill9_shm_client_worker_survives(chain, mode, tmp_path):
+    """kill -9 the shm client mid-write and mid-read under sustained
+    load: the worker survives, the ring file is reclaimed, and a
+    surviving SOCKET client observes zero wrong verdicts and zero
+    lost submissions throughout."""
+    telemetry.enable()
+    w = VerifyWorker(StubKeySet(), serve_native=chain == "native",
+                     max_wait_ms=1.0, transport="shm")
+    try:
+        host, port = w.address
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHAOS_CLIENT, mode, host,
+             str(port)],
+            cwd=REPO, stdout=subprocess.PIPE, text=True, bufsize=1,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("ATTACHED"), line
+            ring_path = line.split()[1]
+            if mode == "read":
+                assert proc.stdout.readline().startswith("UNREAD")
+            # surviving socket client drives load the whole time
+            with VerifyClient(host, port) as survivor:
+                for i in range(5):
+                    toks = [f"sv-{mode}-{i}-{j}.ok" for j in range(16)]
+                    out = survivor.verify_batch(toks)
+                    assert [r["sub"] for r in out] == toks
+                time.sleep(0.2)      # let the chaos client really run
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=10)
+                # zero wrong verdicts / zero lost submissions AFTER
+                # the kill, on the same worker
+                for i in range(10):
+                    toks = [f"sv2-{mode}-{i}-{j}.ok"
+                            for j in range(16)]
+                    out = survivor.verify_batch(toks)
+                    assert [r["sub"] for r in out] == toks
+                st = survivor.stats()
+            assert st["counters"].get("serve.shm.attaches", 0) >= 1
+            # the worker reclaims the region file (detach janitor);
+            # give the EOF probe a beat
+            deadline = time.monotonic() + 10
+            while os.path.exists(ring_path) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert not os.path.exists(ring_path), \
+                "ring file not reclaimed after kill -9"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+    finally:
+        w.close(deadline_s=10)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("chain", CHAINS)
+def test_stale_generation_frames_counted_and_survived(chain):
+    """A record stamped by a foreign generation poisons only ITS
+    connection: counted (serve.shm.stale_gen), transport detached,
+    worker keeps serving everyone else."""
+    telemetry.enable()
+    telemetry.active().reset()
+    w = VerifyWorker(StubKeySet(), serve_native=chain == "native",
+                     max_wait_ms=1.0, transport="shm")
+    try:
+        host, port = w.address
+        cl = ShmVerifyClient(host, port)
+        try:
+            assert cl.transport == "shm"
+            assert cl.verify_batch(["pre.ok"])[0] == {"sub": "pre.ok"}
+            # inject a foreign-generation record directly
+            region = cl._region
+            mm = region._mm
+            head = region.cursor("req", "head")
+            size = region.ring_size["req"]
+            off = region.ring_off["req"] + (head & (size - 1))
+            struct.pack_into("<II", mm, off, 5, region.gen + 1)
+            mm[off + 8: off + 13] = b"stale"
+            region.set_cursor("req", "head", head + 16)
+            # the worker detaches this connection
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st = _socket_stats(w)
+                stale = (st["counters"].get("serve.shm.stale_gen", 0)
+                         or telemetry.active().counters().get(
+                             "serve.shm.stale_gen", 0))
+                if stale:
+                    break
+                time.sleep(0.1)
+            assert stale >= 1, "stale-generation record not counted"
+        finally:
+            cl.close()
+        # everyone else unaffected
+        with VerifyClient(host, port) as ok_client:
+            assert ok_client.verify_batch(["post.ok"])[0] == \
+                {"sub": "post.ok"}
+        with ShmVerifyClient(host, port) as cl2:
+            assert cl2.transport == "shm"
+            assert cl2.verify_batch(["post2.ok"])[0] == \
+                {"sub": "post2.ok"}
+    finally:
+        w.close(deadline_s=10)
+
+
+@pytest.mark.chaos
+def test_fleet_kill9_shm_client_postmortem_shows_shm():
+    """Fleet form of the chaos contract: a pool-supervised worker
+    serving shm keeps its pool healthy through a client kill -9, and
+    its graceful-restart postmortem carries the serve.shm.* counters."""
+    from cap_tpu.fleet.pool import WorkerPool
+
+    chain = "native" if HAVE_NATIVE else "python"
+    pool = WorkerPool(1, keyset_spec="stub", transport="shm",
+                      serve_chain=chain)
+    try:
+        assert pool.wait_all_ready(60)
+        assert pool.transports() == {0: "shm"}
+        host, port = pool.endpoints()[0]
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHAOS_CLIENT, "write", host,
+             str(port)],
+            cwd=REPO, stdout=subprocess.PIPE, text=True, bufsize=1,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            assert proc.stdout.readline().startswith("ATTACHED")
+            time.sleep(0.3)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        with VerifyClient(host, port) as cl:
+            out = cl.verify_batch(["fleet-alive.ok"])
+            assert out[0] == {"sub": "fleet-alive.ok"}
+        pool.restart(0, graceful=True)
+        pm = pool.postmortem(0)
+        assert pm is not None
+        counters = (pm.get("stats") or {}).get("counters") or {}
+        assert counters.get("serve.shm.attaches", 0) >= 1, counters
+    finally:
+        pool.close()

@@ -872,7 +872,139 @@ def fleet_main() -> None:
     }))
 
 
+def transport_main() -> None:
+    """CAP_SERVE_TRANSPORTS=1: the shm-vs-socket serve A/B and the
+    Go-driver loadgen point.
+
+    Emits ``shm_vps`` (closed-loop C drive over the mapped ring
+    against a device-stubbed worker — the zero-copy ingest rate) next
+    to the interleaved socket arm, and ``go_client_vps`` when a Go
+    toolchain exists (``clients/go/captpu/loadgen`` against the same
+    worker; null with a note otherwise — this image has no Go).
+    """
+    import ctypes
+    import shutil
+    import subprocess
+
+    import numpy as np
+
+    from cap_tpu import telemetry
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from cap_tpu.serve import native_serve
+    from cap_tpu.serve.worker import VerifyWorker
+
+    telemetry.disable()
+    seconds = float(os.environ.get("CAP_SERVE_SECONDS", 5))
+    req_tokens = int(os.environ.get("CAP_SERVE_REQ_TOKENS", 64))
+    depth = int(os.environ.get("CAP_SERVE_DEPTH", 48))
+    n_conns = int(os.environ.get("CAP_SERVE_CLIENTS", 4))
+    lib = native_serve.load()
+    if not getattr(lib, "cap_shm_ok", False):
+        raise RuntimeError("library lacks the shm TU "
+                           "(run: make native-build)")
+    chain = "native"
+    try:
+        worker = VerifyWorker(StubKeySet(raw=1), serve_native=True,
+                              max_wait_ms=2.0, transport="shm",
+                              vcache=False)
+        if worker.serve_chain != "native":
+            worker.close(deadline_s=5)
+            raise RuntimeError("native chain unavailable")
+    except Exception:  # noqa: BLE001 - python-chain fallback
+        chain = "python"
+        worker = VerifyWorker(StubKeySet(raw=1), serve_native=False,
+                              max_wait_ms=2.0, transport="shm",
+                              vcache=False)
+    assert worker.transport == "shm"
+    host, port = worker.address
+    tokens = [f"bench.{i:06d}.ok" for i in range(8192)]
+    encoded = [t.encode() for t in tokens]
+    blob = np.frombuffer(b"".join(encoded), np.uint8)
+    offs = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(e) for e in encoded], out=offs[1:])
+    out_tokens = np.zeros(1, np.int64)
+    out_reqs = np.zeros(1, np.int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    shm_dir = os.environ.get("CAP_SHM_DIR") or (
+        "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp")
+
+    def drive(arm: str, window_s: float) -> float:
+        t0 = time.perf_counter()
+        if arm == "shm":
+            rc = lib.cap_shm_drive(
+                host.encode(), port, shm_dir.encode(),
+                blob.ctypes.data_as(u8p), offs.ctypes.data_as(i64p),
+                len(encoded), req_tokens, depth, window_s, n_conns,
+                1 << 20,
+                out_tokens.ctypes.data_as(i64p),
+                out_reqs.ctypes.data_as(i64p))
+        else:
+            rc = lib.cap_bench_drive(
+                host.encode(), port, blob.ctypes.data_as(u8p),
+                offs.ctypes.data_as(i64p), len(encoded), req_tokens,
+                depth, window_s, n_conns,
+                out_tokens.ctypes.data_as(i64p),
+                out_reqs.ctypes.data_as(i64p))
+        elapsed = time.perf_counter() - t0
+        if rc != 0 or int(out_tokens[0]) == 0:
+            raise RuntimeError(f"{arm} drive failed (rc={rc})")
+        return int(out_tokens[0]) / elapsed
+
+    go_point = None
+    go_note = None
+    try:
+        drive("socket", 0.5)        # warmup
+        drive("shm", 0.5)
+        best = {"socket": 0.0, "shm": 0.0}
+        for _ in range(2):          # interleaved arms, best-of-2
+            for arm in ("socket", "shm"):
+                vps = drive(arm, seconds / 2)
+                best[arm] = max(best[arm], vps)
+                print(f"transport {arm:6s} chain={chain} "
+                      f"vps={vps:>10.0f}", file=sys.stderr)
+        go = shutil.which("go")
+        if go:
+            repo = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            out = subprocess.run(
+                [go, "run", "./loadgen", "-addr", f"{host}:{port}",
+                 "-seconds", str(seconds / 2), "-batch",
+                 str(req_tokens), "-conns", str(n_conns),
+                 "-transport", "auto"],
+                cwd=os.path.join(repo, "clients", "go", "captpu"),
+                capture_output=True, text=True, timeout=300)
+            if out.returncode == 0:
+                go_point = json.loads(out.stdout.strip().splitlines()[-1])
+            else:
+                go_note = f"loadgen failed: {out.stderr[-500:]}"
+        else:
+            go_note = ("no Go toolchain on this host — run "
+                       "'make go-conformance' + this mode where go "
+                       "exists")
+    finally:
+        worker.close(deadline_s=10)
+    print(json.dumps({
+        "metric": "shm_verifies_per_sec",
+        "value": best["shm"],
+        "unit": "verifies/sec",
+        "serve_chain": chain,
+        "shm_vps": round(best["shm"], 1),
+        "socket_vps": round(best["socket"], 1),
+        "shm_vs_socket_speedup": (round(best["shm"] / best["socket"],
+                                        3) if best["socket"] else None),
+        "go_client_vps": (round(go_point["go_client_vps"], 1)
+                          if go_point else None),
+        "go_client_transport": (go_point or {}).get("transport"),
+        "go_note": go_note,
+    }))
+
+
 def main() -> None:
+    if os.environ.get("CAP_SERVE_TRANSPORTS"):
+        # Transport mode: shm-vs-socket serve A/B + Go-driver loadgen.
+        transport_main()
+        return
     if os.environ.get("CAP_SERVE_POOLS"):
         # Multi-pool front-door mode: the affinity-vs-rr routing A/B.
         frontdoor_main()

@@ -90,7 +90,13 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # on the Zipf 90%-repeat mix with digest-affinity
                  # routing (higher is better) — the r16 fleet-wide
                  # verdict-tier contract (bench_serve multi-pool mode)
-                 "fleet_affinity_vps": True}
+                 "fleet_affinity_vps": True,
+                 # zero-copy ingest: closed-loop serve rate over the
+                 # shared-memory ring transport, device stubbed
+                 # (higher is better) — the r18 recv+copy-elimination
+                 # contract (bench_stages transport column /
+                 # bench_serve CAP_SERVE_TRANSPORTS mode)
+                 "shm_vps": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -374,6 +380,19 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [fa[1], (17, {"serve_native_vps": 1e6})])):
         problems.append("vanished fleet_affinity_vps NOT flagged")
+    # 4e2. shm_vps (r18): introducing must not flag; a drop and a
+    #      disappearance must
+    sm = [(17, {"serve_native_vps": 1e6}),
+          (18, {"serve_native_vps": 1e6, "shm_vps": 2e6})]
+    if check_serve_series(sm):
+        problems.append("introducing shm_vps flagged")
+    if not check_serve_series(
+            [sm[1], (19, {"serve_native_vps": 1e6,
+                          "shm_vps": 1e6})]):
+        problems.append("shm_vps regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [sm[1], (19, {"serve_native_vps": 1e6})])):
+        problems.append("vanished shm_vps NOT flagged")
     # 4f. resident_slhdsa128s_vps (r17, BENCH series): introducing
     #     must not flag; a drop and a disappearance must
     def _pq(vals):

@@ -180,6 +180,9 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
         # serve.native.active gauge: 1.0 = native C++ serve chain,
         # 0.0 = pure-Python chain (absent on pre-native workers)
         chain = extra.get("serve.native.active")
+        # serve.shm.active gauge: 1.0 = shm attach negotiation live —
+        # rendered tr=shm/socket (absent on pre-shm workers)
+        tr = extra.get("serve.shm.active")
         ring = extra.get("serve.native.ring_depth")
         # peak queued tokens since the previous scrape (native-side
         # high-water mark — bursts the point-in-time ring= misses)
@@ -188,6 +191,8 @@ def render_fleet(worker_data: Dict[str, Dict[str, Any]],
         lines.append(f"worker {ep}  pid={int(extra.get('worker.pid', 0))}"
                      + (f"  chain={'native' if chain else 'python'}"
                         if chain is not None else "")
+                     + (f"  tr={'shm' if tr else 'socket'}"
+                        if tr is not None else "")
                      + (f"  ring={int(ring)}" if ring is not None else "")
                      + (f"  ring_hwm={int(hwm)}" if hwm is not None
                         else "")

@@ -80,6 +80,12 @@ PEER_FILL_DOC = {
 }
 PEER_ACK_DOC = {"imported": 1}
 
+# Pinned shm-attach fixture for the shared-memory transport pair
+# (types 15/16): a fixed region path (never resolved at generation
+# time — the wire layer only moves the string). send_shm_attach
+# canonicalizes the JSON, so regeneration is byte-stable.
+SHM_PATH = "/dev/shm/cap-shm-golden"
+
 
 class _Sock:
     """Duck-typed socket capturing sendall output."""
@@ -516,6 +522,17 @@ def main():
     with open(os.path.join(OUT, "peer_ack.bin"), "wb") as f:
         f.write(s.buf.getvalue())
 
+    # Shared-memory transport pair (types 15/16): additive like every
+    # pair before it — everything written above stays byte-identical.
+    s = _Sock()
+    protocol.send_shm_attach(s, SHM_PATH)
+    with open(os.path.join(OUT, "shm_attach.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    s.sendall(protocol.encode_shm_ack())
+    with open(os.path.join(OUT, "shm_ack.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
     meta = {
         "tokens": TOKENS,
         "trace_id": TRACE_ID,
@@ -523,6 +540,7 @@ def main():
         "keys_jwks": KEYS_JWKS,
         "peer_fill_doc": PEER_FILL_DOC,
         "peer_ack_doc": PEER_ACK_DOC,
+        "shm_path": SHM_PATH,
         "results": [
             {"claims": r} if isinstance(r, dict) else
             {"error": f"{type(r).__name__}: {r}"}

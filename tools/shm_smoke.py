@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""shm-smoke: the shared-memory transport's local CI gate.
+
+Boots one worker per available serve chain (python always; native when
+the runtime builds) with ``transport="shm"``, drives it over the ring
+from the Python shm client, and FAILS on:
+
+- the client not actually negotiating shm (silently measuring the
+  socket would defeat the gate),
+- missing/zero ``serve.shm.*`` accounting (attaches, frames) or a
+  missing ``serve.shm.active`` gauge,
+- ANY protocol error (a malformed ring record under a clean drive
+  means the transport is corrupting frames),
+- a wrong verdict anywhere,
+- the socket-fallback contract breaking: a ``transport="socket"``
+  worker must ack the attach status-1, KEEP serving the same
+  connection over the socket, and count ``serve.shm_fallbacks``.
+
+Stub engines only — no jax import, fits the tier-1 time budget.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from cap_tpu import telemetry  # noqa: E402
+from cap_tpu.fleet.worker_main import StubKeySet  # noqa: E402
+from cap_tpu.serve.shm_client import ShmVerifyClient  # noqa: E402
+from cap_tpu.serve.worker import VerifyWorker  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"shm-smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def drive_chain(chain: str) -> None:
+    telemetry.enable()
+    telemetry.active().reset()
+    w = VerifyWorker(StubKeySet(), serve_native=chain == "native",
+                     max_wait_ms=1.0, transport="shm")
+    try:
+        if w.serve_chain != chain:
+            fail(f"requested chain {chain} but worker runs "
+                 f"{w.serve_chain}")
+        if w.transport != "shm":
+            fail(f"[{chain}] worker transport={w.transport}, not shm "
+                 "(stale library?)")
+        host, port = w.address
+        with ShmVerifyClient(host, port) as cl:
+            if cl.transport != "shm":
+                fail(f"[{chain}] client fell back to the socket: "
+                     f"{cl.attach_error}")
+            for i in range(20):
+                toks = [f"smoke-{chain}-{i}-{j}.ok" for j in range(32)]
+                toks.append(f"smoke-{chain}-{i}-reject.bad")
+                out = cl.verify_batch(toks)
+                for tok, res in zip(toks[:-1], out[:-1]):
+                    if res != {"sub": tok}:
+                        fail(f"[{chain}] wrong verdict for {tok}: "
+                             f"{res!r}")
+                if not isinstance(out[-1], Exception):
+                    fail(f"[{chain}] reject token accepted")
+            if not cl.ping():
+                fail(f"[{chain}] ping over the ring failed")
+            st = cl.stats()
+        gauges = w._obs_gauges()
+        if gauges.get("serve.shm.active") != 1.0:
+            fail(f"[{chain}] serve.shm.active gauge is "
+                 f"{gauges.get('serve.shm.active')}")
+        counters = st.get("counters") or {}
+        attaches = counters.get("serve.shm.attaches", 0)
+        frames = counters.get("serve.shm.frames", 0)
+        if attaches < 1:
+            fail(f"[{chain}] serve.shm.attaches={attaches}")
+        if frames < 20:
+            fail(f"[{chain}] serve.shm.frames={frames} (expected the "
+                 "drive's frames)")
+        proto_errs = (counters.get("worker.protocol_errors", 0)
+                      + counters.get("serve.native.protocol_errors", 0))
+        if proto_errs:
+            fail(f"[{chain}] {proto_errs} protocol errors under a "
+                 "clean shm drive")
+        stale = counters.get("serve.shm.stale_gen", 0)
+        if stale:
+            fail(f"[{chain}] serve.shm.stale_gen={stale} on a fresh "
+                 "region")
+        print(f"shm-smoke [{chain}]: attach ok, {frames} ring frames, "
+              f"0 protocol errors")
+    finally:
+        w.close(deadline_s=10)
+
+
+def drive_fallback() -> None:
+    telemetry.enable()
+    telemetry.active().reset()
+    w = VerifyWorker(StubKeySet(), max_wait_ms=1.0,
+                     transport="socket")
+    try:
+        host, port = w.address
+        with ShmVerifyClient(host, port) as cl:
+            if cl.transport != "socket":
+                fail("socket-transport worker accepted an attach")
+            if cl.attach_error is None:
+                fail("refusal carried no error string")
+            out = cl.verify_batch(["fallback.ok"])
+            if out[0] != {"sub": "fallback.ok"}:
+                fail("socket fallback connection does not serve")
+        rec = telemetry.active()
+        if not rec.counters().get("serve.shm_fallbacks"):
+            fail("serve.shm_fallbacks not counted on refusal")
+        print("shm-smoke [fallback]: status-1 ack, socket kept "
+              "serving, serve.shm_fallbacks counted")
+    finally:
+        w.close(deadline_s=10)
+
+
+def main() -> None:
+    chains = ["python"]
+    try:
+        from cap_tpu.serve import native_serve
+
+        lib = native_serve.load()
+        if getattr(lib, "cap_shm_ok", False):
+            chains.append("native")
+        else:
+            print("shm-smoke: native runtime predates the shm TU — "
+                  "python chain only", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - no compiler
+        print(f"shm-smoke: native runtime unavailable ({e}) — python "
+              "chain only", file=sys.stderr)
+    for chain in chains:
+        drive_chain(chain)
+    drive_fallback()
+    print(f"shm-smoke OK: chains={','.join(chains)} + socket fallback")
+
+
+if __name__ == "__main__":
+    main()
