@@ -241,13 +241,18 @@ class FrontDoor:
                 live = [a for a in arms if a.live()]
                 if live:
                     arm = live[self._rr_next % len(live)]
-            hits = sum(1 for i in range(n)
-                       if self._ring.primary(self._digest(
-                           tokens[i], digests, i)) == arm.pool_id) \
-                if len(arms) > 1 else n
+            if len(arms) > 1:
+                hit_flags = [self._ring.primary(self._digest(
+                    tokens[i], digests, i)) == arm.pool_id
+                    for i in range(n)]
+                hits = sum(hit_flags)
+            else:
+                hit_flags = [True] * n
+                hits = n
             self._count({"frontdoor.lookups": n,
                          "frontdoor.affinity_hits": hits,
                          "frontdoor.affinity_misses": n - hits})
+            self._count_tenants(tokens, hit_flags)
             with self._lock:
                 arm.tokens += n
                 arm.affinity_hits += hits
@@ -256,6 +261,7 @@ class FrontDoor:
         groups: Dict[int, List[int]] = {}
         loads = {a.pool_id: a.inflight for a in arms}
         hits = reroutes = 0
+        hit_flags = [False] * n
         hits_by: Dict[int, int] = {}
         spills_by: Dict[int, int] = {}
         reroutes_by: Dict[int, int] = {}
@@ -276,6 +282,7 @@ class FrontDoor:
                 else:
                     hits += 1      # nothing live: stay on primary,
                     #                the dispatch fallback owns it
+                    hit_flags[i] = True
                     hits_by[target] = hits_by.get(target, 0) + 1
             elif len(pref) > 1:
                 avg = (sum(loads.values()) + n) / max(1, len(loads))
@@ -287,9 +294,11 @@ class FrontDoor:
                     spills_by[target] = spills_by.get(target, 0) + 1
                 else:
                     hits += 1
+                    hit_flags[i] = True
                     hits_by[target] = hits_by.get(target, 0) + 1
             else:
                 hits += 1
+                hit_flags[i] = True
                 hits_by[target] = hits_by.get(target, 0) + 1
             loads[target] += 1
             groups.setdefault(target, []).append(i)
@@ -299,6 +308,7 @@ class FrontDoor:
                      "frontdoor.affinity_misses": spills + reroutes,
                      "frontdoor.spills": spills,
                      "frontdoor.reroutes": reroutes})
+        self._count_tenants(tokens, hit_flags)
         with self._lock:
             for a in arms:
                 extra = len(groups.get(a.pool_id, ()))
@@ -308,6 +318,25 @@ class FrontDoor:
                 a.spills_in += spills_by.get(a.pool_id, 0)
                 a.reroutes_in += reroutes_by.get(a.pool_id, 0)
         return groups, hits_by
+
+    def _count_tenants(self, tokens: List[str],
+                       hit_flags: List[bool]) -> None:
+        """Per-tenant routed traffic + affinity hit-rate
+        (``frontdoor.tenant.<t>.lookups`` / ``.affinity_hits``) — the
+        router-side tenant fold capstat's ledger aggregates across
+        pools. Labels come from the same header-segment cache the
+        decision fold uses (one dict hit per token)."""
+        from collections import Counter
+
+        labels = _decision.tenant_labels(tokens)
+        lookups = Counter(labels)
+        hit_c = Counter(t for t, h in zip(labels, hit_flags) if h)
+        inc = {}
+        for t, k in lookups.items():
+            inc[f"frontdoor.tenant.{t}.lookups"] = k
+        for t, k in hit_c.items():
+            inc[f"frontdoor.tenant.{t}.affinity_hits"] = k
+        self._count(inc)
 
     @staticmethod
     def _digest(token: str, digests, i: int) -> bytes:
@@ -497,9 +526,20 @@ class FrontDoor:
             }
             ctr = dict(self._ctr)
         skew = self.epoch_skew()
+        # per-tenant routed-traffic view (issuer-hash keyed — raw
+        # issuers never appear anywhere in this document)
+        tenants: Dict[str, Dict[str, int]] = {}
+        for k, v in ctr.items():
+            if not k.startswith("frontdoor.tenant."):
+                continue
+            parts = k.split(".")
+            if len(parts) != 4:
+                continue
+            tenants.setdefault(parts[2], {})[parts[3]] = int(v)
         return {
             "routing": self._routing,
             "counters": ctr,
+            "tenants": tenants,
             "pools": pools,
             "key_epochs": self.key_epochs(),
             "epoch_skew": skew,

@@ -259,6 +259,16 @@ class WorkerPool:
                 out.setdefault(h.worker_id, None)
         return out
 
+    def tenant_totals(self) -> dict:
+        """Fleet-wide per-tenant rollup (issuer hash → tokens /
+        accept / reject mix / vcache splits) over the EXACT merged
+        worker counters — the pool-side form of ``capstat --tenants``
+        (docs/OBSERVABILITY.md §Tenant attribution)."""
+        from ..obs import decision as _decision
+
+        merged = self.stats_merged()["aggregate"]["counters"]
+        return _decision.tenant_totals(merged)
+
     def stats_merged(self) -> dict:
         """Per-worker STATS plus an EXACT fleet aggregate.
 

@@ -535,6 +535,14 @@ class FleetClient:
             "breakers": {f"{ep[0]}:{ep[1]}": st
                          for ep, st in self.breaker_states().items()},
         }
+        if rec is not None:
+            # router-side tenant fold (issuer-hash keyed): what THIS
+            # client routed per tenant, from its own decision counters
+            # (docs/OBSERVABILITY.md §Tenant attribution)
+            tenants = _decision.tenant_totals(rec.counters(),
+                                              surface="router")
+            if tenants:
+                out["tenants"] = tenants
         if self._vcache is not None:
             out["vcache"] = self._vcache.stats()
         skew = self.key_epoch_skew()

@@ -184,6 +184,143 @@ def classify(err: BaseException) -> str:
 
 
 # ---------------------------------------------------------------------------
+# tenant (issuer) attribution — the per-stream accounting ROADMAP #1's
+# admission control needs (arXiv 2112.02229 frames multi-tenant verify
+# as filling a fixed-latency pipeline from competing request streams;
+# the streams must be *countable* before they can be arbitrated)
+# ---------------------------------------------------------------------------
+
+# Tenant ids are sha256(iss)[:12] HASHES — the same redaction stance
+# as hash_kid: records correlate per issuer without the issuer string
+# (a URL, i.e. payload material) ever touching a recorder.
+TENANT_HASH_LEN = 12
+
+# Fixed-size tenant table: at most TENANT_CAP distinct issuer hashes
+# get their own label; every later tenant routes to the "other"
+# overflow bucket, so a hostile unique-issuer flood cannot blow up
+# label cardinality. The cap is part of the native-plane ABI
+# (telemetry_native.h N_TEN = TENANT_CAP + 2; layout handshake).
+TENANT_CAP = 64
+TENANT_NONE = "none"      # no/unparseable issuer claim
+TENANT_OTHER = "other"    # table full — overflow bucket
+TENANT_NONE_IDX = TENANT_CAP
+TENANT_OTHER_IDX = TENANT_CAP + 1
+N_TENANT = TENANT_CAP + 2
+
+_MAX_PAYLOAD_SEG = 4096   # issuer parse bound (payloads > headers)
+_MAX_ISS_LEN = 1024
+
+
+class TenantTable:
+    """Bounded issuer-hash → slot map (slots 0..TENANT_CAP-1).
+
+    ``admit`` allocates first-come-first-served and routes everything
+    past the cap to the overflow slot; the mapping is shared by the
+    Python fold and the native plane (the plane counts by SLOT, the
+    binding maps slots back to labels here at scrape time), so both
+    folds attribute identically by construction. ``reset`` drops every
+    mapping and counts the evictions (``tenant.table_evictions``) —
+    the only way an admitted tenant ever leaves the table.
+    """
+
+    def __init__(self, cap: int = TENANT_CAP):
+        self.cap = cap
+        self._slots: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.evictions = 0
+
+    def admit(self, tenant_hash: str) -> tuple:
+        """(slot, label) for one raw issuer hash: its own slot + hash
+        label while the table has room, the overflow slot + "other"
+        after."""
+        slot = self._slots.get(tenant_hash)
+        if slot is not None:
+            return (slot, tenant_hash)
+        with self._lock:
+            slot = self._slots.get(tenant_hash)
+            if slot is not None:
+                return (slot, tenant_hash)
+            if len(self._slots) >= self.cap:
+                return (TENANT_OTHER_IDX, TENANT_OTHER)
+            slot = len(self._slots)
+            self._slots[tenant_hash] = slot
+            return (slot, tenant_hash)
+
+    def label(self, slot: int) -> str:
+        if slot == TENANT_NONE_IDX:
+            return TENANT_NONE
+        if slot == TENANT_OTHER_IDX:
+            return TENANT_OTHER
+        with self._lock:
+            for h, s in self._slots.items():
+                if s == slot:
+                    return h
+        return TENANT_OTHER
+
+    def labels(self) -> Dict[int, str]:
+        """slot → label for every allocated slot (plus none/other)."""
+        with self._lock:
+            out = {s: h for h, s in self._slots.items()}
+        out[TENANT_NONE_IDX] = TENANT_NONE
+        out[TENANT_OTHER_IDX] = TENANT_OTHER
+        return out
+
+    def size(self) -> int:
+        return len(self._slots)
+
+    def reset(self) -> int:
+        """Drop every mapping; returns (and accumulates) the eviction
+        count, mirrored onto the active recorder as
+        ``tenant.table_evictions``."""
+        with self._lock:
+            n = len(self._slots)
+            self._slots.clear()
+            self.evictions += n
+        if n:
+            telemetry.count("tenant.table_evictions", n)
+        return n
+
+
+# The process-wide table (one per process = one per worker; the fleet
+# view merges by LABEL, so slot numbering never crosses processes).
+TENANTS = TenantTable()
+
+
+def issuer_hash(iss: Any) -> str:
+    """sha256(iss)[:12 hex] — or "none" for anything that is not a
+    plausible issuer string (non-str, empty, over-long)."""
+    if not isinstance(iss, str) or not iss or len(iss) > _MAX_ISS_LEN:
+        return TENANT_NONE
+    return hashlib.sha256(iss.encode("utf-8", "surrogatepass")) \
+        .hexdigest()[:TENANT_HASH_LEN]
+
+
+def token_tenant(token: Any) -> str:
+    """Raw tenant hash for one token: the ``iss`` claim of its payload
+    segment, hashed — "none" when the token has no parseable issuer.
+    Bounded like the header parse (over-long segments are "none"
+    without decoding). This is the ONE place issuer extraction
+    happens: the native plane never parses payloads, it memoizes what
+    this classifier produced (the r13 fix_misses seam)."""
+    if not isinstance(token, str):
+        return TENANT_NONE
+    parts = token.split(".")
+    if len(parts) < 2:
+        return TENANT_NONE
+    seg = parts[1]
+    if not seg or len(seg) > _MAX_PAYLOAD_SEG:
+        return TENANT_NONE
+    try:
+        pad = "=" * (-len(seg) % 4)
+        claims = json.loads(base64.urlsafe_b64decode(seg + pad))
+    except (ValueError, binascii.Error, UnicodeDecodeError):
+        return TENANT_NONE
+    if not isinstance(claims, dict):
+        return TENANT_NONE
+    return issuer_hash(claims.get("iss"))
+
+
+# ---------------------------------------------------------------------------
 # family + kid extraction (bounded, cached — hot-path safe)
 # ---------------------------------------------------------------------------
 
@@ -206,9 +343,14 @@ _MLDSA_FAMILY = {"ML-DSA-44": "mldsa44", "ML-DSA-65": "mldsa65",
                  "SLH-DSA-SHAKE-128f": "slhdsa128f"}
 
 # JOSE headers repeat massively across a token stream (one IdP = a
-# handful of distinct headers), so (family, kid-hash) is cached by the
-# raw header segment. The cache holds header TEXT as keys in memory
-# only — nothing from it is ever recorded. Bounded: cleared at cap.
+# handful of distinct headers), so (family, kid-hash, tenant-label)
+# is cached by the raw header segment. The cache holds header TEXT as
+# keys in memory only — nothing from it is ever recorded. Bounded:
+# cleared at cap. The tenant slot is resolved LAZILY (None until a
+# tenant-aware caller supplies a token whose payload carries the
+# issuer) — attribution granularity is therefore per distinct header,
+# which is what lets the native readers classify tenants at frame-
+# parse time without ever parsing a payload in C.
 _HDR_CACHE: Dict[str, tuple] = {}
 _HDR_CACHE_CAP = 4096
 _HDR_LOCK = threading.Lock()
@@ -254,12 +396,65 @@ def _seg_family_kid(seg: Any) -> tuple:
         return ("unknown", None)
     hit = _HDR_CACHE.get(seg)
     if hit is not None:
-        return hit
-    out = _parse_header_segment(seg)
+        return hit[:2]
+    out = _parse_header_segment(seg) + (None,)
     with _HDR_LOCK:
         if len(_HDR_CACHE) >= _HDR_CACHE_CAP:
             _HDR_CACHE.clear()
         _HDR_CACHE[seg] = out
+    return out[:2]
+
+
+def _seg_fkt(seg: Any, token: Any) -> tuple:
+    """(family, kid-hash-or-None, tenant-label) for one header segment,
+    resolving the tenant lazily from ``token``'s payload on the first
+    tenant-aware sighting of the segment. The label is the table's
+    DISPLAY label (hash while the tenant table has room, "other" once
+    it overflowed, "none" without an issuer) captured at resolve time
+    — stable for the cached lifetime of the segment, which is exactly
+    what keeps the Python fold and the native plane bit-identical
+    (fix_misses resolves through THIS function)."""
+    if not isinstance(seg, str) or not seg or len(seg) > 1024:
+        return ("unknown", None, TENANT_NONE)
+    hit = _HDR_CACHE.get(seg)
+    if hit is not None and hit[2] is not None:
+        return hit
+    fam, kid = hit[:2] if hit is not None else _parse_header_segment(seg)
+    raw = token_tenant(token)
+    label = raw if raw == TENANT_NONE else TENANTS.admit(raw)[1]
+    out = (fam, kid, label)
+    with _HDR_LOCK:
+        if len(_HDR_CACHE) >= _HDR_CACHE_CAP:
+            _HDR_CACHE.clear()
+        _HDR_CACHE[seg] = out
+    return out
+
+
+def tenant_index(label: str) -> int:
+    """The native-plane slot for a resolved tenant label (the inverse
+    lives in ``TENANTS.labels()``)."""
+    if label == TENANT_NONE:
+        return TENANT_NONE_IDX
+    if label == TENANT_OTHER:
+        return TENANT_OTHER_IDX
+    return TENANTS.admit(label)[0]
+
+
+def tenant_labels_from_slots(slots: Sequence[int]) -> List[str]:
+    """Native-plane slot array → labels (the native serve chain's
+    per-tenant vcache accounting; unresolved slots map to "none")."""
+    labels = TENANTS.labels()
+    return [labels.get(int(s), TENANT_NONE) for s in slots]
+
+
+def tenant_labels(tokens: Sequence[Any]) -> List[str]:
+    """Per-token tenant labels (header-segment cached — O(1) per
+    repeated header). The python serve chain's cache tier uses this
+    for its per-tenant vcache accounting."""
+    out = []
+    for t in tokens:
+        seg = t.split(".", 1)[0] if isinstance(t, str) else None
+        out.append(_seg_fkt(seg, t)[2])
     return out
 
 
@@ -370,8 +565,12 @@ def record_batch(surface: str, results: Sequence[Any],
         # index list materialized — sampling indexes a range
         accept_idx = range(len(results))
 
+    n_results = len(results)
+    ten_counts: Counter = Counter()
+    ten_of = None
     if families is not None:
         fam_counts = Counter(families)
+        ten_counts[TENANT_NONE] = n_results
 
         def fam_kid(i: int) -> tuple:
             return (families[i], None)
@@ -382,21 +581,78 @@ def record_batch(surface: str, results: Sequence[Any],
             segs = [t.split(".", 1)[0] if isinstance(t, str) else None
                     for t in tokens]
         seg_counts = Counter(segs)
-        seg_fk = {seg: _seg_family_kid(seg) for seg in seg_counts}
+        # tenant resolution rides the SAME per-distinct-segment pass:
+        # the first occurrence of a segment in the chunk supplies the
+        # payload the issuer comes from (exactly what the native
+        # plane's fix_misses does — parity by construction)
+        seg_first: Dict[Any, int] = {}
+        for i, seg in enumerate(segs):
+            if seg not in seg_first:
+                seg_first[seg] = i
+        seg_fk = {seg: _seg_fkt(seg, tokens[seg_first[seg]])
+                  for seg in seg_counts}
         fam_counts = Counter()
         for seg, k in seg_counts.items():
             fam_counts[seg_fk[seg][0]] += k
+            ten_counts[seg_fk[seg][2]] += k
 
         def fam_kid(i: int) -> tuple:
-            return seg_fk[segs[i]]
+            return seg_fk[segs[i]][:2]
+
+        def ten_of(i: int) -> str:
+            return seg_fk[segs[i]][2]
     else:
         fam_counts = Counter({"unknown": len(results)})
+        ten_counts[TENANT_NONE] = n_results
 
         def fam_kid(i: int) -> tuple:
             return ("unknown", None)
 
     increments = {f"decision.{surface}.family.{fam}": k
                   for fam, k in fam_counts.items()}
+    # per-tenant accounting: tokens / accept / reject(+reason) per
+    # resolved tenant label, plus the exact global equation
+    # tenant.lookups == tenant.attributed + tenant.overflow
+    if n_results:
+        overflow = ten_counts.get(TENANT_OTHER, 0)
+        increments["tenant.lookups"] = n_results
+        if n_results - overflow:
+            increments["tenant.attributed"] = n_results - overflow
+        if overflow:
+            increments["tenant.overflow"] = overflow
+    for t, k in ten_counts.items():
+        increments[f"decision.{surface}.tenant.{t}.tokens"] = k
+    if reject_groups and ten_of is not None:
+        rej_ten: Dict[str, Counter] = {}
+        ten_rejects: Counter = Counter()
+        for reason, idxs in reject_groups.items():
+            c = Counter(ten_of(i) for i in idxs)
+            rej_ten[reason] = c
+            ten_rejects.update(c)
+        for t, k in ten_rejects.items():
+            increments[f"decision.{surface}.tenant.{t}.reject"] = k
+        for reason, c in rej_ten.items():
+            for t, k in c.items():
+                increments[
+                    f"decision.{surface}.tenant.{t}.reject.{reason}"] = k
+        for t, k in ten_counts.items():
+            acc = k - ten_rejects.get(t, 0)
+            if acc:
+                increments[f"decision.{surface}.tenant.{t}.accept"] = acc
+    elif reject_groups:
+        # families-only / token-less chunks attribute to "none"
+        n_rej = sum(len(v) for v in reject_groups.values())
+        increments[f"decision.{surface}.tenant.{TENANT_NONE}.reject"] \
+            = n_rej
+        for reason, idxs in reject_groups.items():
+            increments[f"decision.{surface}.tenant.{TENANT_NONE}"
+                       f".reject.{reason}"] = len(idxs)
+        if n_results - n_rej:
+            increments[f"decision.{surface}.tenant.{TENANT_NONE}"
+                       ".accept"] = n_results - n_rej
+    else:
+        for t, k in ten_counts.items():
+            increments[f"decision.{surface}.tenant.{t}.accept"] = k
     accept_key = f"decision.{surface}.accept"
     if accept_idx:
         increments[accept_key] = len(accept_idx)
@@ -404,6 +660,15 @@ def record_batch(surface: str, results: Sequence[Any],
         increments[f"decision.{surface}.reject.{reason}"] = len(idxs)
     # one lock round for the whole chunk's counters
     post = rec.count_many(increments)
+    # per-tenant latency histograms (serve surface only — the worker
+    # side is where verification latency is real; router/front-door
+    # views come from merged worker snapshots): every token of the
+    # chunk observes the chunk latency into its tenant's series, as
+    # ONE bucket add of k per tenant (sum += value * k, the exact
+    # arithmetic the native plane replicates)
+    if surface == "serve" and latency_s is not None:
+        for t, k in ten_counts.items():
+            rec.observe_many(f"tenant.{t}.request_s", latency_s, k)
 
     def bulk(key: str, idxs, verdict: str,
              reason: Optional[str]) -> None:
@@ -468,6 +733,42 @@ def record_one(surface: str, result: Any, token: Optional[str] = None,
                  latency_s=latency_s, trace=trace)
 
 
+def record_wrong_verdict(token: Any = None, n: int = 1) -> None:
+    """Count a verdict conflict caught by a cross-check — globally
+    (``decision.wrong_verdicts``, the zero-tolerance SLO) AND per
+    tenant (``decision.tenant.<t>.wrong_verdicts``, the per-tenant
+    zero-tolerance default rule) when the offending token is known."""
+    rec = telemetry.active()
+    if rec is None or n <= 0:
+        return
+    inc = {"decision.wrong_verdicts": n}
+    if token is not None:
+        seg = token.split(".", 1)[0] if isinstance(token, str) else None
+        label = _seg_fkt(seg, token)[2]
+        inc[f"decision.tenant.{label}.wrong_verdicts"] = n
+    rec.count_many(inc)
+
+
+def count_tenant_cache(labels: Sequence[str],
+                       miss_idx: Sequence[int]) -> None:
+    """Fold one vcache consult into per-tenant hit accounting
+    (``vcache.tenant.<t>.lookups`` / ``.hits``) — what capstat's
+    tenant ledger renders as per-tenant hit%. One count_many round
+    per batch; a no-op while telemetry is off."""
+    rec = telemetry.active()
+    if rec is None or not labels:
+        return
+    lookups = Counter(labels)
+    hits = lookups - Counter(labels[i] for i in miss_idx)
+    inc = {}
+    for t, k in lookups.items():
+        inc[f"vcache.tenant.{t}.lookups"] = k
+    for t, k in hits.items():
+        if k:
+            inc[f"vcache.tenant.{t}.hits"] = k
+    rec.count_many(inc)
+
+
 # ---------------------------------------------------------------------------
 # read side helpers (capstat / obs_smoke)
 # ---------------------------------------------------------------------------
@@ -487,7 +788,11 @@ def surface_totals(counters: Dict[str, int]) -> Dict[str, Dict[str, int]]:
         if not k.startswith("decision."):
             continue
         parts = k.split(".")
-        if len(parts) < 3 or parts[2] == "family":
+        # tenant-keyed counters (decision.<surface>.tenant.<t>.* and
+        # decision.tenant.<t>.wrong_verdicts) have their own rollup
+        # (tenant_totals) — they must not double into the surface view
+        if len(parts) < 3 or parts[1] == "tenant" \
+                or parts[2] in ("family", "tenant"):
             continue
         surf = parts[1]
         row = out.setdefault(surf, {"accept": 0, "reject": 0})
@@ -497,6 +802,53 @@ def surface_totals(counters: Dict[str, int]) -> Dict[str, Dict[str, int]]:
             row["reject"] += int(v)
             row[f"reject.{parts[3]}"] = row.get(f"reject.{parts[3]}", 0) \
                 + int(v)
+    return out
+
+
+def tenant_totals(counters: Dict[str, int],
+                  surface: Optional[str] = None
+                  ) -> Dict[str, Dict[str, int]]:
+    """Per-tenant rollup from a (merged) counter map: tenant label →
+    {tokens, accept, reject, reject.<reason>…, wrong_verdicts,
+    vcache.lookups, vcache.hits}. ``surface`` narrows the decision
+    counters to one surface (capstat's ledger uses "serve" — worker-
+    side truth); None sums every surface."""
+    out: Dict[str, Dict[str, int]] = {}
+
+    def row(t: str) -> Dict[str, int]:
+        return out.setdefault(t, {"tokens": 0, "accept": 0,
+                                  "reject": 0})
+
+    for k, v in counters.items():
+        parts = k.split(".")
+        if k.startswith("decision.tenant.") and len(parts) == 4 \
+                and parts[3] == "wrong_verdicts":
+            r = row(parts[2])
+            r["wrong_verdicts"] = r.get("wrong_verdicts", 0) + int(v)
+            continue
+        if k.startswith("vcache.tenant.") and len(parts) == 4:
+            r = row(parts[2])
+            key = f"vcache.{parts[3]}"
+            r[key] = r.get(key, 0) + int(v)
+            continue
+        if not k.startswith("decision.") or len(parts) < 5 \
+                or parts[2] != "tenant":
+            continue
+        if surface is not None and parts[1] != surface:
+            continue
+        t = parts[3]
+        r = row(t)
+        what = parts[4]
+        if what == "tokens":
+            r["tokens"] += int(v)
+        elif what == "accept":
+            r["accept"] += int(v)
+        elif what == "reject":
+            if len(parts) >= 6:
+                r[f"reject.{parts[5]}"] = r.get(f"reject.{parts[5]}", 0) \
+                    + int(v)
+            else:
+                r["reject"] += int(v)
     return out
 
 

@@ -42,9 +42,11 @@ _FLIGHT_KEEP = 16
 
 
 def _scrub(obj: Any) -> Any:
-    """Recursive write-boundary scrub (strings only; keys included)."""
+    """Recursive write-boundary scrub (strings only; keys included).
+    Token-shaped, over-long, and raw-issuer-shaped (URL — tenants are
+    recorded only as hashes) strings are all replaced."""
     if isinstance(obj, str):
-        if "eyJ" in obj or len(obj) > _MAX_STR:
+        if "eyJ" in obj or "://" in obj or len(obj) > _MAX_STR:
             return "[redacted]"
         return obj
     if isinstance(obj, dict):
@@ -187,6 +189,23 @@ def render_postmortem(doc: Dict[str, Any]) -> str:
         lines.append(f"  decisions[{surf}]: accept={row['accept']} "
                      f"reject={row['reject']}"
                      + (f"  ({reasons})" if reasons else ""))
+    tenants = _decision.tenant_totals(counters)
+    if tenants:
+        lines.append(f"  tenants ({len(tenants)} attributed):")
+        ordered = sorted(tenants.items(),
+                         key=lambda kv: kv[1].get("tokens", 0),
+                         reverse=True)
+        for t, r in ordered[:8]:
+            mix = "  ".join(f"{k.split('.', 1)[1]}={v}"
+                            for k, v in sorted(r.items())
+                            if k.startswith("reject."))
+            lines.append(
+                f"    tenant={t:<12} tokens={r.get('tokens', 0)} "
+                f"accept={r.get('accept', 0)} "
+                f"reject={r.get('reject', 0)}"
+                + (f"  wrong_verdicts={r['wrong_verdicts']}"
+                   if r.get("wrong_verdicts") else "")
+                + (f"  ({mix})" if mix else ""))
     summary = telemetry.summarize_snapshot(snap)
     for name in sorted(summary):
         s = summary[name]

@@ -30,6 +30,16 @@ Rule kinds
     lifetime totals (documented limitation — windowed quantiles would
     need bucket-delta history).
 
+Any rule whose names contain the literal ``tenant.*`` is a
+**per-tenant template**: at evaluation time it expands into one
+concrete rule per observed tenant id (``tenant.<t>`` substituted
+everywhere, result named ``rule[<t>]``, multi-window burn semantics
+unchanged) — e.g. ``tr ratio decision.serve.tenant.*.reject /
+decision.serve.tenant.*.tokens max 0.5 burn 1.5`` pages on the ONE
+issuer burning its rejection budget while every other tenant's rule
+stays green. ``tq quantile tenant.*.request_s p99 max 0.05`` works
+the same way over the per-tenant latency series.
+
 Windows: an :class:`SLOEngine` fed periodic snapshots via
 :meth:`SLOEngine.observe` evaluates counter/ratio rules over each
 configured window's delta. A one-shot evaluation (``capstat --slo``
@@ -46,10 +56,22 @@ Rules files are plain text (one rule per line, ``#`` comments):
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import telemetry
+
+# Per-tenant rule templates: any rule whose counter/series names
+# contain the literal ``tenant.*`` is EXPANDED at evaluation time —
+# one concrete rule per tenant id observed in the evaluated counters
+# and series (``tenant.<t>`` substituted, result name ``rule[<t>]``).
+# Tenant ids are issuer hashes plus the fixed "none"/"other" labels
+# (docs/OBSERVABILITY.md §Tenant attribution); a template with no
+# observed tenants evaluates to a single vacuous-ok result so a quiet
+# fleet never pages.
+TENANT_WILDCARD = "tenant.*"
+_TENANT_ID_RE = re.compile(r"\btenant\.([0-9a-f]{12}|none|other)\.")
 
 DEFAULT_RULES_TEXT = """
 # The availability contract, as data. `capstat --slo` evaluates these
@@ -66,6 +88,15 @@ push_failures    ratio keyplane.push_failures / keyplane.push_attempts max 0.5
 # accept served past its exp/epoch clamp would be a wrong verdict in
 # the making (docs/SERVE.md cache-tier invalidation matrix).
 stale_accepts    counter vcache.stale_accepts max 0
+# Per-tenant budgets (templates — expanded per observed tenant id):
+# wrong verdicts are zero-tolerance per tenant exactly as globally,
+# and a tenant whose traffic is mostly rejections is burning its own
+# rejection budget (a flooding/abusive issuer shows up HERE without
+# drowning in fleet-wide averages). Thresholds: ratio > 0.5 sustained
+# at burn > 1.5 → a tenant sending ≥75% garbage pages; the obs-smoke
+# two-tenant gate pins flood-breaches-while-quiet-stays-green.
+tenant_wrong_verdicts counter decision.tenant.*.wrong_verdicts max 0
+tenant_reject_ratio   ratio decision.serve.tenant.*.reject / decision.serve.tenant.*.tokens max 0.5 burn 1.5
 """
 
 
@@ -148,6 +179,41 @@ def default_rules() -> List[SLORule]:
     return parse_rules(DEFAULT_RULES_TEXT)
 
 
+def is_tenant_template(rule: SLORule) -> bool:
+    return any(TENANT_WILDCARD in f for f in
+               (rule.counter, rule.num, rule.den, rule.series))
+
+
+def observed_tenants(counters: Dict[str, Any],
+                     series_names: Sequence[str] = ()) -> List[str]:
+    """Tenant ids present in a counter map / series-name set — what a
+    ``tenant.*`` rule template expands over."""
+    ids = set()
+    for k in counters:
+        m = _TENANT_ID_RE.search(k)
+        if m:
+            ids.add(m.group(1))
+    for k in series_names:
+        m = _TENANT_ID_RE.search(k)
+        if m:
+            ids.add(m.group(1))
+    return sorted(ids)
+
+
+def expand_tenant_rule(rule: SLORule, tenant_id: str) -> SLORule:
+    """One concrete rule for one tenant id (``tenant.*`` substituted,
+    name suffixed ``[<id>]``)."""
+    sub = f"tenant.{tenant_id}"
+    return SLORule(
+        f"{rule.name}[{tenant_id}]", rule.kind,
+        counter=rule.counter.replace(TENANT_WILDCARD, sub),
+        num=rule.num.replace(TENANT_WILDCARD, sub),
+        den=rule.den.replace(TENANT_WILDCARD, sub),
+        series=rule.series.replace(TENANT_WILDCARD, sub),
+        quantile=rule.quantile, max_value=rule.max_value,
+        burn_threshold=rule.burn_threshold)
+
+
 class SLOEngine:
     """Evaluate rules against snapshots, with optional burn windows.
 
@@ -214,9 +280,30 @@ class SLOEngine:
         deltas = self._window_deltas(now)
         summary = (telemetry.summarize_snapshot(snapshot)
                    if snapshot is not None else {})
+        # tenant templates expand over the tenants observed in the
+        # LATEST counters + the snapshot's series names — per-tenant
+        # objectives are evaluated per tenant, never averaged across
+        # tenants (a flooding issuer must not hide behind quiet ones)
+        tenants: Optional[List[str]] = None
         results = []
         for rule in self.rules:
-            results.append(self._eval_rule(rule, deltas, summary))
+            if not is_tenant_template(rule):
+                results.append(self._eval_rule(rule, deltas, summary))
+                continue
+            if tenants is None:
+                latest = self._samples[-1][1] if self._samples else {}
+                tenants = observed_tenants(latest, summary.keys())
+            if not tenants:
+                results.append({
+                    "name": rule.name, "kind": rule.kind, "ok": True,
+                    "windows": {},
+                    "detail": "no tenants observed (template idle)"})
+                continue
+            for tid in tenants:
+                res = self._eval_rule(expand_tenant_rule(rule, tid),
+                                      deltas, summary)
+                res["tenant"] = tid
+                results.append(res)
         return results
 
     def _eval_rule(self, rule: SLORule,

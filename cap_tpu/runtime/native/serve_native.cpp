@@ -398,10 +398,12 @@ struct Req {
   std::vector<int64_t> offs;  // entry boundaries into blob (n+1)
   std::string blob;           // concatenated entry bytes
   // telemetry plane (when attached): per-token family index (-1 =
-  // header-cache miss, resolved by Python on the drain path) and
-  // hashed kid, classified by THIS reader thread at parse time.
+  // header-cache miss, resolved by Python on the drain path), hashed
+  // kid, and tenant slot (issuer hash → bounded table; misses resolve
+  // with the family), classified by THIS reader thread at parse time.
   std::vector<int8_t> fams;
   std::string kids;  // 12 bytes per token, zero = none
+  std::vector<int16_t> tens;
   // verdict cache (when enabled): sha256(token)[:16] per token,
   // computed by THIS reader thread at parse time
   std::string digests;
@@ -436,10 +438,12 @@ struct Handle {
   // native telemetry plane (nullable; cap_serve_set_telemetry). Owned
   // by this handle once attached — freed together in destroy.
   cap_tel::TelPlane* tel = nullptr;
-  // per-token (fam, kid) of the LAST drain call, in drain order —
-  // cap_serve_drain_aux copies them out; single-consumer like carry.
+  // per-token (fam, kid, tenant) of the LAST drain call, in drain
+  // order — cap_serve_drain_aux / cap_serve_drain_tens copy them out;
+  // single-consumer like carry.
   std::vector<int8_t> last_fams;
   std::vector<uint8_t> last_kids;
+  std::vector<int16_t> last_tens;
   // shm transport armed (cap_serve_set_shm): attach requests are
   // honored; off → acked status 1 + CTR_SHM_FALLBACKS (the socket
   // chain keeps serving, the r12 graceful-fallback contract)
@@ -610,11 +614,14 @@ static bool handle_frame(const std::shared_ptr<Conn>& c,
       }
     }
     if (h->tel && r->kind == K_VERIFY) {
-      // classify each token's family here, GIL-free, while the
-      // frame bytes are cache-hot: header segment = bytes before
-      // the first '.' (token.split(".", 1)[0], byte-for-byte)
+      // classify each token's family AND tenant here, GIL-free, while
+      // the frame bytes are cache-hot: header segment = bytes before
+      // the first '.' (token.split(".", 1)[0], byte-for-byte); the
+      // tenant slot rides the same cache entry (issuer parsing only
+      // ever happens in Python, on a miss)
       r->fams.resize(nent);
       r->kids.assign(nent * cap_tel::KID_LEN, '\0');
+      r->tens.assign(nent, (int16_t)-1);
       for (size_t i = 0; i < nent; i++) {
         const uint8_t* tok = base + p.entries[i].off;
         int64_t tlen = p.entries[i].len;
@@ -624,7 +631,9 @@ static bool handle_frame(const std::shared_ptr<Conn>& c,
         int32_t kid_len = 0;
         r->fams[i] = (int8_t)cap_tel::classify(
             h->tel, tok, slen,
-            (uint8_t*)&r->kids[i * cap_tel::KID_LEN], &kid_len);
+            (uint8_t*)&r->kids[i * cap_tel::KID_LEN], &kid_len,
+            &r->tens[i]);
+        if (r->fams[i] < 0) r->tens[i] = -1;  // miss: Python resolves
       }
     }
     int64_t ntok = r->kind == K_VERIFY ? (int64_t)nent : 1;
@@ -967,6 +976,7 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
   if (h->tel) {
     h->last_fams.clear();
     h->last_kids.clear();
+    h->last_tens.clear();
   }
   bool want_digests = h->digests_on.load(std::memory_order_relaxed);
   if (want_digests) h->last_digests.clear();
@@ -1024,17 +1034,22 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
       std::memcpy(trace_buf + (size_t)n_reqs * MAX_TRACE_BYTES, r->trace,
                   r->trace_len);
     if (h->tel) {
-      // keep token-aligned (fam, kid) for cap_serve_drain_aux —
-      // control entries get filler slots so offsets line up
+      // keep token-aligned (fam, kid, tenant) for cap_serve_drain_aux
+      // / cap_serve_drain_tens — control entries get filler slots so
+      // offsets line up
       if (r->kind == K_VERIFY && (int64_t)r->fams.size() == nent) {
         h->last_fams.insert(h->last_fams.end(), r->fams.begin(),
                             r->fams.end());
         h->last_kids.insert(h->last_kids.end(), r->kids.begin(),
                             r->kids.end());
+        h->last_tens.insert(h->last_tens.end(), r->tens.begin(),
+                            r->tens.end());
       } else {
         h->last_fams.insert(h->last_fams.end(), (size_t)nent, -1);
         h->last_kids.insert(h->last_kids.end(),
                             (size_t)nent * cap_tel::KID_LEN, 0);
+        h->last_tens.insert(h->last_tens.end(), (size_t)nent,
+                            (int16_t)-1);
       }
     }
     if (want_digests) {
@@ -1085,8 +1100,10 @@ static int32_t post_results_impl(Handle* h, const int32_t* req_meta,
                                  const int64_t* payload_off,
                                  const uint8_t* reasons,
                                  const int8_t* fams,
+                                 const int16_t* tens,
                                  const uint8_t* kids,
-                                 int32_t lat_idx, bool do_fold) {
+                                 int32_t lat_idx, double lat_s,
+                                 bool do_fold) {
   int64_t t = 0;
   int32_t dropped = 0;
   double now = (do_fold && req_t0) ? wall_now() : 0.0;
@@ -1148,8 +1165,8 @@ static int32_t post_results_impl(Handle* h, const int32_t* req_meta,
   }
   if (do_fold && h->tel && t > 0) {
     cap_tel::observe(h->tel, cap_tel::SERIES_CHUNK_TOKENS, (double)t);
-    cap_tel::fold(h->tel, t, statuses, reasons, fams, kids, lat_idx,
-                  fold_trace, fold_trace_len);
+    cap_tel::fold(h->tel, t, statuses, reasons, fams, tens, kids,
+                  lat_idx, lat_s, fold_trace, fold_trace_len);
   }
   return dropped;
 }
@@ -1162,24 +1179,28 @@ int32_t cap_serve_post_results(void* hv, const int32_t* req_meta,
                                const int64_t* payload_off) {
   return post_results_impl((Handle*)hv, req_meta, req_seq, trace_buf,
                            nullptr, n_reqs, statuses, payload_blob,
-                           payload_off, nullptr, nullptr, nullptr, 0,
-                           false);
+                           payload_off, nullptr, nullptr, nullptr,
+                           nullptr, 0, -1.0, false);
 }
 
 // The telemetry-folding variant (a separate symbol so a stale .so
 // degrades the plane gracefully — the binding probes for it and falls
-// back to the Python fold when absent). reasons may be NULL when
-// every status is 0 (the all-accept fast path).
+// back to the Python fold when absent; the r19 tenant extension rides
+// the cap_tel_layout_ten handshake, which also gates this signature).
+// reasons may be NULL when every status is 0 (the all-accept fast
+// path); tens NULL folds every token as tenant "none"; lat_s < 0
+// skips the per-tenant latency observation (latency_s=None).
 int32_t cap_serve_post_results_tel(
     void* hv, const int32_t* req_meta, const int64_t* req_seq,
     const uint8_t* trace_buf, const double* req_t0, int32_t n_reqs,
     const uint8_t* statuses, const uint8_t* payload_blob,
     const int64_t* payload_off, const uint8_t* reasons,
-    const int8_t* fams, const uint8_t* kids, int32_t lat_idx) {
+    const int8_t* fams, const int16_t* tens, const uint8_t* kids,
+    int32_t lat_idx, double lat_s) {
   return post_results_impl((Handle*)hv, req_meta, req_seq, trace_buf,
                            req_t0, n_reqs, statuses, payload_blob,
-                           payload_off, reasons, fams, kids, lat_idx,
-                           true);
+                           payload_off, reasons, fams, tens, kids,
+                           lat_idx, lat_s, true);
 }
 
 // Attach a telemetry plane (before any connection is added). The
@@ -1203,6 +1224,20 @@ int64_t cap_serve_drain_aux(void* hv, int8_t* fams_out,
     std::memcpy(kids_out, h->last_kids.data(),
                 (size_t)n * cap_tel::KID_LEN);
   }
+  return n;
+}
+
+// Per-token tenant slots of the LAST cap_serve_drain call (-1 = the
+// header-cache miss Python's fix_misses resolves), token-aligned with
+// cap_serve_drain_aux. Single-consumer, like the others.
+int64_t cap_serve_drain_tens(void* hv, int16_t* tens_out,
+                             int64_t max_tokens) {
+  Handle* h = (Handle*)hv;
+  int64_t n = (int64_t)h->last_tens.size();
+  if (n > max_tokens) n = max_tokens;
+  if (n > 0)
+    std::memcpy(tens_out, h->last_tens.data(),
+                (size_t)n * sizeof(int16_t));
   return n;
 }
 

@@ -56,6 +56,7 @@ struct CacheEnt {
   std::string seg;
   int8_t fam = 0;
   uint8_t kid_len = 0;
+  int16_t ten = TEN_NONE;
   char kid[KID_LEN];
   bool used = false;
 };
@@ -97,6 +98,11 @@ struct TelPlane {
   // these are global rather than per-shard — the per-key sequence
   // must match the Python fold's count_many return values exactly.
   std::atomic<int64_t> ctr[N_CTR];
+  // tenant attribution (r19): 3 globals + per-slot blocks (see
+  // telemetry_native.h TEN_* layout) and one latency histogram per
+  // slot — the binding maps slots back to issuer-hash labels.
+  std::atomic<int64_t> tctr[N_TCTR];
+  Hist ten_hist[N_TEN];
   // exemplar ring (FIFO, overwrites oldest — deque(maxlen) semantics)
   std::mutex ex_mu;
   Exemplar ex_ring[EX_RING];
@@ -109,6 +115,7 @@ struct TelPlane {
 
   TelPlane() : slots(2 * CACHE_CAP) {
     for (auto& c : ctr) c.store(0);
+    for (auto& c : tctr) c.store(0);
   }
 };
 
@@ -117,6 +124,7 @@ TelPlane* create(const double* bounds, int32_t n_bounds) {
   TelPlane* t = new TelPlane();
   t->bounds.assign(bounds, bounds + n_bounds);
   for (auto& h : t->series) h.counts.assign((size_t)n_bounds + 1, 0);
+  for (auto& h : t->ten_hist) h.counts.assign((size_t)n_bounds + 1, 0);
   return t;
 }
 
@@ -144,11 +152,15 @@ static CacheEnt* find_slot(TelPlane* t, const uint8_t* seg, int64_t len,
 }
 
 int32_t classify(TelPlane* t, const uint8_t* seg, int64_t len,
-                 uint8_t* kid_out, int32_t* kid_len_out) {
+                 uint8_t* kid_out, int32_t* kid_len_out,
+                 int16_t* ten_out) {
   if (kid_len_out) *kid_len_out = 0;
+  if (ten_out) *ten_out = TEN_NONE;
   // decision._seg_family_kid: empty or over-long segments are
   // "unknown" without touching the cache (bytes > chars never makes
   // a segment parseable: non-ASCII is invalid base64url anyway).
+  // Tenant follows the same bound: "none" without a payload parse,
+  // exactly like decision._seg_fkt.
   if (len <= 0 || len > MAX_SEG_BYTES) return FAM_UNKNOWN;
   std::lock_guard<std::mutex> lk(t->cache_mu);
   bool found;
@@ -162,14 +174,16 @@ int32_t classify(TelPlane* t, const uint8_t* seg, int64_t len,
     std::memcpy(kid_out, e->kid, e->kid_len);
     if (kid_len_out) *kid_len_out = e->kid_len;
   }
+  if (ten_out) *ten_out = e->ten;
   return e->fam;
 }
 
 void learn(TelPlane* t, const uint8_t* seg, int64_t len, int32_t fam,
-           const uint8_t* kid, int32_t kid_len) {
+           const uint8_t* kid, int32_t kid_len, int32_t ten) {
   if (len <= 0 || len > MAX_SEG_BYTES) return;
   if (fam < 0 || fam >= N_FAM) fam = FAM_UNKNOWN;
   if (kid_len != KID_LEN || !kid) kid_len = 0;
+  if (ten < 0 || ten >= N_TEN) ten = TEN_NONE;
   std::lock_guard<std::mutex> lk(t->cache_mu);
   if (t->cache_used >= CACHE_CAP) {  // clear at cap, like _HDR_CACHE
     for (auto& e : t->slots) {
@@ -187,6 +201,7 @@ void learn(TelPlane* t, const uint8_t* seg, int64_t len, int32_t fam,
   }
   e->fam = (int8_t)fam;
   e->kid_len = (uint8_t)kid_len;
+  e->ten = (int16_t)ten;
   if (kid_len) std::memcpy(e->kid, kid, (size_t)kid_len);
 }
 
@@ -211,6 +226,33 @@ void observe(TelPlane* t, int32_t series, double value) {
   }
   h.count++;
   h.sum += value;
+}
+
+// telemetry.Histogram.add_many: k observations of one value in one
+// bucket add, sum += value * k — the per-(chunk, tenant) latency
+// fold. The arithmetic ORDER matches the Python side exactly, so
+// merged states stay bit-identical.
+static void hist_add_many(TelPlane* t, Hist& h, double value,
+                          int64_t k) {
+  if (k <= 0) return;
+  size_t idx = (size_t)(std::lower_bound(t->bounds.begin(),
+                                         t->bounds.end(), value) -
+                        t->bounds.begin());
+  std::lock_guard<std::mutex> lk(h.mu);
+  h.counts[idx] += k;
+  if (h.count == 0) {
+    h.vmin = value;
+    h.vmax = value;
+  } else {
+    if (value < h.vmin) h.vmin = value;
+    if (value > h.vmax) h.vmax = value;
+  }
+  h.count += k;
+  // volatile: forbid the compiler from contracting the multiply-add
+  // into one FMA (-O3 -march=native does) — Python rounds the product
+  // BEFORE the add, and the parity pin is bit-exact sums
+  volatile double add = value * (double)k;
+  h.sum += add;
 }
 
 // -- the fold ---------------------------------------------------------------
@@ -238,24 +280,33 @@ static void build_exemplar(Exemplar& ex, int32_t key, int8_t fam,
 
 void fold(TelPlane* t, int64_t n_tokens, const uint8_t* statuses,
           const uint8_t* reasons, const int8_t* fams,
-          const uint8_t* kids, int32_t lat_idx, const uint8_t* trace,
-          int32_t trace_len) {
+          const int16_t* tens, const uint8_t* kids, int32_t lat_idx,
+          double lat_s, const uint8_t* trace, int32_t trace_len) {
   if (n_tokens <= 0) return;  // record_batch: empty chunk is a no-op
   if (lat_idx < 0 || lat_idx >= N_LAT) lat_idx = LAT_NA;
-  // one pass: group token indices by decision key, count families —
-  // the same grouping record_batch builds before its count_many call.
+  // one pass: group token indices by decision key, count families
+  // and tenants — the same grouping record_batch builds before its
+  // count_many call. Tenant counts accumulate on the stack (~7 KB)
+  // and apply as ONE atomic add per touched key per chunk.
   std::vector<int32_t> accept_idx;
   std::vector<int32_t> rej_idx[N_REASON];
   int reason_order[N_REASON];
   int n_reasons = 0;
   bool seen[N_REASON] = {};
   int64_t fam_counts[N_FAM] = {};
+  int64_t tloc[N_TEN * TEN_STRIDE];
+  std::memset(tloc, 0, sizeof(tloc));
   for (int64_t i = 0; i < n_tokens; i++) {
     int f = fams ? fams[i] : FAM_UNKNOWN;
     if (f < 0 || f >= N_FAM) f = FAM_UNKNOWN;
     fam_counts[f]++;
+    int ten = tens ? tens[i] : TEN_NONE;
+    if (ten < 0 || ten >= N_TEN) ten = TEN_NONE;
+    int64_t* tb = tloc + ten * TEN_STRIDE;
+    tb[0]++;  // tokens
     if (!statuses || statuses[i] == 0) {
       accept_idx.push_back((int32_t)i);
+      tb[1]++;  // accept
     } else {
       int r = reasons ? reasons[i] : (N_REASON - 1);  // internal
       if (r < 0 || r >= N_REASON) r = N_REASON - 1;
@@ -264,12 +315,36 @@ void fold(TelPlane* t, int64_t n_tokens, const uint8_t* statuses,
         reason_order[n_reasons++] = r;  // first-occurrence order
       }
       rej_idx[r].push_back((int32_t)i);
+      tb[2]++;        // reject total
+      tb[3 + r]++;    // reject by reason
     }
   }
   for (int f = 0; f < N_FAM; f++)
     if (fam_counts[f])
       t->ctr[CTR_FAM0 + f].fetch_add(fam_counts[f],
                                      std::memory_order_relaxed);
+  // tenant counters + the exact lookups == attributed + overflow
+  // equation (record_batch emits the same three globals)
+  int64_t ovf = tloc[TEN_OTHER * TEN_STRIDE + 0];
+  t->tctr[TCTR_LOOKUPS].fetch_add(n_tokens, std::memory_order_relaxed);
+  if (n_tokens - ovf)
+    t->tctr[TCTR_ATTRIBUTED].fetch_add(n_tokens - ovf,
+                                       std::memory_order_relaxed);
+  if (ovf)
+    t->tctr[TCTR_OVERFLOW].fetch_add(ovf, std::memory_order_relaxed);
+  for (int s = 0; s < N_TEN; s++) {
+    int64_t* tb = tloc + s * TEN_STRIDE;
+    if (!tb[0]) continue;
+    for (int j = 0; j < TEN_STRIDE; j++)
+      if (tb[j])
+        t->tctr[TCTR_BASE + s * TEN_STRIDE + j].fetch_add(
+            tb[j], std::memory_order_relaxed);
+    // per-tenant latency histogram: every token of the chunk
+    // observes the chunk latency, as one bucket add of k
+    // (record_batch's serve-surface observe_many)
+    if (lat_s >= 0.0)
+      hist_add_many(t, t->ten_hist[s], lat_s, tb[0]);
+  }
   std::vector<Exemplar> exs;
   static const uint8_t no_kid[KID_LEN] = {};
   auto emit = [&](int key, std::atomic<int64_t>& c,
@@ -337,6 +412,17 @@ void cap_tel_layout(int32_t* out) {
   out[7] = EX_RING;
 }
 
+// Tenant-block handshake (r19, a separate symbol so its ABSENCE in a
+// stale .so disables the plane cleanly — the binding requires it):
+// slot count, per-slot stride, total tenant-counter block, overflow
+// slot index. Any drift from obs/decision's registries → plane off.
+void cap_tel_layout_ten(int32_t* out) {
+  out[0] = N_TEN;
+  out[1] = TEN_STRIDE;
+  out[2] = N_TCTR;
+  out[3] = TEN_OTHER;
+}
+
 void* cap_tel_create(const double* bounds, int32_t n_bounds) {
   return create(bounds, n_bounds);
 }
@@ -344,21 +430,25 @@ void* cap_tel_create(const double* bounds, int32_t n_bounds) {
 void cap_tel_destroy(void* t) { destroy((TelPlane*)t); }
 
 int32_t cap_tel_classify_seg(void* t, const uint8_t* seg, int64_t len,
-                             uint8_t* kid_out, int32_t* kid_len_out) {
-  return classify((TelPlane*)t, seg, len, kid_out, kid_len_out);
+                             uint8_t* kid_out, int32_t* kid_len_out,
+                             int16_t* ten_out) {
+  return classify((TelPlane*)t, seg, len, kid_out, kid_len_out,
+                  ten_out);
 }
 
 void cap_tel_learn(void* t, const uint8_t* seg, int64_t len,
-                   int32_t fam, const uint8_t* kid, int32_t kid_len) {
-  learn((TelPlane*)t, seg, len, fam, kid, kid_len);
+                   int32_t fam, const uint8_t* kid, int32_t kid_len,
+                   int32_t ten) {
+  learn((TelPlane*)t, seg, len, fam, kid, kid_len, ten);
 }
 
 void cap_tel_fold(void* t, int64_t n_tokens, const uint8_t* statuses,
                   const uint8_t* reasons, const int8_t* fams,
-                  const uint8_t* kids, int32_t lat_idx,
-                  const uint8_t* trace, int32_t trace_len) {
-  fold((TelPlane*)t, n_tokens, statuses, reasons, fams, kids, lat_idx,
-       trace, trace_len);
+                  const int16_t* tens, const uint8_t* kids,
+                  int32_t lat_idx, double lat_s, const uint8_t* trace,
+                  int32_t trace_len) {
+  fold((TelPlane*)t, n_tokens, statuses, reasons, fams, tens, kids,
+       lat_idx, lat_s, trace, trace_len);
 }
 
 void cap_tel_hist_observe(void* t, int32_t series, double value) {
@@ -369,6 +459,32 @@ void cap_tel_counters(void* t, int64_t* out) {
   TelPlane* p = (TelPlane*)t;
   for (int i = 0; i < N_CTR; i++)
     out[i] = p->ctr[i].load(std::memory_order_relaxed);
+}
+
+// The whole tenant counter block (N_TCTR slots, telemetry_native.h
+// layout); the binding maps nonzero slots back to labels.
+void cap_tel_tenant_counters(void* t, int64_t* out) {
+  TelPlane* p = (TelPlane*)t;
+  for (int i = 0; i < N_TCTR; i++)
+    out[i] = p->tctr[i].load(std::memory_order_relaxed);
+}
+
+// One tenant slot's latency-histogram state (same shape as
+// cap_tel_hist_state).
+void cap_tel_tenant_hist_state(void* t, int32_t slot,
+                               int64_t* bucket_out, int64_t* count_out,
+                               double* sum_out, double* min_out,
+                               double* max_out) {
+  TelPlane* p = (TelPlane*)t;
+  if (slot < 0 || slot >= N_TEN) return;
+  Hist& h = p->ten_hist[slot];
+  std::lock_guard<std::mutex> lk(h.mu);
+  std::memcpy(bucket_out, h.counts.data(),
+              h.counts.size() * sizeof(int64_t));
+  *count_out = h.count;
+  *sum_out = h.sum;
+  *min_out = h.vmin;
+  *max_out = h.vmax;
 }
 
 // Histogram state for one series: bucket counts (n_bounds + 1 slots)
@@ -408,12 +524,19 @@ int32_t cap_tel_drain_exemplars(void* t, uint8_t* out, int32_t max_n) {
 void cap_tel_reset(void* t) {
   TelPlane* p = (TelPlane*)t;
   for (auto& c : p->ctr) c.store(0);
+  for (auto& c : p->tctr) c.store(0);
   {
     std::lock_guard<std::mutex> lk(p->ex_mu);
     p->ex_head = 0;
     p->ex_len = 0;
   }
   for (auto& h : p->series) {
+    std::lock_guard<std::mutex> lk(h.mu);
+    std::fill(h.counts.begin(), h.counts.end(), 0);
+    h.count = 0;
+    h.sum = h.vmin = h.vmax = 0.0;
+  }
+  for (auto& h : p->ten_hist) {
     std::lock_guard<std::mutex> lk(h.mu);
     std::fill(h.counts.begin(), h.counts.end(), 0);
     h.count = 0;

@@ -52,6 +52,25 @@ enum {
   KID_LEN = 12,
   MAX_SEG_BYTES = 1024,  // decision._seg_family_kid's parse bound
   CACHE_CAP = 4096,      // decision._HDR_CACHE_CAP (clear at cap)
+  // tenant attribution (r19): obs/decision.py's bounded tenant table
+  // — TENANT_CAP real slots + "none" + "other". Like families, the
+  // native side never derives a tenant itself: slots arrive from the
+  // Python classifier through learn(), counters are per SLOT here and
+  // mapped back to labels (issuer hashes) by the binding at scrape.
+  TEN_SLOTS = 64,        // decision.TENANT_CAP
+  TEN_NONE = 64,         // decision.TENANT_NONE_IDX
+  TEN_OTHER = 65,        // decision.TENANT_OTHER_IDX
+  N_TEN = 66,            // decision.N_TENANT
+  // per-slot tenant counter stride: tokens, accept, reject_total,
+  // reject[N_REASON] — then the whole block is prefixed by three
+  // globals (lookups, attributed, overflow) so the exact equation
+  // lookups == attributed + overflow folds natively too.
+  TEN_STRIDE = 3 + N_REASON,
+  TCTR_LOOKUPS = 0,
+  TCTR_ATTRIBUTED = 1,
+  TCTR_OVERFLOW = 2,
+  TCTR_BASE = 3,
+  N_TCTR = TCTR_BASE + N_TEN * TEN_STRIDE,
 };
 
 struct TelPlane;
@@ -60,24 +79,29 @@ TelPlane* create(const double* bounds, int32_t n_bounds);
 void destroy(TelPlane* t);
 
 // Classify one header SEGMENT against the native cache. Returns the
-// family index on a hit (kid copied into kid_out, kid_len_out set),
-// -1 on a miss — the caller (Python, on the drain path) resolves the
-// miss with obs/decision._seg_family_kid and learn()s it back, which
-// is what makes family classification structurally bit-exact: the
-// cache only ever holds values the Python classifier produced.
+// family index on a hit (kid copied into kid_out, kid_len_out set,
+// tenant slot into ten_out), -1 on a miss — the caller (Python, on
+// the drain path) resolves the miss with obs/decision._seg_fkt and
+// learn()s it back, which is what makes family AND tenant
+// classification structurally bit-exact: the cache only ever holds
+// values the Python classifier produced.
 int32_t classify(TelPlane* t, const uint8_t* seg, int64_t len,
-                 uint8_t* kid_out, int32_t* kid_len_out);
+                 uint8_t* kid_out, int32_t* kid_len_out,
+                 int16_t* ten_out);
 void learn(TelPlane* t, const uint8_t* seg, int64_t len, int32_t fam,
-           const uint8_t* kid, int32_t kid_len);
+           const uint8_t* kid, int32_t kid_len, int32_t ten);
 
 // Fold one chunk of verdicts: the exact obs/decision.record_batch
 // aggregation (one counter add per present key, sampling positions
 // c == 1 or c % 16 == 0 over the post-increment sequence, exemplars
 // attributed to the same token the Python fold would sample).
+// tens: per-token tenant slot (nullptr / out-of-range → TEN_NONE);
+// lat_s: the chunk latency in seconds (< 0 → no per-tenant latency
+// observation, mirroring record_batch's latency_s=None).
 void fold(TelPlane* t, int64_t n_tokens, const uint8_t* statuses,
           const uint8_t* reasons, const int8_t* fams,
-          const uint8_t* kids, int32_t lat_idx, const uint8_t* trace,
-          int32_t trace_len);
+          const int16_t* tens, const uint8_t* kids, int32_t lat_idx,
+          double lat_s, const uint8_t* trace, int32_t trace_len);
 
 void observe(TelPlane* t, int32_t series, double value);
 

@@ -58,7 +58,13 @@ _TEL_SYMBOLS = ("cap_tel_layout", "cap_tel_create", "cap_tel_destroy",
                 "cap_tel_hist_state", "cap_tel_drain_exemplars",
                 "cap_tel_reset", "cap_serve_set_telemetry",
                 "cap_serve_drain_aux", "cap_serve_post_results_tel",
-                "cap_serve_ring_hwm")
+                "cap_serve_ring_hwm",
+                # r19 tenant-attribution block: REQUIRED — a .so
+                # missing these predates tenant counting and the
+                # extended classify/fold signatures, so the plane
+                # must disable as a whole (Python fold, counted).
+                "cap_tel_layout_ten", "cap_tel_tenant_counters",
+                "cap_tel_tenant_hist_state", "cap_serve_drain_tens")
 
 # Verdict-cache digest symbols are OPTIONAL too: a stale .so without
 # them still serves — the drain loop hashes in Python instead of
@@ -95,6 +101,7 @@ CTR_SHM_DETACHES = 11
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i8p = ctypes.POINTER(ctypes.c_int8)
+_i16p = ctypes.POINTER(ctypes.c_int16)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64p = ctypes.POINTER(ctypes.c_int64)
 _f64p = ctypes.POINTER(ctypes.c_double)
@@ -208,22 +215,27 @@ def _setup_tel(lib: ctypes.CDLL) -> bool:
     if not all(hasattr(lib, s) for s in _TEL_SYMBOLS):
         return False
     lib.cap_tel_layout.argtypes = [_i32p]
+    lib.cap_tel_layout_ten.argtypes = [_i32p]
     lib.cap_tel_create.restype = ctypes.c_void_p
     lib.cap_tel_create.argtypes = [_f64p, ctypes.c_int32]
     lib.cap_tel_destroy.argtypes = [ctypes.c_void_p]
     lib.cap_tel_classify_seg.restype = ctypes.c_int32
     lib.cap_tel_classify_seg.argtypes = [
-        ctypes.c_void_p, _u8p, ctypes.c_int64, _u8p, _i32p]
+        ctypes.c_void_p, _u8p, ctypes.c_int64, _u8p, _i32p, _i16p]
     lib.cap_tel_learn.argtypes = [
         ctypes.c_void_p, _u8p, ctypes.c_int64, ctypes.c_int32, _u8p,
-        ctypes.c_int32]
+        ctypes.c_int32, ctypes.c_int32]
     lib.cap_tel_fold.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, _u8p, _u8p, _i8p, _u8p,
-        ctypes.c_int32, _u8p, ctypes.c_int32]
+        ctypes.c_void_p, ctypes.c_int64, _u8p, _u8p, _i8p, _i16p,
+        _u8p, ctypes.c_int32, ctypes.c_double, _u8p, ctypes.c_int32]
     lib.cap_tel_hist_observe.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
     lib.cap_tel_counters.argtypes = [ctypes.c_void_p, _i64p]
+    lib.cap_tel_tenant_counters.argtypes = [ctypes.c_void_p, _i64p]
     lib.cap_tel_hist_state.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, _i64p, _i64p, _f64p, _f64p,
+        _f64p]
+    lib.cap_tel_tenant_hist_state.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, _i64p, _i64p, _f64p, _f64p,
         _f64p]
     lib.cap_tel_drain_exemplars.restype = ctypes.c_int32
@@ -235,10 +247,14 @@ def _setup_tel(lib: ctypes.CDLL) -> bool:
     lib.cap_serve_drain_aux.restype = ctypes.c_int64
     lib.cap_serve_drain_aux.argtypes = [
         ctypes.c_void_p, _i8p, _u8p, ctypes.c_int64]
+    lib.cap_serve_drain_tens.restype = ctypes.c_int64
+    lib.cap_serve_drain_tens.argtypes = [
+        ctypes.c_void_p, _i16p, ctypes.c_int64]
     lib.cap_serve_post_results_tel.restype = ctypes.c_int32
     lib.cap_serve_post_results_tel.argtypes = [
         ctypes.c_void_p, _i32p, _i64p, _u8p, _f64p, ctypes.c_int32,
-        _u8p, _u8p, _i64p, _u8p, _i8p, _u8p, ctypes.c_int32]
+        _u8p, _u8p, _i64p, _u8p, _i8p, _i16p, _u8p, ctypes.c_int32,
+        ctypes.c_double]
     lib.cap_serve_ring_hwm.restype = ctypes.c_int64
     lib.cap_serve_ring_hwm.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     # layout handshake: reason/family/latency vocabularies are indexed
@@ -250,7 +266,16 @@ def _setup_tel(lib: ctypes.CDLL) -> bool:
             1 + len(_dec.REASON_INDEX) + len(_dec.FAMILIES) + 3,
             _EX_STRIDE, 2, _dec.RING_SAMPLE_EVERY,
             telemetry.MAX_DECISION_ENTRIES)
-    return tuple(int(v) for v in layout) == want
+    if tuple(int(v) for v in layout) != want:
+        return False
+    # tenant-block handshake (r19): the bounded tenant table's slot
+    # layout is ABI too — drift disables the plane the same way
+    ten_stride = 3 + len(_dec.REASON_INDEX)
+    layout_ten = np.zeros(4, np.int32)
+    lib.cap_tel_layout_ten(layout_ten.ctypes.data_as(_i32p))
+    want_ten = (_dec.N_TENANT, ten_stride,
+                3 + _dec.N_TENANT * ten_stride, _dec.TENANT_OTHER_IDX)
+    return tuple(int(v) for v in layout_ten) == want_ten
 
 
 def probe_frame(data: bytes) -> int:
@@ -320,6 +345,13 @@ class NativeTelemetryPlane:
         self._n_ctr = len(self._ctr_names)
         self._n_reason = n_reason
         self._ctr_buf = np.zeros(self._n_ctr, np.int64)
+        # tenant counter block (telemetry_native.h TEN_* layout): 3
+        # globals + per-slot [tokens, accept, reject, reject.<r>…];
+        # slots map back to issuer-hash labels via decision.TENANTS
+        # at scrape time, so names match the Python fold exactly
+        self._ten_stride = 3 + n_reason
+        self._n_tctr = 3 + _decision.N_TENANT * self._ten_stride
+        self._tctr_buf = np.zeros(self._n_tctr, np.int64)
         self._ex_buf = np.zeros(
             telemetry.MAX_DECISION_ENTRIES * _EX_STRIDE, np.uint8)
         self._bucket_buf = np.zeros(self._n_buckets, np.int64)
@@ -332,23 +364,26 @@ class NativeTelemetryPlane:
     # -- classification ---------------------------------------------------
 
     def classify_seg(self, seg_bytes: bytes):
-        """(fam_idx, kid) via the NATIVE cache; fam_idx -1 = miss."""
+        """(fam_idx, kid, tenant_slot) via the NATIVE cache; fam_idx
+        -1 = miss (tenant then unresolved too)."""
         if not self._h:
-            return (-1, None)
+            return (-1, None, -1)
         if not seg_bytes:
-            return (self._FAM_UNKNOWN, None)
+            return (self._FAM_UNKNOWN, None, _decision.TENANT_NONE_IDX)
         buf = np.frombuffer(seg_bytes, np.uint8)
         kid_out = np.zeros(_KID_LEN, np.uint8)
         kid_len = ctypes.c_int32(0)
+        ten = ctypes.c_int16(-1)
         fam = int(self._lib.cap_tel_classify_seg(
             self._h, buf.ctypes.data_as(_u8p), len(seg_bytes),
-            kid_out.ctypes.data_as(_u8p), ctypes.byref(kid_len)))
+            kid_out.ctypes.data_as(_u8p), ctypes.byref(kid_len),
+            ctypes.byref(ten)))
         kid = (kid_out[: kid_len.value].tobytes().decode("ascii")
                if kid_len.value else None)
-        return (fam, kid)
+        return (fam, kid, int(ten.value) if fam >= 0 else -1)
 
     def learn(self, seg_bytes: bytes, fam_idx: int,
-              kid: Optional[str]) -> None:
+              kid: Optional[str], ten_idx: int) -> None:
         if not self._h or not seg_bytes:
             return
         buf = np.frombuffer(seg_bytes, np.uint8)
@@ -356,23 +391,36 @@ class NativeTelemetryPlane:
         self._lib.cap_tel_learn(
             self._h, buf.ctypes.data_as(_u8p), len(seg_bytes), fam_idx,
             kb.ctypes.data_as(_u8p) if kb is not None else None,
-            _KID_LEN if kid else 0)
+            _KID_LEN if kid else 0, int(ten_idx))
 
-    def fix_misses(self, tokens, fams: np.ndarray,
-                   kids: np.ndarray) -> None:
+    def fix_misses(self, tokens, fams: np.ndarray, kids: np.ndarray,
+                   tens: Optional[np.ndarray] = None) -> None:
         """Resolve header-cache misses (fam < 0) with the Python
         classifier and teach the native cache — cold headers cost one
-        Python parse per DISTINCT header, then hit natively forever."""
+        Python parse (header AND, for the tenant, the first such
+        token's payload — decision._seg_fkt) per DISTINCT header, then
+        hit natively forever. Per-chunk the first miss of a segment
+        resolves it; later same-segment tokens reuse that resolution,
+        exactly like record_batch's per-distinct-segment pass."""
+        seen: dict = {}
         for i in np.nonzero(fams < 0)[0]:
             tok = tokens[i]
             seg = tok.split(".", 1)[0] if isinstance(tok, str) else None
-            fam_name, kid = _decision._seg_family_kid(seg)
-            fams[i] = self._fam_to_idx[fam_name]
-            if kid:
+            hit = seen.get(seg) if isinstance(seg, str) else None
+            if hit is None:
+                fam_name, kid, ten_label = _decision._seg_fkt(seg, tok)
+                hit = (self._fam_to_idx[fam_name], kid,
+                       _decision.tenant_index(ten_label))
+                if isinstance(seg, str) and 0 < len(seg) <= 1024:
+                    seen[seg] = hit
+                    self.learn(seg.encode("utf-8"), hit[0], kid,
+                               hit[2])
+            fams[i] = hit[0]
+            if hit[1]:
                 kids[i * _KID_LEN:(i + 1) * _KID_LEN] = \
-                    np.frombuffer(kid.encode(), np.uint8)
-            if isinstance(seg, str) and 0 < len(seg) <= 1024:
-                self.learn(seg.encode("utf-8"), int(fams[i]), kid)
+                    np.frombuffer(hit[1].encode(), np.uint8)
+            if tens is not None:
+                tens[i] = hit[2]
 
     # -- standalone fold (the parity sweep's entry point) -----------------
 
@@ -387,22 +435,26 @@ class NativeTelemetryPlane:
             return
         fams = np.full(n, -1, np.int8)
         kids = np.zeros(n * _KID_LEN, np.uint8)
+        tens = np.full(n, -1, np.int16)
         if tokens is not None:
             for i, t in enumerate(tokens):
                 if not isinstance(t, str):
                     fams[i] = self._FAM_UNKNOWN
+                    tens[i] = _decision.TENANT_NONE_IDX
                     continue
-                fam, kid = self.classify_seg(
+                fam, kid, ten = self.classify_seg(
                     t.split(".", 1)[0].encode("utf-8"))
                 if fam >= 0:
                     fams[i] = fam
+                    tens[i] = ten
                     if kid:
                         kids[i * _KID_LEN:(i + 1) * _KID_LEN] = \
                             np.frombuffer(kid.encode(), np.uint8)
             if (fams < 0).any():
-                self.fix_misses(tokens, fams, kids)
+                self.fix_misses(tokens, fams, kids, tens)
         else:
             fams[:] = self._FAM_UNKNOWN
+            tens[:] = _decision.TENANT_NONE_IDX
         statuses = np.zeros(n, np.uint8)
         reasons = None
         for i, r in enumerate(results):
@@ -418,8 +470,9 @@ class NativeTelemetryPlane:
             self._h, n, statuses.ctypes.data_as(_u8p),
             reasons.ctypes.data_as(_u8p) if reasons is not None
             else None,
-            fams.ctypes.data_as(_i8p), kids.ctypes.data_as(_u8p),
-            lat_idx,
+            fams.ctypes.data_as(_i8p), tens.ctypes.data_as(_i16p),
+            kids.ctypes.data_as(_u8p), lat_idx,
+            -1.0 if latency_s is None else float(latency_s),
             tb.ctypes.data_as(_u8p) if tb is not None else None,
             len(tb) if tb is not None else 0)
 
@@ -456,25 +509,54 @@ class NativeTelemetryPlane:
 
     def counters(self):
         """Nonzero plane counters under their registered names (the
-        final pre-teardown values once destroyed)."""
+        final pre-teardown values once destroyed) — including the
+        per-tenant block, with native slots mapped back to issuer-hash
+        labels so the names match the Python fold exactly."""
         h = self._h
         if not h:
             return dict((self._final_snapshot or {}).get("counters")
                         or {})
         self._lib.cap_tel_counters(h,
                                    self._ctr_buf.ctypes.data_as(_i64p))
-        return {name: int(v) for name, v
-                in zip(self._ctr_names, self._ctr_buf) if v}
+        out = {name: int(v) for name, v
+               in zip(self._ctr_names, self._ctr_buf) if v}
+        self._lib.cap_tel_tenant_counters(
+            h, self._tctr_buf.ctypes.data_as(_i64p))
+        tb = self._tctr_buf
+        for name, v in zip(("tenant.lookups", "tenant.attributed",
+                            "tenant.overflow"), tb[:3]):
+            if v:
+                out[name] = int(v)
+        if tb[3:].any():
+            labels = _decision.TENANTS.labels()
+            stride = self._ten_stride
+            for slot in range(_decision.N_TENANT):
+                base = 3 + slot * stride
+                if not tb[base]:
+                    continue
+                t = labels.get(slot, _decision.TENANT_OTHER)
+                prefix = f"decision.serve.tenant.{t}"
+                out[f"{prefix}.tokens"] = int(tb[base])
+                if tb[base + 1]:
+                    out[f"{prefix}.accept"] = int(tb[base + 1])
+                if tb[base + 2]:
+                    out[f"{prefix}.reject"] = int(tb[base + 2])
+                for j, reason in enumerate(_decision.REASON_INDEX):
+                    if tb[base + 3 + j]:
+                        out[f"{prefix}.reject.{reason}"] = \
+                            int(tb[base + 3 + j])
+        return out
 
-    def _hist_state(self, series: int):
+    def _hist_state(self, series: int, tenant_slot: bool = False):
         count = np.zeros(1, np.int64)
         smm = np.zeros(3, np.float64)
-        self._lib.cap_tel_hist_state(
-            self._h, series, self._bucket_buf.ctypes.data_as(_i64p),
-            count.ctypes.data_as(_i64p),
-            smm[0:].ctypes.data_as(_f64p),
-            smm[1:].ctypes.data_as(_f64p),
-            smm[2:].ctypes.data_as(_f64p))
+        fn = (self._lib.cap_tel_tenant_hist_state if tenant_slot
+              else self._lib.cap_tel_hist_state)
+        fn(self._h, series, self._bucket_buf.ctypes.data_as(_i64p),
+           count.ctypes.data_as(_i64p),
+           smm[0:].ctypes.data_as(_f64p),
+           smm[1:].ctypes.data_as(_f64p),
+           smm[2:].ctypes.data_as(_f64p))
         return {"count": int(count[0]), "sum": float(smm[0]),
                 "min": float(smm[1]), "max": float(smm[2]),
                 "buckets": {str(i): int(c) for i, c
@@ -493,6 +575,17 @@ class NativeTelemetryPlane:
             st = self._hist_state(idx)
             if st["count"]:
                 series[name] = st
+        # per-tenant latency series under the fold's exact names
+        # (tenant.<label>.request_s), slot → label like counters()
+        labels = None
+        for slot in range(_decision.N_TENANT):
+            st = self._hist_state(slot, tenant_slot=True)
+            if not st["count"]:
+                continue
+            if labels is None:
+                labels = _decision.TENANTS.labels()
+            t = labels.get(slot, _decision.TENANT_OTHER)
+            series[f"tenant.{t}.request_s"] = st
         return {"v": 1, "counters": self.counters(), "gauges": {},
                 "series": series}
 
@@ -604,10 +697,11 @@ class NativeServeChain:
         self._req_t0 = np.zeros(max_reqs, np.float64)
         self._trace_buf = np.zeros(max_reqs * 64, np.uint8)
         self._out_counts = np.zeros(3, np.int64)
-        # telemetry plane: per-token (family idx, kid hash) of the
-        # last drain, classified by the native readers
+        # telemetry plane: per-token (family idx, kid hash, tenant
+        # slot) of the last drain, classified by the native readers
         self._fam_buf = np.full(max_tokens, -1, np.int8)
         self._kid_buf = np.zeros(max_tokens * _KID_LEN, np.uint8)
+        self._ten_buf = np.full(max_tokens, -1, np.int16)
         # verdict cache: per-token digest of the last drain (sha256
         # truncated, computed by the native readers; all-zero rows
         # fall back to Python hashing)
@@ -726,6 +820,9 @@ class NativeServeChain:
                     h, self._fam_buf.ctypes.data_as(_i8p),
                     self._kid_buf.ctypes.data_as(_u8p),
                     self._max_tokens)
+                lib.cap_serve_drain_tens(
+                    h, self._ten_buf.ctypes.data_as(_i16p),
+                    self._max_tokens)
             if self._native_digests:
                 lib.cap_serve_drain_digests(
                     h, self._dig_buf.ctypes.data_as(_u8p),
@@ -793,16 +890,18 @@ class NativeServeChain:
             traces_raw = self._trace_buf[i0 * 64: i1 * 64].copy()
             plane = self._plane
             if plane is not None:
-                # reader-classified (family, kid) per token; the rare
-                # header-cache misses resolve through the Python
-                # classifier ONCE per distinct header, then hit native
+                # reader-classified (family, kid, tenant) per token;
+                # the rare header-cache misses resolve through the
+                # Python classifier ONCE per distinct header (issuer
+                # parse included), then hit native
                 fams = self._fam_buf[tok0: tok0 + seg_toks].copy()
                 kids = self._kid_buf[tok0 * _KID_LEN:
                                      (tok0 + seg_toks) * _KID_LEN].copy()
+                tens = self._ten_buf[tok0: tok0 + seg_toks].copy()
                 if (fams < 0).any():
-                    plane.fix_misses(tokens, fams, kids)
+                    plane.fix_misses(tokens, fams, kids, tens)
             else:
-                fams = kids = None
+                fams = kids = tens = None
             traces: List[tuple] = []
             for k in range(n):
                 tl = int(meta[k * 6 + 4])
@@ -825,11 +924,12 @@ class NativeServeChain:
             # the SAME fold — the decision counters cannot tell a
             # cached verdict from a fresh one (that is the parity pin).
             if plane is not None:
-                lat_idx = _decision.latency_bucket_index(
-                    time.time() - t_drain)
+                lat_s = time.time() - t_drain
                 self._post(results, meta, seqs, traces_raw, n, traces,
-                           t0s=t0s, fams=fams, kids=kids,
-                           lat_idx=lat_idx)
+                           t0s=t0s, fams=fams, kids=kids, tens=tens,
+                           lat_idx=_decision.latency_bucket_index(
+                               lat_s),
+                           lat_s=lat_s)
             else:
                 _decision.record_batch(
                     "serve", results, tokens=tokens,
@@ -864,6 +964,14 @@ class NativeServeChain:
                                          (k + 1) * _DIG_LEN])
                         == _ZERO_DIG else d for k in range(seg_toks)]
         hits, miss_idx, digs = vc.lookup_batch(tokens, digests=dig_list)
+        # per-tenant cache accounting (the capstat ledger's hit%
+        # column): reader-classified slots when the plane runs, the
+        # Python classifier on the plane-less fallback arm
+        if telemetry.active() is not None:
+            _decision.count_tenant_cache(
+                _decision.tenant_labels_from_slots(tens)
+                if tens is not None
+                else _decision.tenant_labels(tokens), miss_idx)
         if not miss_idx:
             # every token answered from cache: encode + fold directly,
             # no batcher round-trip (memory-speed path)
@@ -903,7 +1011,8 @@ class NativeServeChain:
               t0s: Optional[np.ndarray] = None,
               fams: Optional[np.ndarray] = None,
               kids: Optional[np.ndarray] = None,
-              lat_idx: int = 0) -> None:
+              tens: Optional[np.ndarray] = None,
+              lat_idx: int = 0, lat_s: float = -1.0) -> None:
         tel = fams is not None and self._plane is not None
         with telemetry.span(telemetry.SPAN_NATIVE_POST):
             n_tok = len(results)
@@ -958,7 +1067,9 @@ class NativeServeChain:
                     reasons.ctypes.data_as(_u8p)
                     if reasons is not None else None,
                     fams.ctypes.data_as(_i8p),
-                    kids.ctypes.data_as(_u8p), lat_idx)
+                    tens.ctypes.data_as(_i16p)
+                    if tens is not None else None,
+                    kids.ctypes.data_as(_u8p), lat_idx, lat_s)
             else:
                 self._lib.cap_serve_post_results(
                     self._h, meta.ctypes.data_as(_i32p),

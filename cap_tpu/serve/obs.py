@@ -18,6 +18,11 @@ HTTP endpoint exposing the process's telemetry:
 - ``/decisions`` — the sampled decision ring
   (:mod:`cap_tpu.obs.decision`): full verdict records with reason
   class, family, latency bucket, hashed kid;
+- ``/tenants`` — this worker's per-tenant rollup (issuer HASH →
+  tokens / accept / reject mix / vcache splits) plus the exact
+  ``lookups == attributed + overflow`` accounting triple, over the
+  same merged snapshot ``/snapshot`` serves (docs/OBSERVABILITY.md
+  §Tenant attribution — raw issuers never appear here);
 - ``/healthz`` — liveness.
 
 Stalled-scraper hardening: every connection runs on its own daemon
@@ -188,6 +193,17 @@ class ObsServer:
         elif path == "/decisions":
             body = json.dumps({
                 "decisions": rec.decisions() if rec is not None else [],
+            }).encode()
+            ctype = "application/json"
+        elif path == "/tenants":
+            from ..obs import decision as _decision
+
+            counters = self._snapshot(rec).get("counters") or {}
+            body = json.dumps({
+                "tenants": _decision.tenant_totals(counters),
+                "lookups": counters.get("tenant.lookups", 0),
+                "attributed": counters.get("tenant.attributed", 0),
+                "overflow": counters.get("tenant.overflow", 0),
             }).encode()
             ctype = "application/json"
         elif path == "/healthz":

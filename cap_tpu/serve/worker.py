@@ -650,6 +650,12 @@ class VerifyWorker:
         if vc is None:
             return self._batcher.submit_nowait(entries, trace=trace)
         hits, miss_idx, digests = vc.lookup_batch(entries)
+        if telemetry.active() is not None:
+            # per-tenant cache accounting (header-segment cached —
+            # one dict hit per token); the native chain counts the
+            # same names from its reader-classified slots
+            _decision.count_tenant_cache(
+                _decision.tenant_labels(entries), miss_idx)
         if not miss_idx:
             return _CachePending(list(entries), hits, (), None, None)
         epoch0 = vc.epoch

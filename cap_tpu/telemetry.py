@@ -153,6 +153,27 @@ class Histogram:
             else:
                 self.raw = None                # bucket-only from now on
 
+    def add_many(self, value: float, k: int) -> None:
+        """``k`` observations of the same value in one bucket add
+        (``sum += value * k`` — the exact arithmetic the native
+        telemetry plane replicates, so merged states stay
+        bit-identical). The per-tenant latency fold uses this: one add
+        per (chunk, tenant), never per token."""
+        if k <= 0:
+            return
+        self.counts[bisect_left(BUCKET_BOUNDS, value)] += k
+        self.count += k
+        self.total += value * k
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        if self.raw is not None:
+            if len(self.raw) + k <= RESERVOIR_CAP:
+                self.raw.extend([value] * k)
+            else:
+                self.raw = None                # bucket-only from now on
+
     def quantile(self, q: float) -> float:
         """Exact while the reservoir holds every sample; bucket
         geometric-midpoint interpolation beyond it."""
@@ -211,11 +232,13 @@ MAX_NAME_LEN = 120
 
 def check_name(name: str) -> str:
     """Reject metric/span names that could smuggle payload material:
-    over-long names, embedded whitespace/newlines, or anything
-    starting like a JWS segment (``eyJ`` = base64url('{"')). Applied
-    on FIRST use of a name (dict miss), so the hot path stays one
-    dict hit."""
-    if (len(name) > MAX_NAME_LEN or "eyJ" in name
+    over-long names, embedded whitespace/newlines, anything starting
+    like a JWS segment (``eyJ`` = base64url('{"')), or a raw ISSUER
+    string (URL-shaped — ``://``; tenants are recorded ONLY as
+    sha256(iss)[:12] hashes, docs/OBSERVABILITY.md §Tenant
+    attribution). Applied on FIRST use of a name (dict miss), so the
+    hot path stays one dict hit."""
+    if (len(name) > MAX_NAME_LEN or "eyJ" in name or "://" in name
             or any(ch.isspace() for ch in name)):
         raise ValueError(
             f"metric name rejected by redaction rules (len="
@@ -226,10 +249,12 @@ def check_name(name: str) -> str:
 
 def scrub_note(note: Optional[str]) -> Optional[str]:
     """Span notes are free-text-ish (endpoints, family names) — bound
-    the length and drop anything token-shaped rather than record it."""
+    the length and drop anything token-shaped or issuer-shaped (raw
+    issuer URLs are tenant PII — only their hashes may be recorded)
+    rather than record it."""
     if note is None:
         return None
-    if "eyJ" in note or len(note) > MAX_NAME_LEN:
+    if "eyJ" in note or "://" in note or len(note) > MAX_NAME_LEN:
         return "[redacted]"
     return note
 
@@ -292,6 +317,17 @@ class Recorder:
             if h is None:
                 h = self._series[check_name(name)] = Histogram()
             h.add(float(value))
+
+    def observe_many(self, name: str, value: float, k: int) -> None:
+        """``k`` observations of one value under one lock round (see
+        :meth:`Histogram.add_many`)."""
+        if k <= 0:
+            return
+        with self._lock:
+            h = self._series.get(name)
+            if h is None:
+                h = self._series[check_name(name)] = Histogram()
+            h.add_many(float(value), k)
 
     @contextmanager
     def span(self, name: str, note: Optional[str] = None) -> Iterator[None]:

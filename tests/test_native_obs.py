@@ -163,8 +163,9 @@ def taxonomy_rejects():
 def _run_both(batches):
     """Run the same batch stream through record_batch (fresh recorder)
     and through the native plane (classify → learn → fold → pump into
-    a second fresh recorder); assert counters and decision rings are
-    identical after EVERY batch."""
+    a second fresh recorder); assert counters (decision AND tenant),
+    per-tenant latency series, and decision rings are identical after
+    EVERY batch — the r13 contract extended to the tenant plane."""
     rec_py = telemetry.Recorder()
     rec_nat = telemetry.Recorder()
     plane = make_plane()
@@ -177,10 +178,18 @@ def _run_both(batches):
                              trace=trace)
             plane.pump(rec_nat)
             py_c = {k: v for k, v in rec_py.counters().items()
-                    if k.startswith("decision.")}
+                    if k.startswith(("decision.", "tenant."))}
             nat_c = {k: v for k, v in plane.counters().items()
-                     if k.startswith("decision.")}
+                     if k.startswith(("decision.", "tenant."))}
             assert py_c == nat_c, f"counter divergence at batch {bi}"
+            py_s = {k: v for k, v
+                    in rec_py.snapshot()["series"].items()
+                    if k.startswith("tenant.")}
+            nat_s = {k: v for k, v
+                     in plane.snapshot()["series"].items()
+                     if k.startswith("tenant.")}
+            assert py_s == nat_s, \
+                f"tenant series divergence at batch {bi}"
             assert rec_py.decisions() == rec_nat.decisions(), \
                 f"ring divergence at batch {bi}"
     finally:
@@ -239,6 +248,53 @@ def test_parity_sweep_random_mixed_batches():
         use_tokens = tokens if rng.random() < 0.9 else None
         batches.append((results, use_tokens, rng.choice(lats), trace))
     _run_both(batches)
+
+
+@needs_tel
+def test_parity_sweep_tenant_attribution():
+    """Tenant extension of the parity contract (ISSUE 14 acceptance):
+    issuer-bearing tokens — a stable multi-tenant mix, a unique-issuer
+    overflow flood past the table cap, adversarial payloads (missing /
+    non-string / overlong issuers, undecodable segments) — produce
+    bit-identical per-tenant counters AND latency histograms through
+    both folds, after every batch."""
+    rng = random.Random(0x7E4A47)
+    rejects = taxonomy_rejects()
+
+    def tok(i, iss, suffix="sig"):
+        hdr = _b64({"alg": "ES256", "kid": f"ten-{i}"})
+        return f"{hdr}.{_b64({'iss': iss})}.{suffix}"
+
+    # stable tenants + a flood that overflows the bounded table
+    stable = [tok(i, f"https://idp-{i}.example") for i in range(12)]
+    flood = [tok(1000 + i, f"https://flood-{i}.unique.example")
+             for i in range(decision.TENANT_CAP + 30)]
+    adversarial = [
+        _b64({"alg": "ES256", "kid": "t-noiss"}) + "."
+        + _b64({"sub": "x"}) + ".s",                    # no iss
+        _b64({"alg": "RS256", "kid": "t-numiss"}) + "."
+        + _b64({"iss": 99}) + ".s",                     # non-str iss
+        _b64({"alg": "ES256", "kid": "t-longiss"}) + "."
+        + _b64({"iss": "x" * 1500}) + ".s",             # overlong iss
+        _b64({"alg": "ES256", "kid": "t-badpay"}) + ".!!!.s",
+        "no-dots-at-all",
+    ]
+    pool = stable + adversarial
+    lats = [None, 0.0004, 0.004, 0.04, 0.4]
+    batches = []
+    for i in range(300):
+        n = rng.randrange(1, 16)
+        tokens = [rng.choice(pool) for _ in range(n)]
+        if i % 3 == 0:       # flood pressure in a third of batches
+            tokens += [flood[rng.randrange(len(flood))]
+                       for _ in range(rng.randrange(1, 6))]
+        results = [rng.choice(rejects) if rng.random() < 0.4
+                   else {"s": j} for j in range(len(tokens))]
+        batches.append((results, tokens, rng.choice(lats), None))
+    _run_both(batches)
+    # the flood hit the bounded table: "other" traffic was folded and
+    # the exact equation held through BOTH folds (checked per batch)
+    assert decision.TENANTS.size() == decision.TENANT_CAP
 
 
 @needs_tel

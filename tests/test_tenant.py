@@ -1,0 +1,529 @@
+"""Tenant (issuer) attribution plane: vocabulary, bounded table,
+Python-fold counters/histograms, SLO templates, redaction (ISSUE 14).
+
+Tier-1 and dependency-free. The native-plane side of the same
+contract (bit-exact parity) lives in tests/test_native_obs.py; the
+fleet/chaos side (two-tenant flood, kill -9 postmortems) in
+tests/test_tenant_fleet.py.
+"""
+
+import base64
+import hashlib
+import json
+import os
+import sys
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.errors import ExpiredTokenError, InvalidSignatureError
+from cap_tpu.obs import decision, postmortem, slo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import capstat  # noqa: E402
+
+
+def b64(obj) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(obj).encode()).rstrip(b"=").decode()
+
+
+def tenant_token(iss, alg="ES256", kid="k", suffix="sig") -> str:
+    return (b64({"alg": alg, "kid": kid}) + "."
+            + b64({"iss": iss}) + "." + suffix)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_attribution():
+    """Tenant attribution is process-global (table + header cache);
+    isolate every test from what earlier tests admitted."""
+    telemetry.disable()
+    decision._HDR_CACHE.clear()
+    decision.TENANTS.reset()
+    yield
+    telemetry.disable()
+    decision._HDR_CACHE.clear()
+    decision.TENANTS.reset()
+
+
+# ---------------------------------------------------------------------------
+# derivation: sha256(iss)[:12], bounded, adversarial-proof
+# ---------------------------------------------------------------------------
+
+def test_issuer_hash_and_token_tenant():
+    iss = "https://idp.example.com"
+    h = decision.issuer_hash(iss)
+    assert h == hashlib.sha256(iss.encode()).hexdigest()[:12]
+    assert len(h) == decision.TENANT_HASH_LEN
+    assert decision.token_tenant(tenant_token(iss)) == h
+    # the raw issuer never appears in the id
+    assert "idp" not in h and "://" not in h
+
+
+@pytest.mark.parametrize("payload_seg", [
+    "",                                     # empty
+    "not-base64!!!",                        # undecodable
+    b64([1, 2, 3]),                         # non-dict claims
+    b64({"sub": "x"}),                      # no iss at all
+    b64({"iss": 123}),                      # non-string iss
+    b64({"iss": True}),                     # bool iss
+    b64({"iss": ""}),                       # empty iss
+    b64({"iss": "x" * 2000}),               # overlong iss
+    "x" * 5000,                             # segment over parse bound
+    base64.urlsafe_b64encode(b"\xff\xfe{").decode(),  # non-UTF-8
+])
+def test_token_tenant_none_for_adversarial_payloads(payload_seg):
+    tok = b64({"alg": "ES256"}) + "." + payload_seg + ".sig"
+    assert decision.token_tenant(tok) == decision.TENANT_NONE
+
+
+def test_token_tenant_none_for_non_tokens():
+    assert decision.token_tenant(None) == decision.TENANT_NONE
+    assert decision.token_tenant(1234) == decision.TENANT_NONE
+    assert decision.token_tenant("nodots") == decision.TENANT_NONE
+
+
+def test_adversarial_issuer_values_hash_cleanly():
+    """eyJ-prefixed / URL / whitespace issuer VALUES must still come
+    out as plain 12-hex ids that pass the name redaction check."""
+    for iss in ("eyJhbGciOiJFUzI1NiJ9", "https://a b c.example\n",
+                "x" * 1024, "日本語の発行者"):
+        h = decision.issuer_hash(iss)
+        assert h != decision.TENANT_NONE
+        telemetry.check_name(f"decision.serve.tenant.{h}.accept")
+
+
+# ---------------------------------------------------------------------------
+# bounded tenant table
+# ---------------------------------------------------------------------------
+
+def test_tenant_table_caps_and_overflows():
+    t = decision.TenantTable(cap=4)
+    labels = [t.admit(f"{i:012x}") for i in range(7)]
+    # first 4 get their own slots + hash labels
+    assert [lab for _, lab in labels[:4]] == \
+        [f"{i:012x}" for i in range(4)]
+    assert sorted(s for s, _ in labels[:4]) == [0, 1, 2, 3]
+    # everything past the cap routes to the overflow bucket
+    for s, lab in labels[4:]:
+        assert s == decision.TENANT_OTHER_IDX
+        assert lab == decision.TENANT_OTHER
+    # re-admitting an existing tenant is stable
+    assert t.admit("000000000000") == (0, "000000000000")
+    assert t.size() == 4
+
+
+def test_tenant_table_reset_counts_evictions():
+    t = decision.TenantTable(cap=8)
+    for i in range(5):
+        t.admit(f"{i:012x}")
+    with telemetry.recording() as rec:
+        assert t.reset() == 5
+        assert rec.counters()["tenant.table_evictions"] == 5
+    assert t.size() == 0 and t.evictions == 5
+
+
+def test_unique_issuer_flood_bounded_with_exact_accounting():
+    """The satellite pin: a hostile unique-issuer flood cannot blow up
+    label cardinality — the table caps, overflow routes to
+    ``tenant.other``, and ``lookups == attributed + overflow`` holds
+    EXACTLY (with zero evictions: admitted tenants never churn)."""
+    cap = decision.TENANT_CAP
+    n_flood = cap + 40
+    with telemetry.recording() as rec:
+        for i in range(n_flood):
+            tok = tenant_token(f"https://flood-{i}.example",
+                               kid=f"fk{i}")
+            decision.record_batch("serve", [InvalidSignatureError()],
+                                  tokens=[tok], latency_s=0.001)
+        c = rec.counters()
+    assert decision.TENANTS.size() == cap
+    assert c["tenant.lookups"] == n_flood
+    assert c["tenant.attributed"] == cap
+    assert c["tenant.overflow"] == n_flood - cap
+    assert c["tenant.lookups"] == \
+        c["tenant.attributed"] + c["tenant.overflow"]
+    assert c.get("tenant.table_evictions", 0) == 0
+    other = f"decision.serve.tenant.{decision.TENANT_OTHER}"
+    assert c[f"{other}.tokens"] == n_flood - cap
+    assert c[f"{other}.reject.bad_signature"] == n_flood - cap
+    # label cardinality is bounded: at most cap + none + other tenant
+    # label values across every emitted counter
+    labels = {k.split(".")[3] for k in c
+              if k.startswith("decision.serve.tenant.")}
+    assert len(labels) <= decision.N_TENANT
+    for name in c:
+        telemetry.check_name(name)
+
+
+# ---------------------------------------------------------------------------
+# the Python fold: per-tenant counters + latency histograms
+# ---------------------------------------------------------------------------
+
+def test_record_batch_per_tenant_counters_and_hist():
+    ta = tenant_token("https://a.example", kid="ka")
+    tb = tenant_token("https://b.example", alg="RS256", kid="kb")
+    ha = decision.token_tenant(ta)
+    hb = decision.token_tenant(tb)
+    assert ha != hb
+    with telemetry.recording() as rec:
+        decision.record_batch(
+            "serve",
+            [{"s": 1}, InvalidSignatureError(), {"s": 2},
+             ExpiredTokenError()],
+            tokens=[ta, tb, ta, tb], latency_s=0.002)
+        c = rec.counters()
+        assert c[f"decision.serve.tenant.{ha}.tokens"] == 2
+        assert c[f"decision.serve.tenant.{ha}.accept"] == 2
+        assert f"decision.serve.tenant.{ha}.reject" not in c
+        assert c[f"decision.serve.tenant.{hb}.tokens"] == 2
+        assert c[f"decision.serve.tenant.{hb}.reject"] == 2
+        assert c[f"decision.serve.tenant.{hb}"
+                 ".reject.bad_signature"] == 1
+        assert c[f"decision.serve.tenant.{hb}.reject.expired"] == 1
+        # per-tenant latency: one observation per token at the chunk
+        # latency (serve surface only)
+        snap = rec.snapshot()
+        sa = snap["series"][f"tenant.{ha}.request_s"]
+        assert sa["count"] == 2 and sa["sum"] == 0.002 * 2
+        assert snap["series"][f"tenant.{hb}.request_s"]["count"] == 2
+
+
+def test_record_batch_tenant_none_paths():
+    with telemetry.recording() as rec:
+        # families-only fold (no payloads): everything is "none"
+        decision.record_batch("tpu", [{"s": 1}, ExpiredTokenError()],
+                              families=["es", "es"])
+        # token-less fold
+        decision.record_batch("oracle", [{"s": 1}])
+        c = rec.counters()
+        assert c["decision.tpu.tenant.none.tokens"] == 2
+        assert c["decision.tpu.tenant.none.reject.expired"] == 1
+        assert c["decision.oracle.tenant.none.accept"] == 1
+        # non-serve surfaces never grow latency series
+        assert not any(k.startswith("tenant.")
+                       for k in rec.snapshot()["series"])
+        assert c["tenant.lookups"] == 3
+        assert c["tenant.attributed"] == 3
+
+
+def test_record_batch_tenant_counters_all_surfaces():
+    tok = tenant_token("https://s.example", kid="ks")
+    h = decision.token_tenant(tok)
+    with telemetry.recording() as rec:
+        for surface in decision.SURFACES:
+            decision.record_batch(surface, [{"s": 1}], tokens=[tok])
+        c = rec.counters()
+    for surface in decision.SURFACES:
+        assert c[f"decision.{surface}.tenant.{h}.accept"] == 1
+
+
+def test_record_wrong_verdict_counts_global_and_tenant():
+    tok = tenant_token("https://w.example", kid="kw")
+    h = decision.token_tenant(tok)
+    with telemetry.recording() as rec:
+        decision.record_wrong_verdict(tok)
+        decision.record_wrong_verdict()          # tokenless: global only
+        c = rec.counters()
+    assert c["decision.wrong_verdicts"] == 2
+    assert c[f"decision.tenant.{h}.wrong_verdicts"] == 1
+
+
+def test_surface_totals_skips_tenant_keys():
+    counters = {
+        "decision.serve.accept": 5,
+        "decision.serve.tenant.aaaaaaaaaaaa.accept": 5,
+        "decision.serve.tenant.aaaaaaaaaaaa.tokens": 5,
+        "decision.tenant.aaaaaaaaaaaa.wrong_verdicts": 1,
+    }
+    rollup = decision.surface_totals(counters)
+    assert rollup == {"serve": {"accept": 5, "reject": 0}}
+
+
+def test_tenant_totals_rollup():
+    counters = {
+        "decision.serve.tenant.aaaaaaaaaaaa.tokens": 10,
+        "decision.serve.tenant.aaaaaaaaaaaa.accept": 7,
+        "decision.serve.tenant.aaaaaaaaaaaa.reject": 3,
+        "decision.serve.tenant.aaaaaaaaaaaa.reject.expired": 3,
+        "decision.router.tenant.aaaaaaaaaaaa.tokens": 10,
+        "decision.tenant.aaaaaaaaaaaa.wrong_verdicts": 2,
+        "vcache.tenant.aaaaaaaaaaaa.lookups": 8,
+        "vcache.tenant.aaaaaaaaaaaa.hits": 6,
+        "decision.serve.accept": 7,
+    }
+    t = decision.tenant_totals(counters, surface="serve")
+    row = t["aaaaaaaaaaaa"]
+    assert row["tokens"] == 10 and row["accept"] == 7
+    assert row["reject"] == 3 and row["reject.expired"] == 3
+    assert row["wrong_verdicts"] == 2
+    assert row["vcache.lookups"] == 8 and row["vcache.hits"] == 6
+    # surface=None sums serve + router token rows
+    assert decision.tenant_totals(counters)["aaaaaaaaaaaa"]["tokens"] \
+        == 20
+
+
+def test_count_tenant_cache_accounting():
+    labels = ["aaaaaaaaaaaa"] * 4 + ["bbbbbbbbbbbb"] * 2
+    with telemetry.recording() as rec:
+        decision.count_tenant_cache(labels, miss_idx=[0, 4, 5])
+        c = rec.counters()
+    assert c["vcache.tenant.aaaaaaaaaaaa.lookups"] == 4
+    assert c["vcache.tenant.aaaaaaaaaaaa.hits"] == 3
+    assert c["vcache.tenant.bbbbbbbbbbbb.lookups"] == 2
+    assert "vcache.tenant.bbbbbbbbbbbb.hits" not in c
+
+
+# ---------------------------------------------------------------------------
+# redaction: raw issuers can never reach a recorder / a postmortem
+# ---------------------------------------------------------------------------
+
+def test_check_name_rejects_raw_issuer_urls():
+    with pytest.raises(ValueError):
+        telemetry.check_name("decision.serve.tenant."
+                             "https://idp.example.com.accept")
+    with pytest.raises(ValueError):
+        telemetry.check_name("tenant.http://x.y.request_s")
+    assert telemetry.scrub_note("https://idp.example.com/auth") == \
+        "[redacted]"
+    # plain endpoint notes survive (no scheme)
+    assert telemetry.scrub_note("127.0.0.1:8443") == "127.0.0.1:8443"
+
+
+def test_recorder_surfaces_carry_no_issuer_after_adversarial_sweep():
+    """Sweep every recorder surface (counters, series names, decision
+    ring, postmortem JSON) after folding adversarial issuers — eyJ
+    prefixes, URLs, overlong, non-UTF-8-ish — and assert zero raw
+    issuer material anywhere."""
+    issuers = ["https://evil.example/realm",
+               "eyJhbGciOiJFUzI1NiJ9.sneaky",
+               "http://" + "a" * 500 + ".example",
+               "日本語の発行者"]
+    with telemetry.recording() as rec:
+        for i, iss in enumerate(issuers):
+            tok = tenant_token(iss, kid=f"adv{i}")
+            decision.record_batch(
+                "serve", [InvalidSignatureError(), {"s": 1}],
+                tokens=[tok, tok], latency_s=0.001)
+        doc = postmortem.build_postmortem("test", lambda: {})
+        blob = json.dumps({
+            "counters": rec.counters(),
+            "series": sorted(rec.snapshot()["series"]),
+            "decisions": rec.decisions(),
+            "postmortem": doc,
+        })
+    for needle in ("evil.example", "://", "sneaky", "発行者",
+                   "a" * 40):
+        assert needle not in blob, f"issuer material {needle!r} leaked"
+
+
+# ---------------------------------------------------------------------------
+# merge_snapshots over tenant sections
+# ---------------------------------------------------------------------------
+
+def _tenant_snap(h, tokens, accept, lat, k):
+    rec = telemetry.Recorder()
+    rec.count_many({
+        f"decision.serve.tenant.{h}.tokens": tokens,
+        f"decision.serve.tenant.{h}.accept": accept,
+        "tenant.lookups": tokens,
+        "tenant.attributed": tokens,
+    })
+    rec.observe_many(f"tenant.{h}.request_s", lat, k)
+    return rec.snapshot()
+
+
+def test_merge_snapshots_disjoint_tenant_sections():
+    a = _tenant_snap("aaaaaaaaaaaa", 10, 9, 0.001, 10)
+    b = _tenant_snap("bbbbbbbbbbbb", 4, 4, 0.1, 4)
+    m = telemetry.merge_snapshots([a, b])
+    c = m["counters"]
+    assert c["decision.serve.tenant.aaaaaaaaaaaa.tokens"] == 10
+    assert c["decision.serve.tenant.bbbbbbbbbbbb.tokens"] == 4
+    assert c["tenant.lookups"] == 14
+    assert m["series"]["tenant.aaaaaaaaaaaa.request_s"]["count"] == 10
+    assert m["series"]["tenant.bbbbbbbbbbbb.request_s"]["count"] == 4
+
+
+def test_merge_snapshots_overlapping_tenant_sections_add_exactly():
+    a = _tenant_snap("cccccccccccc", 10, 9, 0.001, 10)
+    b = _tenant_snap("cccccccccccc", 6, 2, 0.004, 6)
+    m = telemetry.merge_snapshots([a, b])
+    c = m["counters"]
+    assert c["decision.serve.tenant.cccccccccccc.tokens"] == 16
+    assert c["decision.serve.tenant.cccccccccccc.accept"] == 11
+    s = m["series"]["tenant.cccccccccccc.request_s"]
+    assert s["count"] == 16
+    assert s["sum"] == pytest.approx(0.001 * 10 + 0.004 * 6)
+    assert s["min"] == 0.001 and s["max"] == 0.004
+    # the merged histogram quantile is computable (capstat p99 column)
+    summary = telemetry.summarize_snapshot(m)
+    assert summary["tenant.cccccccccccc.request_s"]["count"] == 16
+
+
+def test_observe_many_matches_k_single_adds_in_buckets():
+    h1 = telemetry.Histogram()
+    h2 = telemetry.Histogram()
+    for _ in range(37):
+        h1.add(0.0042)
+    h2.add_many(0.0042, 37)
+    assert h1.counts == h2.counts
+    assert h1.count == h2.count
+    assert h1.vmin == h2.vmin and h1.vmax == h2.vmax
+
+
+# ---------------------------------------------------------------------------
+# SLO: per-tenant rule expansion
+# ---------------------------------------------------------------------------
+
+def test_default_rules_include_tenant_templates():
+    rules = slo.default_rules()
+    names = {r.name for r in rules}
+    assert "tenant_wrong_verdicts" in names
+    assert "tenant_reject_ratio" in names
+    assert sum(1 for r in rules if slo.is_tenant_template(r)) == 2
+
+
+def test_tenant_rule_expansion_per_observed_tenant():
+    rules = slo.parse_rules(
+        "tr ratio decision.serve.tenant.*.reject / "
+        "decision.serve.tenant.*.tokens max 0.5 burn 1.5")
+    snap = {"counters": {
+        "decision.serve.tenant.aaaaaaaaaaaa.tokens": 100,
+        "decision.serve.tenant.aaaaaaaaaaaa.reject": 95,
+        "decision.serve.tenant.bbbbbbbbbbbb.tokens": 100,
+        "decision.serve.tenant.bbbbbbbbbbbb.reject": 2,
+        "decision.serve.tenant.other.tokens": 10,
+        "decision.serve.tenant.other.reject": 10,
+    }}
+    res = slo.evaluate_once(snap, rules)
+    by = {r["name"]: r for r in res}
+    assert len(res) == 3                   # one per observed tenant
+    assert not by["tr[aaaaaaaaaaaa]"]["ok"]
+    assert by["tr[bbbbbbbbbbbb]"]["ok"]
+    assert not by["tr[other]"]["ok"]       # overflow bucket counts too
+    assert by["tr[aaaaaaaaaaaa]"]["tenant"] == "aaaaaaaaaaaa"
+
+
+def test_tenant_template_vacuous_without_tenants():
+    rules = slo.parse_rules(
+        "tw counter decision.tenant.*.wrong_verdicts max 0")
+    res = slo.evaluate_once({"counters": {"worker.tokens": 5}}, rules)
+    assert len(res) == 1 and res[0]["ok"]
+    assert "no tenants" in res[0]["detail"]
+
+
+def test_tenant_quantile_template_expands_over_series():
+    rules = slo.parse_rules(
+        "tq quantile tenant.*.request_s p99 max 0.0001")
+    rec = telemetry.Recorder()
+    rec.observe_many("tenant.dddddddddddd.request_s", 0.05, 20)
+    res = slo.evaluate_once(rec.snapshot(), rules)
+    assert len(res) == 1
+    assert res[0]["name"] == "tq[dddddddddddd]" and not res[0]["ok"]
+
+
+def test_tenant_burn_windows_unchanged():
+    """Multi-window burn semantics apply per expanded tenant rule: a
+    sustained per-tenant burn breaches, an absorbed spike does not."""
+    rules = slo.parse_rules(
+        "tr ratio decision.serve.tenant.*.reject / "
+        "decision.serve.tenant.*.tokens max 0.01")
+    tid = "eeeeeeeeeeee"
+    tok = f"decision.serve.tenant.{tid}.tokens"
+    rej = f"decision.serve.tenant.{tid}.reject"
+    eng = slo.SLOEngine(rules, windows=(60, 300))
+    eng.observe({"counters": {tok: 0, rej: 0}}, now=0.0)
+    eng.observe({"counters": {tok: 5000, rej: 100}}, now=240.0)
+    res = eng.evaluate({"counters": {tok: 10000, rej: 300}}, now=299.0)
+    assert [r["ok"] for r in res] == [False]
+
+    spike = slo.SLOEngine(rules, windows=(60, 300))
+    spike.observe({"counters": {tok: 0, rej: 0}}, now=0.0)
+    spike.observe({"counters": {tok: 990_000, rej: 0}}, now=250.0)
+    res = spike.evaluate({"counters": {tok: 1_000_000, rej: 300}},
+                         now=300.0)
+    assert [r["ok"] for r in res] == [True]
+
+
+# ---------------------------------------------------------------------------
+# capstat ledger
+# ---------------------------------------------------------------------------
+
+def test_capstat_render_tenants_ledger():
+    ta = tenant_token("https://ledger-a.example", kid="la")
+    tb = tenant_token("https://ledger-b.example", kid="lb")
+    ha, hb = decision.token_tenant(ta), decision.token_tenant(tb)
+    with telemetry.recording() as rec:
+        decision.record_batch("serve", [{"s": 1}] * 8, tokens=[ta] * 8,
+                              latency_s=0.002)
+        decision.record_batch("serve", [InvalidSignatureError()] * 12,
+                              tokens=[tb] * 12, latency_s=0.004)
+        decision.count_tenant_cache(
+            decision.tenant_labels([ta] * 4), [0])
+        merged = rec.snapshot()
+    out = capstat.render_tenants(merged)
+    assert ha in out and hb in out
+    assert "[EXACT]" in out                 # lookups == attr + overflow
+    assert "BREACH" in out                  # flood tenant's SLO state
+    assert "ledger-a" not in out and "://" not in out
+    # flood first (sorted by tokens), with its reject mix
+    assert out.index(hb) < out.index(ha)
+    assert "bad_signature=12" in out
+    # --watch shape: per-interval vps column from counter deltas
+    watched = capstat.render_tenants(
+        merged, prev_counters={
+            f"decision.serve.tenant.{hb}.tokens": 4}, interval_s=2.0)
+    assert "vps" in watched
+
+
+def test_capstat_tenants_cli_over_live_scrape():
+    from cap_tpu.fleet import FleetClient
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from cap_tpu.serve.worker import VerifyWorker
+
+    quiet = tenant_token("https://cli-quiet.example", kid="cq",
+                         suffix="ok")
+    hq = decision.token_tenant(quiet)
+    worker = VerifyWorker(StubKeySet(), target_batch=8,
+                          max_wait_ms=1.0, obs_port=0)
+    try:
+        with telemetry.recording():
+            cl = FleetClient([worker.address], fallback=StubKeySet(),
+                             rr_seed=0)
+            for _ in range(3):
+                assert len(cl.verify_batch([quiet] * 2)) == 2
+            host, port = worker.obs_address
+            rc = capstat.main(["--tenants", f"{host}:{port}"])
+    finally:
+        worker.close()
+    assert rc == 0
+    # exercised via capsys-free check: main printed the ledger with
+    # the hashed tenant id (stdout captured by pytest)
+    assert hq  # id derived; rendering asserted in the unit test above
+
+
+# ---------------------------------------------------------------------------
+# doc pin: the metric catalog + derivation rule live in the docs
+# ---------------------------------------------------------------------------
+
+def test_observability_doc_pins_tenant_attribution():
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
+        doc = f.read()
+    for needle in (
+            "## Tenant attribution",
+            "sha256(iss)",
+            "`tenant.lookups`", "`tenant.attributed`",
+            "`tenant.overflow`", "`tenant.table_evictions`",
+            "`decision.<surface>.tenant.<t>.tokens`",
+            "`tenant.<t>.request_s`",
+            "tenant.*", "capstat --tenants",
+            f"{decision.TENANT_CAP}",
+            "`vcache.tenant.<t>.lookups`",
+            "`frontdoor.tenant.<t>.lookups`",
+            "`decision.tenant.<t>.wrong_verdicts`",
+    ):
+        assert needle in doc, \
+            f"docs/OBSERVABILITY.md missing {needle!r}"

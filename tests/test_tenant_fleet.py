@@ -1,0 +1,241 @@
+"""Two-tenant flood chaos: the fleet-level tenant attribution pins
+(ISSUE 14 acceptance): a flooding tenant breaches ITS per-tenant SLO
+rule while the quiet tenant's stays green, the victim worker's
+postmortem carries per-tenant counters through kill -9, the capstat
+ledger renders the fleet view, and zero raw issuer strings appear on
+any exposed surface — on BOTH serve chains.
+"""
+
+import base64
+import hashlib
+import json
+import signal
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet import FleetClient, WorkerPool
+from cap_tpu.fleet.chaos import kill9
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.obs import decision, postmortem as obs_postmortem, slo
+from tools import capstat
+
+pytestmark = pytest.mark.chaos
+
+HARD_TIMEOUT_S = 120
+
+ISS_QUIET = "https://tenant-quiet.example"
+ISS_FLOOD = "https://tenant-flood.example"
+H_QUIET = hashlib.sha256(ISS_QUIET.encode()).hexdigest()[:12]
+H_FLOOD = hashlib.sha256(ISS_FLOOD.encode()).hexdigest()[:12]
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded hard {HARD_TIMEOUT_S}s timeout")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _b64(obj) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(obj).encode()).rstrip(b"=").decode()
+
+
+def _token(iss: str, kid: str, suffix: str) -> str:
+    return (_b64({"alg": "ES256", "kid": kid}) + "."
+            + _b64({"iss": iss}) + "." + suffix)
+
+
+QUIET_TOK = _token(ISS_QUIET, "kq", "ok")
+FLOOD_TOK = _token(ISS_FLOOD, "kf", "bad")
+
+
+@pytest.fixture(params=["python", "native"])
+def fleet(request):
+    native = request.param == "native"
+    pool = WorkerPool(2, keyset_spec="stub:batch_ms=40",
+                      ping_interval=0.2, max_restarts=20,
+                      max_wait_ms=1.0,
+                      env_extra={"CAP_SERVE_NATIVE":
+                                 "1" if native else "0"})
+    assert pool.wait_all_ready(30), "fleet did not come up"
+    chains = set(pool.serve_chains().values())
+    if native and chains != {"native"}:
+        pool.close()
+        pytest.skip(f"native chain unavailable (workers ran {chains})")
+    assert native or chains == {"python"}, chains
+    yield pool
+    pool.close()
+
+
+def _merged_worker_counters(pool):
+    snaps = []
+    for _wid, (host, port) in sorted(pool.obs_endpoints().items()):
+        snaps.append(capstat.scrape(f"{host}:{port}")["snapshot"])
+    return telemetry.merge_snapshots(snaps)
+
+
+def test_two_tenant_flood_kill9_postmortem_and_slo(fleet):
+    """The acceptance scenario: a flooding tenant (all rejects, 8× the
+    quiet tenant's traffic) under sustained load, kill -9 landing on a
+    worker mid-flood. Zero wrong verdicts; the flooding tenant's
+    burn-rate rule breaches and is visible in ``capstat --tenants``
+    AND the victim's postmortem; the quiet tenant's rule stays green;
+    zero raw issuer strings on any exposed surface."""
+    telemetry.enable()
+    telemetry.active().reset()
+    cl = FleetClient(fleet, fallback=StubKeySet(), attempt_timeout=2.0,
+                     total_deadline=30.0)
+    # first wave: both tenants reach both workers, then wait for a
+    # postmortem CHECKPOINT carrying the per-tenant counters (pool
+    # default interval 1 s) so the kill -9 document must include them
+    for _ in range(4):
+        assert len(cl.verify_batch([QUIET_TOK] * 2)) == 2
+        assert len(cl.verify_batch([FLOOD_TOK] * 4)) == 4
+    victim = fleet.pid(0)
+    pm_path = fleet.postmortem_path(0)
+    assert pm_path, "pool did not assign a postmortem path"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        doc = obs_postmortem.read_postmortem(pm_path)
+        if doc and decision.tenant_totals(
+                doc.get("snapshot", {}).get("counters") or {}):
+            break
+        time.sleep(0.1)
+    # sustained flood, kill -9 landing mid-batch
+    batches = ([[QUIET_TOK] * 4] * 4) + ([[FLOOD_TOK] * 8] * 16)
+    results = {}
+
+    def submit(i):
+        results[i] = cl.verify_batch(batches[i])
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(len(batches))]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    kill9(victim)        # lands mid-flood (40 ms simulated batches)
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "submission thread wedged"
+    # zero wrong verdicts / zero lost submissions, flood included
+    for i, toks in enumerate(batches):
+        assert len(results[i]) == len(toks)
+        for tok, r in zip(toks, results[i]):
+            if tok.endswith(".ok"):
+                assert not isinstance(r, Exception), \
+                    f"WRONG verdict for quiet tenant: {r!r}"
+            else:
+                assert isinstance(r, Exception), \
+                    "WRONG verdict for flood tenant: accepted"
+    # respawn converges
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if fleet.state(0) == "ready" and fleet.pid(0) != victim:
+            break
+        time.sleep(0.1)
+    assert fleet.state(0) == "ready" and fleet.pid(0) != victim
+
+    # victim's postmortem carries per-tenant counters through kill -9
+    doc = fleet.postmortem(0)
+    assert doc is not None, "no postmortem collected after kill -9"
+    pm_counters = (doc.get("snapshot") or {}).get("counters") or {}
+    pm_tenants = decision.tenant_totals(pm_counters)
+    assert pm_tenants, "postmortem lost the per-tenant counters"
+    # the victim served SOME of the flood before dying (router spread
+    # both workers): its document attributes that traffic by tenant
+    assert any(row.get("tokens") for row in pm_tenants.values())
+    rendered = obs_postmortem.render_postmortem(doc)
+    assert "tenants (" in rendered
+    # raw postmortem JSON: no issuer material
+    blob = json.dumps(doc)
+    for needle in (ISS_QUIET, ISS_FLOOD, "tenant-quiet",
+                   "tenant-flood", "://"):
+        assert needle not in blob, f"{needle!r} leaked into postmortem"
+
+    # fleet view: merged worker scrape → ledger + per-tenant SLO
+    merged = _merged_worker_counters(fleet)
+    counters = merged.get("counters") or {}
+    assert counters.get(f"decision.serve.tenant.{H_FLOOD}.reject", 0) \
+        > 0
+    assert counters.get(f"decision.serve.tenant.{H_QUIET}.accept", 0) \
+        > 0
+    look = counters.get("tenant.lookups", 0)
+    assert look == counters.get("tenant.attributed", 0) \
+        + counters.get("tenant.overflow", 0)
+    states = {}
+    for r in slo.evaluate_once(merged):
+        if r["name"].startswith("tenant_reject_ratio["):
+            states[r.get("tenant")] = r["ok"]
+    assert states.get(H_FLOOD) is False, \
+        "flooding tenant's burn-rate rule did not breach"
+    assert states.get(H_QUIET) is True, \
+        "quiet tenant's rule is not green"
+    ledger = capstat.render_tenants(merged)
+    assert H_FLOOD in ledger and "BREACH" in ledger
+    assert H_QUIET in ledger
+    assert "tenant-quiet" not in ledger and "://" not in ledger
+
+    # pool-side rollup + router-side tenant fold see the same tenants
+    pool_tenants = fleet.tenant_totals()
+    assert pool_tenants.get(H_FLOOD, {}).get("reject", 0) > 0
+    router_snap = cl.snapshot()
+    assert H_FLOOD in (router_snap.get("tenants") or {}), \
+        "router snapshot lost its tenant fold"
+
+    # every exposed HTTP surface (the /tenants endpoint included):
+    # zero raw issuers, and /tenants serves the hashed rollup
+    for _wid, (host, port) in sorted(fleet.obs_endpoints().items()):
+        for path in ("/metrics", "/snapshot", "/decisions",
+                     "/tenants"):
+            body = urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=5) \
+                .read().decode()
+            for needle in (ISS_QUIET, ISS_FLOOD, "://"):
+                assert needle not in body, \
+                    f"{needle!r} leaked into {path}"
+            if path == "/tenants":
+                doc = json.loads(body)
+                assert doc["lookups"] == doc["attributed"] \
+                    + doc["overflow"]
+    telemetry.disable()
+
+
+def test_sigterm_drain_postmortem_carries_tenant_counters(fleet):
+    """Graceful path: a SIGTERM-drained worker's fresh final
+    postmortem carries the per-tenant counters it folded (extends the
+    r9 postmortem contract to the tenant plane)."""
+    from cap_tpu.serve.client import VerifyClient
+
+    telemetry.enable()
+    telemetry.active().reset()
+    # direct connection: THIS worker must fold the two tenants
+    host, port = fleet.address(1)
+    with VerifyClient(host, port) as direct:
+        out = direct.verify_batch([QUIET_TOK] * 2 + [FLOOD_TOK] * 2)
+        assert len(out) == 4
+    fleet.restart(1, graceful=True)
+    doc = fleet.postmortem(1)
+    assert doc is not None
+    assert doc.get("reason") == "sigterm-drain"
+    pm_counters = (doc.get("snapshot") or {}).get("counters") or {}
+    tenants = decision.tenant_totals(pm_counters)
+    assert tenants.get(H_QUIET, {}).get("accept", 0) >= 2, tenants
+    assert tenants.get(H_FLOOD, {}).get("reject", 0) >= 2, tenants
+    rendered = obs_postmortem.render_postmortem(doc)
+    assert "tenants (" in rendered and H_FLOOD in rendered
+    blob = json.dumps(doc)
+    assert "tenant-quiet" not in blob and "://" not in blob
+    telemetry.disable()

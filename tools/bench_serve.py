@@ -867,6 +867,10 @@ def fleet_main() -> None:
         "supervision_counters": supervision,
         "ping_p99_s": round(ping["p99"], 6) if ping else None,
         "decisions": obs_decision.decision_counters(sweep_counters),
+        # per-tenant rollup of the same sweep counters (issuer-hash
+        # keyed: tokens / accept / reject mix / vcache hit splits) —
+        # the BENCH record shows WHOSE traffic the headline served
+        "tenants": obs_decision.tenant_totals(sweep_counters),
         "slo": slo_results,
         "points": points,
     }))
@@ -1084,6 +1088,8 @@ def main() -> None:
         "telemetry": {"stage_latency": stage_latency},
         # Decision/SLO self-description (cap_tpu.obs), serve surface.
         "decisions": obs_decision.decision_counters(counters),
+        # per-tenant rollup (issuer-hash keyed), same counters
+        "tenants": obs_decision.tenant_totals(counters),
         "slo": slo_results,
         "points": points,
     }))

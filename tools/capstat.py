@@ -342,6 +342,98 @@ def render_frontdoor(snap: Dict[str, Any]) -> str:
         lines.append("  peer fill: " + "  ".join(
             f"{k.split('.', 1)[1]}={v}" for k, v in sorted(
                 peer.items())))
+    tenants = snap.get("tenants") or {}
+    if tenants:
+        lines.append(f"  tenants routed ({len(tenants)}):")
+        ordered = sorted(tenants.items(),
+                         key=lambda kv: kv[1].get("lookups", 0),
+                         reverse=True)
+        for t, row in ordered[:12]:
+            tl = int(row.get("lookups", 0) or 0)
+            th = int(row.get("affinity_hits", 0) or 0)
+            t_rate = f"{100.0 * th / tl:.1f}%" if tl else "0.0%"
+            lines.append(f"    tenant={t:<14} lookups={tl} "
+                         f"affinity_hit={t_rate}")
+    return "\n".join(lines)
+
+
+def render_tenants(merged: Dict[str, Any],
+                   prev_counters: Optional[Dict[str, int]] = None,
+                   interval_s: float = 0.0, top: int = 20) -> str:
+    """The fleet tenant ledger (``--tenants``): per-tenant verify
+    rate, reject mix, serve-side p99, vcache hit%, and per-tenant SLO
+    state, over the exact merged fleet scrape — tenants are issuer
+    HASHES (plus ``none``/``other``), raw issuers never reach a
+    scrape. Under ``--watch`` the vps column is the per-interval rate
+    (counter deltas); one-shot renders lifetime totals."""
+    counters = {k: int(v) for k, v in
+                (merged.get("counters") or {}).items()}
+    tenants = obs_decision.tenant_totals(counters, surface="serve")
+    summary = telemetry.summarize_snapshot(merged)
+    # per-tenant SLO state from the DEFAULT tenant templates (the
+    # reject-ratio budget + per-tenant wrong-verdicts), evaluated over
+    # the same merged counters the table renders
+    slo_state: Dict[str, str] = {}
+    try:
+        rules = [r for r in obs_slo.default_rules()
+                 if obs_slo.is_tenant_template(r)]
+        for r in obs_slo.evaluate_once(merged, rules):
+            tid = r.get("tenant")
+            if tid is None:
+                continue
+            if not r["ok"]:
+                slo_state[tid] = "BREACH"
+            else:
+                slo_state.setdefault(tid, "ok")
+    except Exception as e:  # noqa: BLE001 - ledger must still render
+        slo_state = {}
+        print(f"capstat: tenant SLO evaluation failed: {e!r}",
+              file=sys.stderr)
+    look = counters.get("tenant.lookups", 0)
+    attr = counters.get("tenant.attributed", 0)
+    ovf = counters.get("tenant.overflow", 0)
+    ev = counters.get("tenant.table_evictions", 0)
+    state = ("EXACT" if look == attr + ovf else
+             f"DRIFT({look}!={attr}+{ovf})")
+    lines = [f"tenants ({len(tenants)} observed)  lookups={look} "
+             f"attributed={attr} overflow={ovf} evictions={ev} "
+             f"[{state}]"]
+    rate_col = "vps" if prev_counters is not None and interval_s > 0 \
+        else "tokens"
+    lines.append(f"  {'tenant':<14} {rate_col:>10} {'accept':>9} "
+                 f"{'reject':>9} {'p99':>10} {'vc-hit':>7} "
+                 f"{'slo':<7} reject mix")
+    ordered = sorted(tenants.items(),
+                     key=lambda kv: kv[1].get("tokens", 0),
+                     reverse=True)
+    for t, row in ordered[:top]:
+        toks = row.get("tokens", 0)
+        if rate_col == "vps":
+            prev = prev_counters.get(
+                f"decision.serve.tenant.{t}.tokens", 0)
+            d = toks if toks < prev else toks - prev
+            rate = f"{d / interval_s:10.1f}"
+        else:
+            rate = f"{toks:10d}"
+        s = summary.get(f"tenant.{t}.request_s")
+        p99 = f"{s['p99'] * 1e3:8.2f}ms" if s else "       -"
+        vl = row.get("vcache.lookups", 0)
+        vh = row.get("vcache.hits", 0)
+        vc = f"{100.0 * vh / vl:6.1f}%" if vl else "      -"
+        mix = "  ".join(
+            f"{k.split('.', 1)[1]}={v}" for k, v in sorted(
+                row.items(), key=lambda kv: -kv[1]
+                if isinstance(kv[1], int) else 0)
+            if k.startswith("reject."))[:60]
+        wrong = row.get("wrong_verdicts", 0)
+        lines.append(
+            f"  {t:<14} {rate} {row.get('accept', 0):>9} "
+            f"{row.get('reject', 0):>9} {p99} {vc} "
+            f"{slo_state.get(t, '-'):<7} "
+            + (f"WRONG={wrong} " if wrong else "") + mix)
+    if len(tenants) > top:
+        lines.append(f"  … {len(tenants) - top} more (sorted by "
+                     "tokens; raise --tenants-top)")
     return "\n".join(lines)
 
 
@@ -441,6 +533,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="JSON file with FrontDoor.snapshot() for the "
                          "router-tier view (per-host affinity hit%%, "
                          "spill/re-route counts, fleet epoch state)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="render the fleet tenant ledger (per-tenant "
+                         "vps/reject mix/p99/vcache hit%%/SLO state "
+                         "over the merged scrape; --watch turns the "
+                         "tokens column into a per-interval rate)")
+    ap.add_argument("--tenants-top", type=int, default=20,
+                    metavar="N", help="rows in the tenant ledger "
+                    "(default 20, sorted by tokens)")
     ap.add_argument("--postmortem", metavar="FILE",
                     help="render a collected crash postmortem file "
                          "(no endpoints scraped)")
@@ -506,19 +606,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         else:
             if frontdoor is not None:
                 print(render_frontdoor(frontdoor))
-            print(render_fleet(worker_data, client))
-            if args.watch:
-                # burn view: cumulative counters hide movement at a
-                # glance — show what changed THIS interval (respawn
-                # resets clamp to the fresh value, never negative)
-                cur = {k: int(v) for k, v in (merged_snapshot(
-                    worker_data).get("counters") or {}).items()}
+            if args.tenants:
+                merged = merged_snapshot(worker_data, client)
                 now = time.monotonic()
-                if prev_counters is not None:
-                    print(render_deltas(
-                        counter_deltas(prev_counters, cur),
-                        now - prev_t))
-                prev_counters, prev_t = cur, now
+                print(render_tenants(
+                    merged, prev_counters=prev_counters,
+                    interval_s=now - prev_t, top=args.tenants_top))
+                if args.watch:
+                    prev_counters = {
+                        k: int(v) for k, v in
+                        (merged.get("counters") or {}).items()}
+                    prev_t = now
+            else:
+                print(render_fleet(worker_data, client))
+                if args.watch:
+                    # burn view: cumulative counters hide movement at
+                    # a glance — show what changed THIS interval
+                    # (respawn resets clamp to the fresh value, never
+                    # negative)
+                    cur = {k: int(v) for k, v in (merged_snapshot(
+                        worker_data).get("counters") or {}).items()}
+                    now = time.monotonic()
+                    if prev_counters is not None:
+                        print(render_deltas(
+                            counter_deltas(prev_counters, cur),
+                            now - prev_t))
+                    prev_counters, prev_t = cur, now
         if args.slo or args.slo_rules:
             table, breach = run_slo(worker_data, client,
                                     args.slo_rules)

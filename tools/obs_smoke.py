@@ -98,7 +98,12 @@ def run_fleet(serve_chain):
             for name in REQUIRED_PROM:
                 if f"\n{name}" not in "\n" + text:
                     failures.append(f"worker {wid}: /metrics missing {name}")
-            if "nan" in text.lower():
+            # \b-anchored: a NaN VALUE renders as a standalone token;
+            # metric NAMES may legitimately contain the substring
+            # ("tenant_…")
+            import re as _re
+
+            if _re.search(r"\bnan\b", text, _re.IGNORECASE):
                 failures.append(f"worker {wid}: NaN value in /metrics")
             traced = traced or any(e.get("trace") == tid
                                    for e in worker_data[ep]["flight"])
@@ -185,6 +190,146 @@ def run_fleet(serve_chain):
     return ([f"{serve_chain}: {f}" for f in failures], info)
 
 
+def _tenant_token(issuer: str, kid: str, suffix: str) -> str:
+    """A stub-verifiable token whose payload carries a real issuer
+    claim (suffix .ok/.bad drives the stub verdict; the payload drives
+    tenant attribution)."""
+    import base64
+    import json
+
+    def b64(obj):
+        return base64.urlsafe_b64encode(
+            json.dumps(obj).encode()).rstrip(b"=").decode()
+
+    return (b64({"alg": "ES256", "kid": kid}) + "."
+            + b64({"iss": issuer}) + "." + suffix)
+
+
+def run_tenant_gate(serve_chain):
+    """The two-tenant attribution gate: a QUIET tenant (all accepts)
+    and a FLOODING tenant (all rejects, 10× the traffic) through one
+    stub fleet. FAIL if (a) the two issuers do not produce DISTINCT
+    per-tenant counters keyed by their hashes, (b) the exact
+    ``tenant.lookups == tenant.attributed + tenant.overflow`` equation
+    drifts, (c) the flooding tenant's default per-tenant SLO rule does
+    NOT breach or the quiet tenant's does, or (d) any RAW issuer
+    string appears in any scraped surface (/metrics, /snapshot,
+    /decisions). Returns (failures, tenant-counter map) so main() can
+    pin native-vs-python equality."""
+    import hashlib
+    import json as _json
+
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import FleetClient, WorkerPool
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from cap_tpu.obs import decision as obs_decision
+    from cap_tpu.obs import slo as obs_slo
+    from tools import capstat
+
+    iss_quiet = "https://tenant-quiet.example"
+    iss_flood = "https://tenant-flood.example"
+    h_quiet = hashlib.sha256(iss_quiet.encode()).hexdigest()[:12]
+    h_flood = hashlib.sha256(iss_flood.encode()).hexdigest()[:12]
+    quiet = _tenant_token(iss_quiet, "kq", "ok")
+    flood = _tenant_token(iss_flood, "kf", "bad")
+    failures = []
+    tenant_counters = {}
+    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.3,
+                      serve_chain=serve_chain)
+    try:
+        if not pool.wait_all_ready(30):
+            return ([f"{serve_chain}: tenant fleet did not come up"],
+                    tenant_counters)
+        telemetry.enable()
+        telemetry.active().reset()
+        cl = FleetClient(pool, fallback=StubKeySet(), rr_seed=0)
+        for _ in range(3):
+            assert len(cl.verify_batch([quiet] * 4)) == 4
+        for _ in range(12):
+            assert len(cl.verify_batch([flood] * 8)) == 8
+        obs = pool.obs_endpoints()
+        raw_bodies = []
+        snaps = []
+        for wid, (host, port) in sorted(obs.items()):
+            data = capstat.scrape(f"{host}:{port}")
+            snaps.append(data["snapshot"])
+            raw_bodies.append(urllib.request.urlopen(
+                f"http://{host}:{port}/metrics",
+                timeout=5).read().decode())
+            raw_bodies.append(_json.dumps(data["snapshot"]))
+            raw_bodies.append(urllib.request.urlopen(
+                f"http://{host}:{port}/decisions",
+                timeout=5).read().decode())
+            # the /tenants operator endpoint: must serve the rollup
+            # (hashed ids only) and join the redaction sweep
+            ten_body = urllib.request.urlopen(
+                f"http://{host}:{port}/tenants",
+                timeout=5).read().decode()
+            raw_bodies.append(ten_body)
+            if _json.loads(ten_body).get("lookups", 0) <= 0:
+                failures.append(
+                    f"worker {wid}: /tenants served zero lookups "
+                    "after two-tenant traffic")
+        merged = telemetry.merge_snapshots(snaps)
+        counters = merged.get("counters") or {}
+        qa = counters.get(f"decision.serve.tenant.{h_quiet}.accept", 0)
+        fr = counters.get(f"decision.serve.tenant.{h_flood}.reject", 0)
+        if qa < 12:
+            failures.append(f"quiet tenant accept counter {qa} < 12")
+        if fr < 96:
+            failures.append(f"flood tenant reject counter {fr} < 96")
+        if counters.get(f"decision.serve.tenant.{h_quiet}.reject", 0):
+            failures.append("quiet tenant shows rejects")
+        look = counters.get("tenant.lookups", 0)
+        attr = counters.get("tenant.attributed", 0)
+        ovf = counters.get("tenant.overflow", 0)
+        if not look or look != attr + ovf:
+            failures.append(
+                f"tenant accounting drift: lookups {look} != "
+                f"attributed {attr} + overflow {ovf}")
+        # per-tenant latency series must exist for both tenants
+        for h in (h_quiet, h_flood):
+            if f"tenant.{h}.request_s" not in (merged.get("series")
+                                               or {}):
+                failures.append(f"missing tenant latency series for "
+                                f"{h}")
+        # default per-tenant SLO: flood breaches, quiet stays green
+        states = {}
+        for r in obs_slo.evaluate_once(merged):
+            if r["name"].startswith("tenant_reject_ratio["):
+                states[r.get("tenant")] = r["ok"]
+        if states.get(h_flood, True):
+            failures.append("flooding tenant's reject-ratio rule did "
+                            "NOT breach")
+        if not states.get(h_quiet, False):
+            failures.append("quiet tenant's reject-ratio rule is not "
+                            "green")
+        # capstat ledger renders over the live scrape
+        rendered = capstat.render_tenants(merged)
+        if h_flood not in rendered or "BREACH" not in rendered:
+            failures.append("capstat.render_tenants missing the "
+                            "flooding tenant / its breach state")
+        # redaction: no raw issuer anywhere on any scraped surface
+        for body in raw_bodies:
+            for needle in (iss_quiet, iss_flood, "tenant-quiet",
+                           "tenant-flood", "://"):
+                if needle in body:
+                    failures.append(
+                        f"raw issuer material {needle!r} leaked into "
+                        "a scraped surface")
+                    break
+        # decision-side tenant counters only: vcache.tenant.* hit
+        # splits depend on request/chunk coalescing timing, decision
+        # totals never do — those are the cross-chain equality pin
+        tenant_counters = {
+            k: v for k, v in sorted(counters.items())
+            if (k.startswith("decision.") and ".tenant." in k)
+            or k.startswith("tenant.")}
+    finally:
+        pool.close()
+    return ([f"{serve_chain}: {f}" for f in failures], tenant_counters)
+
+
 def run_frontdoor_gate():
     """The 2-pool front-door gate: a repeated-token burst routed by
     digest affinity must (a) show ``frontdoor.affinity_hits`` > 0 with
@@ -256,6 +401,12 @@ def main() -> int:
     if py_info["chains"] != {"python"}:
         failures.append(f"python run came up as {py_info['chains']}")
 
+    # two-tenant attribution gate (python chain): distinct issuers →
+    # distinct hashed tenant counters, flood breaches its per-tenant
+    # SLO while the quiet tenant stays green, zero raw issuers
+    ten_failures, py_tenants = run_tenant_gate("python")
+    failures.extend(ten_failures)
+
     # native-chain gate: same load, native serve chain + telemetry
     # plane; decision counters must be IDENTICAL to the python run
     native_ok = False
@@ -276,6 +427,12 @@ def main() -> int:
                 "native/python serve decision counters diverge: "
                 f"native={nat_info['serve_decisions']} "
                 f"python={py_info['serve_decisions']}")
+        nat_ten_failures, nat_tenants = run_tenant_gate("native")
+        failures.extend(nat_ten_failures)
+        if nat_tenants != py_tenants:
+            failures.append(
+                "native/python TENANT counters diverge: "
+                f"native={nat_tenants} python={py_tenants}")
     else:
         print("obs-smoke NOTE: native serve runtime unavailable — "
               "native-chain gate skipped", file=sys.stderr)
@@ -289,9 +446,11 @@ def main() -> int:
             print(f"obs-smoke FAIL: {f}", file=sys.stderr)
         return 1
     print("obs-smoke OK: python fleet scraped clean (gauges, trace "
-          "reassembly, decision counters, SLO engine)"
-          + (", native fleet scraped clean with counter parity to "
-             "the python run" if native_ok else "")
+          "reassembly, decision counters, SLO engine), two-tenant "
+          "gate clean (hashed attribution, flood SLO breach, zero "
+          "raw issuers)"
+          + (", native fleet scraped clean with counter AND tenant "
+             "parity to the python run" if native_ok else "")
           + ", 2-pool front door routed clean (affinity hits, exact "
             "lookup accounting, zero stale accepts)")
     return 0
