@@ -159,3 +159,24 @@ class ExpiredAuthTimeError(CapError):
 
 class MissingClaimError(CapError):
     default_message = "missing required claim"
+
+
+class ThrottledError(CapError):
+    """Admission control rejected the token BEFORE verification: the
+    tenant (issuer) is over its token-bucket budget. A terminal,
+    non-verdict rejection — the signature was never checked, so no
+    caller may treat it as "invalid", only as "retry later". The wire
+    form carries an additive retry-after hint inside the ordinary
+    status-1 payload (``retry_after_ms=<int>``), parsed back by
+    :func:`cap_tpu.serve.protocol.retry_after_hint`."""
+
+    default_message = "tenant over admission budget"
+
+    def __init__(self, message: str | None = None, *,
+                 retry_after_ms: int | None = None,
+                 op: str | None = None):
+        if message is None and retry_after_ms is not None:
+            message = (f"{self.default_message} "
+                       f"(retry_after_ms={int(retry_after_ms)})")
+        super().__init__(message, op=op)
+        self.retry_after_ms = retry_after_ms

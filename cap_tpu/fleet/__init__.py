@@ -17,9 +17,11 @@ lost submissions, under kill -9, stalls, black holes, and corrupt
 frames. See docs/SERVE.md.
 """
 
+from .autoscale import PoolAutoscaler
 from .frontdoor import ConsistentHashRing, FrontDoor
 from .pool import FleetError, WorkerPool
 from .router import FleetClient, FleetExhaustedError
 
 __all__ = ["ConsistentHashRing", "FleetClient", "FleetError",
-           "FleetExhaustedError", "FrontDoor", "WorkerPool"]
+           "FleetExhaustedError", "FrontDoor", "PoolAutoscaler",
+           "WorkerPool"]

@@ -215,6 +215,16 @@ class FrontDoor:
                     th.join()
         _decision.record_batch("frontdoor", out, tokens=tokens,
                                latency_s=time.perf_counter() - t0)
+        # Admission pushback accounting (r20): throttled rejects are
+        # TERMINAL here exactly as at the router — the front door
+        # never re-routes or oracle-falls-back a shed token (that
+        # would defeat admission); it only counts what came back.
+        thr = sum(1 for r in out
+                  if isinstance(r, Exception)
+                  and _decision.classify(r)
+                  == _decision.REASON_THROTTLED)
+        if thr:
+            self._count({"frontdoor.throttled_tokens": thr})
         return out
 
     def verify_batch_digests(self, tokens: Sequence[str],
@@ -522,6 +532,7 @@ class FrontDoor:
                     "inflight": a.inflight,
                     "endpoints": len(a.client._live_endpoints()),
                     "live": a.live(),
+                    "pushback": a.client.pushback_state(),
                 } for a in self._arms
             }
             ctr = dict(self._ctr)

@@ -35,7 +35,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import telemetry
 from ..errors import CapError
@@ -58,6 +58,7 @@ READY = "ready"
 DRAINING = "draining"
 DEAD = "dead"          # crash observed, respawn pending/possible
 FAILED = "failed"      # out of respawn budget; devices idle
+RETIRED = "retired"    # drained by resize(); slot reusable on growth
 
 
 class WorkerHandle:
@@ -127,7 +128,8 @@ class WorkerPool:
                  serve_chain: Optional[str] = None,
                  transport: Optional[str] = None,
                  peer_fill: bool = True, peer_fill_max: int = 2048,
-                 peer_fill_attempts: int = 50):
+                 peer_fill_attempts: int = 50,
+                 autoscale: Optional[dict] = None):
         if placements is None:
             placements = single_owner_placement(
                 n_workers, n_devices if n_devices is not None else n_workers,
@@ -181,9 +183,25 @@ class WorkerPool:
         self._peer_fill_budget = int(peer_fill_attempts)
         self._lock = threading.Lock()
         self._closed = threading.Event()
+        # Resize machinery (r20): the placement split every later
+        # growth extends, plus the bounded transition log capstat and
+        # the chaos postmortems render.
+        self._platform = placements[0].platform if placements else "cpu"
+        self._devices_per_worker = (len(placements[0].device_ids)
+                                    if placements else 1)
+        self._resize_events: List[dict] = []
         self._handles = [WorkerHandle(p) for p in placements]
         for h in self._handles:
             self._spawn(h)
+        telemetry.gauge("fleet.pool_size", n_workers)
+        # SLO-burn autoscaler (r20): opt-in via a knob dict (see
+        # fleet/autoscale.PoolAutoscaler); ticked from the supervisor
+        # sweep so scaling rides the existing supervision cadence.
+        self._autoscaler = None
+        if autoscale is not None:
+            from .autoscale import PoolAutoscaler
+
+            self._autoscaler = PoolAutoscaler(self, **autoscale)
         self._supervisor = threading.Thread(
             target=self._supervise_loop, daemon=True,
             name="cap-tpu-fleet-supervisor")
@@ -235,6 +253,153 @@ class WorkerPool:
                 return all(s == READY for s in states)
             time.sleep(0.05)
         return False
+
+    # -- resize / autoscale (r20) -----------------------------------------
+
+    def size(self) -> int:
+        """ACTIVE worker slots (everything not retired/failed)."""
+        with self._lock:
+            return sum(1 for h in self._handles
+                       if h.state not in (RETIRED, FAILED))
+
+    def resize_events(self, last: int = 64) -> List[dict]:
+        """The bounded transition log: every resize / shed / unshed,
+        newest last — capstat renders it and the chaos postmortems
+        embed it (the pool annotates collected docs)."""
+        with self._lock:
+            return list(self._resize_events[-last:])
+
+    def _record_resize(self, kind: str, frm: int, to: int, reason: str,
+                       tenant: Optional[str] = None) -> None:
+        ev: Dict[str, Any] = {"t": time.time(), "kind": kind,
+                              "from": frm, "to": to, "reason": reason}
+        if tenant is not None:
+            ev["tenant"] = tenant
+        with self._lock:
+            self._resize_events.append(ev)
+            del self._resize_events[:-64]
+        telemetry.count(f"fleet.resize.{kind}")
+        telemetry.gauge("fleet.pool_size", to)
+
+    def resize(self, n: int, reason: str = "manual") -> int:
+        """Grow or shrink the pool to ``n`` active workers under the
+        existing placement + supervision machinery.
+
+        Growth reuses RETIRED slots first (fresh respawn budget), then
+        appends new single-owner placements extending the original
+        devices-per-worker split — virtual on ``cpu`` (each child gets
+        its own device world), so growth is unbounded there; a ``tpu``
+        pool cannot grow past the chips it was given. Shrink drains
+        the HIGHEST-id active workers (SIGTERM → grace → SIGKILL,
+        postmortem collected) and retires their slots. Every
+        transition is a counter (``fleet.resize.up`` / ``.down``) and
+        a :meth:`resize_events` entry. Returns the new active size."""
+        n = int(n)
+        if n < 1:
+            raise FleetError(f"cannot resize below 1 worker (asked {n})")
+        cur = self.size()
+        if n == cur or self._closed.is_set():
+            return cur
+        if n > cur:
+            grow = n - cur
+            with self._lock:
+                retired = [h for h in self._handles
+                           if h.state == RETIRED][:grow]
+            for h in retired:
+                with self._lock:
+                    h.restarts = 0
+                self._spawn(h)
+                grow -= 1
+            while grow > 0:
+                if self._platform == "tpu":
+                    raise FleetError(
+                        "cannot grow a TPU pool past its initial "
+                        "device budget (single-owner placement)")
+                with self._lock:
+                    wid = len(self._handles)
+                    placement = WorkerPlacement(
+                        worker_id=wid,
+                        device_ids=tuple(range(
+                            wid * self._devices_per_worker,
+                            (wid + 1) * self._devices_per_worker)),
+                        platform=self._platform)
+                    active_pl = [x.placement for x in self._handles
+                                 if x.state != RETIRED]
+                    h = WorkerHandle(placement)
+                    self._handles.append(h)
+                # disjointness stays structural even under growth
+                assert_single_owner(active_pl + [placement])
+                self._spawn(h)
+                grow -= 1
+            self._record_resize("up", cur, n, reason)
+            return n
+        # shrink: drain the highest-id active workers
+        with self._lock:
+            victims = sorted(
+                (h for h in self._handles
+                 if h.state not in (RETIRED, FAILED)),
+                key=lambda h: -h.worker_id)[: cur - n]
+            for h in victims:
+                h.state = DRAINING
+        for h in victims:
+            self._reap(h, graceful=True)
+            self._collect_postmortem(h)
+            with self._lock:
+                h.state = RETIRED
+        self._record_resize("down", cur, n, reason)
+        return n
+
+    # -- admission distribution (r20) -------------------------------------
+
+    def _control_exchange(self, h: WorkerHandle,
+                          doc: dict) -> Optional[dict]:
+        """One type-13/14 control exchange on a fresh connection
+        (KEYS-push shape; returns the ack doc or None)."""
+        import json as _json
+
+        with self._lock:
+            addr = h.address if h.state == READY else None
+        if addr is None:
+            return None
+        try:
+            with socket.create_connection(
+                    addr, timeout=self._ping_timeout) as s:
+                s.settimeout(self._keys_push_timeout)
+                protocol.send_peer_fill(s, doc)
+                ftype, entries = protocol.FrameReader(s).recv_frame()
+            if (ftype != protocol.T_PEER_ACK or not entries
+                    or entries[0][0] != 0):
+                return None
+            return _json.loads(entries[0][1])
+        except (OSError, protocol.ProtocolError, ValueError):
+            return None
+
+    def push_admission(self, doc: dict) -> Dict[int, bool]:
+        """Push one admission op (rate/burst retune and/or per-tenant
+        shed scales) to every READY worker — the autoscaler's tighten
+        lever, riding the existing peer-fill control pair (no new
+        frame type). Returns worker_id → applied."""
+        doc = {**doc, "op": "admission"}
+        with self._lock:
+            targets = [h for h in self._handles
+                       if h.state == READY and h.address is not None]
+        telemetry.count("fleet.admission_pushes")
+        out: Dict[int, bool] = {}
+        for h in targets:
+            out[h.worker_id] = self._control_exchange(h, doc) \
+                is not None
+        return out
+
+    def shed_tenant(self, tenant: str, scale: float,
+                    reason: str = "slo-burn") -> Dict[int, bool]:
+        """Tighten one tenant's admission fleet-wide (scale < 1.0
+        sheds; 1.0 restores) — counted, evented, capstat-visible."""
+        out = self.push_admission({"scale": {str(tenant):
+                                             float(scale)}})
+        sz = self.size()
+        self._record_resize("shed" if scale < 1.0 else "unshed",
+                            sz, sz, reason, tenant=str(tenant))
+        return out
 
     def stats(self) -> Dict[int, Optional[dict]]:
         """Aggregate per-worker STATS snapshots (None for the dead)."""
@@ -299,6 +464,8 @@ class WorkerPool:
                 "epoch_skew": self.epoch_skew(),
                 "serve_chains": self.serve_chains(),
                 "transports": self.transports(),
+                "pool_size": self.size(),
+                "resize_events": self.resize_events(),
             },
         }
 
@@ -514,6 +681,13 @@ class WorkerPool:
             return
         doc = _postmortem.read_postmortem(h.postmortem_path)
         if doc is not None:
+            # Pool-side enrichment: the dying worker cannot see pool
+            # transitions, so the collector stamps the resize/shed log
+            # onto the doc — the chaos bar "resize events visible in
+            # the victim's postmortem".
+            events = self.resize_events()
+            if events:
+                doc["pool_resize_events"] = events
             with self._lock:
                 h.postmortem = doc
             telemetry.count("fleet.postmortems_collected")
@@ -674,12 +848,17 @@ class WorkerPool:
                 telemetry.gauge(
                     "fleet.workers_ready",
                     sum(1 for h in self._handles if h.state == READY))
+            if self._autoscaler is not None:
+                try:
+                    self._autoscaler.tick()
+                except Exception:  # noqa: BLE001 - never kill the loop
+                    telemetry.count("fleet.autoscale_errors")
             for h in list(self._handles):
                 if self._closed.is_set():
                     return
                 with self._lock:
                     state, proc, addr = h.state, h.proc, h.address
-                if state == FAILED or proc is None:
+                if state in (FAILED, RETIRED) or proc is None:
                     continue
                 if proc.poll() is not None and state != DRAINING:
                     # Crash (or kill -9): the process is gone.

@@ -180,6 +180,18 @@ class FleetClient:
         self._hedge_after = hedge_after
         self._lock = threading.Lock()
         self._breakers: Dict[Endpoint, _Breaker] = {}
+        # Admission pushback (r20): when a worker answers with
+        # ``throttled`` rejects, the retry-after hint opens a
+        # client-side pushback window — inside it this client does
+        # not hedge (duplicating a throttled batch doubles the very
+        # load admission is shedding) and briefly waits before the
+        # next routed batch (bounded by backoff_max). A throttled
+        # reject is TERMINAL: it never triggers the CPU-oracle
+        # fallback (re-verifying shed traffic would defeat admission)
+        # and never earns breaker credit or failure — the transport
+        # worked; the tenant is over budget.
+        self._pushback_until = 0.0
+        self._last_retry_after: Optional[float] = None
         # Start round-robin at a per-process offset (rr_seed pins it
         # for tests): N client processes all beginning at index 0
         # march over the workers in lockstep (batching re-syncs the
@@ -328,10 +340,66 @@ class FleetClient:
         # RemoteVerifyError and classify back to the engine's reason.
         _decision.record_batch("router", out, tokens=tokens,
                                latency_s=time.perf_counter() - t0)
+        self._note_pushback(out)
         return out
+
+    # -- admission pushback ------------------------------------------------
+
+    @staticmethod
+    def _is_throttled(res: Any) -> bool:
+        return (isinstance(res, Exception)
+                and _decision.classify(res)
+                == _decision.REASON_THROTTLED)
+
+    def _note_pushback(self, results: Sequence[Any]) -> None:
+        """Honor throttled rejects: count them and open the pushback
+        window from the worker's retry-after hint."""
+        thr = sum(1 for r in results if self._is_throttled(r))
+        if not thr:
+            return
+        telemetry.count("fleet.throttled_tokens", thr)
+        hint = None
+        for r in results:
+            if self._is_throttled(r):
+                h = protocol.retry_after_hint(str(r))
+                if h is not None and (hint is None or h > hint):
+                    hint = h
+        if hint is None:
+            hint = self._backoff_base
+        self._last_retry_after = hint
+        until = time.monotonic() + min(hint, self._backoff_max)
+        with self._lock:
+            if until > self._pushback_until:
+                self._pushback_until = until
+
+    def _pushback_remaining(self) -> float:
+        with self._lock:
+            return max(0.0, self._pushback_until - time.monotonic())
+
+    @classmethod
+    def _all_throttled(cls, results: Sequence[Any]) -> bool:
+        """True when a response is PURE admission pushback: such an
+        exchange proves the transport works but says nothing about
+        verify health — it earns neither breaker credit nor failure."""
+        return bool(results) and all(cls._is_throttled(r)
+                                     for r in results)
+
+    def pushback_state(self) -> Dict[str, Any]:
+        """The live pushback window (capstat's router view): seconds
+        remaining + the last retry-after hint a worker sent."""
+        return {"active_s": round(self._pushback_remaining(), 4),
+                "retry_after_s": self._last_retry_after}
 
     def _verify_batch_routed(self, tokens: List[str],
                              trace: Optional[str]) -> List[Any]:
+        # Client-side backoff inside an open pushback window: one
+        # bounded wait (≤ backoff_max) before dispatching more load
+        # at a fleet that is actively shedding this client's tenants.
+        wait = self._pushback_remaining()
+        if wait > 0:
+            telemetry.count("fleet.pushback_waits")
+            with telemetry.span(telemetry.SPAN_ROUTER_BACKOFF):
+                time.sleep(min(wait, self._backoff_max))
         deadline = time.monotonic() + self._total_deadline
         tried_this_round: List[Endpoint] = []
         rounds = 0
@@ -404,9 +472,14 @@ class FleetClient:
         take the first success (verify is deterministic → duplicate
         execution cannot change any verdict)."""
         hedge = self._hedge_after
+        if hedge is not None and self._pushback_remaining() > 0:
+            # no hedging inside a pushback window: duplicating a
+            # throttled batch doubles exactly the load being shed
+            hedge = None
         if hedge is None or hedge >= budget:
             res = self._attempt_once(ep, tokens, budget, trace)
-            self._on_success(ep)
+            if not self._all_throttled(res):
+                self._on_success(ep)
             return res
 
         result_q: "List[Tuple[Endpoint, Any]]" = []
@@ -482,7 +555,8 @@ class FleetClient:
                 winner_ep, res = oks[0]
             if winner_ep != ep:
                 telemetry.count("fleet.hedge_wins")
-            self._on_success(winner_ep)
+            if not self._all_throttled(res):
+                self._on_success(winner_ep)
             return res
         finally:
             # Close EVERY attempt socket (winner included — done with
@@ -534,6 +608,7 @@ class FleetClient:
             "spans": rec.trace_spans() if rec is not None else [],
             "breakers": {f"{ep[0]}:{ep[1]}": st
                          for ep, st in self.breaker_states().items()},
+            "pushback": self.pushback_state(),
         }
         if rec is not None:
             # router-side tenant fold (issuer-hash keyed): what THIS
@@ -551,6 +626,14 @@ class FleetClient:
                                  self._pool.key_epochs().items()}
             out["epoch_skew"] = skew
             telemetry.gauge("keyplane.epoch_skew", skew)
+        out["pushback"] = self.pushback_state()
+        if self._pool is not None and hasattr(self._pool,
+                                              "resize_events"):
+            events = self._pool.resize_events()
+            if events:
+                out["resize_events"] = events[-8:]
+            if hasattr(self._pool, "size"):
+                out["pool_size"] = self._pool.size()
         return out
 
     def close(self) -> None:

@@ -62,13 +62,14 @@ REASON_INVALID_CLAIMS = "invalid_claims"  # iss/aud/sub/nonce/azp/hashes
 REASON_JWKS_ERROR = "jwks_error"          # key material unavailable/bad
 REASON_OIDC_FLOW = "oidc_flow"            # RP flow violations
 REASON_TRANSPORT = "transport"            # wire/socket/protocol failure
+REASON_THROTTLED = "throttled"            # admission pushback (not a verdict)
 REASON_INTERNAL = "internal"              # anything else (bug bucket)
 
 REASON_CLASSES = frozenset({
     REASON_MALFORMED, REASON_NOT_SIGNED, REASON_BAD_SIGNATURE,
     REASON_UNKNOWN_KID, REASON_UNSUPPORTED_ALG, REASON_EXPIRED,
     REASON_INVALID_CLAIMS, REASON_JWKS_ERROR, REASON_OIDC_FLOW,
-    REASON_TRANSPORT, REASON_INTERNAL,
+    REASON_TRANSPORT, REASON_THROTTLED, REASON_INTERNAL,
 })
 
 # FIXED-ORDER index form of the registry: the native telemetry plane
@@ -76,11 +77,15 @@ REASON_CLASSES = frozenset({
 # struct region and the binding maps indices back to these names at
 # scrape time. Order is part of the native ABI — append-only; the
 # layout handshake in native_serve disables the plane on length drift.
+# Like families, new reasons insert BEFORE "internal" (the native fold
+# uses the LAST index as its out-of-range bucket): r20 added
+# "throttled" for admission pushback, bumping N_REASON 11 → 12 with a
+# matching telemetry_native.h edit + rebuild.
 REASON_INDEX = (
     REASON_MALFORMED, REASON_NOT_SIGNED, REASON_BAD_SIGNATURE,
     REASON_UNKNOWN_KID, REASON_UNSUPPORTED_ALG, REASON_EXPIRED,
     REASON_INVALID_CLAIMS, REASON_JWKS_ERROR, REASON_OIDC_FLOW,
-    REASON_TRANSPORT, REASON_INTERNAL,
+    REASON_TRANSPORT, REASON_THROTTLED, REASON_INTERNAL,
 )
 _REASON_TO_INDEX = {r: i for i, r in enumerate(REASON_INDEX)}
 
@@ -151,6 +156,8 @@ REASON_FOR_ERROR: Dict[str, str] = {
     "MissingAccessTokenError": REASON_OIDC_FLOW,
     "IDGeneratorFailedError": REASON_INTERNAL,
     "NotFoundError": REASON_INTERNAL,
+    # admission control (serve-time pushback; never a verify verdict)
+    "ThrottledError": REASON_THROTTLED,
     # serve/fleet transport layer
     "ProtocolError": REASON_TRANSPORT,
     "MalformedFrameError": REASON_TRANSPORT,

@@ -97,6 +97,11 @@ stale_accepts    counter vcache.stale_accepts max 0
 # two-tenant gate pins flood-breaches-while-quiet-stays-green.
 tenant_wrong_verdicts counter decision.tenant.*.wrong_verdicts max 0
 tenant_reject_ratio   ratio decision.serve.tenant.*.reject / decision.serve.tenant.*.tokens max 0.5 burn 1.5
+# Admission (r20): a tenant whose traffic is mostly THROTTLED is
+# burning the fleet's admission budget — its own rule pages (the
+# flooder breaches, quiet tenants have zero throttles and stay
+# green), and the pool autoscaler reads this burn as its shed signal.
+tenant_throttle_ratio ratio decision.serve.tenant.*.reject.throttled / decision.serve.tenant.*.tokens max 0.5 burn 1.5
 """
 
 

@@ -38,6 +38,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -355,6 +356,96 @@ class MpscRing {
 };
 
 // ---------------------------------------------------------------------------
+// deficit-round-robin scheduler (r20 tenant fairness). One slot per
+// real tenant (telemetry_native.h TEN_SLOTS) plus ONE shared
+// best-effort slot for none/other/unclassified traffic. Costs are
+// TOKENS (the unit the pipeline fills with); a queue whose head costs
+// more than its deficit yields the cursor and earns another quantum
+// on its next visit — the classic DRR result behind token-bucket-
+// policed ingest (the FPGA ECDSA engine paper's scheduling frame).
+//
+// Single-consumer by construction: only the drain thread touches it.
+// The algorithm is mirrored LINE FOR LINE by cap_tpu/serve/drr.py
+// (the python chain's AdaptiveBatcher fair mode) and the dispatch-
+// order parity is pinned by tests/test_admission.py through the
+// cap_drr_* test ABI below — both chains must schedule identically.
+// ---------------------------------------------------------------------------
+
+static const int SCHED_SLOTS = 65;       // TEN_SLOTS real + 1 best-effort
+static const int SCHED_BE = 64;          // the shared best-effort slot
+static const int64_t SCHED_QUANTUM = 512;
+
+struct DrrSched {
+  std::deque<std::pair<void*, int64_t>> q[SCHED_SLOTS];
+  int64_t deficit[SCHED_SLOTS] = {};
+  int32_t weight[SCHED_SLOTS];
+  int64_t quantum = SCHED_QUANTUM;
+  int32_t cursor = 0;
+  bool fresh = true;   // cursor just arrived at its slot (one charge)
+  int64_t n = 0;
+
+  DrrSched() {
+    for (auto& w : weight) w = 1;
+  }
+
+  void push(int slot, void* item, int64_t cost) {
+    if (slot < 0 || slot >= SCHED_SLOTS) slot = SCHED_BE;
+    q[slot].emplace_back(item, cost < 1 ? 1 : cost);
+    n++;
+  }
+
+  // Next item in DRR order (nullptr when empty). Deterministic given
+  // the arrival sequence — the parity contract with serve/drr.py.
+  void* pop() {
+    if (n == 0) return nullptr;
+    int empties = 0;
+    for (;;) {
+      int s = cursor;
+      if (q[s].empty()) {
+        deficit[s] = 0;              // leaving the active set resets
+        cursor = (s + 1) % SCHED_SLOTS;
+        fresh = true;
+        if (++empties >= SCHED_SLOTS) return nullptr;  // defensive
+        continue;
+      }
+      empties = 0;
+      if (fresh) {
+        deficit[s] += quantum * (int64_t)weight[s];
+        fresh = false;
+      }
+      auto& head = q[s].front();
+      if (head.second <= deficit[s]) {
+        deficit[s] -= head.second;
+        void* item = head.first;
+        q[s].pop_front();
+        n--;
+        return item;
+      }
+      cursor = (s + 1) % SCHED_SLOTS;  // out of deficit: yield turn
+      fresh = true;
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// per-tenant token-bucket admission (r20). Checked by the READER
+// threads at enqueue, per token: over-budget tokens are marked
+// throttled and never reach the verify pipeline — the drain path
+// answers them with a status-1 ThrottledError carrying a retry-after
+// hint, and the reader's blocking push is what turns a sustained
+// flood into TCP backpressure (wire pushback). One bucket per tenant
+// slot INCLUDING none/other (N_TEN buckets), refilled lazily from a
+// monotonic clock under one small mutex (one lock round per frame).
+// ---------------------------------------------------------------------------
+
+struct AdmBucket {
+  double level = 0.0;
+  double t_last = 0.0;
+  double scale = 1.0;   // shed lever: effective rate = rate * scale
+  bool init = false;
+};
+
+// ---------------------------------------------------------------------------
 // handle / connection / request records
 // ---------------------------------------------------------------------------
 
@@ -407,6 +498,14 @@ struct Req {
   // verdict cache (when enabled): sha256(token)[:16] per token,
   // computed by THIS reader thread at parse time
   std::string digests;
+  // tenant-fair scheduling (r20): the DRR slot this request queues
+  // under (first token's tenant; -1 / out-of-range → best-effort) and
+  // the per-token admission verdicts — thr[i] != 0 means token i was
+  // rejected by the token bucket and must NOT be verified; retry_ms
+  // is the frame's retry-after hint (max over its throttled tokens).
+  int16_t sched_slot = -1;
+  std::vector<uint8_t> thr;
+  int32_t retry_ms = 0;
 };
 
 // counter slots (cap_serve_counter)
@@ -425,7 +524,14 @@ enum {
   CTR_SHM_FRAMES = 9,
   CTR_SHM_STALE_GEN = 10,
   CTR_SHM_DETACHES = 11,
-  CTR_N = 12,
+  // admission control (r20; slots additive like the shm block — a
+  // stale binding reading only 0-11 keeps its exact meanings). The
+  // exact equation ADM_CHECKED == ADM_ADMITTED + ADM_THROTTLED is an
+  // obs-smoke gate.
+  CTR_ADM_CHECKED = 12,
+  CTR_ADM_ADMITTED = 13,
+  CTR_ADM_THROTTLED = 14,
+  CTR_N = 15,
 };
 
 struct Handle {
@@ -452,6 +558,27 @@ struct Handle {
   // cap_serve_drain_digests copies the last drain's out)
   std::atomic<int32_t> digests_on{0};
   std::vector<uint8_t> last_digests;
+  // tenant-fair DRR scheduling (r20, cap_serve_set_fair). The sched
+  // struct and barrier are CONSUMER-OWNED (only the drain thread
+  // touches them); fair_on is sampled per pop so arming/disarming is
+  // safe at any time. A control record becomes a BARRIER: everything
+  // queued before it drains first (DRR only reorders verifies BETWEEN
+  // control records — the keys-push ordering contract is unchanged),
+  // and nothing behind it leaves the MPSC ring until it is delivered.
+  std::atomic<int32_t> fair_on{0};
+  DrrSched sched;
+  Req* barrier = nullptr;
+  // per-tenant token-bucket admission (r20, cap_serve_set_admission):
+  // shared by every reader thread under adm_mu. rate is tokens/sec
+  // PER TENANT; burst is the bucket depth in tokens.
+  std::atomic<int32_t> adm_on{0};
+  std::mutex adm_mu;
+  double adm_rate = 0.0;
+  double adm_burst = 0.0;
+  AdmBucket adm[cap_tel::N_TEN];
+  // per-token throttle mask of the LAST drain (cap_serve_drain_thr),
+  // token-aligned like last_fams; single-consumer.
+  std::vector<uint8_t> last_thr;
   std::mutex mu;  // guards the two cvs' sleep/wake protocol
   std::condition_variable cv_data;   // drain thread sleeps here
   std::condition_variable cv_space;  // producers sleep here when full
@@ -472,6 +599,12 @@ static double wall_now() {
   struct timeval tv;
   gettimeofday(&tv, nullptr);
   return (double)tv.tv_sec + (double)tv.tv_usec * 1e-6;
+}
+
+static double mono_now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
 }
 
 static bool send_all(int fd, const std::string& data) {
@@ -634,6 +767,72 @@ static bool handle_frame(const std::shared_ptr<Conn>& c,
             (uint8_t*)&r->kids[i * cap_tel::KID_LEN], &kid_len,
             &r->tens[i]);
         if (r->fams[i] < 0) r->tens[i] = -1;  // miss: Python resolves
+      }
+    }
+    if (r->kind == K_VERIFY) {
+      // DRR slot: the FIRST token's reader-classified tenant decides
+      // (frames are per-connection and issuers per-client, so mixed-
+      // tenant frames are rare; the python twin picks the same way).
+      // Unclassified / none / other / header-cache miss → the shared
+      // best-effort slot (sched_slot stays -1).
+      if (!r->tens.empty() && r->tens[0] >= 0 &&
+          r->tens[0] < cap_tel::TEN_SLOTS)
+        r->sched_slot = r->tens[0];
+      if (h->adm_on.load(std::memory_order_relaxed) && nent) {
+        // token-bucket admission, per token, while the frame is hot:
+        // a throttled token is marked (never verified) and answered
+        // from the drain path with the retry-after pushback — the
+        // whole point is that a flood costs the pipeline ~nothing.
+        r->thr.assign(nent, 0);
+        double now = mono_now();
+        int64_t throttled = 0, judged = 0;
+        double worst = 0.0;
+        {
+          std::lock_guard<std::mutex> lk(h->adm_mu);
+          for (size_t i = 0; i < nent; i++) {
+            if (i >= r->tens.size() || r->tens[i] < 0 ||
+                r->tens[i] >= cap_tel::N_TEN) {
+              // header-cache miss (or no telemetry plane): the tenant
+              // is unknown HERE — judging it against a shared bucket
+              // would let one tenant's cold frames starve another's.
+              // Mark PENDING; the drain path judges it through
+              // cap_serve_adm_take once Python resolved the issuer.
+              r->thr[i] = 2;
+              continue;
+            }
+            judged++;
+            AdmBucket& b = h->adm[r->tens[i]];
+            double rate = h->adm_rate * b.scale;
+            if (!b.init) {
+              b.init = true;
+              b.level = h->adm_burst;   // buckets start full
+              b.t_last = now;
+            } else if (now > b.t_last) {
+              b.level += (now - b.t_last) * rate;
+              if (b.level > h->adm_burst) b.level = h->adm_burst;
+              b.t_last = now;
+            }
+            if (b.level >= 1.0) {
+              b.level -= 1.0;
+            } else {
+              r->thr[i] = 1;
+              throttled++;
+              double wait = rate > 1e-9 ? (1.0 - b.level) / rate
+                                        : 60.0;
+              if (wait > worst) worst = wait;
+            }
+          }
+        }
+        if (judged) h->ctr[CTR_ADM_CHECKED].fetch_add(judged);
+        if (throttled) {
+          h->ctr[CTR_ADM_THROTTLED].fetch_add(throttled);
+          int64_t ms = (int64_t)(worst * 1000.0) + 1;
+          if (ms < 1) ms = 1;
+          if (ms > 60000) ms = 60000;
+          r->retry_ms = (int32_t)ms;
+        }
+        if (judged - throttled)
+          h->ctr[CTR_ADM_ADMITTED].fetch_add(judged - throttled);
       }
     }
     int64_t ntok = r->kind == K_VERIFY ? (int64_t)nent : 1;
@@ -889,6 +1088,43 @@ static void writer_main(std::shared_ptr<Conn> c) {
   finish_conn(c);
 }
 
+// Single-consumer pop honoring fair mode (drain thread only). FIFO
+// mode with an empty scheduler is the plain ring pop — zero added
+// work on the classic path. In fair mode everything currently queued
+// in the MPSC ring first transfers into the per-tenant subqueues,
+// stopping at the first CONTROL record, which becomes a barrier:
+// every request read before it drains first (over however many drain
+// calls that takes), and nothing read after it leaves the ring until
+// it is delivered — DRR reorders verifies only BETWEEN controls, so
+// the keys-push / stats ordering contract is exactly the FIFO one.
+static Req* sched_pop(Handle* h) {
+  bool fair = h->fair_on.load(std::memory_order_relaxed) != 0;
+  if (!fair && h->sched.n == 0 && !h->barrier)
+    return (Req*)h->ring.try_pop();
+  if (fair && !h->barrier) {
+    for (;;) {
+      Req* r = (Req*)h->ring.try_pop();
+      if (!r) break;
+      if (r->kind != K_VERIFY) {
+        h->barrier = r;
+        break;
+      }
+      h->sched.push(r->sched_slot >= 0 ? r->sched_slot : SCHED_BE, r,
+                    (int64_t)r->offs.size() - 1);
+    }
+  }
+  if (h->sched.n) {
+    Req* r = (Req*)h->sched.pop();
+    if (r) return r;
+  }
+  if (h->barrier) {
+    Req* c = h->barrier;
+    h->barrier = nullptr;
+    return c;
+  }
+  return (Req*)h->ring.try_pop();
+}
+
 // remove fully-finished connections (both threads exited → every
 // owed response was sent or discarded; any later post is dropped)
 static void sweep_conns(Handle* h) {
@@ -980,14 +1216,15 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
   }
   bool want_digests = h->digests_on.load(std::memory_order_relaxed);
   if (want_digests) h->last_digests.clear();
+  h->last_thr.clear();
   bool stop_drain = false;
   while (!stop_drain) {
     Req* r = h->carry;
     h->carry = nullptr;
-    if (!r) r = (Req*)h->ring.try_pop();
+    if (!r) r = sched_pop(h);
     if (!r) {
       std::unique_lock<std::mutex> lk(h->mu);
-      r = (Req*)h->ring.try_pop();
+      r = sched_pop(h);
       if (!r) {
         if (h->stop.load(std::memory_order_relaxed)) break;
         auto now = clock::now();
@@ -1027,7 +1264,7 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
     m[2] = r->ftype;
     m[3] = (int32_t)nent;
     m[4] = r->trace_len;
-    m[5] = 0;
+    m[5] = r->retry_ms;  // admission retry-after hint (0 = none)
     req_seq[n_reqs] = r->seq;
     req_t0[n_reqs] = r->t_recv;
     if (r->trace_len)
@@ -1063,6 +1300,14 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
         h->last_digests.insert(h->last_digests.end(),
                                (size_t)nent * DIG_LEN, 0);
       }
+    }
+    // token-aligned admission verdicts (cap_serve_drain_thr): zero
+    // filler for control records / pre-arming requests
+    if (r->kind == K_VERIFY && (int64_t)r->thr.size() == nent) {
+      h->last_thr.insert(h->last_thr.end(), r->thr.begin(),
+                         r->thr.end());
+    } else {
+      h->last_thr.insert(h->last_thr.end(), (size_t)nent, 0);
     }
     int64_t consumed = r->kind == K_VERIFY ? nent : 1;
     h->queued_tokens.fetch_sub(consumed, std::memory_order_relaxed);
@@ -1254,6 +1499,170 @@ void cap_serve_set_shm(void* hv, int32_t on) {
   ((Handle*)hv)->shm_on.store(on, std::memory_order_relaxed);
 }
 
+// ---------------------------------------------------------------------------
+// r20 tenant-fair scheduling + admission ABI. ALL of these symbols
+// are probed as one group by the binding (_SCHED_SYMBOLS): a stale
+// .so missing any of them degrades to FIFO + python-side admission
+// with a counted fallback — never wrong scheduling, just slower.
+// ---------------------------------------------------------------------------
+
+// Layout handshake: slot counts and the counter-block length the
+// binding must agree on before arming fair/admission natively.
+void cap_serve_layout_sched(int32_t* out) {
+  out[0] = SCHED_SLOTS;
+  out[1] = SCHED_BE;
+  out[2] = cap_tel::N_TEN;
+  out[3] = CTR_N;
+}
+
+// Arm (or disarm) DRR fair scheduling on the drain path. quantum is
+// the per-visit token credit (<= 0 keeps the current value). Safe at
+// any time; a disarm flushes the parked subqueues in DRR order first.
+void cap_serve_set_fair(void* hv, int32_t on, int64_t quantum) {
+  Handle* h = (Handle*)hv;
+  if (quantum > 0) h->sched.quantum = quantum;
+  h->fair_on.store(on, std::memory_order_relaxed);
+}
+
+// Per-slot DRR weight (slot = tenant slot 0..63, or SCHED_BE for the
+// shared best-effort slot). Weights < 1 are ignored.
+void cap_serve_set_weight(void* hv, int32_t slot, int32_t w) {
+  Handle* h = (Handle*)hv;
+  if (slot < 0 || slot >= SCHED_SLOTS || w < 1) return;
+  h->sched.weight[slot] = w;
+}
+
+// Arm (or disarm) per-tenant token-bucket admission in the readers:
+// rate tokens/sec per tenant, burst tokens of depth. Reconfiguring
+// resets every bucket (full) and every shed scale (1.0).
+void cap_serve_set_admission(void* hv, int32_t on, double rate,
+                             double burst) {
+  Handle* h = (Handle*)hv;
+  std::lock_guard<std::mutex> lk(h->adm_mu);
+  h->adm_rate = rate < 0 ? 0 : rate;
+  h->adm_burst = burst < 0 ? 0 : burst;
+  for (auto& b : h->adm) b = AdmBucket();
+  h->adm_on.store(on, std::memory_order_relaxed);
+}
+
+// Shed lever: scale one tenant slot's effective rate (slot indexes
+// the FULL tenant table, none/other included). 1.0 restores.
+void cap_serve_set_tenant_scale(void* hv, int32_t slot, double scale) {
+  Handle* h = (Handle*)hv;
+  if (slot < 0 || slot >= cap_tel::N_TEN) return;
+  std::lock_guard<std::mutex> lk(h->adm_mu);
+  h->adm[slot].scale = scale < 0 ? 0 : scale;
+}
+
+// Late admission: one bucket take for a token whose tenant was a
+// header-cache MISS at read time (the drain path calls this after
+// Python resolved the issuer — same arithmetic, same counters, so
+// the exact checked == admitted + throttled equation still holds).
+// Returns 1 = throttled (*retry_ms_out set), 0 = admitted.
+int32_t cap_serve_adm_take(void* hv, int32_t slot,
+                           int32_t* retry_ms_out) {
+  Handle* h = (Handle*)hv;
+  if (slot < 0 || slot >= cap_tel::N_TEN) slot = cap_tel::TEN_NONE;
+  double now = mono_now();
+  bool throttled = false;
+  double wait = 0.0;
+  {
+    std::lock_guard<std::mutex> lk(h->adm_mu);
+    AdmBucket& b = h->adm[slot];
+    double rate = h->adm_rate * b.scale;
+    if (!b.init) {
+      b.init = true;
+      b.level = h->adm_burst;
+      b.t_last = now;
+    } else if (now > b.t_last) {
+      b.level += (now - b.t_last) * rate;
+      if (b.level > h->adm_burst) b.level = h->adm_burst;
+      b.t_last = now;
+    }
+    if (b.level >= 1.0) {
+      b.level -= 1.0;
+    } else {
+      throttled = true;
+      wait = rate > 1e-9 ? (1.0 - b.level) / rate : 60.0;
+    }
+  }
+  h->ctr[CTR_ADM_CHECKED].fetch_add(1);
+  if (throttled) {
+    h->ctr[CTR_ADM_THROTTLED].fetch_add(1);
+    if (retry_ms_out) {
+      int64_t ms = (int64_t)(wait * 1000.0) + 1;
+      if (ms < 1) ms = 1;
+      if (ms > 60000) ms = 60000;
+      *retry_ms_out = (int32_t)ms;
+    }
+    return 1;
+  }
+  h->ctr[CTR_ADM_ADMITTED].fetch_add(1);
+  return 0;
+}
+
+// One tenant bucket's current fill level in tokens (no refill — the
+// capstat admission column's point-in-time view).
+double cap_serve_bucket_fill(void* hv, int32_t slot) {
+  Handle* h = (Handle*)hv;
+  if (slot < 0 || slot >= cap_tel::N_TEN) return 0.0;
+  std::lock_guard<std::mutex> lk(h->adm_mu);
+  return h->adm[slot].init ? h->adm[slot].level : h->adm_burst;
+}
+
+// Per-token admission verdicts of the LAST cap_serve_drain call
+// (1 = throttled: answer with pushback, never verify), token-aligned
+// with cap_serve_drain_aux. Single-consumer, like the others.
+int64_t cap_serve_drain_thr(void* hv, uint8_t* out,
+                            int64_t max_tokens) {
+  Handle* h = (Handle*)hv;
+  int64_t n = (int64_t)h->last_thr.size();
+  if (n > max_tokens) n = max_tokens;
+  if (n > 0) std::memcpy(out, h->last_thr.data(), (size_t)n);
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// DRR test probe: drives the EXACT scheduler struct the drain path
+// uses, item identity = arrival order — tests/test_admission.py pins
+// the dispatch order bit-for-bit against the python twin
+// (cap_tpu/serve/drr.py), which is what makes both chains schedule
+// identically by construction.
+// ---------------------------------------------------------------------------
+
+namespace serve_native {
+struct DrrProbe {
+  DrrSched s;
+  int64_t next_id = 0;
+};
+}  // namespace serve_native
+
+void* cap_drr_create(int64_t quantum) {
+  DrrProbe* p = new DrrProbe();
+  if (quantum > 0) p->s.quantum = quantum;
+  return p;
+}
+
+void cap_drr_set_weight(void* pv, int32_t slot, int32_t w) {
+  DrrProbe* p = (DrrProbe*)pv;
+  if (slot >= 0 && slot < SCHED_SLOTS && w >= 1)
+    p->s.weight[slot] = w;
+}
+
+void cap_drr_push(void* pv, int32_t slot, int64_t cost) {
+  DrrProbe* p = (DrrProbe*)pv;
+  p->next_id++;
+  p->s.push(slot, (void*)(uintptr_t)p->next_id, cost);
+}
+
+int64_t cap_drr_pop(void* pv) {
+  DrrProbe* p = (DrrProbe*)pv;
+  void* item = p->s.pop();
+  return item ? (int64_t)(uintptr_t)item - 1 : -1;
+}
+
+void cap_drr_destroy(void* pv) { delete (DrrProbe*)pv; }
+
 // Per-token sha256[:16] digests of the LAST cap_serve_drain call,
 // token-aligned with its tok_off ordering (zero rows = compute in
 // Python). Single-consumer, like cap_serve_drain_aux.
@@ -1333,6 +1742,15 @@ void cap_serve_destroy(void* hv) {
     Req* r = (Req*)h->ring.try_pop();
     if (!r) break;
     delete r;
+  }
+  for (int s = 0; s < SCHED_SLOTS; s++) {
+    for (auto& it : h->sched.q[s]) delete (Req*)it.first;
+    h->sched.q[s].clear();
+  }
+  h->sched.n = 0;
+  if (h->barrier) {
+    delete h->barrier;
+    h->barrier = nullptr;
   }
   if (h->carry) {
     delete h->carry;

@@ -16,9 +16,12 @@
 
 namespace cap_tel {
 
-// obs/decision.py REASON_INDEX order (11 registered reason classes).
+// obs/decision.py REASON_INDEX order (12 registered reason classes;
+// r20 inserted "throttled" — admission pushback — before "internal",
+// which stays LAST: the fold uses the final index as its
+// out-of-range bucket).
 enum {
-  N_REASON = 11,
+  N_REASON = 12,
   // obs/decision.py FAMILIES order; index 10 is "unknown" (r17 added
   // slhdsa128s/slhdsa128f before "other" — layout handshake bumped).
   N_FAM = 11,

@@ -78,8 +78,26 @@ class AdaptiveBatcher:
     def __init__(self, keyset, target_batch: int = 4096,
                  max_wait_ms: float = 2.0, max_batch: int = 32768,
                  max_queued_tokens: int = 0,
-                 dedup: Optional[bool] = None):
+                 dedup: Optional[bool] = None,
+                 fair: Optional[bool] = None,
+                 drr_quantum: int = 0):
         self._keyset = keyset
+        # Tenant-fair mode (r20): pending submissions park in
+        # per-tenant DRR subqueues (cap_tpu.serve.drr — the EXACT
+        # python twin of the native ring's scheduler) and flushes pop
+        # them in deficit-round-robin order, so a flooding issuer
+        # cannot starve quiet tenants of batch slots on the python
+        # chain either. fair=None → CAP_SERVE_FAIR=1.
+        if fair is None:
+            fair = os.environ.get("CAP_SERVE_FAIR", "0") == "1"
+        self._sched = None
+        self._carry: Optional["_Pending"] = None
+        if fair:
+            from . import drr as _drr
+
+            self._sched = _drr.DRRScheduler(
+                quantum=drr_quantum or _drr.DEFAULT_QUANTUM)
+        self.fair = self._sched is not None
         # In-flight replay dedup (ROADMAP #3): identical tokens queued
         # together verify ONCE per flush and the single verdict fans
         # out to every waiter (verify is deterministic, so duplicate
@@ -193,10 +211,67 @@ class AdaptiveBatcher:
                 self._cv.wait()
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._queue.append(p)
+            if self._sched is not None:
+                from . import drr as _drr
+
+                self._sched.push(_drr.sched_slot_for_tokens(p.tokens),
+                                 p, len(p.tokens))
+            else:
+                self._queue.append(p)
             self._queued_tokens += len(p.tokens)
             self._cv.notify_all()
         return p
+
+    def set_weight(self, slot: int, w: int) -> None:
+        """Per-tenant DRR weight (fair mode only; slot = tenant slot,
+        ``drr.SCHED_BE`` for the best-effort slot)."""
+        if self._sched is not None:
+            with self._lock:
+                self._sched.set_weight(slot, w)
+
+    # -- fair-mode pending accessors (called under self._lock) ------------
+
+    def _have_pending(self) -> bool:
+        if self._sched is not None:
+            return self._carry is not None or self._sched.n > 0
+        return bool(self._queue)
+
+    def _oldest_ts(self) -> float:
+        if self._sched is None:
+            return self._queue[0].ts
+        oldest = self._carry.ts if self._carry is not None else None
+        ts = self._sched.peek_oldest_ts(lambda p: p.ts)
+        if ts is not None and (oldest is None or ts < oldest):
+            oldest = ts
+        return oldest if oldest is not None else time.monotonic()
+
+    def _take_batch(self):
+        """Next flush's members: FIFO order, or DRR order in fair mode
+        (a popped submission that would overflow max_batch carries to
+        the next flush — same carry semantics as the native drain)."""
+        batch: List[_Pending] = []
+        n = 0
+        if self._sched is None:
+            while self._queue and n < self._max_batch:
+                nxt = self._queue[0]
+                if batch and n + len(nxt.tokens) > self._max_batch:
+                    break
+                batch.append(self._queue.pop(0))
+                n += len(nxt.tokens)
+            return batch, n
+        while n < self._max_batch:
+            p = self._carry
+            self._carry = None
+            if p is None:
+                p = self._sched.pop()
+            if p is None:
+                break
+            if batch and n + len(p.tokens) > self._max_batch:
+                self._carry = p
+                break
+            batch.append(p)
+            n += len(p.tokens)
+        return batch, n
 
     def depth(self) -> Dict[str, int]:
         """Queue-depth snapshot: tokens awaiting dispatch + batches in
@@ -232,27 +307,20 @@ class AdaptiveBatcher:
     def _run_loop(self) -> None:
         while True:
             with self._cv:
-                while not self._queue and not self._closed:
+                while not self._have_pending() and not self._closed:
                     self._cv.wait()
-                if self._closed and not self._queue:
+                if self._closed and not self._have_pending():
                     return
                 # Wait for more work up to the flush condition: the
                 # OLDEST queued submission waits at most max_wait.
                 while (self._queued_tokens < self._target
                        and not self._closed):
-                    remaining = (self._queue[0].ts + self._max_wait
+                    remaining = (self._oldest_ts() + self._max_wait
                                  - time.monotonic())
                     if remaining <= 0:
                         break
                     self._cv.wait(timeout=remaining)
-                batch: List[_Pending] = []
-                n = 0
-                while self._queue and n < self._max_batch:
-                    nxt = self._queue[0]
-                    if batch and n + len(nxt.tokens) > self._max_batch:
-                        break
-                    batch.append(self._queue.pop(0))
-                    n += len(nxt.tokens)
+                batch, n = self._take_batch()
                 self._queued_tokens -= n
                 if n:
                     self._cv.notify_all()   # wake admission waiters

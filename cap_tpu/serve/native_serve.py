@@ -79,6 +79,17 @@ _SHM_SYMBOLS = ("cap_serve_set_shm", "cap_shm_create", "cap_shm_open",
                 "cap_shm_close", "cap_shm_probe", "cap_shm_write",
                 "cap_shm_read", "cap_shm_drive")
 
+# Tenant-fair scheduling + admission symbols (r20) are OPTIONAL as a
+# group: a stale .so degrades to FIFO scheduling and PYTHON-side
+# admission with a counted fallback (serve.native.sched_fallbacks) —
+# never wrong scheduling, only slower pushback.
+_SCHED_SYMBOLS = ("cap_serve_layout_sched", "cap_serve_set_fair",
+                  "cap_serve_set_weight", "cap_serve_set_admission",
+                  "cap_serve_set_tenant_scale", "cap_serve_adm_take",
+                  "cap_serve_bucket_fill", "cap_serve_drain_thr",
+                  "cap_drr_create", "cap_drr_set_weight",
+                  "cap_drr_push", "cap_drr_pop", "cap_drr_destroy")
+
 # exemplar record stride (telemetry_native.h EX_STRIDE)
 _EX_STRIDE = 88
 _KID_LEN = 12
@@ -98,6 +109,9 @@ CTR_SHM_FALLBACKS = 8
 CTR_SHM_FRAMES = 9
 CTR_SHM_STALE_GEN = 10
 CTR_SHM_DETACHES = 11
+CTR_ADM_CHECKED = 12
+CTR_ADM_ADMITTED = 13
+CTR_ADM_THROTTLED = 14
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i8p = ctypes.POINTER(ctypes.c_int8)
@@ -160,8 +174,51 @@ def load() -> ctypes.CDLL:
         lib.cap_tel_ok = _setup_tel(lib)
         lib.cap_vc_ok = _setup_vc(lib)
         lib.cap_shm_ok = _setup_shm(lib)
+        lib.cap_sched_ok = _setup_sched(lib)
         _lib = lib
         return lib
+
+
+def _setup_sched(lib: ctypes.CDLL) -> bool:
+    """Type the fair-scheduling/admission symbols and verify the slot
+    layout; False (FIFO + python admission, counted fallback) on a
+    stale .so or any layout drift."""
+    from ..obs import decision as _dec
+
+    if not all(hasattr(lib, s) for s in _SCHED_SYMBOLS):
+        return False
+    lib.cap_serve_layout_sched.argtypes = [_i32p]
+    lib.cap_serve_set_fair.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                       ctypes.c_int64]
+    lib.cap_serve_set_weight.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_int32, ctypes.c_int32]
+    lib.cap_serve_set_admission.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_double,
+        ctypes.c_double]
+    lib.cap_serve_set_tenant_scale.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+    lib.cap_serve_adm_take.restype = ctypes.c_int32
+    lib.cap_serve_adm_take.argtypes = [ctypes.c_void_p,
+                                       ctypes.c_int32, _i32p]
+    lib.cap_serve_bucket_fill.restype = ctypes.c_double
+    lib.cap_serve_bucket_fill.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int32]
+    lib.cap_serve_drain_thr.restype = ctypes.c_int64
+    lib.cap_serve_drain_thr.argtypes = [ctypes.c_void_p, _u8p,
+                                        ctypes.c_int64]
+    lib.cap_drr_create.restype = ctypes.c_void_p
+    lib.cap_drr_create.argtypes = [ctypes.c_int64]
+    lib.cap_drr_set_weight.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                       ctypes.c_int32]
+    lib.cap_drr_push.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                 ctypes.c_int64]
+    lib.cap_drr_pop.restype = ctypes.c_int64
+    lib.cap_drr_pop.argtypes = [ctypes.c_void_p]
+    lib.cap_drr_destroy.argtypes = [ctypes.c_void_p]
+    layout = np.zeros(4, np.int32)
+    lib.cap_serve_layout_sched(layout.ctypes.data_as(_i32p))
+    want = (_dec.TENANT_CAP + 1, _dec.TENANT_CAP, _dec.N_TENANT, 15)
+    return tuple(int(v) for v in layout) == want
 
 
 def _setup_shm(lib: ctypes.CDLL) -> bool:
@@ -619,7 +676,7 @@ class NativeServeChain:
                  peer_fill_fn: Optional[Callable[[dict], dict]] = None,
                  target_batch: int = 4096, max_wait_ms: float = 2.0,
                  max_batch: int = 32768, vcache=None,
-                 shm: bool = False):
+                 shm: bool = False, admission=None):
         self._lib = load()
         self._batcher = batcher
         self._stats_fn = stats_fn
@@ -674,6 +731,38 @@ class NativeServeChain:
             except Exception:  # noqa: BLE001 - fall back, visibly
                 telemetry.count("serve.native.obs_fallbacks")
                 self._plane = None
+        # Tenant-fair DRR scheduling + token-bucket admission (r20):
+        # armed NATIVELY (the C++ readers police, the drain pops DRR)
+        # when the library carries the sched group, else the counted
+        # degradation — FIFO pop order + PYTHON-side admission in
+        # _submit_segment. Either way the wire behavior (throttled
+        # rejects with retry-after pushback) is identical; only the
+        # enforcement point moves.
+        self.fair_native = False
+        self.adm_native = False
+        self._py_admission = None
+        self._shed: dict = {}               # tenant label → scale
+        if admission is not None and (admission.fair
+                                      or admission.admission_on):
+            if getattr(self._lib, "cap_sched_ok", False):
+                if admission.fair:
+                    self._lib.cap_serve_set_fair(
+                        self._h, 1, int(admission.quantum or 0))
+                    for label, w in admission.weights.items():
+                        self.set_weight(label, w)
+                    self.fair_native = True
+                if admission.admission_on:
+                    self._lib.cap_serve_set_admission(
+                        self._h, 1, float(admission.rate),
+                        float(admission.burst))
+                    self.adm_native = True
+            else:
+                telemetry.count("serve.native.sched_fallbacks")
+                if admission.admission_on:
+                    from . import admission as _adm
+
+                    self._py_admission = _adm.AdmissionController(
+                        admission.rate, admission.burst)
         self._final_counters: dict = {}     # captured at destroy
         self._stop = threading.Event()
         self._drained = threading.Event()   # ring empty after stop
@@ -706,6 +795,9 @@ class NativeServeChain:
         # truncated, computed by the native readers; all-zero rows
         # fall back to Python hashing)
         self._dig_buf = np.zeros(max_tokens * _DIG_LEN, np.uint8)
+        # admission: per-token throttle verdicts of the last drain
+        # (1 = over budget — answer with pushback, never verify)
+        self._thr_buf = np.zeros(max_tokens, np.uint8)
 
     # -- connection handoff ------------------------------------------------
 
@@ -741,6 +833,55 @@ class NativeServeChain:
         """The attached native telemetry plane (None: Python fold)."""
         return self._plane
 
+    # -- fair scheduling / admission (r20) ---------------------------------
+
+    @staticmethod
+    def _sched_slot(label: str) -> int:
+        """Tenant label → DRR slot (best-effort for none/other/"be")."""
+        if label == "be":
+            return _decision.TENANT_CAP
+        idx = _decision.tenant_index(label)
+        return idx if 0 <= idx < _decision.TENANT_CAP \
+            else _decision.TENANT_CAP
+
+    def set_weight(self, label: str, w: int) -> None:
+        """Per-tenant DRR weight (label = issuer hash, or "be" for the
+        shared best-effort slot). No-op without the sched group."""
+        if getattr(self._lib, "cap_sched_ok", False) and self._h:
+            self._lib.cap_serve_set_weight(self._h,
+                                           self._sched_slot(label),
+                                           int(w))
+
+    def set_tenant_scale(self, label: str, scale: float) -> None:
+        """Shed lever: scale one tenant's admission rate (1.0
+        restores). Reaches whichever enforcement point runs — the
+        native buckets or the python fallback controller."""
+        scale = max(0.0, float(scale))
+        if self.adm_native and self._h:
+            self._lib.cap_serve_set_tenant_scale(
+                self._h, _decision.tenant_index(label), scale)
+        if self._py_admission is not None:
+            self._py_admission.set_scale(label, scale)
+        if scale < 1.0:
+            self._shed[label] = scale
+        else:
+            self._shed.pop(label, None)
+
+    @property
+    def shed_state(self) -> dict:
+        """Currently shed tenants (label → rate scale)."""
+        return dict(self._shed)
+
+    def admission_fill(self, label: str) -> Optional[float]:
+        """One tenant bucket's current level in tokens (None when
+        admission is not natively armed)."""
+        if not self.adm_native or not self._h:
+            if self._py_admission is not None:
+                return self._py_admission.fill(label)
+            return None
+        return float(self._lib.cap_serve_bucket_fill(
+            self._h, _decision.tenant_index(label)))
+
     def counters(self) -> dict:
         h = self._h
         if not h:               # destroyed: serve the final values
@@ -765,6 +906,20 @@ class NativeServeChain:
             out["serve.shm.frames"] = int(c(h, CTR_SHM_FRAMES))
             out["serve.shm.stale_gen"] = int(c(h, CTR_SHM_STALE_GEN))
             out["serve.shm.detaches"] = int(c(h, CTR_SHM_DETACHES))
+        if getattr(self._lib, "cap_sched_ok", False):
+            # admission slots (r20, additive like shm): exposed under
+            # the EXACT names the python AdmissionController counts,
+            # so fleet merges and the obs-smoke equality gate are
+            # chain-agnostic. Zeros stay out (a python-chain worker
+            # with admission off has no such counters either).
+            for name, slot in (("admission.checked", CTR_ADM_CHECKED),
+                               ("admission.admitted",
+                                CTR_ADM_ADMITTED),
+                               ("admission.throttled",
+                                CTR_ADM_THROTTLED)):
+                v = int(c(h, slot))
+                if v:
+                    out[name] = v
         return out
 
     # -- drain loop --------------------------------------------------------
@@ -826,6 +981,11 @@ class NativeServeChain:
             if self._native_digests:
                 lib.cap_serve_drain_digests(
                     h, self._dig_buf.ctypes.data_as(_u8p),
+                    self._max_tokens)
+            if self.adm_native:
+                self._thr_buf[:] = 0
+                lib.cap_serve_drain_thr(
+                    h, self._thr_buf.ctypes.data_as(_u8p),
                     self._max_tokens)
             telemetry.gauge("serve.native.ring_depth",
                             float(self.ring_depth()))
@@ -937,60 +1097,137 @@ class NativeServeChain:
                     trace=traces[0][0] if traces else None)
                 self._post(results, meta, seqs, traces_raw, n, traces)
 
-        vc = self._vcache
-        if vc is None:
-            dig_list = None
-            if self._native_digests:
-                db = self._dig_buf[tok0 * _DIG_LEN:
-                                   (tok0 + seg_toks) * _DIG_LEN] \
-                    .tobytes()
-                dig_list = [None if (d := db[k * _DIG_LEN:
-                                             (k + 1) * _DIG_LEN])
-                            == _ZERO_DIG else d
-                            for k in range(seg_toks)]
-            self._batcher.submit_handoff(
-                tokens, traces=[t for t, _ in traces], on_done=on_done,
-                digests=dig_list)
-            return
-        # Verdict-cache consult BEFORE the batcher: reader-computed
-        # digests when the .so carries them (all-zero rows — stale
-        # carry, control filler — rehash in Python), else lookup_batch
-        # hashes itself.
-        dig_list = None
+        # Admission (r20): throttled tokens are answered with the
+        # retry-after pushback and NEVER verified — they skip the
+        # cache and the batcher entirely. The decision fold still
+        # counts them (reason "throttled", per tenant) because
+        # on_done always receives the FULL-length results.
+        verify_idx: Optional[List[int]] = None
+        thr = None
+        retry_pend: dict = {}
+        if self.adm_native:
+            tb = self._thr_buf[tok0: tok0 + seg_toks]
+            if (tb == 2).any():
+                # header-cache-miss tokens the reader could not judge:
+                # their tenants are resolved NOW (fix_misses above /
+                # the python classifier), so take from the native
+                # buckets late — same arithmetic, same counters, and
+                # no cross-tenant bleed through a shared miss bucket
+                if tens is not None:
+                    slots = [int(s) for s in tens]
+                else:
+                    slots = [_decision.tenant_index(label) for label
+                             in _decision.tenant_labels(tokens)]
+                rb = ctypes.c_int32(0)
+                for i in np.nonzero(tb == 2)[0]:
+                    i = int(i)
+                    if self._lib.cap_serve_adm_take(
+                            self._h, slots[i], ctypes.byref(rb)):
+                        tb[i] = 1
+                        retry_pend[i] = int(rb.value)
+                    else:
+                        tb[i] = 0
+            if tb.any():
+                thr = tb != 0
+        elif self._py_admission is not None:
+            labels = (_decision.tenant_labels_from_slots(tens)
+                      if tens is not None
+                      else _decision.tenant_labels(tokens))
+            mask, retry_ms0 = self._py_admission.check(labels)
+            if mask is not None:
+                thr = np.asarray(mask, bool)
+        if thr is not None and thr.any():
+            from . import admission as _adm
+
+            # per-token retry hint: the owning request's drained
+            # meta[5] (native readers) or the controller's chunk hint
+            retry_of = np.zeros(seg_toks, np.int32)
+            if self.adm_native:
+                at = 0
+                for k in range(n):
+                    cnt = int(meta[k * 6 + 3])
+                    retry_of[at: at + cnt] = int(meta[k * 6 + 5])
+                    at += cnt
+                for i, ms in retry_pend.items():
+                    retry_of[i] = ms    # late-judged miss tokens
+            else:
+                retry_of[:] = retry_ms0
+            full: List[Any] = [None] * seg_toks
+            verify_idx = []
+            for i in range(seg_toks):
+                if thr[i]:
+                    full[i] = _adm.throttled_error(int(retry_of[i]))
+                else:
+                    verify_idx.append(i)
+            base_done = on_done
+            if not verify_idx:
+                base_done(full)     # all-throttled: zero verify work
+                return
+
+            def on_done(fresh: List[Any], _full=full, _vi=verify_idx,
+                        _bd=base_done) -> None:
+                for j, i in enumerate(_vi):
+                    _full[i] = fresh[j]
+                _bd(_full)
+
+            tokens_v = [tokens[i] for i in verify_idx]
+        else:
+            tokens_v = tokens
+        # reader-computed digests when the .so carries them (all-zero
+        # rows — stale carry, control filler — rehash in Python)
+        dig_full = None
         if self._native_digests:
             db = self._dig_buf[tok0 * _DIG_LEN:
                                (tok0 + seg_toks) * _DIG_LEN].tobytes()
-            dig_list = [None if (d := db[k * _DIG_LEN:
+            dig_full = [None if (d := db[k * _DIG_LEN:
                                          (k + 1) * _DIG_LEN])
                         == _ZERO_DIG else d for k in range(seg_toks)]
-        hits, miss_idx, digs = vc.lookup_batch(tokens, digests=dig_list)
+        if verify_idx is None or dig_full is None:
+            dig_list = dig_full
+        else:
+            dig_list = [dig_full[i] for i in verify_idx]
+        vc = self._vcache
+        if vc is None:
+            self._batcher.submit_handoff(
+                tokens_v, traces=[t for t, _ in traces],
+                on_done=on_done, digests=dig_list)
+            return
+        # Verdict-cache consult BEFORE the batcher (admitted tokens
+        # only — throttled traffic must not warm or read the cache).
+        hits, miss_idx, digs = vc.lookup_batch(tokens_v,
+                                               digests=dig_list)
         # per-tenant cache accounting (the capstat ledger's hit%
         # column): reader-classified slots when the plane runs, the
         # Python classifier on the plane-less fallback arm
         if telemetry.active() is not None:
-            _decision.count_tenant_cache(
-                _decision.tenant_labels_from_slots(tens)
-                if tens is not None
-                else _decision.tenant_labels(tokens), miss_idx)
+            if tens is not None:
+                tens_v = (tens if verify_idx is None
+                          else tens[np.asarray(verify_idx,
+                                               np.intp)])
+                cache_labels = _decision.tenant_labels_from_slots(
+                    tens_v)
+            else:
+                cache_labels = _decision.tenant_labels(tokens_v)
+            _decision.count_tenant_cache(cache_labels, miss_idx)
         if not miss_idx:
             # every token answered from cache: encode + fold directly,
             # no batcher round-trip (memory-speed path)
             on_done(hits)
             return
-        if len(miss_idx) == len(tokens):
+        if len(miss_idx) == len(tokens_v):
             epoch0 = vc.epoch
 
             def on_done_fill(fresh: List[Any]) -> None:
-                vc.insert_batch(digs, fresh, tokens=tokens,
+                vc.insert_batch(digs, fresh, tokens=tokens_v,
                                 epoch=epoch0)
                 on_done(fresh)
 
             self._batcher.submit_handoff(
-                tokens, traces=[t for t, _ in traces],
+                tokens_v, traces=[t for t, _ in traces],
                 on_done=on_done_fill, digests=digs)
             return
         epoch0 = vc.epoch
-        miss_tokens = [tokens[i] for i in miss_idx]
+        miss_tokens = [tokens_v[i] for i in miss_idx]
 
         def on_done_merge(fresh: List[Any]) -> None:
             vc.insert_batch([digs[i] for i in miss_idx], fresh,

@@ -174,6 +174,43 @@ class ProtocolError(Exception):
     """Base class for CVB1 wire-format violations."""
 
 
+# ---------------------------------------------------------------------------
+# admission pushback (r20): the wire encoding is ADDITIVE on the
+# existing status-1 response entry — a throttled token's payload is
+# the ordinary "<ErrorClass>: <message>" error string whose class head
+# is ``ThrottledError`` and whose message carries a machine-parseable
+# ``retry_after_ms=<int>`` hint. Frames stay byte-identical when
+# admission is off (no throttled entries then), so every committed
+# golden vector is untouched and stale clients simply see one more
+# rejected-token error class.
+# ---------------------------------------------------------------------------
+
+_RETRY_AFTER_RE = None
+
+
+def is_throttled_payload(payload: str) -> bool:
+    """Whether a status-1 entry's error string is an admission
+    pushback (class head ``ThrottledError``) rather than a verify
+    verdict."""
+    return payload.startswith("ThrottledError")
+
+
+def retry_after_hint(payload: str) -> Optional[float]:
+    """Parse the additive ``retry_after_ms=<int>`` hint out of a
+    pushback payload → seconds, or None when absent/unparseable.
+    Never raises: a garbled hint degrades to "no hint", the same
+    stance as every other additive field."""
+    global _RETRY_AFTER_RE
+    if _RETRY_AFTER_RE is None:
+        import re
+
+        _RETRY_AFTER_RE = re.compile(r"retry_after_ms=(\d{1,9})")
+    m = _RETRY_AFTER_RE.search(payload)
+    if not m:
+        return None
+    return int(m.group(1)) / 1000.0
+
+
 class MalformedFrameError(ProtocolError):
     """Structurally invalid frame: bad magic, unknown type, nonzero
     ping/pong count, or a response status byte outside {0, 1}."""

@@ -99,7 +99,10 @@ class VerifyWorker:
                  serve_native: Optional[bool] = None,
                  vcache: Optional[bool] = None,
                  vcache_capacity: int = 0,
-                 transport: Optional[str] = None):
+                 transport: Optional[str] = None,
+                 fair: Optional[bool] = None,
+                 admit_rate: Optional[float] = None,
+                 admit_burst: Optional[float] = None):
         # Transport capability (docs/SERVE.md §Transports): "shm"
         # accepts per-connection shared-memory attach negotiations
         # (CVB1 type 15) on BOTH serve chains; "socket" (default) acks
@@ -134,11 +137,32 @@ class VerifyWorker:
         # one switch) unless CAP_SERVE_DEDUP overrides explicitly.
         if vcache is None:
             vcache = _vcache.enabled_from_env(True)
+        # Tenant-fair scheduling + admission (r20, docs/SERVE.md
+        # §Admission & fairness): DRR over per-tenant queues in both
+        # chains (native ring subqueues / the batcher's fair mode)
+        # plus per-tenant token-bucket admission with wire pushback.
+        # Knobs: args here win, else CAP_SERVE_FAIR /
+        # CAP_SERVE_ADMIT_RATE / CAP_SERVE_ADMIT_BURST /
+        # CAP_SERVE_DRR_QUANTUM / CAP_SERVE_DRR_WEIGHTS.
+        from . import admission as _admission
+
+        self._adm_cfg = _admission.AdmissionConfig(
+            fair=fair, rate=admit_rate, burst=admit_burst)
+        self._admission: Optional[_admission.AdmissionController] = None
         self._batcher = AdaptiveBatcher(
             keyset, target_batch=target_batch, max_wait_ms=max_wait_ms,
             max_batch=max_batch,
             dedup=(None if os.environ.get("CAP_SERVE_DEDUP") is not None
-                   else bool(vcache)))
+                   else bool(vcache)),
+            fair=self._adm_cfg.fair,
+            drr_quantum=self._adm_cfg.quantum)
+        if self._adm_cfg.fair and self._adm_cfg.weights:
+            from . import drr as _drr
+
+            for label, w in self._adm_cfg.weights.items():
+                slot = (_drr.SCHED_BE if label == "be"
+                        else _drr.sched_slot_for_label(label))
+                self._batcher.set_weight(slot, w)
         self._vcache: Optional[_vcache.VerdictCache] = None
         if vcache:
             self._vcache = _vcache.VerdictCache(
@@ -167,10 +191,16 @@ class VerifyWorker:
                     target_batch=target_batch,
                     max_wait_ms=max_wait_ms, max_batch=max_batch,
                     vcache=self._vcache,
-                    shm=self._shm_enabled)
+                    shm=self._shm_enabled,
+                    admission=self._adm_cfg)
             except Exception:  # noqa: BLE001 - fall back, visibly
                 telemetry.count("serve.native_fallbacks")
                 self._native = None
+        if self._native is None and self._adm_cfg.admission_on:
+            # python-chain admission (also the native-request-fell-
+            # back arm): the reader thread polices at dispatch time
+            self._admission = _admission.AdmissionController(
+                self._adm_cfg.rate, self._adm_cfg.burst)
         self._uds_path = uds_path
         if uds_path is not None:
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -264,14 +294,19 @@ class VerifyWorker:
         :mod:`cap_tpu.serve.vcache` for the clamp contract).
 
         ``op=export`` dumps a bounded slice of this worker's verdict
-        cache; ``op=import`` installs a sibling's dump into it. Raises
-        when this worker has no cache tier or the document is
-        unusable — the caller acks with the error, nothing is
-        half-applied."""
+        cache; ``op=import`` installs a sibling's dump into it;
+        ``op=admission`` (r20 — rides the same control pair, no new
+        frame type) retunes the admission plane: per-tenant shed
+        scales and/or a new rate/burst, pushed by the pool's
+        SLO-burn autoscaler. Raises when this worker cannot serve the
+        op or the document is unusable — the caller acks with the
+        error, nothing is half-applied."""
+        op = doc.get("op")
+        if op == "admission":
+            return self.apply_admission(doc)
         if self._vcache is None:
             raise TypeError("worker has no verdict-cache tier "
                             "(vcache off)")
-        op = doc.get("op")
         if op == "export":
             max_n = int(doc.get("max") or 2048)
             entries, epoch = self._vcache.export_entries(
@@ -284,6 +319,57 @@ class VerifyWorker:
             telemetry.count("worker.peer_imports")
             return {"imported": n}
         raise ValueError(f"unknown peer-fill op {op!r}")
+
+    def apply_admission(self, doc: dict) -> dict:
+        """Apply one admission-control op (``op=admission`` on the
+        CVB1 type-13/14 pair): ``scale`` maps tenant hashes to rate
+        scales (< 1.0 sheds, 1.0 restores); ``rate``/``burst`` retune
+        the buckets wholesale. Raises when this worker has no
+        admission plane armed — the pool's autoscaler treats that as
+        "nothing to tighten" and moves on."""
+        native = self._native
+        if self._admission is None and (
+                native is None or not (native.adm_native
+                                       or native._py_admission)):
+            raise TypeError("worker has no admission plane armed "
+                            "(CAP_SERVE_ADMIT_RATE unset)")
+        applied = 0
+        rate = doc.get("rate")
+        burst = doc.get("burst")
+        if rate is not None:
+            rate = float(rate)
+            burst = float(burst) if burst is not None \
+                else max(1.0, 2.0 * rate)
+            if native is not None and native.adm_native:
+                native._lib.cap_serve_set_admission(
+                    native._h, 1, rate, burst)
+            if self._admission is not None:
+                self._admission.rate = max(0.0, rate)
+                self._admission.burst = burst
+            self._adm_cfg.rate = max(0.0, rate)
+            self._adm_cfg.burst = burst
+            applied += 1
+        for label, s in (doc.get("scale") or {}).items():
+            label = str(label)
+            s = float(s)
+            if native is not None:
+                native.set_tenant_scale(label, s)
+            if self._admission is not None:
+                self._admission.set_scale(label, s)
+            telemetry.count("admission.sheds" if s < 1.0
+                            else "admission.unsheds")
+            applied += 1
+        telemetry.count("worker.admission_ops")
+        return {"applied": applied, "shed": self.shed_state()}
+
+    def shed_state(self) -> dict:
+        """Currently shed tenants (label → rate scale), whichever
+        enforcement point holds them."""
+        if self._native is not None:
+            return self._native.shed_state
+        if self._admission is not None:
+            return dict(self._admission.shed)
+        return {}
 
     def _obs_gauges(self) -> dict:
         d = self._batcher.depth()
@@ -312,6 +398,35 @@ class VerifyWorker:
             out["keyplane.epoch"] = float(epoch)
         if self._vcache is not None:
             out["vcache.size"] = float(self._vcache.size())
+        # admission & fairness state (capstat's tenant-ledger columns)
+        out["serve.fair.active"] = 1.0 if self._adm_cfg.fair else 0.0
+        adm_on = (self._admission is not None
+                  or (self._native is not None
+                      and (self._native.adm_native
+                           or self._native._py_admission is not None)))
+        out["admission.active"] = 1.0 if adm_on else 0.0
+        if adm_on:
+            out["admission.rate"] = float(self._adm_cfg.rate)
+            out["admission.burst"] = float(self._adm_cfg.burst)
+            for label, s in self.shed_state().items():
+                out[f"admission.tenant.{label}.shed_scale"] = float(s)
+            # per-tenant bucket fill + DRR weight for the capstat
+            # ledger's admission columns (bounded: the tenant table
+            # caps at 64 slots + none/other)
+            weights = self._adm_cfg.weights
+            for slot, label in sorted(
+                    _decision.TENANTS.labels().items()):
+                fill = None
+                if self._native is not None:
+                    fill = self._native.admission_fill(label)
+                elif self._admission is not None:
+                    fill = self._admission.fill(label)
+                if fill is not None:
+                    out[f"admission.tenant.{label}.fill"] = \
+                        round(float(fill), 3)
+                w = weights.get(label)
+                if w is not None:
+                    out[f"admission.tenant.{label}.weight"] = float(w)
         return out
 
     def _native_obs_snapshot(self):
@@ -535,7 +650,7 @@ class VerifyWorker:
         # one a traced response echoing its trace id — the fleet
         # router's end-to-end integrity envelope.
         if ftype == protocol.T_VERIFY_REQ_TRACE:
-            pending = self._cached_submit(entries, trace=trace)
+            pending = self._admitted_submit(entries, trace=trace)
             telemetry.trace_span(
                 trace, telemetry.SPAN_WORKER_DEQUEUE, t_recv,
                 time.time() - t_recv)
@@ -543,7 +658,7 @@ class VerifyWorker:
             return True
         crc = ftype == protocol.T_VERIFY_REQ_CRC
         respq.put(("batch_crc" if crc else "batch",
-                   self._cached_submit(entries), None))
+                   self._admitted_submit(entries), None))
         return True
 
     def _shm_attach(self, entries, respq):
@@ -639,6 +754,31 @@ class VerifyWorker:
                 os.unlink(region.path)
             except OSError:
                 pass
+
+    def _admitted_submit(self, entries, trace: Optional[str] = None):
+        """Token-bucket admission in front of the cache/batcher (the
+        python chain's enforcement point — the native chain polices in
+        its C++ readers instead). Throttled tokens get a ThrottledError
+        with the retry-after pushback hint and are NEVER verified; the
+        responder's decision fold counts them under reason
+        ``throttled`` per tenant like any other reject."""
+        adm = self._admission
+        if adm is None:
+            return self._cached_submit(entries, trace=trace)
+        mask, retry_ms = adm.check_tokens(entries)
+        if mask is None:
+            return self._cached_submit(entries, trace=trace)
+        from . import admission as _admission
+
+        hits = [(_admission.throttled_error(retry_ms) if m else None)
+                for m in mask]
+        admit_idx = [i for i, m in enumerate(mask) if not m]
+        if not admit_idx:
+            return _CachePending(list(entries), hits, (), None, None)
+        inner = self._cached_submit([entries[i] for i in admit_idx],
+                                    trace=trace)
+        return _CachePending(list(entries), hits, admit_idx, inner,
+                             None)
 
     def _cached_submit(self, entries, trace: Optional[str] = None):
         """Consult the verdict cache, then submit only the misses.

@@ -21,6 +21,8 @@ import (
 	"fmt"
 	"io"
 	"net"
+	"regexp"
+	"strconv"
 	"strings"
 	"sync"
 	"time"
@@ -63,6 +65,38 @@ type Result struct {
 type RemoteVerifyError struct{ Msg string }
 
 func (e *RemoteVerifyError) Error() string { return e.Msg }
+
+// ThrottledError is admission pushback: the worker rejected the token
+// BEFORE verification because its tenant is over budget. It is NOT a
+// verdict about token validity — callers retry after RetryAfter, they
+// must not treat it as "invalid" (and the Client never burns a retry
+// round or its fallback on it). The wire form is the ordinary
+// status-1 entry whose payload head is "ThrottledError" carrying an
+// additive "retry_after_ms=<int>" hint.
+type ThrottledError struct {
+	Msg        string
+	RetryAfter time.Duration // 0 when the hint was absent/garbled
+}
+
+func (e *ThrottledError) Error() string { return e.Msg }
+
+var retryAfterRe = regexp.MustCompile(`retry_after_ms=(\d{1,9})`)
+
+// throttledFromPayload maps a status-1 payload to its typed error:
+// *ThrottledError for admission pushback, *RemoteVerifyError for
+// every real rejection.
+func throttledFromPayload(payload string) error {
+	if !strings.HasPrefix(payload, "ThrottledError") {
+		return &RemoteVerifyError{Msg: payload}
+	}
+	e := &ThrottledError{Msg: payload}
+	if m := retryAfterRe.FindStringSubmatch(payload); m != nil {
+		if ms, err := strconv.Atoi(m[1]); err == nil {
+			e.RetryAfter = time.Duration(ms) * time.Millisecond
+		}
+	}
+	return e
+}
 
 // ErrClosed is returned after Close.
 var ErrClosed = errors.New("captpu: client closed")
@@ -369,7 +403,7 @@ func decodeResponse(r io.Reader, count int) ([]Result, error) {
 			}
 			out[i] = Result{Claims: claims}
 		} else {
-			out[i] = Result{Err: &RemoteVerifyError{Msg: string(payload)}}
+			out[i] = Result{Err: throttledFromPayload(string(payload))}
 		}
 	}
 	return out, nil

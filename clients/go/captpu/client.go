@@ -305,6 +305,35 @@ type Client struct {
 	pools  []*connPool
 	rr     uint64
 	closed int32
+
+	// admission pushback (docs/SERVE.md §Admission & fairness): a
+	// ThrottledError's retry-after hint opens a window during which
+	// the client does not hedge — duplicating a throttled batch
+	// doubles exactly the load the worker is shedding.
+	pushbackMu    sync.Mutex
+	pushbackUntil time.Time
+}
+
+// notePushback extends the pushback window from a worker hint.
+func (c *Client) notePushback(d time.Duration) {
+	if d <= 0 {
+		d = c.o.Backoff
+	}
+	if d > 30*time.Second {
+		d = 30 * time.Second
+	}
+	c.pushbackMu.Lock()
+	if t := time.Now().Add(d); t.After(c.pushbackUntil) {
+		c.pushbackUntil = t
+	}
+	c.pushbackMu.Unlock()
+}
+
+// PushbackActive reports whether a worker retry-after window is open.
+func (c *Client) PushbackActive() bool {
+	c.pushbackMu.Lock()
+	defer c.pushbackMu.Unlock()
+	return time.Now().Before(c.pushbackUntil)
 }
 
 // NewClient validates options, applies defaults, and verifies that at
@@ -446,7 +475,7 @@ func (c *Client) attempt(ctx context.Context, primary, hedge *connPool, frame []
 	launched := 1
 	go c.oneAttempt(primary, frame, want, ch)
 	var hedgeTimer <-chan time.Time
-	if hedge != nil && c.o.HedgeAfter > 0 {
+	if hedge != nil && c.o.HedgeAfter > 0 && !c.PushbackActive() {
 		hedgeTimer = time.After(c.o.HedgeAfter)
 	}
 	var lastErr error
@@ -514,7 +543,11 @@ func (c *Client) decodeVerify(rf *respFrame, want int) ([]Result, error) {
 			}
 			out[i] = Result{Claims: claims}
 		} else {
-			out[i] = Result{Err: &RemoteVerifyError{Msg: string(e.payload)}}
+			err := throttledFromPayload(string(e.payload))
+			if t, ok := err.(*ThrottledError); ok {
+				c.notePushback(t.RetryAfter)
+			}
+			out[i] = Result{Err: err}
 		}
 	}
 	return out, nil

@@ -24,14 +24,18 @@ import (
 	"time"
 )
 
+type metaResult struct {
+	Claims map[string]interface{} `json:"claims"`
+	Error  string                 `json:"error"`
+}
+
 type fullMeta struct {
-	Tokens  []string `json:"tokens"`
-	TraceID string   `json:"trace_id"`
-	ShmPath string   `json:"shm_path"`
-	Results []struct {
-		Claims map[string]interface{} `json:"claims"`
-		Error  string                 `json:"error"`
-	} `json:"results"`
+	Tokens       []string     `json:"tokens"`
+	TraceID      string       `json:"trace_id"`
+	ShmPath      string       `json:"shm_path"`
+	Results      []metaResult `json:"results"`
+	PushResults  []metaResult `json:"push_results"`
+	PushRetryMS  int          `json:"push_retry_after_ms"`
 }
 
 func loadMeta(t *testing.T) fullMeta {
@@ -198,6 +202,53 @@ func TestGoldenFrameSweepDecoders(t *testing.T) {
 	}
 	if err := json.Unmarshal(rf.entries[0].payload, &sa); err != nil || sa.Transport != "shm" {
 		t.Fatalf("shm_ack.bin: transport %q err %v", sa.Transport, err)
+	}
+}
+
+// TestPushbackGolden pins the r20 admission-pushback vector: a mixed
+// verified/throttled response must decode to a typed *ThrottledError
+// with the retry_after_ms hint parsed — on the plain AND checksummed
+// frame forms. (The hint rides the ordinary status-1 payload, so a
+// stale client sees one more RemoteVerifyError and nothing breaks.)
+func TestPushbackGolden(t *testing.T) {
+	meta := loadMeta(t)
+	if len(meta.PushResults) == 0 {
+		t.Fatal("meta.json carries no push_results (regenerate: python tools/gen_go_golden.py)")
+	}
+	for _, name := range []string{"response_push.bin", "response_push_crc.bin"} {
+		rf, err := readFrame(bufio.NewReader(bytes.NewReader(readGolden(t, name))))
+		if err != nil {
+			t.Fatalf("%s: %v", name, err)
+		}
+		if len(rf.entries) != len(meta.PushResults) {
+			t.Fatalf("%s: %d entries, want %d", name, len(rf.entries), len(meta.PushResults))
+		}
+		for i, want := range meta.PushResults {
+			e := rf.entries[i]
+			if want.Error == "" {
+				if e.status != 0 {
+					t.Fatalf("%s entry %d: unexpected reject", name, i)
+				}
+				continue
+			}
+			if e.status != 1 || string(e.payload) != want.Error {
+				t.Fatalf("%s entry %d: status %d payload %q, want %q",
+					name, i, e.status, e.payload, want.Error)
+			}
+			err := throttledFromPayload(string(e.payload))
+			te, ok := err.(*ThrottledError)
+			if !ok {
+				t.Fatalf("%s entry %d: decoded %T, want *ThrottledError", name, i, err)
+			}
+			wantDur := time.Duration(meta.PushRetryMS) * time.Millisecond
+			if te.RetryAfter != wantDur {
+				t.Fatalf("%s entry %d: RetryAfter %v, want %v", name, i, te.RetryAfter, wantDur)
+			}
+		}
+	}
+	// a non-throttled payload must stay a plain RemoteVerifyError
+	if _, ok := throttledFromPayload("InvalidSignatureError: nope").(*RemoteVerifyError); !ok {
+		t.Fatal("plain rejection decoded as ThrottledError")
 	}
 }
 

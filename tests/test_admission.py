@@ -1,0 +1,724 @@
+"""Tenant-fair scheduling + admission control (ISSUE 15 / r20).
+
+Pins, layer by layer:
+
+- the DRR scheduler twins: ``cap_tpu/serve/drr.py`` vs the native
+  ``cap_drr_*`` probe ABI — IDENTICAL dispatch order over randomized
+  multi-tenant interleaves (the cross-chain scheduling contract);
+- token-bucket admission arithmetic (burst cap, lazy refill, shed
+  scales) and the exact ``checked == admitted + throttled`` equation;
+- the ``throttled`` reason class end to end: taxonomy coverage, wire
+  round trip, retry-after hint parse;
+- the batcher's ``fair=True`` mode dispatching quiet tenants ahead of
+  a flooding backlog;
+- both serve chains throttling a flooder (and ONLY the flooder) with
+  wire pushback, counters exact, verdicts never altered;
+- the router's terminal reason-class routing: NO terminal reject —
+  ``throttled`` included — may trigger the CPU-oracle fallback, while
+  transport failure still does; pushback honor (window, counters);
+- ``WorkerPool.resize`` / shed / autoscaler state machine.
+"""
+
+import base64
+import json
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.errors import ThrottledError
+from cap_tpu.obs import decision
+from cap_tpu.serve import admission as adm
+from cap_tpu.serve import drr
+from cap_tpu.serve import protocol
+from cap_tpu.serve.batcher import AdaptiveBatcher
+from cap_tpu.serve.client import RemoteVerifyError, VerifyClient
+from cap_tpu.serve.worker import VerifyWorker
+from cap_tpu.fleet.worker_main import StubKeySet
+
+
+def _b64(obj) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(obj).encode()).rstrip(b"=").decode()
+
+
+def _token(iss: str, kid: str, sfx: str = "ok") -> str:
+    return (_b64({"alg": "ES256", "kid": kid}) + "."
+            + _b64({"iss": iss}) + "." + sfx)
+
+
+def _native_lib():
+    try:
+        from cap_tpu.serve import native_serve
+
+        lib = native_serve.load()
+        return lib if getattr(lib, "cap_sched_ok", False) else None
+    except Exception:  # noqa: BLE001 - no compiler on this host
+        return None
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    telemetry.enable()
+    telemetry.active().reset()
+    yield
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# reason class: taxonomy, wire round trip, hint parse
+# ---------------------------------------------------------------------------
+
+def test_throttled_reason_registered_and_ordered():
+    assert "throttled" in decision.REASON_CLASSES
+    # insert-before-internal discipline (internal stays the native
+    # fold's out-of-range bucket)
+    assert decision.REASON_INDEX[-1] == decision.REASON_INTERNAL
+    assert decision.REASON_INDEX[-2] == decision.REASON_THROTTLED
+    err = ThrottledError(retry_after_ms=40)
+    assert decision.classify(err) == "throttled"
+    assert decision.REASON_INDEX[decision.reason_index(err)] \
+        == "throttled"
+    # wire round trip: the worker's "<Class>: <msg>" payload seen by
+    # the router classifies identically
+    wire = RemoteVerifyError(f"{type(err).__name__}: {err}")
+    assert decision.classify(wire) == "throttled"
+
+
+def test_retry_after_hint_parse():
+    e = ThrottledError(retry_after_ms=250)
+    payload = f"ThrottledError: {e}"
+    assert protocol.is_throttled_payload(payload)
+    assert protocol.retry_after_hint(payload) == 0.25
+    assert protocol.retry_after_hint("ThrottledError: no hint") is None
+    assert protocol.retry_after_hint(
+        "InvalidSignatureError: nope") is None
+    assert not protocol.is_throttled_payload("InvalidSignatureError: x")
+
+
+# ---------------------------------------------------------------------------
+# DRR scheduler: python twin semantics + native parity
+# ---------------------------------------------------------------------------
+
+def test_drr_weights_share_tokens_proportionally():
+    s = drr.DRRScheduler(quantum=10)
+    s.set_weight(0, 3)
+    s.set_weight(1, 1)
+    for i in range(40):
+        s.push(0, ("a", i), 10)
+        s.push(1, ("b", i), 10)
+    order = []
+    while True:
+        it = s.pop()
+        if it is None:
+            break
+        order.append(it[0])
+    # first 24 pops: ~3:1 split (weight 3 earns 30 tokens per visit =
+    # 3 requests; weight 1 earns 1)
+    head = order[:24]
+    assert head.count("a") == 18 and head.count("b") == 6
+
+
+def test_drr_best_effort_slot_for_unknown_and_none():
+    s = drr.DRRScheduler()
+    s.push(-5, "x", 1)        # out of range → best-effort
+    s.push(999, "y", 1)
+    assert s.n == 2
+    assert s.pop() == "x" and s.pop() == "y"
+    assert drr.sched_slot_for_tokens([]) == drr.SCHED_BE
+    assert drr.sched_slot_for_tokens(["no-tenant"]) == drr.SCHED_BE
+    t = _token("https://drr-slot.example", "drs")
+    slot = drr.sched_slot_for_tokens([t])
+    assert 0 <= slot < decision.TENANT_CAP
+
+
+def test_drr_big_request_accumulates_deficit():
+    """A request costing more than one quantum earns credit across
+    visits instead of wedging; nothing is ever stranded."""
+    s = drr.DRRScheduler(quantum=4)
+    s.push(0, "big", 10)      # needs 3 visits of quantum 4
+    s.push(1, "small", 1)
+    out = []
+    while True:
+        it = s.pop()
+        if it is None:
+            break
+        out.append(it)
+    assert sorted(out) == ["big", "small"]
+    assert s.n == 0
+
+
+@pytest.mark.parametrize("seed", [7, 1234])
+def test_drr_dispatch_order_parity_native_vs_python(seed):
+    """THE cross-chain pin: a randomized two-tenant (+ best-effort)
+    interleave of pushes and pops through the native scheduler probe
+    and the python twin must dispatch in IDENTICAL order."""
+    lib = _native_lib()
+    if lib is None:
+        pytest.skip("native scheduler unavailable on this host")
+    rng = random.Random(seed)
+    quantum = rng.choice([8, 64, 512])
+    d = lib.cap_drr_create(quantum)
+    try:
+        py = drr.DRRScheduler(quantum=quantum)
+        wa, wb = rng.randint(1, 5), rng.randint(1, 5)
+        lib.cap_drr_set_weight(d, 2, wa)
+        py.set_weight(2, wa)
+        lib.cap_drr_set_weight(d, 9, wb)
+        py.set_weight(9, wb)
+        nid = 0
+        native_order, py_order = [], []
+        for _ in range(400):
+            if rng.random() < 0.55 or nid == 0:
+                slot = rng.choice([2, 9, drr.SCHED_BE])
+                cost = rng.randint(1, 200)
+                lib.cap_drr_push(d, slot, cost)
+                py.push(slot, nid, cost)
+                nid += 1
+            else:
+                got = lib.cap_drr_pop(d)
+                p = py.pop()
+                assert (got >= 0) == (p is not None)
+                if got >= 0:
+                    native_order.append(got)
+                    py_order.append(p)
+        while True:
+            got = lib.cap_drr_pop(d)
+            p = py.pop()
+            assert (got >= 0) == (p is not None)
+            if got < 0:
+                break
+            native_order.append(got)
+            py_order.append(p)
+        assert native_order == py_order
+        assert len(native_order) == nid
+    finally:
+        lib.cap_drr_destroy(d)
+
+
+# ---------------------------------------------------------------------------
+# token buckets
+# ---------------------------------------------------------------------------
+
+def test_bucket_burst_cap_and_exact_accounting():
+    clock = [100.0]
+    c = adm.AdmissionController(rate=1.0, burst=4,
+                                clock=lambda: clock[0])
+    mask, retry = c.check(["t1"] * 6)
+    assert mask == [False] * 4 + [True] * 2
+    assert retry >= 1
+    # another tenant is untouched
+    mask2, _ = c.check(["t2"] * 3)
+    assert mask2 is None
+    # refill: 2 seconds at rate 1 → 2 more tokens for t1
+    clock[0] += 2.0
+    mask3, _ = c.check(["t1"] * 3)
+    assert mask3 == [False, False, True]
+    ctr = telemetry.active().counters()
+    assert ctr["admission.checked"] == 12
+    assert ctr["admission.checked"] == ctr["admission.admitted"] \
+        + ctr["admission.throttled"]
+
+
+def test_bucket_shed_scale_tightens_and_restores():
+    clock = [0.0]
+    c = adm.AdmissionController(rate=10.0, burst=2,
+                                clock=lambda: clock[0])
+    c.check(["x"])            # bucket exists (level 1 of 2 left)
+    c.set_scale("x", 0.0)     # full shed: no refill at all
+    clock[0] += 100.0
+    mask, _ = c.check(["x"] * 3)
+    assert mask == [False, True, True]   # only the leftover token
+    assert c.shed == {"x": 0.0}
+    c.set_scale("x", 1.0)
+    assert c.shed == {}
+    clock[0] += 1.0           # 10 tok/s restored
+    mask, _ = c.check(["x"] * 2)
+    assert mask is None
+
+
+# ---------------------------------------------------------------------------
+# batcher fair mode
+# ---------------------------------------------------------------------------
+
+class _SlowRecordingKeySet:
+    def __init__(self, delay_s=0.05):
+        self.batches = []
+        self.delay_s = delay_s
+        self.gate = threading.Event()
+
+    def verify_batch(self, tokens):
+        self.gate.wait(5.0)
+        self.batches.append(list(tokens))
+        time.sleep(self.delay_s)
+        return [{"sub": "x"} for _ in tokens]
+
+
+def test_batcher_fair_mode_interleaves_tenants():
+    """With a flooding tenant's backlog queued ahead of one quiet
+    submission, fair mode dispatches the quiet tenant LONG before the
+    flood drains; FIFO would put it last. (Everything queues inside
+    one flush window — max_wait 300 ms — so the flush sequence IS the
+    DRR pop order.)"""
+    ks = _SlowRecordingKeySet(delay_s=0.0)
+    ks.gate.set()
+    flood_tok = _token("https://bf-flood.example", "bff")
+    quiet_tok = _token("https://bf-quiet.example", "bfq")
+    b = AdaptiveBatcher(ks, target_batch=10 ** 9, max_wait_ms=300.0,
+                        max_batch=64, max_queued_tokens=10 ** 6,
+                        fair=True, drr_quantum=64)
+    try:
+        assert b.fair
+        pends = [b.submit_nowait([flood_tok] * 64) for _ in range(8)]
+        quiet = b.submit_nowait([quiet_tok] * 8)
+        quiet.event.wait(10.0)
+        assert quiet.results is not None
+        for p in pends:
+            p.event.wait(10.0)
+        flat_order = [t for batch in ks.batches for t in batch]
+        quiet_at = flat_order.index(quiet_tok)
+        # DRR gave the quiet tenant a slot within the first couple of
+        # quanta instead of behind 512 flood tokens
+        assert quiet_at < 256, f"quiet dispatched at {quiet_at}"
+    finally:
+        b.close(deadline_s=10)
+
+
+def test_batcher_fifo_unchanged_by_default():
+    ks = _SlowRecordingKeySet(delay_s=0.0)
+    ks.gate.set()
+    b = AdaptiveBatcher(ks, target_batch=4, max_wait_ms=1.0)
+    try:
+        assert not b.fair
+        out = b.submit(["a.b.ok", "c.d.ok"])
+        assert len(out) == 2
+    finally:
+        b.close(deadline_s=10)
+
+
+# ---------------------------------------------------------------------------
+# serve chains end to end
+# ---------------------------------------------------------------------------
+
+def _drive_admission(worker):
+    host, port = worker.address
+    cl = VerifyClient(host, port)
+    try:
+        flood = _token("https://e2e-flood.example", "e2f")
+        quiet = _token("https://e2e-quiet.example", "e2q")
+        out_flood = cl.verify_batch([flood] * 12)
+        out_quiet = cl.verify_batch([quiet] * 3)
+        out_flood2 = cl.verify_batch([flood] * 4)
+        return out_flood + out_flood2, out_quiet
+    finally:
+        cl.close()
+
+
+def _check_admission_outcomes(worker, out_flood, out_quiet):
+    thr = [r for r in out_flood if isinstance(r, Exception)]
+    assert len(thr) == 8, [str(r)[:40] for r in out_flood]  # 16 - burst 8
+    for r in thr:
+        assert str(r).startswith("ThrottledError"), str(r)
+        assert protocol.retry_after_hint(str(r)) is not None
+    # admitted flood tokens verified normally (admission never
+    # alters a verdict)
+    assert sum(not isinstance(r, Exception) for r in out_flood) == 8
+    assert all(not isinstance(r, Exception) for r in out_quiet)
+    time.sleep(0.15)
+    c = worker.stats()["counters"]
+    assert c.get("admission.checked") == 19
+    assert c.get("admission.checked") == \
+        c.get("admission.admitted", 0) + c.get("admission.throttled", 0)
+    assert c.get("decision.serve.reject.throttled") == 8
+    h_flood = decision.issuer_hash("https://e2e-flood.example")
+    h_quiet = decision.issuer_hash("https://e2e-quiet.example")
+    assert c.get(
+        f"decision.serve.tenant.{h_flood}.reject.throttled") == 8
+    assert not c.get(
+        f"decision.serve.tenant.{h_quiet}.reject.throttled")
+
+
+def test_python_chain_admission_end_to_end():
+    w = VerifyWorker(StubKeySet(), obs_port=None, serve_native=False,
+                     fair=True, admit_rate=1e-4, admit_burst=8)
+    try:
+        assert w.serve_chain == "python"
+        out_flood, out_quiet = _drive_admission(w)
+        _check_admission_outcomes(w, out_flood, out_quiet)
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_native_chain_admission_end_to_end():
+    if _native_lib() is None:
+        pytest.skip("native scheduler unavailable on this host")
+    w = VerifyWorker(StubKeySet(), obs_port=None, serve_native=True,
+                     fair=True, admit_rate=1e-4, admit_burst=8)
+    try:
+        if w.serve_chain != "native":
+            pytest.skip("native chain unavailable")
+        assert w._native.fair_native and w._native.adm_native
+        out_flood, out_quiet = _drive_admission(w)
+        _check_admission_outcomes(w, out_flood, out_quiet)
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_admission_off_means_byte_identical_behavior():
+    """With admission off (the default) no throttled entry can exist
+    — frames stay exactly the pre-r20 bytes (the golden vectors pin
+    the encodings; this pins the serve path)."""
+    w = VerifyWorker(StubKeySet(), obs_port=None, serve_native=False)
+    try:
+        out_flood, out_quiet = _drive_admission(w)
+        assert all(not isinstance(r, Exception) for r in out_flood)
+        c = w.stats()["counters"]
+        assert "admission.checked" not in c
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_admission_op_shed_via_peer_fill():
+    """The pool's shed lever: op=admission on the control pair scales
+    one tenant's bucket; scale 0 starves it outright."""
+    w = VerifyWorker(StubKeySet(), obs_port=None, serve_native=False,
+                     admit_rate=1000.0, admit_burst=200.0)
+    try:
+        quiet = _token("https://shed-victim.example", "shv")
+        h = decision.issuer_hash("https://shed-victim.example")
+        host, port = w.address
+        import socket as _socket
+
+        with _socket.create_connection((host, port), timeout=5) as s:
+            protocol.send_peer_fill(
+                s, {"op": "admission", "scale": {h: 0.0}})
+            ftype, entries = protocol.FrameReader(s).recv_frame()
+        assert ftype == protocol.T_PEER_ACK and entries[0][0] == 0
+        ack = json.loads(entries[0][1])
+        assert ack["applied"] == 1 and ack["shed"] == {h: 0.0}
+        assert w.shed_state() == {h: 0.0}
+        cl = VerifyClient(host, port)
+        try:
+            out = cl.verify_batch([quiet] * 300)
+            thr = sum(1 for r in out if isinstance(r, Exception)
+                      and str(r).startswith("ThrottledError"))
+            # burst 200 drains, then the scaled-to-zero rate refills
+            # nothing: the tail throttles
+            assert thr >= 90
+        finally:
+            cl.close()
+        # restore
+        assert w.apply_admission({"scale": {h: 1.0}})["shed"] == {}
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_admission_op_requires_armed_plane():
+    w = VerifyWorker(StubKeySet(), obs_port=None, serve_native=False)
+    try:
+        with pytest.raises(TypeError):
+            w.apply_admission({"scale": {"ab": 0.5}})
+    finally:
+        w.close(deadline_s=10)
+
+
+# ---------------------------------------------------------------------------
+# router: terminal reason-class routing + pushback honor
+# ---------------------------------------------------------------------------
+
+class _RecordingFallback:
+    def __init__(self):
+        self.calls = 0
+
+    def verify_batch(self, tokens):
+        self.calls += 1
+        return [{"sub": "oracle"} for _ in tokens]
+
+
+class _RejectingKeySet:
+    """Engine that rejects every token with one fixed exception."""
+
+    def __init__(self, err):
+        self.err = err
+
+    def verify_batch(self, tokens):
+        return [self.err for _ in tokens]
+
+
+def _terminal_error_for(reason):
+    from cap_tpu import errors as E
+
+    by_reason = {
+        "malformed": E.MalformedTokenError(),
+        "not_signed": E.TokenNotSignedError(),
+        "bad_signature": E.InvalidSignatureError(),
+        "unknown_kid": E.UnknownKeyIDError(),
+        "unsupported_alg": E.UnsupportedAlgError(),
+        "expired": E.ExpiredTokenError(),
+        "invalid_claims": E.InvalidAudienceError(),
+        "jwks_error": E.InvalidJWKSError(),
+        "oidc_flow": E.InvalidFlowError(),
+        "transport": E.CapError("worker-side transport-class reject"),
+        "throttled": ThrottledError(retry_after_ms=30),
+        "internal": E.NotFoundError(),
+    }
+    return by_reason[reason]
+
+
+@pytest.mark.parametrize("reason", list(decision.REASON_INDEX))
+def test_router_terminal_reason_routing(reason):
+    """EVERY terminal reason — throttled included — is a VERDICT, not
+    a transport failure: the router returns it and must never invoke
+    the CPU-oracle fallback for it (re-verifying a throttled token on
+    the oracle would defeat admission; re-verifying any reject would
+    just re-reject)."""
+    from cap_tpu.fleet import FleetClient
+
+    err = _terminal_error_for(reason)
+    if reason == "transport":
+        # a worker-side reject whose MESSAGE classifies transport-ish
+        # still crosses as a per-token verdict
+        err = ThrottledError() if False else err
+    w = VerifyWorker(_RejectingKeySet(err), obs_port=None,
+                     serve_native=False, raw_claims=False,
+                     vcache=False)
+    fb = _RecordingFallback()
+    try:
+        cl = FleetClient([w.address], fallback=fb, rr_seed=0,
+                         attempt_timeout=5.0)
+        out = cl.verify_batch(["x.y.z"] * 2)
+        assert fb.calls == 0, \
+            f"terminal reason {reason} hit the CPU-oracle fallback"
+        for r in out:
+            assert isinstance(r, Exception)
+            want = decision.classify(err)
+            assert decision.classify(r) == want
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_router_transport_failure_still_falls_back():
+    from cap_tpu.fleet import FleetClient
+
+    fb = _RecordingFallback()
+    # no listener on this endpoint → genuine transport failure
+    cl = FleetClient([("127.0.0.1", 9)], fallback=fb, rr_seed=0,
+                     attempt_timeout=0.3, total_deadline=2.0,
+                     max_rounds=1, backoff_base=0.01)
+    out = cl.verify_batch(["x.y.ok"])
+    assert fb.calls == 1
+    assert out[0]["sub"] == "oracle"
+
+
+def test_router_pushback_window_and_counters():
+    from cap_tpu.fleet import FleetClient
+
+    w = VerifyWorker(StubKeySet(), obs_port=None, serve_native=False,
+                     admit_rate=1e-4, admit_burst=2)
+    try:
+        flood = _token("https://pb-flood.example", "pbf")
+        cl = FleetClient([w.address], fallback=_RecordingFallback(),
+                         rr_seed=0)
+        out = cl.verify_batch([flood] * 6)
+        thr = [r for r in out if isinstance(r, Exception)]
+        assert len(thr) == 4
+        st = cl.pushback_state()
+        assert st["active_s"] > 0
+        assert st["retry_after_s"] is not None
+        c = telemetry.active().counters()
+        assert c.get("fleet.throttled_tokens") == 4
+        # next routed batch waits (bounded) inside the window
+        cl.verify_batch([flood] * 1)
+        c = telemetry.active().counters()
+        assert c.get("fleet.pushback_waits", 0) >= 1
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_router_all_throttled_earns_no_breaker_credit():
+    from cap_tpu.fleet import FleetClient
+
+    results = [RemoteVerifyError(
+        "ThrottledError: tenant over admission budget "
+        "(retry_after_ms=10)")]
+    assert FleetClient._all_throttled(results)
+    assert not FleetClient._all_throttled(
+        results + [{"sub": "ok"}])
+    assert not FleetClient._all_throttled([])
+
+
+# ---------------------------------------------------------------------------
+# pool resize + autoscaler state machine
+# ---------------------------------------------------------------------------
+
+def test_pool_resize_and_shed_events():
+    from cap_tpu.fleet import WorkerPool
+
+    pool = WorkerPool(1, keyset_spec="stub", ping_interval=0.3,
+                      env_extra={"CAP_SERVE_ADMIT_RATE": "1000"})
+    try:
+        assert pool.wait_all_ready(30)
+        assert pool.size() == 1
+        pool.resize(2, reason="test")
+        assert pool.wait_all_ready(30)
+        assert pool.size() == 2 and len(pool.endpoints()) == 2
+        pool.resize(1, reason="test")
+        assert pool.size() == 1
+        # regrow reuses the retired slot
+        pool.resize(2, reason="test")
+        assert pool.wait_all_ready(30)
+        assert sorted(pool.endpoints()) == [0, 1]
+        acks = pool.shed_tenant("deadbeef0123", 0.25)
+        assert all(acks.values())
+        kinds = [e["kind"] for e in pool.resize_events()]
+        assert kinds == ["up", "down", "up", "shed"]
+        ev = pool.resize_events()[-1]
+        assert ev["tenant"] == "deadbeef0123"
+        c = telemetry.active().counters()
+        assert c.get("fleet.resize.up") == 2
+        assert c.get("fleet.resize.down") == 1
+        assert c.get("fleet.resize.shed") == 1
+        assert c.get("fleet.admission_pushes") == 1
+        with pytest.raises(Exception):
+            pool.resize(0)
+    finally:
+        pool.close()
+
+
+def test_autoscaler_state_machine():
+    from cap_tpu.fleet import PoolAutoscaler
+
+    class _FakePool:
+        def __init__(self):
+            self.n = 1
+            self.sheds = []
+
+        def size(self):
+            return self.n
+
+        def resize(self, n, reason=""):
+            self.n = n
+
+        def shed_tenant(self, t, s, reason=""):
+            self.sheds.append((t, s))
+
+        def stats_merged(self):
+            raise AssertionError("tick() was given merged explicitly")
+
+    pool = _FakePool()
+    sc = PoolAutoscaler(pool, min_workers=1, max_workers=2,
+                        high_queue_per_worker=100, sustain_ticks=2,
+                        quiet_ticks=2, interval_s=0.0)
+    hot = {"aggregate": {"queued_tokens": 1000, "counters": {},
+                         "snapshot": {}}, "workers": {}}
+    calm = {"aggregate": {"queued_tokens": 0, "counters": {},
+                          "snapshot": {}}, "workers": {}}
+    t = [0.0]
+
+    def tick(m):
+        t[0] += 1.0
+        return sc.tick(now=t[0], merged=m)
+
+    assert tick(hot) is None           # 1 hot look: not sustained
+    assert tick(hot) == "up"           # sustained → scale up
+    assert pool.n == 2
+    # at max size + a breaching tenant → shed the flooder
+    h_flood = decision.issuer_hash("https://as-flood.example")
+    burn = {"aggregate": {
+        "queued_tokens": 1000,
+        "counters": {
+            f"decision.serve.tenant.{h_flood}.tokens": 100,
+            f"decision.serve.tenant.{h_flood}.reject": 90,
+        },
+        "snapshot": {"counters": {
+            f"decision.serve.tenant.{h_flood}.tokens": 100,
+            f"decision.serve.tenant.{h_flood}.reject": 90,
+        }}}, "workers": {}}
+    assert tick(burn) is None
+    assert tick(burn) == "shed"
+    assert pool.sheds == [(h_flood, sc.shed_scale)]
+    # calm: unshed first, then scale down
+    assert tick(calm) is None
+    assert tick(calm) == "unshed"
+    assert pool.sheds[-1] == (h_flood, 1.0)
+    assert tick(calm) is None
+    assert tick(calm) == "down"
+    assert pool.n == 1
+
+
+def test_autoscaler_never_sheds_quiet_tenants():
+    from cap_tpu.fleet import PoolAutoscaler
+
+    class _FakePool:
+        def size(self):
+            return 1
+
+        def shed_tenant(self, *a, **k):
+            raise AssertionError("quiet tenant shed")
+
+        def resize(self, *a, **k):
+            raise AssertionError("no resize expected")
+
+    sc = PoolAutoscaler(_FakePool(), min_workers=1, max_workers=1,
+                        high_queue_per_worker=1, sustain_ticks=1,
+                        quiet_ticks=10 ** 9, interval_s=0.0)
+    h_quiet = decision.issuer_hash("https://as-quiet.example")
+    merged = {"aggregate": {
+        "queued_tokens": 1000,
+        "counters": {
+            f"decision.serve.tenant.{h_quiet}.tokens": 100,
+            f"decision.serve.tenant.{h_quiet}.accept": 100,
+        },
+        "snapshot": {"counters": {
+            f"decision.serve.tenant.{h_quiet}.tokens": 100,
+            f"decision.serve.tenant.{h_quiet}.accept": 100,
+        }}}, "workers": {}}
+    # pressure without any BURNING tenant: at max size, nothing sheds
+    assert sc.tick(now=1.0, merged=merged) is None
+
+
+# ---------------------------------------------------------------------------
+# capstat ledger: admission columns render
+# ---------------------------------------------------------------------------
+
+def test_capstat_ledger_admission_columns():
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools import capstat
+
+    h = decision.issuer_hash("https://ledger-adm.example")
+    merged = {
+        "counters": {
+            "tenant.lookups": 10, "tenant.attributed": 10,
+            "admission.checked": 10, "admission.admitted": 6,
+            "admission.throttled": 4,
+            f"decision.serve.tenant.{h}.tokens": 10,
+            f"decision.serve.tenant.{h}.accept": 6,
+            f"decision.serve.tenant.{h}.reject": 4,
+            f"decision.serve.tenant.{h}.reject.throttled": 4,
+            "fleet.resize.up": 1,
+        },
+        "gauges": {"fleet.pool_size": 2.0},
+        "series": {},
+    }
+    extras = {"admission.active": 1.0, "admission.rate": 100.0,
+              "admission.burst": 200.0,
+              f"admission.tenant.{h}.fill": 3.5,
+              f"admission.tenant.{h}.shed_scale": 0.25,
+              f"admission.tenant.{h}.weight": 2.0}
+    client = {"pool_size": 2, "resize_events": [
+        {"kind": "up", "from": 1, "to": 2, "reason": "queue-pressure"}]}
+    out = capstat.render_tenants(merged, client=client, extras=extras)
+    assert "admission: checked=10 admitted=6 throttled=4 [EXACT]" \
+        in out
+    assert "pool:" in out and "up=1" in out
+    assert "resize[up] 1→2" in out
+    assert h in out
+    assert "0.25" in out       # shed scale column
+    # the throttled column renders the per-tenant count
+    assert "       4" in out

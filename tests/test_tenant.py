@@ -383,7 +383,9 @@ def test_default_rules_include_tenant_templates():
     names = {r.name for r in rules}
     assert "tenant_wrong_verdicts" in names
     assert "tenant_reject_ratio" in names
-    assert sum(1 for r in rules if slo.is_tenant_template(r)) == 2
+    # r20: the admission plane's shed signal rides a third template
+    assert "tenant_throttle_ratio" in names
+    assert sum(1 for r in rules if slo.is_tenant_template(r)) == 3
 
 
 def test_tenant_rule_expansion_per_observed_tenant():

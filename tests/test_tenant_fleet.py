@@ -213,6 +213,159 @@ def test_two_tenant_flood_kill9_postmortem_and_slo(fleet):
     telemetry.disable()
 
 
+ISS_ADM_QUIET = "https://adm-chaos-quiet.example"
+ISS_ADM_FLOOD = "https://adm-chaos-flood.example"
+H_ADM_QUIET = hashlib.sha256(ISS_ADM_QUIET.encode()).hexdigest()[:12]
+H_ADM_FLOOD = hashlib.sha256(ISS_ADM_FLOOD.encode()).hexdigest()[:12]
+ADM_QUIET_TOK = _token(ISS_ADM_QUIET, "acq", "ok")
+ADM_FLOOD_TOK = _token(ISS_ADM_FLOOD, "acf", "ok")
+
+
+@pytest.fixture(params=["python", "native"])
+def adm_fleet(request):
+    """Two-worker fleet with the r20 enforcement plane armed: DRR
+    fair scheduling + per-tenant token buckets (rate sized so the
+    quiet tenant never trips while the flooder must)."""
+    native = request.param == "native"
+    pool = WorkerPool(2, keyset_spec="stub:batch_ms=10",
+                      ping_interval=0.2, max_restarts=20,
+                      max_wait_ms=1.0,
+                      env_extra={"CAP_SERVE_NATIVE":
+                                 "1" if native else "0",
+                                 "CAP_SERVE_FAIR": "1",
+                                 "CAP_SERVE_ADMIT_RATE": "300",
+                                 "CAP_SERVE_ADMIT_BURST": "150"})
+    assert pool.wait_all_ready(30), "admission fleet did not come up"
+    chains = set(pool.serve_chains().values())
+    if native and chains != {"native"}:
+        pool.close()
+        pytest.skip(f"native chain unavailable (workers ran {chains})")
+    assert native or chains == {"python"}, chains
+    yield pool
+    pool.close()
+
+
+def test_admission_flood_kill9_quiet_slo_and_resize(adm_fleet):
+    """ROADMAP #1 *Done* bar (r20 enforcement): a sustained flooding
+    tenant with kill -9 landing mid-flood cannot push the well-behaved
+    tenant past its SLO — the flooder is throttled (breaching only ITS
+    burn-rate rules), every ADMITTED verdict is right and none is
+    lost, and the pool's resize events are visible in capstat's
+    ledger AND the victim's postmortem."""
+    telemetry.enable()
+    telemetry.active().reset()
+    cl_quiet = FleetClient(adm_fleet, fallback=StubKeySet(),
+                           attempt_timeout=2.0, total_deadline=30.0,
+                           rr_seed=0)
+    cl_flood = FleetClient(adm_fleet, fallback=StubKeySet(),
+                           attempt_timeout=2.0, total_deadline=30.0,
+                           rr_seed=1)
+    stop = threading.Event()
+    flood_out = []
+    quiet_out = []
+    quiet_lat = []
+
+    def flooder():
+        while not stop.is_set():
+            out = cl_flood.verify_batch([ADM_FLOOD_TOK] * 32)
+            flood_out.extend(out)
+
+    def victim():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            out = cl_quiet.verify_batch([ADM_QUIET_TOK] * 4)
+            quiet_lat.append(time.monotonic() - t0)
+            quiet_out.append(out)
+            time.sleep(0.05)     # ~80 tok/s: inside its budget
+
+    threads = [threading.Thread(target=flooder, daemon=True)
+               for _ in range(2)]
+    threads.append(threading.Thread(target=victim, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(0.5)              # sustained flood established
+    victim_pid = adm_fleet.pid(0)
+    kill9(victim_pid)            # lands mid-flood
+    adm_fleet.resize(3, reason="chaos-pressure")   # capstat-visible
+    time.sleep(1.8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "driver thread wedged"
+
+    # zero lost submissions; zero wrong verdicts among ADMITTED
+    # tokens (every flood token is .ok — if it was admitted it MUST
+    # verify; if not it must be the typed pushback, nothing else)
+    assert quiet_out and flood_out
+    for out in quiet_out:
+        assert len(out) == 4
+        for r in out:
+            assert not isinstance(r, Exception), \
+                f"quiet tenant admitted token rejected: {r!r}"
+    throttled = 0
+    for r in flood_out:
+        if isinstance(r, Exception):
+            assert str(r).startswith("ThrottledError"), \
+                f"WRONG verdict for admitted flood token: {r!r}"
+            throttled += 1
+    assert throttled > 0, "sustained flood was never throttled"
+
+    # the victim respawns
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if adm_fleet.state(0) == "ready" \
+                and adm_fleet.pid(0) != victim_pid:
+            break
+        time.sleep(0.1)
+    assert adm_fleet.state(0) == "ready"
+
+    # fleet view: the flooder breaches ITS rules only, and the quiet
+    # tenant's serve-side p99 stays within its SLO
+    merged = _merged_worker_counters(adm_fleet)
+    counters = merged.get("counters") or {}
+    assert counters.get("admission.checked", 0) == \
+        counters.get("admission.admitted", 0) \
+        + counters.get("admission.throttled", 0)
+    assert counters.get(
+        f"decision.serve.tenant.{H_ADM_FLOOD}.reject.throttled", 0) > 0
+    assert not counters.get(
+        f"decision.serve.tenant.{H_ADM_QUIET}.reject.throttled", 0)
+    states = {}
+    for r in slo.evaluate_once(merged):
+        if r["name"].startswith(("tenant_reject_ratio[",
+                                 "tenant_throttle_ratio[")):
+            states.setdefault(r.get("tenant"), True)
+            states[r.get("tenant")] &= r["ok"]
+    assert states.get(H_ADM_FLOOD) is False, \
+        "flooding tenant breached no burn-rate rule"
+    assert states.get(H_ADM_QUIET) is True, \
+        "quiet tenant's rules are not green"
+    quiet_p99_rule = slo.parse_rules(
+        f"quiet_p99 quantile tenant.{H_ADM_QUIET}.request_s "
+        "p99 max 1.0")
+    res = slo.evaluate_once(merged, quiet_p99_rule)
+    assert res and res[0]["ok"], \
+        f"well-behaved tenant's serve p99 breached its SLO: {res}"
+
+    # resize events: capstat ledger (client snapshot path) AND the
+    # victim's postmortem carry the transition log
+    router_snap = cl_quiet.snapshot()
+    assert any(e["kind"] == "up"
+               for e in router_snap.get("resize_events") or [])
+    ledger = capstat.render_tenants(merged, client=router_snap)
+    assert "resize[up]" in ledger and "chaos-pressure" in ledger
+    assert H_ADM_FLOOD in ledger
+    doc = adm_fleet.postmortem(0)
+    assert doc is not None, "no postmortem collected after kill -9"
+    pm_events = doc.get("pool_resize_events") or []
+    assert any(e["kind"] == "up" for e in pm_events), \
+        "victim's postmortem lost the pool resize events"
+    blob = json.dumps(doc)
+    for needle in (ISS_ADM_QUIET, ISS_ADM_FLOOD, "://"):
+        assert needle not in blob, f"{needle!r} leaked into postmortem"
+    telemetry.disable()
+
+
 def test_sigterm_drain_postmortem_carries_tenant_counters(fleet):
     """Graceful path: a SIGTERM-drained worker's fresh final
     postmortem carries the per-tenant counters it folded (extends the

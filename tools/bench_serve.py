@@ -650,6 +650,304 @@ def run_frontdoor_point(n_pools: int, pool_workers: int, routing: str,
     return pt
 
 
+def _mk_tenant_tokens(iss: str, kid: str, n: int = 128):
+    """Stub-verifiable tokens for ONE tenant: a shared header (kid) +
+    payload (iss) with n distinct trailing segments, so the batcher's
+    dedup can't collapse the load while tenant attribution stays
+    per-issuer."""
+    import base64 as _b64
+    import json as _json
+
+    def b64(obj):
+        return _b64.urlsafe_b64encode(
+            _json.dumps(obj).encode()).rstrip(b"=").decode()
+
+    hdr = b64({"alg": "ES256", "kid": kid})
+    pay = b64({"iss": iss})
+    return [f"{hdr}.{pay}.s{i}.ok" for i in range(n)]
+
+
+def _tenant_driver_proc(endpoints, tokens, req_tokens, start_at,
+                        seconds, target_vps, outq):
+    """One closed-loop per-tenant driver PROCESS: hammers its tenant's
+    token pool, optionally rate-limited to target_vps (the flooding
+    driver runs unbounded / at the configured flood rate), and splits
+    its outcomes accepted / throttled / rejected so the fairness A/B
+    can report the per-tenant vps + p99 view."""
+    import time as _t
+
+    from cap_tpu.fleet import FleetClient
+
+    cl = FleetClient(endpoints, attempt_timeout=30.0,
+                     total_deadline=120.0)
+    lats = []
+    ok = thr = rej = 0
+    i = 0
+    # warmup exclusion (CAP_SERVE_WARMUP_S): latencies sampled only
+    # after the cold-start transient (first flushes, bucket prefill)
+    # — the steady-state p99 is what the fairness bar describes; the
+    # same window applies to every arm, flood and baseline alike
+    warmup = float(os.environ.get("CAP_SERVE_WARMUP_S", "0"))
+    while _t.time() < start_at:
+        _t.sleep(0.005)
+    t_start = _t.time()
+    measure_from = t_start + warmup
+    deadline = t_start + seconds
+    sent = 0
+    err = None
+    try:
+        while _t.time() < deadline:
+            if target_vps and sent > (_t.time() - t_start) * target_vps:
+                _t.sleep(0.002)
+                continue
+            batch = [tokens[(i + j) % len(tokens)]
+                     for j in range(req_tokens)]
+            i += req_tokens
+            in_window = _t.time() >= measure_from
+            t0 = _t.perf_counter()
+            out = cl.verify_batch(batch)
+            if in_window:
+                lats.append(_t.perf_counter() - t0)
+            sent += len(batch)
+            for r in out:
+                if isinstance(r, Exception):
+                    if str(r).startswith("ThrottledError"):
+                        thr += 1
+                    else:
+                        rej += 1
+                else:
+                    ok += 1
+    except BaseException as e:  # noqa: BLE001 - reported to the parent
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        outq.put((ok, thr, rej, lats, err))
+
+
+def run_fairness_point(arm: str, flood_vps: float, keyset_spec: str,
+                       n_workers: int, n_victims: int,
+                       req_tokens: int, seconds: float,
+                       max_wait_ms: float, target_batch: int,
+                       with_flood: bool = True) -> dict:
+    """One fairness arm: a fleet with (fair) or without (fifo) the
+    enforcement plane, a flooding tenant driver next to well-behaved
+    drivers, per-tenant vps/p99 split from the drivers AND the exact
+    merged worker counters. with_flood=False is the no-flood baseline
+    the inflation ratios are computed against."""
+    import multiprocessing as mp
+
+    from cap_tpu.fleet import WorkerPool
+    from cap_tpu.obs import decision as obs_decision
+
+    env_extra = {"CAP_SERVE_VCACHE": "0"}   # honest scheduling A/B
+    if arm == "fair":
+        env_extra["CAP_SERVE_FAIR"] = "1"
+        env_extra["CAP_SERVE_ADMIT_RATE"] = os.environ.get(
+            "CAP_SERVE_FAIR_RATE", "2000")
+        burst = os.environ.get("CAP_SERVE_FAIR_BURST")
+        if burst:
+            env_extra["CAP_SERVE_ADMIT_BURST"] = burst
+    autoscale = {"min_workers": n_workers,
+                 "max_workers": n_workers + 1,
+                 "high_queue_per_worker": float(os.environ.get(
+                     "CAP_SERVE_SCALE_WATERMARK", "2048")),
+                 "sustain_ticks": 2, "quiet_ticks": 1000,
+                 "interval_s": 1.0}
+    pool = WorkerPool(n_workers, keyset_spec=keyset_spec,
+                      target_batch=target_batch,
+                      max_wait_ms=max_wait_ms, ping_interval=0.5,
+                      env_extra=env_extra,
+                      autoscale=autoscale if arm == "fair" else None)
+    try:
+        if not pool.wait_all_ready(120.0):
+            raise RuntimeError("fairness fleet did not come up")
+        endpoints = sorted(pool.endpoints().values())
+        quiet_toks = _mk_tenant_tokens(
+            "https://tenant-wellbehaved.example", "kw")
+        flood_toks = _mk_tenant_tokens(
+            "https://tenant-flooding.example", "kf")
+        # victim offered load is PINNED (CAP_SERVE_VICTIM_VPS per
+        # driver, 0 = closed loop) so both arms and the no-flood
+        # baseline see the identical well-behaved demand — that is
+        # what makes the p99 inflation ratios comparable.
+        victim_vps = float(os.environ.get("CAP_SERVE_VICTIM_VPS",
+                                          "0"))
+        n_flooders = int(os.environ.get("CAP_SERVE_FLOOD_CLIENTS",
+                                        "1"))
+        # the flood's batch size may differ from the victims' (a
+        # flood of big frames behind small victim requests is the
+        # head-of-line shape the FIFO control arm must exhibit)
+        flood_req = int(os.environ.get("CAP_SERVE_FLOOD_REQ_TOKENS",
+                                       str(req_tokens)))
+        ctx = mp.get_context("spawn")
+        outq = ctx.Queue()
+        floodq = ctx.Queue()
+        start_at = time.time() + max(3.0,
+                                     (n_victims + n_flooders) * 0.2)
+        procs = [ctx.Process(
+            target=_tenant_driver_proc,
+            args=(endpoints, quiet_toks, req_tokens, start_at,
+                  seconds, victim_vps, outq), daemon=True)
+            for _ in range(n_victims)]
+        if with_flood:
+            for _ in range(n_flooders):
+                procs.append(ctx.Process(
+                    target=_tenant_driver_proc,
+                    args=(endpoints, flood_toks, flood_req, start_at,
+                          seconds, flood_vps / n_flooders, floodq),
+                    daemon=True))
+        for p in procs:
+            p.start()
+        v_ok = v_thr = v_rej = 0
+        v_lats = []
+        errors = []
+        for _ in range(n_victims):
+            ok, thr, rej, lats, err = outq.get(timeout=seconds + 300)
+            v_ok += ok
+            v_thr += thr
+            v_rej += rej
+            v_lats.extend(lats)
+            if err:
+                errors.append(err)
+        f_ok = f_thr = f_rej = 0
+        f_lats = []
+        if with_flood:
+            for _ in range(n_flooders):
+                ok, thr, rej, lats, err = floodq.get(
+                    timeout=seconds + 300)
+                f_ok += ok
+                f_thr += thr
+                f_rej += rej
+                f_lats.extend(lats)
+                if err:
+                    errors.append(err)
+        for p in procs:
+            p.join(timeout=30)
+        if errors:
+            raise RuntimeError(f"fairness drivers failed: {errors[:3]}")
+        merged = pool.stats_merged()
+        agg_counters = merged["aggregate"]["counters"]
+        tenants = obs_decision.tenant_totals(agg_counters,
+                                             surface="serve")
+        resize_events = pool.resize_events()
+    finally:
+        pool.close()
+    v_lats.sort()
+    f_lats.sort()
+    return {
+        "arm": arm,
+        "with_flood": with_flood,
+        "n_workers": n_workers,
+        "victims": n_victims,
+        "flood_target_vps": flood_vps if with_flood else 0,
+        "victim_vps": round(v_ok / seconds, 1),
+        "victim_p50_ms": round(_quantile(v_lats, 0.50) * 1e3, 2),
+        "victim_p99_ms": round(_quantile(v_lats, 0.99) * 1e3, 2),
+        "victim_throttled": v_thr,
+        "victim_rejected": v_rej,
+        "flood_vps": round(f_ok / seconds, 1),
+        "flood_throttled": f_thr,
+        "flood_p99_ms": round(_quantile(f_lats, 0.99) * 1e3, 2),
+        "admission": {
+            "checked": agg_counters.get("admission.checked", 0),
+            "admitted": agg_counters.get("admission.admitted", 0),
+            "throttled": agg_counters.get("admission.throttled", 0),
+            "sheds": agg_counters.get("admission.sheds", 0),
+        },
+        "resize_events": resize_events,
+        "tenants": tenants,
+    }
+
+
+def fairness_main() -> None:
+    """Fairness A/B mode (``CAP_SERVE_FLOOD=<tenant_vps>``): a
+    flooding tenant driver next to well-behaved drivers, run through
+    a FAIR fleet (DRR + admission + autoscaler) and a FIFO control
+    fleet, arms interleaved over ``CAP_SERVE_REPS``, plus one
+    no-flood baseline per arm. Headlines: ``fairness_vps`` (the
+    well-behaved tenant's verified/s under flood on the fair arm —
+    bench-trend-tracked) and ``fair_p99_ms`` next to the inflation
+    ratios the acceptance bar reads (fair ≤ 2× no-flood while fifo
+    inflates)."""
+    from cap_tpu import telemetry
+
+    telemetry.enable()
+    flood_vps = float(os.environ["CAP_SERVE_FLOOD"])
+    n_workers = int(os.environ.get("CAP_SERVE_POOL_WORKERS", 1))
+    keyset_spec = os.environ.get("CAP_SERVE_FLEET_KEYSET",
+                                 "stub:batch_ms=1,token_us=300")
+    n_victims = int(os.environ.get("CAP_SERVE_CLIENTS", 2))
+    req_tokens = int(os.environ.get("CAP_SERVE_REQ_TOKENS", 64))
+    seconds = float(os.environ.get("CAP_SERVE_SECONDS", 12))
+    max_wait_ms = float(os.environ.get("CAP_SERVE_WAITS",
+                                       "2").split(",")[0])
+    target_batch = int(os.environ.get("CAP_SERVE_TARGET_BATCH", 8192))
+    reps = int(os.environ.get("CAP_SERVE_REPS", 2))
+
+    points = []
+    baselines = {}
+    for arm in ("fair", "fifo"):
+        pt = run_fairness_point(arm, flood_vps, keyset_spec,
+                                n_workers, n_victims, req_tokens,
+                                max(4.0, seconds / 2), max_wait_ms,
+                                target_batch, with_flood=False)
+        baselines[arm] = pt
+        print(f"fairness arm={arm:<5} NO-FLOOD  "
+              f"victim_vps={pt['victim_vps']:>9.0f} "
+              f"p99={pt['victim_p99_ms']:7.1f}ms", file=sys.stderr)
+    for rep in range(reps):
+        for arm in ("fair", "fifo"):      # interleaved, same-day arms
+            pt = run_fairness_point(arm, flood_vps, keyset_spec,
+                                    n_workers, n_victims, req_tokens,
+                                    seconds, max_wait_ms,
+                                    target_batch)
+            pt["rep"] = rep
+            points.append(pt)
+            print(f"fairness arm={arm:<5} rep={rep} "
+                  f"victim_vps={pt['victim_vps']:>9.0f} "
+                  f"p99={pt['victim_p99_ms']:7.1f}ms  "
+                  f"flood_vps={pt['flood_vps']:>9.0f} "
+                  f"flood_throttled={pt['flood_throttled']}  "
+                  f"resizes={len(pt['resize_events'])}",
+                  file=sys.stderr)
+
+    def _best(arm, key="victim_vps"):
+        vals = [p[key] for p in points if p["arm"] == arm]
+        return max(vals) if vals else None
+
+    def _p99(arm):
+        vals = [p["victim_p99_ms"] for p in points if p["arm"] == arm]
+        return min(vals) if vals else None
+
+    fairness_vps = _best("fair")
+    fair_p99 = _p99("fair")
+    fifo_p99 = _p99("fifo")
+    base_fair = baselines["fair"]["victim_p99_ms"] or None
+    base_fifo = baselines["fifo"]["victim_p99_ms"] or None
+    print(json.dumps({
+        "metric": "fairness_victim_verifies_per_sec",
+        "value": fairness_vps,
+        "unit": "verifies/sec",
+        "fairness_vps": fairness_vps,
+        "fair_p99_ms": fair_p99,
+        "fifo_p99_ms": fifo_p99,
+        "noflood_fair_p99_ms": base_fair,
+        "noflood_fifo_p99_ms": base_fifo,
+        "p99_inflation_fair": (round(fair_p99 / base_fair, 3)
+                               if fair_p99 and base_fair else None),
+        "p99_inflation_fifo": (round(fifo_p99 / base_fifo, 3)
+                               if fifo_p99 and base_fifo else None),
+        "fifo_victim_vps": _best("fifo"),
+        "flood_target_vps": flood_vps,
+        "throttled_total": sum(p["admission"]["throttled"]
+                               for p in points),
+        "sheds_total": sum(p["admission"]["sheds"] for p in points),
+        "resize_events_total": sum(len(p["resize_events"])
+                                   for p in points),
+        "baselines": baselines,
+        "points": points,
+    }))
+
+
 def frontdoor_main() -> None:
     """Multi-pool front-door mode (``CAP_SERVE_POOLS=N``): N fresh
     WorkerPools ("hosts") behind FrontDoor drivers, one run per
@@ -1008,6 +1306,11 @@ def main() -> None:
     if os.environ.get("CAP_SERVE_TRANSPORTS"):
         # Transport mode: shm-vs-socket serve A/B + Go-driver loadgen.
         transport_main()
+        return
+    if os.environ.get("CAP_SERVE_FLOOD"):
+        # Fairness mode: flooding-tenant A/B (fair DRR+admission fleet
+        # vs FIFO control), per-tenant vps/p99 split + fairness_vps.
+        fairness_main()
         return
     if os.environ.get("CAP_SERVE_POOLS"):
         # Multi-pool front-door mode: the affinity-vs-rr routing A/B.

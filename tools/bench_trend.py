@@ -96,7 +96,13 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # (higher is better) — the r18 recv+copy-elimination
                  # contract (bench_stages transport column /
                  # bench_serve CAP_SERVE_TRANSPORTS mode)
-                 "shm_vps": True}
+                 "shm_vps": True,
+                 # tenant fairness: the WELL-BEHAVED tenant's
+                 # verified/s under a flooding tenant with the fair
+                 # plane on (DRR + admission; higher is better) — the
+                 # r20 enforcement contract (bench_serve
+                 # CAP_SERVE_FLOOD mode)
+                 "fairness_vps": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -393,6 +399,19 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [sm[1], (19, {"serve_native_vps": 1e6})])):
         problems.append("vanished shm_vps NOT flagged")
+    # 4e3. fairness_vps (r20): introducing must not flag; a drop and
+    #      a disappearance must
+    fv = [(19, {"serve_native_vps": 1e6}),
+          (20, {"serve_native_vps": 1e6, "fairness_vps": 5e4})]
+    if check_serve_series(fv):
+        problems.append("introducing fairness_vps flagged")
+    if not check_serve_series(
+            [fv[1], (21, {"serve_native_vps": 1e6,
+                          "fairness_vps": 3e4})]):
+        problems.append("fairness_vps regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [fv[1], (21, {"serve_native_vps": 1e6})])):
+        problems.append("vanished fairness_vps NOT flagged")
     # 4f. resident_slhdsa128s_vps (r17, BENCH series): introducing
     #     must not flag; a drop and a disappearance must
     def _pq(vals):

@@ -359,13 +359,19 @@ def render_frontdoor(snap: Dict[str, Any]) -> str:
 
 def render_tenants(merged: Dict[str, Any],
                    prev_counters: Optional[Dict[str, int]] = None,
-                   interval_s: float = 0.0, top: int = 20) -> str:
+                   interval_s: float = 0.0, top: int = 20,
+                   client: Optional[Dict[str, Any]] = None,
+                   extras: Optional[Dict[str, Any]] = None) -> str:
     """The fleet tenant ledger (``--tenants``): per-tenant verify
-    rate, reject mix, serve-side p99, vcache hit%, and per-tenant SLO
-    state, over the exact merged fleet scrape — tenants are issuer
-    HASHES (plus ``none``/``other``), raw issuers never reach a
-    scrape. Under ``--watch`` the vps column is the per-interval rate
-    (counter deltas); one-shot renders lifetime totals."""
+    rate, reject mix, serve-side p99, vcache hit%, per-tenant SLO
+    state, and — with admission armed — the enforcement columns
+    (DRR weight, bucket fill, throttled count/rate, shed state) plus
+    a pool-size/resize-event line, over the exact merged fleet scrape
+    — tenants are issuer HASHES (plus ``none``/``other``), raw
+    issuers never reach a scrape. Under ``--watch`` the vps and thr/s
+    columns are per-interval rates (counter deltas); one-shot renders
+    lifetime totals. ``client`` (the --client snapshot) supplies the
+    pool-side resize-event log when present."""
     counters = {k: int(v) for k, v in
                 (merged.get("counters") or {}).items()}
     tenants = obs_decision.tenant_totals(counters, surface="serve")
@@ -398,10 +404,57 @@ def render_tenants(merged: Dict[str, Any],
     lines = [f"tenants ({len(tenants)} observed)  lookups={look} "
              f"attributed={attr} overflow={ovf} evictions={ev} "
              f"[{state}]"]
+    gauges = {k: v for k, v in (merged.get("gauges") or {}).items()}
+    # live worker gauges (admission rate/burst, per-tenant fill /
+    # weight / shed state) arrive via the scrape's "extra" section,
+    # pre-merged by main() — min for fills, max otherwise
+    gauges.update(extras or {})
+    # admission summary: the exact checked == admitted + throttled
+    # equation, rendered EXACT/DRIFT like the tenant equation above
+    adm_checked = counters.get("admission.checked", 0)
+    adm_ok = counters.get("admission.admitted", 0)
+    adm_thr = counters.get("admission.throttled", 0)
+    adm_armed = adm_checked or gauges.get("admission.active")
+    if adm_armed:
+        astate = ("EXACT" if adm_checked == adm_ok + adm_thr else
+                  f"DRIFT({adm_checked}!={adm_ok}+{adm_thr})")
+        lines.append(
+            f"  admission: checked={adm_checked} admitted={adm_ok} "
+            f"throttled={adm_thr} [{astate}]  "
+            f"rate={gauges.get('admission.rate', '-')}/s "
+            f"burst={gauges.get('admission.burst', '-')}  "
+            f"sheds={counters.get('admission.sheds', 0)} "
+            f"unsheds={counters.get('admission.unsheds', 0)}")
+    # pool line: size/ready gauges + resize counters (pool-side, so
+    # they reach a scrape through the --client snapshot's recorder)
+    pool_bits = []
+    if client is not None and client.get("pool_size") is not None:
+        pool_bits.append(f"size={client['pool_size']}")
+    if gauges.get("fleet.pool_size") is not None:
+        pool_bits.append(f"gauge_size={int(gauges['fleet.pool_size'])}")
+    if gauges.get("fleet.workers_ready") is not None:
+        pool_bits.append(f"ready={int(gauges['fleet.workers_ready'])}")
+    for k, label in (("fleet.resize.up", "up"),
+                     ("fleet.resize.down", "down"),
+                     ("fleet.resize.shed", "shed"),
+                     ("fleet.resize.unshed", "unshed"),
+                     ("fleet.admission_pushes", "adm_pushes")):
+        if counters.get(k):
+            pool_bits.append(f"{label}={counters[k]}")
+    if pool_bits:
+        lines.append("  pool: " + "  ".join(pool_bits))
+    for e in ((client or {}).get("resize_events") or [])[-4:]:
+        lines.append(
+            f"    resize[{e.get('kind')}] {e.get('from')}→{e.get('to')}"
+            f"  reason={e.get('reason')}"
+            + (f"  tenant={e.get('tenant')}" if e.get("tenant")
+               else ""))
     rate_col = "vps" if prev_counters is not None and interval_s > 0 \
         else "tokens"
+    thr_col = "thr/s" if rate_col == "vps" else "thrtl"
     lines.append(f"  {'tenant':<14} {rate_col:>10} {'accept':>9} "
-                 f"{'reject':>9} {'p99':>10} {'vc-hit':>7} "
+                 f"{'reject':>9} {thr_col:>8} {'p99':>10} "
+                 f"{'vc-hit':>7} {'w':>3} {'fill':>7} {'shed':>5} "
                  f"{'slo':<7} reject mix")
     ordered = sorted(tenants.items(),
                      key=lambda kv: kv[1].get("tokens", 0),
@@ -415,11 +468,25 @@ def render_tenants(merged: Dict[str, Any],
             rate = f"{d / interval_s:10.1f}"
         else:
             rate = f"{toks:10d}"
+        thr_n = row.get("reject.throttled", 0)
+        if rate_col == "vps":
+            pthr = prev_counters.get(
+                f"decision.serve.tenant.{t}.reject.throttled", 0)
+            dthr = thr_n if thr_n < pthr else thr_n - pthr
+            thr_cell = f"{dthr / interval_s:8.1f}"
+        else:
+            thr_cell = f"{thr_n:8d}"
         s = summary.get(f"tenant.{t}.request_s")
         p99 = f"{s['p99'] * 1e3:8.2f}ms" if s else "       -"
         vl = row.get("vcache.lookups", 0)
         vh = row.get("vcache.hits", 0)
         vc = f"{100.0 * vh / vl:6.1f}%" if vl else "      -"
+        w = gauges.get(f"admission.tenant.{t}.weight")
+        w_cell = f"{int(w):>3}" if w is not None else "  1"
+        fill = gauges.get(f"admission.tenant.{t}.fill")
+        fill_cell = f"{fill:7.1f}" if fill is not None else "      -"
+        shed = gauges.get(f"admission.tenant.{t}.shed_scale")
+        shed_cell = f"{shed:5.2f}" if shed is not None else "    -"
         mix = "  ".join(
             f"{k.split('.', 1)[1]}={v}" for k, v in sorted(
                 row.items(), key=lambda kv: -kv[1]
@@ -428,7 +495,8 @@ def render_tenants(merged: Dict[str, Any],
         wrong = row.get("wrong_verdicts", 0)
         lines.append(
             f"  {t:<14} {rate} {row.get('accept', 0):>9} "
-            f"{row.get('reject', 0):>9} {p99} {vc} "
+            f"{row.get('reject', 0):>9} {thr_cell} {p99} {vc} "
+            f"{w_cell} {fill_cell} {shed_cell} "
             f"{slo_state.get(t, '-'):<7} "
             + (f"WRONG={wrong} " if wrong else "") + mix)
     if len(tenants) > top:
@@ -609,9 +677,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if args.tenants:
                 merged = merged_snapshot(worker_data, client)
                 now = time.monotonic()
+                extras: Dict[str, Any] = {}
+                for d in worker_data.values():
+                    for k, v in (d.get("extra") or {}).items():
+                        if not isinstance(v, (int, float)):
+                            continue
+                        if k in extras:
+                            extras[k] = (min(extras[k], v)
+                                         if k.endswith(".fill")
+                                         else max(extras[k], v))
+                        else:
+                            extras[k] = v
                 print(render_tenants(
                     merged, prev_counters=prev_counters,
-                    interval_s=now - prev_t, top=args.tenants_top))
+                    interval_s=now - prev_t, top=args.tenants_top,
+                    client=client, extras=extras))
                 if args.watch:
                     prev_counters = {
                         k: int(v) for k, v in

@@ -32,7 +32,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from cap_tpu.errors import InvalidSignatureError
+from cap_tpu.errors import InvalidSignatureError, ThrottledError
 from cap_tpu.serve import protocol
 
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -44,6 +44,18 @@ RESULTS = [
     InvalidSignatureError(
         "no known key successfully validated the token signature"),
     {"sub": "alice", "unicode": "ü†✓"},
+]
+
+# Pinned admission-pushback response vector (r20): one verified token
+# next to one THROTTLED one — the additive encoding on the ordinary
+# status-1 entry (class head "ThrottledError", machine-parseable
+# retry_after_ms hint). Its own golden file; every frame generated
+# before it stays byte-identical (the pushback wire note in
+# docs/SERVE.md §Admission & fairness).
+PUSH_RETRY_MS = 250
+PUSH_RESULTS = [
+    {"sub": "quiet"},
+    ThrottledError(retry_after_ms=PUSH_RETRY_MS),
 ]
 
 # Pinned trace id for the traced frame pair (types 9/10): 16 lowercase
@@ -522,6 +534,19 @@ def main():
     with open(os.path.join(OUT, "peer_ack.bin"), "wb") as f:
         f.write(s.buf.getvalue())
 
+    # Admission-pushback response vector (r20): the plain and
+    # checksummed forms of a mixed verified/throttled response —
+    # additive ON THE PAYLOAD of the existing status-1 entry, so
+    # every file above stays byte-identical.
+    s = _Sock()
+    protocol.send_response(s, PUSH_RESULTS)
+    with open(os.path.join(OUT, "response_push.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+    s = _Sock()
+    protocol.send_response(s, PUSH_RESULTS, crc=True)
+    with open(os.path.join(OUT, "response_push_crc.bin"), "wb") as f:
+        f.write(s.buf.getvalue())
+
     # Shared-memory transport pair (types 15/16): additive like every
     # pair before it — everything written above stays byte-identical.
     s = _Sock()
@@ -536,6 +561,12 @@ def main():
     meta = {
         "tokens": TOKENS,
         "trace_id": TRACE_ID,
+        "push_retry_after_ms": PUSH_RETRY_MS,
+        "push_results": [
+            {"claims": r} if isinstance(r, dict) else
+            {"error": f"{type(r).__name__}: {r}"}
+            for r in PUSH_RESULTS
+        ],
         "keys_epoch": KEYS_EPOCH,
         "keys_jwks": KEYS_JWKS,
         "peer_fill_doc": PEER_FILL_DOC,

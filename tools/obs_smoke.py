@@ -330,6 +330,96 @@ def run_tenant_gate(serve_chain):
     return ([f"{serve_chain}: {f}" for f in failures], tenant_counters)
 
 
+def run_admission_gate(serve_chain):
+    """The flooding-tenant ADMISSION gate (r20): a two-tenant stub
+    fleet with per-tenant token buckets armed (deterministic config —
+    rate ≈ 0, burst 8 — so refill is negligible and the counts are
+    exact). FAIL if (a) the flooder collects zero ``throttled``
+    rejects or the quiet tenant collects ANY, (b) the exact equation
+    ``admission.checked == admission.admitted + admission.throttled``
+    drifts on the merged scrape, (c) a throttled response carries no
+    parseable retry-after hint, or (d) the quiet tenant's verdicts are
+    not all accepts (admission must never alter a verdict). Returns
+    (failures, admission-counter map) so main() can pin
+    native-vs-python equality — the config is deterministic, so the
+    chains must count IDENTICALLY."""
+    import hashlib
+
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import FleetClient, WorkerPool
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from cap_tpu.serve import protocol
+    from tools import capstat
+
+    iss_quiet = "https://adm-quiet.example"
+    iss_flood = "https://adm-flood.example"
+    h_flood = hashlib.sha256(iss_flood.encode()).hexdigest()[:12]
+    h_quiet = hashlib.sha256(iss_quiet.encode()).hexdigest()[:12]
+    quiet = _tenant_token(iss_quiet, "aq", "ok")
+    flood = _tenant_token(iss_flood, "af", "ok")
+    failures = []
+    adm_counters = {}
+    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.3,
+                      serve_chain=serve_chain,
+                      env_extra={"CAP_SERVE_FAIR": "1",
+                                 "CAP_SERVE_ADMIT_RATE": "0.0001",
+                                 "CAP_SERVE_ADMIT_BURST": "8"})
+    try:
+        if not pool.wait_all_ready(30):
+            return ([f"{serve_chain}: admission fleet did not come "
+                     "up"], adm_counters)
+        telemetry.enable()
+        telemetry.active().reset()
+        cl = FleetClient(pool, fallback=StubKeySet(), rr_seed=0)
+        quiet_out = cl.verify_batch([quiet] * 6)
+        flood_out = []
+        for _ in range(4):
+            flood_out.extend(cl.verify_batch([flood] * 8))
+        thr = [r for r in flood_out if isinstance(r, Exception)
+               and str(r).startswith("ThrottledError")]
+        if not thr:
+            failures.append("flooding tenant collected zero "
+                            "throttled rejects")
+        if any(isinstance(r, Exception) for r in quiet_out):
+            failures.append("quiet tenant's verdicts were altered "
+                            "under admission")
+        if thr and protocol.retry_after_hint(str(thr[0])) is None:
+            failures.append("throttled response carries no parseable "
+                            "retry-after hint")
+        merged = telemetry.merge_snapshots(
+            [capstat.scrape(f"{host}:{port}")["snapshot"]
+             for _, (host, port) in sorted(
+                 pool.obs_endpoints().items())])
+        counters = merged.get("counters") or {}
+        checked = counters.get("admission.checked", 0)
+        admitted = counters.get("admission.admitted", 0)
+        throttled = counters.get("admission.throttled", 0)
+        if not checked or checked != admitted + throttled:
+            failures.append(
+                f"admission accounting drift: checked {checked} != "
+                f"admitted {admitted} + throttled {throttled}")
+        ft = counters.get(
+            f"decision.serve.tenant.{h_flood}.reject.throttled", 0)
+        qt = counters.get(
+            f"decision.serve.tenant.{h_quiet}.reject.throttled", 0)
+        if ft <= 0:
+            failures.append("flood tenant's throttled counter is "
+                            f"zero (got {ft})")
+        if qt:
+            failures.append(f"quiet tenant was throttled ({qt})")
+        if len(thr) != throttled:
+            failures.append(
+                f"wire/counter mismatch: {len(thr)} throttled "
+                f"responses vs counter {throttled}")
+        adm_counters = {
+            k: v for k, v in sorted(counters.items())
+            if k.startswith("admission.")
+            or k.endswith(".reject.throttled")}
+    finally:
+        pool.close()
+    return ([f"{serve_chain}: {f}" for f in failures], adm_counters)
+
+
 def run_frontdoor_gate():
     """The 2-pool front-door gate: a repeated-token burst routed by
     digest affinity must (a) show ``frontdoor.affinity_hits`` > 0 with
@@ -407,6 +497,12 @@ def main() -> int:
     ten_failures, py_tenants = run_tenant_gate("python")
     failures.extend(ten_failures)
 
+    # flooding-tenant ADMISSION gate (python chain): flooder throttled
+    # with the exact checked == admitted + throttled equation, quiet
+    # tenant untouched, retry-after hint parseable
+    adm_failures, py_adm = run_admission_gate("python")
+    failures.extend(adm_failures)
+
     # native-chain gate: same load, native serve chain + telemetry
     # plane; decision counters must be IDENTICAL to the python run
     native_ok = False
@@ -433,6 +529,12 @@ def main() -> int:
             failures.append(
                 "native/python TENANT counters diverge: "
                 f"native={nat_tenants} python={py_tenants}")
+        nat_adm_failures, nat_adm = run_admission_gate("native")
+        failures.extend(nat_adm_failures)
+        if nat_adm != py_adm:
+            failures.append(
+                "native/python ADMISSION counters diverge: "
+                f"native={nat_adm} python={py_adm}")
     else:
         print("obs-smoke NOTE: native serve runtime unavailable — "
               "native-chain gate skipped", file=sys.stderr)
@@ -448,9 +550,12 @@ def main() -> int:
     print("obs-smoke OK: python fleet scraped clean (gauges, trace "
           "reassembly, decision counters, SLO engine), two-tenant "
           "gate clean (hashed attribution, flood SLO breach, zero "
-          "raw issuers)"
+          "raw issuers), admission gate clean (flooder throttled "
+          "with exact checked==admitted+throttled, quiet tenant "
+          "untouched, retry-after parseable)"
           + (", native fleet scraped clean with counter AND tenant "
-             "parity to the python run" if native_ok else "")
+             "AND admission parity to the python run"
+             if native_ok else "")
           + ", 2-pool front door routed clean (affinity hits, exact "
             "lookup accounting, zero stale accepts)")
     return 0
