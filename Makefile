@@ -35,7 +35,8 @@ native: $(NATIVE_SO) $(CLIENT_SO) $(CLAIMS_SO)
 $(NATIVE_SO): $(NATIVE_DIR)/jose_native.cpp $(NATIVE_DIR)/serve_native.cpp \
 		$(NATIVE_DIR)/telemetry_native.cpp $(NATIVE_DIR)/telemetry_native.h \
 		$(NATIVE_DIR)/claims_validate.cpp $(NATIVE_DIR)/claims_tape.h \
-		$(NATIVE_DIR)/shm_ring.cpp $(NATIVE_DIR)/shm_ring.h
+		$(NATIVE_DIR)/shm_ring.cpp $(NATIVE_DIR)/shm_ring.h \
+		$(NATIVE_DIR)/frontdoor_native.cpp $(NATIVE_DIR)/cvb1_wire.h
 	$(CXX) $(CXXFLAGS) -o $@ $(filter %.cpp,$^)
 
 $(CLIENT_SO): $(CLIENT_DIR)/client_native.cpp
@@ -55,7 +56,10 @@ native-build:
 	   'cap_serve_probe_frame', 'cap_bench_drive', 'cap_tel_create', \
 	   'cap_tel_fold', 'cap_serve_post_results_tel', \
 	   'cap_serve_ring_hwm', 'cap_claims_layout', \
-	   'cap_claims_validate_batch')]; \
+	   'cap_claims_validate_batch', 'cap_frontdoor_create', \
+	   'cap_frontdoor_add_conn', 'cap_frontdoor_commit', \
+	   'cap_frontdoor_drain', 'cap_frontdoor_post_raw', \
+	   'cap_frontdoor_probe_route')]; \
 	  ctypes.CDLL('$(CLIENT_SO)').cap_client_connect; \
 	  print('native-build: all serve-native symbols resolve')"
 

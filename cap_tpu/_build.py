@@ -30,18 +30,20 @@ _FLAGS = ["-O3", "-fPIC", "-shared", "-pthread", "-std=c++17"]
 EXT_NAME = "_capclaims" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so")
 
 # (sources, output, needs_python_headers) — paths relative to
-# cap_tpu/. libcapruntime.so is built from FIVE translation units:
+# cap_tpu/. libcapruntime.so is built from SIX translation units:
 # jose_native.cpp (batch JOSE prep), serve_native.cpp (the GIL-free
 # serve chain), telemetry_native.cpp (the native telemetry plane),
-# claims_validate.cpp (the OIDC claims-rule engine), and shm_ring.cpp
-# (the zero-copy shared-memory transport) — one .so, so every binding
-# loads the same library.
+# claims_validate.cpp (the OIDC claims-rule engine), shm_ring.cpp
+# (the zero-copy shared-memory transport), and frontdoor_native.cpp
+# (the zero-copy relay front door) — one .so, so every binding loads
+# the same library.
 _TARGETS = [
     ((os.path.join("runtime", "native", "jose_native.cpp"),
       os.path.join("runtime", "native", "serve_native.cpp"),
       os.path.join("runtime", "native", "telemetry_native.cpp"),
       os.path.join("runtime", "native", "claims_validate.cpp"),
-      os.path.join("runtime", "native", "shm_ring.cpp")),
+      os.path.join("runtime", "native", "shm_ring.cpp"),
+      os.path.join("runtime", "native", "frontdoor_native.cpp")),
      os.path.join("runtime", "native", "libcapruntime.so"), False),
     ((os.path.join("serve", "native", "client_native.cpp"),),
      os.path.join("serve", "native", "libcapclient.so"), False),
@@ -65,12 +67,13 @@ def _build_one(sources, out: str, py_headers: bool,
     deps = srcs + [h for s in srcs
                    for h in [os.path.splitext(s)[0] + ".h"]
                    if os.path.exists(h)]
-    # telemetry_native.h and shm_ring.h are likewise cross-TU
-    # (serve_native.cpp feeds the plane and consumes the shm rings —
-    # an ABI/layout bump must rebuild every consumer)
+    # telemetry_native.h, shm_ring.h and cvb1_wire.h are likewise
+    # cross-TU (serve_native.cpp feeds the plane and consumes the shm
+    # rings; frontdoor_native.cpp shares the CVB1 parser — an
+    # ABI/layout bump must rebuild every consumer)
     deps += [h for d in src_dirs
              for name in ("claims_tape.h", "telemetry_native.h",
-                          "shm_ring.h")
+                          "shm_ring.h", "cvb1_wire.h")
              for h in [os.path.join(d, name)]
              if os.path.exists(h) and h not in deps]
     if not force and os.path.exists(out) and \

@@ -609,3 +609,439 @@ def frontdoor_from_spec(spec: str) -> FrontDoor:
     if not pools:
         raise ValueError("frontdoor spec names no pools")
     return FrontDoor(pools, routing=routing, spill_factor=spill)
+
+
+# ---------------------------------------------------------------------------
+# Native relay front door (r21): the C++ fast path wrapped around the
+# FrontDoor slow path.
+# ---------------------------------------------------------------------------
+
+def native_frontdoor_enabled() -> bool:
+    """The kill switch: ``CAP_FRONTDOOR_NATIVE=0`` forces the Python
+    router chain everywhere the native relay would be picked by
+    default (worker_main ``--frontdoor-chain auto``)."""
+    import os
+    return os.environ.get("CAP_FRONTDOOR_NATIVE", "1").lower() \
+        not in ("0", "false", "no", "off")
+
+
+# drain meta[1] reason codes → counter suffixes
+# (frontdoor_native.cpp R_* enum; pinned by the layout handshake's
+# version field rather than per-code — keep in sync)
+_SLOW_REASONS = {1: "control", 2: "dead_pool", 3: "overload",
+                 4: "upstream_fail", 5: "unrouted"}
+
+# cap_frontdoor_counter slot → exported counter suffix, in slot order
+# (native_serve.FDC_* constants)
+_FDC_NAMES = ("conns", "frames", "tokens", "proto_errors", "pongs",
+              "lookups", "hits", "relays", "relay_tokens", "splices",
+              "slow_frames", "slow_tokens", "upstream_fails",
+              "seq_held_max", "dropped_posts", "conns_closed")
+
+
+class NativeFrontDoorServer:
+    """The zero-copy relay front door: C++ per-connection readers
+    parse/validate/classify each CVB1 frame ONCE at the edge, look the
+    reader-computed digest up against a pushed-down ring snapshot, and
+    splice payload bytes straight onto the owning pool's socket —
+    responses splice back in strict per-connection seq order. Python
+    (the wrapped :class:`FrontDoor`) stays the slow path: bounded-load
+    spill, breaker re-route, keyplane fan-out and every control frame
+    drain through ``cap_frontdoor_drain`` and are answered via
+    ``cap_frontdoor_post_raw`` — the twin pattern (drr.py) keeps the
+    routing decision itself pinned bit-exact via
+    ``cap_frontdoor_probe_route``.
+
+    Surface-compatible with ``VerifyWorker(FrontDoor(...))`` — the
+    deployable gateway worker_main builds for ``--frontdoor-chain
+    native``: ``address`` / ``obs_address`` / ``key_epoch`` /
+    ``stats()`` / ``close()``.
+
+    Counting contract: the native fast path relays ONLY to a token's
+    live primary owner, so its lookups and affinity hits are EQUAL by
+    construction; the refresh thread folds their deltas into the
+    wrapped front door's counters, and every slow-path token is
+    counted by ``FrontDoor.verify_batch`` itself — the fleet-wide
+    ``frontdoor.lookups == affinity_hits + affinity_misses`` invariant
+    survives the split (obs-smoke gates it through this chain).
+
+    Known undercount: per-POOL ``tokens`` / ``affinity_hits`` arm
+    attribution only sees slow-path traffic (the native relay keeps
+    per-pool in-flight gauges, not lifetime arm counters) — the
+    fleet-level counters above are exact either way.
+    """
+
+    def __init__(self, frontdoor: FrontDoor, host: str = "127.0.0.1",
+                 port: int = 0, *, obs_port: Optional[int] = None,
+                 drain_wait_s: float = 0.1, refresh_s: float = 0.25,
+                 max_frames: int = 64):
+        import ctypes
+        import socket as _socket
+
+        import numpy as np
+
+        from ..serve import native_serve as _ns
+
+        lib = _ns.load()
+        if not getattr(lib, "cap_fd_ok", False):
+            raise ImportError(
+                "native front-door symbols unavailable (stale "
+                "libcapruntime.so — run: make native-build)")
+        if frontdoor._routing != "affinity":
+            raise ValueError(
+                "native relay requires routing='affinity' (rr is the "
+                "Python control arm)")
+        if len(frontdoor._arms) > _ns.FD_MAX_POOLS:
+            raise ValueError(
+                f"native relay supports at most {_ns.FD_MAX_POOLS} "
+                f"pools, got {len(frontdoor._arms)}")
+        self._fd = frontdoor
+        self._ns = _ns
+        self._np = np
+        self._lib = lib
+        self._ct = ctypes
+        self._u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._u64p = ctypes.POINTER(ctypes.c_uint64)
+        self._i32p = ctypes.POINTER(ctypes.c_int32)
+        self._i64p = ctypes.POINTER(ctypes.c_int64)
+        self._drain_wait_s = float(drain_wait_s)
+        self._refresh_s = float(refresh_s)
+        self._max_frames = int(max_frames)
+        self._closed = False
+        self._stop_ev = threading.Event()
+        self._ctr_lock = threading.Lock()
+        self._last_lookups = 0
+        self._last_hits = 0
+        self._ep_sig: Optional[tuple] = None
+        self._h = ctypes.c_void_p(lib.cap_frontdoor_create())
+        try:
+            self._push_config(force=True)
+            for arm in frontdoor._arms:
+                lib.cap_frontdoor_set_live(
+                    self._h, arm.pool_id, 1 if arm.live() else 0)
+            self._sock = _socket.socket(_socket.AF_INET,
+                                        _socket.SOCK_STREAM)
+            self._sock.setsockopt(_socket.SOL_SOCKET,
+                                  _socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self._sock.listen(128)
+            self._addr: Endpoint = self._sock.getsockname()
+        except Exception:
+            lib.cap_frontdoor_destroy(self._h)
+            raise
+        self._obs = None
+        if obs_port is not None:
+            from ..serve.obs import ObsServer
+
+            self._obs = ObsServer(host=host, port=obs_port,
+                                  extra=self._obs_gauges,
+                                  snapshot_extra=self._obs_snapshot)
+        self._threads = []
+        for name, fn in (("cap-tpu-fd-accept", self._accept_loop),
+                         ("cap-tpu-fd-drain", self._drain_loop),
+                         ("cap-tpu-fd-refresh", self._refresh_loop)):
+            th = threading.Thread(target=fn, daemon=True, name=name)
+            th.start()
+            self._threads.append(th)
+
+    # -- VerifyWorker-compatible surface ----------------------------------
+
+    @property
+    def address(self) -> Endpoint:
+        return self._addr
+
+    @property
+    def obs_address(self) -> Optional[Endpoint]:
+        return self._obs.address if self._obs is not None else None
+
+    @property
+    def key_epoch(self) -> Optional[int]:
+        return self._fd.key_epoch
+
+    @property
+    def serve_chain(self) -> str:
+        return "native"
+
+    @property
+    def frontdoor_chain(self) -> str:
+        return "native"
+
+    @property
+    def transport(self) -> str:
+        return "socket"
+
+    @property
+    def frontdoor(self) -> FrontDoor:
+        return self._fd
+
+    def native_counters(self) -> Dict[str, int]:
+        """Raw relay counters, exported as ``frontdoor.native.<slot>``
+        (``seq_held_max`` is a high-water mark, not a monotone count)."""
+        lib, h = self._lib, self._h
+        return {f"frontdoor.native.{name}":
+                int(lib.cap_frontdoor_counter(h, i))
+                for i, name in enumerate(_FDC_NAMES)}
+
+    def probe_route(self, digests: Sequence[bytes]) -> List[int]:
+        """The parity pin: the exact owner decision the relay fast
+        path would make per 16-byte digest (-1 = slow path)."""
+        np = self._np
+        if not digests:
+            return []
+        buf = np.frombuffer(
+            b"".join(bytes(d[:16]).ljust(16, b"\x00")
+                     for d in digests), np.uint8)
+        out = np.zeros(len(digests), np.int32)
+        self._lib.cap_frontdoor_probe_route(
+            self._h, buf.ctypes.data_as(self._u8p), len(digests),
+            out.ctypes.data_as(self._i32p))
+        return [int(x) for x in out]
+
+    def stats(self) -> dict:
+        import os as _os
+
+        rec = telemetry.active()
+        obs = self.obs_address
+        self._fold_native_counters()
+        return {
+            "pid": _os.getpid(),
+            "key_epoch": self.key_epoch,
+            "serve_chain": self.serve_chain,
+            "frontdoor_chain": self.frontdoor_chain,
+            "transport": self.transport,
+            "obs_port": obs[1] if obs is not None else None,
+            "counters": {**(rec.counters() if rec is not None else {}),
+                         **self._fd.counters(),
+                         **self.native_counters()},
+            "series": rec.summary() if rec is not None else {},
+            "snapshot": rec.snapshot() if rec is not None else {},
+            "frontdoor": self._fd.snapshot(),
+        }
+
+    def close(self, deadline_s: float = 30.0) -> None:
+        import socket as _socket
+
+        self._closed = True
+        self._stop_ev.set()
+        if self._obs is not None:
+            self._obs.close()
+        # shutdown() is what actually WAKES an accept() blocked in the
+        # accept thread (closing the fd from another thread leaves it
+        # parked until a client happens to connect — close would then
+        # burn its whole deadline in the join below).
+        try:
+            self._sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # The drain thread must be OUT of cap_frontdoor_drain before
+        # the handle dies (destroy frees it); it exits on its next
+        # empty poll once _closed is set.
+        deadline = time.monotonic() + max(1.0, deadline_s)
+        for th in self._threads:
+            th.join(timeout=max(0.1, deadline - time.monotonic()))
+        if not any(th.is_alive() for th in self._threads):
+            self._lib.cap_frontdoor_destroy(self._h)
+        # else: leak the handle rather than free it under a live
+        # drain call — close is on the exit path either way.
+        self._fd.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- config push-down -------------------------------------------------
+
+    def _push_config(self, force: bool = False) -> None:
+        """Stage ring points + per-pool endpoints and commit one
+        immutable config snapshot. The ring is static for a front
+        door's lifetime (membership is fixed at construction); the
+        endpoint lists are not — the refresh thread re-commits when a
+        pool's live endpoint set changes."""
+        fd, lib, np = self._fd, self._lib, self._np
+        sig = tuple(tuple(sorted(a.client._live_endpoints()))
+                    for a in fd._arms)
+        if not force and sig == self._ep_sig:
+            return
+        self._ep_sig = sig
+        ring = fd._ring
+        pts = np.asarray(ring._points, dtype=np.uint64)
+        owners = np.asarray(ring._owners, dtype=np.int32)
+        rc = lib.cap_frontdoor_stage_ring(
+            self._h, pts.ctypes.data_as(self._u64p),
+            owners.ctypes.data_as(self._i32p), len(pts))
+        if rc:
+            raise ValueError("ring owner id out of native range")
+        for arm, eps in zip(fd._arms, sig):
+            for ep_host, ep_port in eps:
+                # (path, 0) is the UDS convention fleet-wide; the
+                # native side takes port<0 as "host is a UDS path".
+                lib.cap_frontdoor_stage_pool(
+                    self._h, arm.pool_id, ep_host.encode(),
+                    ep_port if ep_port > 0 else -1)
+        lib.cap_frontdoor_commit(
+            self._h, len(fd._arms),
+            self._ct.c_double(fd._spill_factor))
+
+    # -- observability ----------------------------------------------------
+
+    def _obs_gauges(self) -> Dict[str, float]:
+        lib, h, ns = self._lib, self._h, self._ns
+        conns = int(lib.cap_frontdoor_counter(h, ns.FDC_CONNS)) \
+            - int(lib.cap_frontdoor_counter(h, ns.FDC_CONNS_CLOSED))
+        g = {"frontdoor.native.active": 1.0,
+             "frontdoor.native.conns_live": float(conns),
+             "frontdoor.native.seq_held_max": float(
+                 lib.cap_frontdoor_counter(h, ns.FDC_SEQ_HELD_MAX))}
+        for arm in self._fd._arms:
+            g[f"frontdoor.pool.{arm.pool_id}.relay_inflight"] = float(
+                lib.cap_frontdoor_inflight(h, arm.pool_id))
+        return g
+
+    def _obs_snapshot(self) -> Optional[dict]:
+        self._fold_native_counters()
+        return {"v": 1, "counters": self.native_counters(),
+                "gauges": {}, "series": {}}
+
+    def _fold_native_counters(self) -> None:
+        """Fold native fast-path lookup/hit deltas into the wrapped
+        front door's exact counters (relays go only to live primaries:
+        the two deltas are equal, misses stay 0 for native traffic)."""
+        lib, h, ns = self._lib, self._h, self._ns
+        with self._ctr_lock:
+            cur_l = int(lib.cap_frontdoor_counter(h, ns.FDC_LOOKUPS))
+            cur_h = int(lib.cap_frontdoor_counter(h, ns.FDC_HITS))
+            d_l, d_h = cur_l - self._last_lookups, \
+                cur_h - self._last_hits
+            self._last_lookups, self._last_hits = cur_l, cur_h
+        if d_l or d_h:
+            self._fd._count({"frontdoor.lookups": d_l,
+                             "frontdoor.affinity_hits": d_h})
+
+    # -- threads ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        import os as _os
+        import socket as _socket
+
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listen socket closed
+            telemetry.count("worker.connections")
+            try:
+                conn.setsockopt(_socket.IPPROTO_TCP,
+                                _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            fd = conn.detach()
+            if self._closed:        # raced close(): never touch the
+                _os.close(fd)       # handle once destroy may run
+                return
+            cid = int(self._lib.cap_frontdoor_add_conn(self._h, fd))
+            if cid < 0:
+                _os.close(fd)
+
+    def _refresh_loop(self) -> None:
+        fd, lib = self._fd, self._lib
+        while not self._stop_ev.wait(self._refresh_s):
+            try:
+                for arm in fd._arms:
+                    lib.cap_frontdoor_set_live(
+                        self._h, arm.pool_id, 1 if arm.live() else 0)
+                self._push_config()
+                self._fold_native_counters()
+            except Exception:  # noqa: BLE001 - keep refreshing
+                pass
+
+    def _drain_loop(self) -> None:
+        np, lib, ct = self._np, self._lib, self._ct
+        mf = self._max_frames
+        blob = np.zeros(1 << 20, np.uint8)
+        frame_off = np.zeros(mf + 1, np.int64)
+        meta = np.zeros(mf * 4, np.int32)
+        seqs = np.zeros(mf, np.int64)
+        need = np.zeros(1, np.int64)
+        while True:
+            n = int(lib.cap_frontdoor_drain(
+                self._h, ct.c_double(self._drain_wait_s),
+                blob.ctypes.data_as(self._u8p), blob.size,
+                frame_off.ctypes.data_as(self._i64p),
+                meta.ctypes.data_as(self._i32p),
+                seqs.ctypes.data_as(self._i64p), mf,
+                need.ctypes.data_as(self._i64p)))
+            if n == -1:
+                return
+            if n == -2:     # grow-and-retry; the frame is carried
+                blob = np.zeros(max(int(need[0]), blob.size * 2),
+                                np.uint8)
+                continue
+            for k in range(n):
+                raw = bytes(blob[int(frame_off[k]):
+                                 int(frame_off[k + 1])])
+                conn_id = int(meta[4 * k + 0])
+                reason = int(meta[4 * k + 1])
+                ftype = int(meta[4 * k + 2])
+                ntok = int(meta[4 * k + 3])
+                rname = _SLOW_REASONS.get(reason, f"r{reason}")
+                self._fd._count(
+                    {f"frontdoor.native.slow.{rname}": 1})
+                try:
+                    resp = self._handle_slow(raw, ftype, ntok)
+                except Exception as e:  # noqa: BLE001 - must answer
+                    resp = protocol.encode_response(
+                        [e] * max(1, ntok),
+                        crc=ftype == protocol.T_VERIFY_REQ_CRC)
+                rb = np.frombuffer(resp, np.uint8)
+                lib.cap_frontdoor_post_raw(
+                    self._h, conn_id, int(seqs[k]),
+                    rb.ctypes.data_as(self._u8p), len(resp))
+            if n == 0 and self._closed:
+                return
+
+    # -- the slow path ----------------------------------------------------
+
+    def _handle_slow(self, raw: bytes, ftype: int, ntok: int) -> bytes:
+        """One drained frame → exactly one pre-encoded response frame.
+        Every branch returns bytes (the caller's catch-all answers
+        anything that raises) — a slow-path frame is NEVER dropped."""
+        import json as _json
+
+        P = protocol
+        if ftype in (P.T_VERIFY_REQ, P.T_VERIFY_REQ_CRC,
+                     P.T_VERIFY_REQ_TRACE):
+            _ft, tokens, trace, _c = P.parse_frame_bytes(raw)
+            try:
+                with telemetry.span(telemetry.SPAN_FRONTDOOR_RELAY):
+                    results = self._fd.verify_batch(tokens)
+            except Exception as e:  # noqa: BLE001 - per-token errors
+                results = [e] * len(tokens)
+            return P.encode_response(
+                results, crc=ftype == P.T_VERIFY_REQ_CRC, trace=trace)
+        if ftype == P.T_STATS_REQ:
+            return P.encode_stats_response(self.stats())
+        if ftype == P.T_KEYS_PUSH:
+            try:
+                _ft, entries, _t, _c = P.parse_frame_bytes(raw)
+                doc = _json.loads(entries[0])
+                epoch = self._fd.swap_keys(doc["jwks"],
+                                           epoch=doc.get("epoch"))
+                return P.encode_keys_ack(epoch=epoch)
+            except Exception as e:  # noqa: BLE001 - error ack
+                return P.encode_keys_ack(
+                    error=f"{type(e).__name__}: {e}")
+        if ftype == P.T_PEER_FILL:
+            return P.encode_peer_ack(
+                error="TypeError: front-door relay keeps no verdict "
+                      "cache (peer fill targets pool workers)")
+        if ftype == P.T_SHM_ATTACH:
+            return P.encode_shm_ack(
+                error="shm transport is not offered at the front door")
+        raise protocol.MalformedFrameError(
+            f"unroutable slow-path frame type {ftype}")

@@ -274,6 +274,16 @@ def main(argv=None) -> int:
     # the ready line's serve_chain= field reports what actually runs.
     ap.add_argument("--serve-chain", default="auto",
                     choices=["auto", "native", "python"])
+    # Front-door router chain (frontdoor: keysets only): "native" runs
+    # the zero-copy relay gate (C++ readers route by digest against
+    # the pushed-down ring and splice payload bytes to the owning
+    # pool; Python keeps the slow path), "python" the classic
+    # VerifyWorker(FrontDoor) gate, "auto" native unless
+    # CAP_FRONTDOOR_NATIVE=0 — an unbuildable native gate falls back
+    # to python with frontdoor.native_fallbacks counted. The ready
+    # line's frontdoor_chain= field reports what actually runs.
+    ap.add_argument("--frontdoor-chain", default="auto",
+                    choices=["auto", "native", "python"])
     # Native telemetry plane: "auto" (on whenever the native chain and
     # telemetry are both on — CAP_SERVE_NATIVE_OBS in the environment
     # wins) or "off" (force the Python decision fold; the A/B knob
@@ -321,15 +331,40 @@ def main(argv=None) -> int:
     keyset = make_keyset(args.keyset)
     serve_native = (None if args.serve_chain == "auto"
                     else args.serve_chain == "native")
-    worker = VerifyWorker(keyset, host=args.host, port=args.port,
-                          target_batch=args.target_batch,
-                          max_wait_ms=args.max_wait_ms,
-                          max_batch=args.max_batch,
-                          obs_port=(None if args.obs_port < 0
-                                    else args.obs_port),
-                          serve_native=serve_native,
-                          transport=(None if args.transport == "auto"
-                                     else args.transport))
+    worker = None
+    fd_chain = None
+    from .frontdoor import (FrontDoor, NativeFrontDoorServer,
+                            native_frontdoor_enabled)
+
+    if isinstance(keyset, FrontDoor):
+        want_native = (args.frontdoor_chain == "native"
+                       or (args.frontdoor_chain == "auto"
+                           and native_frontdoor_enabled()))
+        fd_chain = "python"
+        if want_native:
+            try:
+                worker = NativeFrontDoorServer(
+                    keyset, host=args.host, port=args.port,
+                    obs_port=(None if args.obs_port < 0
+                              else args.obs_port))
+                fd_chain = "native"
+            except Exception as e:  # noqa: BLE001 - fall back loudly
+                if args.frontdoor_chain == "native":
+                    raise
+                keyset._count({"frontdoor.native_fallbacks": 1})
+                print(f"CAP_FRONTDOOR_FALLBACK "
+                      f"{type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+    if worker is None:
+        worker = VerifyWorker(keyset, host=args.host, port=args.port,
+                              target_batch=args.target_batch,
+                              max_wait_ms=args.max_wait_ms,
+                              max_batch=args.max_batch,
+                              obs_port=(None if args.obs_port < 0
+                                        else args.obs_port),
+                              serve_native=serve_native,
+                              transport=(None if args.transport == "auto"
+                                         else args.transport))
     pm = None
     if args.postmortem_path:
         from ..obs.postmortem import PostmortemWriter
@@ -348,7 +383,8 @@ def main(argv=None) -> int:
           + (f" obs={obs[1]}" if obs is not None else "")
           + (f" epoch={epoch}" if epoch is not None else "")
           + f" serve_chain={worker.serve_chain}"
-          + f" transport={worker.transport}",
+          + f" transport={worker.transport}"
+          + (f" frontdoor_chain={fd_chain}" if fd_chain else ""),
           flush=True)
 
     stop = threading.Event()

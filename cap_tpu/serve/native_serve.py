@@ -90,6 +90,18 @@ _SCHED_SYMBOLS = ("cap_serve_layout_sched", "cap_serve_set_fair",
                   "cap_drr_create", "cap_drr_set_weight",
                   "cap_drr_push", "cap_drr_pop", "cap_drr_destroy")
 
+# Native relay front-door symbols (frontdoor_native.cpp, r21) are
+# OPTIONAL as a group: a stale .so degrades the front-door gate to
+# the pure-Python router with a counted fallback
+# (frontdoor.native_fallbacks) — same routing decisions, just slower.
+_FD_SYMBOLS = ("cap_frontdoor_create", "cap_frontdoor_destroy",
+               "cap_frontdoor_layout", "cap_frontdoor_stage_ring",
+               "cap_frontdoor_stage_pool", "cap_frontdoor_commit",
+               "cap_frontdoor_set_live", "cap_frontdoor_add_conn",
+               "cap_frontdoor_drain", "cap_frontdoor_post_raw",
+               "cap_frontdoor_counter", "cap_frontdoor_inflight",
+               "cap_frontdoor_probe_route")
+
 # exemplar record stride (telemetry_native.h EX_STRIDE)
 _EX_STRIDE = 88
 _KID_LEN = 12
@@ -112,6 +124,33 @@ CTR_SHM_DETACHES = 11
 CTR_ADM_CHECKED = 12
 CTR_ADM_ADMITTED = 13
 CTR_ADM_THROTTLED = 14
+
+# front-door relay counter slots, mirroring frontdoor_native.cpp
+FDC_CONNS = 0
+FDC_FRAMES = 1
+FDC_TOKENS = 2
+FDC_PROTO_ERR = 3
+FDC_PONGS = 4
+FDC_LOOKUPS = 5
+FDC_HITS = 6
+FDC_RELAYS = 7
+FDC_RELAY_TOKENS = 8
+FDC_SPLICES = 9
+FDC_SLOW_FRAMES = 10
+FDC_SLOW_TOKENS = 11
+FDC_UPSTREAM_FAILS = 12
+FDC_SEQ_HELD_MAX = 13
+FDC_DROPPED_POSTS = 14
+FDC_CONNS_CLOSED = 15
+FDC_N = 16
+FD_MAX_POOLS = 64
+
+# front-door slow-path handoff reasons (drain meta[1])
+FD_R_CONTROL = 1
+FD_R_DEAD_POOL = 2
+FD_R_OVERLOAD = 3
+FD_R_UPSTREAM_FAIL = 4
+FD_R_UNROUTED = 5
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i8p = ctypes.POINTER(ctypes.c_int8)
@@ -175,6 +214,7 @@ def load() -> ctypes.CDLL:
         lib.cap_vc_ok = _setup_vc(lib)
         lib.cap_shm_ok = _setup_shm(lib)
         lib.cap_sched_ok = _setup_sched(lib)
+        lib.cap_fd_ok = _setup_fd(lib)
         _lib = lib
         return lib
 
@@ -218,6 +258,55 @@ def _setup_sched(lib: ctypes.CDLL) -> bool:
     layout = np.zeros(4, np.int32)
     lib.cap_serve_layout_sched(layout.ctypes.data_as(_i32p))
     want = (_dec.TENANT_CAP + 1, _dec.TENANT_CAP, _dec.N_TENANT, 15)
+    return tuple(int(v) for v in layout) == want
+
+
+def _setup_fd(lib: ctypes.CDLL) -> bool:
+    """Type the relay front-door symbols and verify the layout
+    handshake; False (pure-Python front door, counted fallback) on a
+    stale .so or any constant drift."""
+    if not all(hasattr(lib, s) for s in _FD_SYMBOLS):
+        return False
+    lib.cap_frontdoor_create.restype = ctypes.c_void_p
+    lib.cap_frontdoor_create.argtypes = []
+    lib.cap_frontdoor_destroy.argtypes = [ctypes.c_void_p]
+    lib.cap_frontdoor_layout.argtypes = [_i32p]
+    lib.cap_frontdoor_stage_ring.restype = ctypes.c_int32
+    lib.cap_frontdoor_stage_ring.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), _i32p,
+        ctypes.c_int64]
+    lib.cap_frontdoor_stage_pool.restype = ctypes.c_int32
+    lib.cap_frontdoor_stage_pool.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+        ctypes.c_int32]
+    lib.cap_frontdoor_commit.restype = ctypes.c_int32
+    lib.cap_frontdoor_commit.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_double]
+    lib.cap_frontdoor_set_live.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    lib.cap_frontdoor_add_conn.restype = ctypes.c_int32
+    lib.cap_frontdoor_add_conn.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_int32]
+    lib.cap_frontdoor_drain.restype = ctypes.c_int32
+    lib.cap_frontdoor_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, _u8p, ctypes.c_int64, _i64p,
+        _i32p, _i64p, ctypes.c_int32, _i64p]
+    lib.cap_frontdoor_post_raw.restype = ctypes.c_int32
+    lib.cap_frontdoor_post_raw.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64, _u8p,
+        ctypes.c_int64]
+    lib.cap_frontdoor_counter.restype = ctypes.c_int64
+    lib.cap_frontdoor_counter.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int32]
+    lib.cap_frontdoor_inflight.restype = ctypes.c_int64
+    lib.cap_frontdoor_inflight.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_int32]
+    lib.cap_frontdoor_probe_route.restype = ctypes.c_int32
+    lib.cap_frontdoor_probe_route.argtypes = [
+        ctypes.c_void_p, _u8p, ctypes.c_int32, _i32p]
+    layout = np.zeros(4, np.int32)
+    lib.cap_frontdoor_layout(layout.ctypes.data_as(_i32p))
+    want = (FD_MAX_POOLS, FDC_N, 1, _DIG_LEN)
     return tuple(int(v) for v in layout) == want
 
 

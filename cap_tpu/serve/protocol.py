@@ -311,6 +311,23 @@ def send_response(sock: socket.socket, results: Sequence[Any],
     sock.sendall(b"".join(parts))
 
 
+def encode_response(results: Sequence[Any], crc: bool = False,
+                    trace: Optional[str] = None) -> bytes:
+    """Encoded verify-response frame bytes (same family selection as
+    :func:`send_response`). The native front-door gate posts these
+    verbatim through ``cap_frontdoor_post_raw`` for slow-path frames,
+    so the socket writer and the relay writer share one encoder."""
+    if trace is not None:
+        parts = _response_parts(T_VERIFY_RESP_TRACE, results)
+        parts.insert(1, _trace_field(trace))
+        _with_crc(parts)
+    elif crc:
+        parts = _with_crc(_response_parts(T_VERIFY_RESP_CRC, results))
+    else:
+        parts = _response_parts(T_VERIFY_RESP, results)
+    return b"".join(parts)
+
+
 def send_ping(sock: socket.socket) -> None:
     sock.sendall(_HDR.pack(MAGIC, T_PING, 0))
 

@@ -57,6 +57,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 # ---------------------------------------------------------------------------
 
 SPAN_FRONTDOOR_ROUTE = "frontdoor.route"    # FrontDoor partition+dispatch
+SPAN_FRONTDOOR_RELAY = "frontdoor.relay"    # native gate slow-path handoff
 SPAN_CLIENT_SUBMIT = "client.submit"        # FleetClient.verify_batch, whole
 SPAN_ROUTER_ATTEMPT = "router.attempt"      # one wire attempt on one worker
 SPAN_ROUTER_HEDGE = "router.hedge"          # duplicate attempt on a peer
@@ -80,7 +81,7 @@ SPAN_NAMES = frozenset({
     SPAN_BATCHER_FILL, SPAN_BATCHER_FLUSH, SPAN_BATCHER_DISPATCH,
     SPAN_BATCHER_COLLECT, SPAN_KEYPLANE_SWAP, SPAN_NATIVE_DRAIN,
     SPAN_NATIVE_POST, SPAN_SHM_ATTACH, SPAN_OIDC_VALIDATE,
-    SPAN_FRONTDOOR_ROUTE,
+    SPAN_FRONTDOOR_ROUTE, SPAN_FRONTDOOR_RELAY,
 })
 
 # ---------------------------------------------------------------------------

@@ -132,7 +132,14 @@ def _assert_parity(crv: str, ladder: str):
         (n, g, w) for n, g, w in zip(names, got, want) if g != w]
 
 
-@pytest.mark.parametrize("crv", CURVES)
+@pytest.mark.parametrize("crv", [
+    "P-256",
+    "P-384",
+    # P-521 limb parity alone costs ~2 CPU-minutes on the 1-core tier-1
+    # box; the ladder code paths it exercises are identical to P-384's,
+    # only the limb count differs — run it with the slow suite
+    pytest.param("P-521", marks=pytest.mark.slow),
+])
 def test_affine_limb_parity(crv, monkeypatch):
     monkeypatch.setenv("CAP_TPU_RNS", "0")
     _assert_parity(crv, "affine")
@@ -144,7 +151,10 @@ def test_affine_rns_parity_es256(monkeypatch):
 
 
 @pytest.mark.heavy
-@pytest.mark.parametrize("crv", ["P-384", "P-521"])
+@pytest.mark.parametrize("crv", [
+    "P-384",
+    pytest.param("P-521", marks=pytest.mark.slow),
+])
 def test_affine_rns_parity_heavy(crv, monkeypatch):
     """RNS engine on the larger curves — compile-heavy on CPU, same
     marker policy as the other RNS-on-CPU engine tests."""

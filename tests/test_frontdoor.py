@@ -471,13 +471,25 @@ def _expected_ok(t):
 
 
 @pytest.mark.chaos
-@pytest.mark.parametrize("serve_chain", ["python", "native"])
-def test_pool_kill9_mid_rotation_under_hot_load(serve_chain):
+@pytest.mark.parametrize("serve_chain,router_chain", [
+    ("python", "python"),
+    ("native", "python"),
+    # the crossed arm adds no routing coverage beyond the two above
+    # (the relay is serve-chain-agnostic) — kept out of the tier-1
+    # time budget, still run with the slow suite
+    pytest.param("python", "native", marks=pytest.mark.slow),
+    ("native", "native"),
+])
+def test_pool_kill9_mid_rotation_under_hot_load(serve_chain,
+                                                router_chain):
     """Kill -9 an ENTIRE pool mid-rotation while hot-token load flows:
     zero wrong verdicts, zero lost submissions, zero stale accepts
     fleet-wide, epoch convergence after respawn, and a peer-filled
     replacement worker shows ``vcache.peer_fills`` > 0 in its
-    postmortem."""
+    postmortem. ``router_chain=native`` drives the SAME load through
+    the zero-copy relay gate (NativeFrontDoorServer) over a socket —
+    relay failures mid-kill must re-dispatch through the Python slow
+    path with the identical availability contract."""
     native = serve_chain == "native"
     pools = [WorkerPool(2, keyset_spec="stub:batch_ms=25",
                         ping_interval=0.2, max_restarts=20,
@@ -486,6 +498,7 @@ def test_pool_kill9_mid_rotation_under_hot_load(serve_chain):
                                    "1" if native else "0"})
              for _ in range(2)]
     fd = None
+    gw = None
     try:
         for p in pools:
             assert p.wait_all_ready(30), "fleet did not come up"
@@ -497,15 +510,43 @@ def test_pool_kill9_mid_rotation_under_hot_load(serve_chain):
                        client_kw={"attempt_timeout": 2.0,
                                   "total_deadline": 20.0,
                                   "breaker_reset_s": 0.5})
+        if router_chain == "native":
+            try:
+                from cap_tpu.fleet.frontdoor import \
+                    NativeFrontDoorServer
+
+                gw = NativeFrontDoorServer(fd, refresh_s=0.1)
+            except (ImportError, ValueError) as e:
+                pytest.skip(f"native router chain unavailable ({e})")
         hot = [f"hot-{i}.ok" for i in range(10)] + ["hot-bad"]
         stop = threading.Event()
         failures = []
         served = [0]
+        local = threading.local()
+
+        def submit(tokens):
+            if gw is None:
+                return fd.verify_batch(tokens)
+            # one relay-gate connection per driver thread; verdicts
+            # come back over the wire exactly as a fleet client sees
+            # them, whatever path (splice or slow) produced each
+            s = getattr(local, "sock", None)
+            if s is None:
+                s = socket.create_connection(gw.address,
+                                             timeout=10.0)
+                s.settimeout(25.0)
+                local.sock = s
+                local.reader = P.FrameReader(s)
+            P.send_request(s, tokens)
+            _ft, entries = local.reader.recv_frame()
+            return [json.loads(payload) if st == 0
+                    else RuntimeError(payload.decode())
+                    for st, payload in entries]
 
         def drive():
             while not stop.is_set():
                 try:
-                    out = fd.verify_batch(hot)
+                    out = submit(hot)
                 except Exception as e:  # noqa: BLE001 - recorded
                     failures.append(f"raised: {e!r}")
                     return
@@ -589,7 +630,9 @@ def test_pool_kill9_mid_rotation_under_hot_load(serve_chain):
         assert pm_counters.get("vcache.peer_fills", 0) > 0, \
             pm_counters
     finally:
-        if fd is not None:
+        if gw is not None:
+            gw.close(deadline_s=5.0)
+        elif fd is not None:
             fd.close()
         for p in pools:
             p.close()

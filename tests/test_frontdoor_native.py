@@ -1,0 +1,448 @@
+"""Native relay front door: parity pin + relay e2e + counters.
+
+The routing-decision parity pin is the load-bearing test: the C++
+fast path (``cap_frontdoor_probe_route``) must make bit-identical
+owner decisions to the Python :class:`ConsistentHashRing` twin —
+across ring sizes, membership change, and breaker trips — exactly the
+twin stance the DRR scheduler pins with ``cap_drr_*``. The relay
+e2e section then drives every CVB1 frame family through a live
+:class:`NativeFrontDoorServer` over real in-process workers and gates
+the exact-counting contract (``frontdoor.lookups ==
+affinity_hits + affinity_misses``) through the split fast/slow path.
+"""
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet import ConsistentHashRing, FrontDoor
+from cap_tpu.fleet.frontdoor import (NativeFrontDoorServer,
+                                     native_frontdoor_enabled)
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.serve import protocol as P
+from cap_tpu.serve import vcache as V
+from cap_tpu.serve.worker import VerifyWorker
+
+try:
+    from cap_tpu.serve import native_serve
+    _HAVE = bool(getattr(native_serve.load(), "cap_fd_ok", False))
+except Exception:  # noqa: BLE001 - any load failure → skip module
+    _HAVE = False
+
+pytestmark = pytest.mark.skipif(
+    not _HAVE, reason="native front-door chain unavailable")
+
+HARD_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"frontdoor-native test exceeded {HARD_TIMEOUT_S}s")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(HARD_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _bare_frontdoor(n_pools=2, **kw):
+    return FrontDoor([[("127.0.0.1", 1 + i)] for i in range(n_pools)],
+                     **kw)
+
+
+def _gateway(fd, **kw):
+    kw.setdefault("refresh_s", 0.05)
+    return NativeFrontDoorServer(fd, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the parity pin: native owner decision == Python ring twin, bit-exact
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_pools,vnodes", [(1, 64), (2, 64), (3, 16),
+                                            (5, 64)])
+def test_probe_route_parity_pin(n_pools, vnodes):
+    """Randomized digests through the native ring lookup vs the
+    Python twin: owner decisions must be bit-identical, including
+    the breaker-trip (-1 = slow path) and membership-change cases."""
+    fd = _bare_frontdoor(n_pools, vnodes=vnodes)
+    # refresh_s huge: the test owns the live flags below
+    gw = _gateway(fd, refresh_s=999.0)
+    try:
+        rng = random.Random(0xF00D + n_pools)
+        digests = [rng.randbytes(16) for _ in range(300)]
+        ring = fd._ring
+        want = [ring.primary(d) for d in digests]
+        assert gw.probe_route(digests) == want
+
+        # breaker trip: dead owner → -1 (the frame would slow-path),
+        # every other decision UNCHANGED
+        dead = n_pools - 1
+        gw._lib.cap_frontdoor_set_live(gw._h, dead, 0)
+        want_dead = [-1 if w == dead else w for w in want]
+        assert gw.probe_route(digests) == want_dead
+        gw._lib.cap_frontdoor_set_live(gw._h, dead, 1)
+        assert gw.probe_route(digests) == want
+    finally:
+        gw.close(deadline_s=5.0)
+
+
+def test_probe_route_membership_change_parity():
+    """Re-staging a grown ring re-pins parity: the native decision
+    tracks the NEW ring exactly, and only segments the new pool owns
+    moved (the consistent-hash property, through the native path)."""
+    fd2 = _bare_frontdoor(2)
+    gw2 = _gateway(fd2, refresh_s=999.0)
+    try:
+        rng = random.Random(29)
+        digests = [rng.randbytes(16) for _ in range(300)]
+        before = gw2.probe_route(digests)
+        assert before == [fd2._ring.primary(d) for d in digests]
+    finally:
+        gw2.close(deadline_s=5.0)
+    fd3 = _bare_frontdoor(3)
+    gw3 = _gateway(fd3, refresh_s=999.0)
+    try:
+        after = gw3.probe_route(digests)
+        assert after == [fd3._ring.primary(d) for d in digests]
+        moved = [(b, a) for b, a in zip(before, after) if b != a]
+        assert moved and all(a == 2 for _b, a in moved), \
+            "membership change must move only the new pool's segments"
+    finally:
+        gw3.close(deadline_s=5.0)
+
+
+def test_probe_route_point_math_matches_bisect():
+    """The ring-point math itself: big-endian u64 of digest[:8] +
+    upper_bound == Python int.from_bytes + bisect_right, pinned on
+    crafted edge digests (all-zero, all-ff, exact point values)."""
+    fd = _bare_frontdoor(3)
+    gw = _gateway(fd, refresh_s=999.0)
+    try:
+        ring = fd._ring
+        edges = [bytes(16), b"\xff" * 16]
+        for pt in ring._points[:8]:
+            edges.append(pt.to_bytes(8, "big") + bytes(8))
+            edges.append((pt - 1).to_bytes(8, "big") + bytes(8))
+        assert gw.probe_route(edges) == [ring.primary(d)
+                                         for d in edges]
+    finally:
+        gw.close(deadline_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# relay e2e over live workers: every frame family, exact counters
+# ---------------------------------------------------------------------------
+
+
+def _two_workers(**kw):
+    w0 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      **kw)
+    w1 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0,
+                      **kw)
+    return w0, w1
+
+
+def _connect(gw):
+    s = socket.create_connection(gw.address, timeout=10.0)
+    s.settimeout(30.0)
+    return s, P.FrameReader(s)
+
+
+def test_relay_e2e_all_frame_families_and_exact_counters():
+    rec = telemetry.enable()
+    rec.reset()
+    w0, w1 = _two_workers()
+    gw = None
+    try:
+        fd = FrontDoor([[w0.address], [w1.address]],
+                       fallback=StubKeySet(),
+                       client_kw={"attempt_timeout": 5.0,
+                                  "total_deadline": 10.0})
+        gw = _gateway(fd)
+        s, r = _connect(gw)
+        toks = [f"relay-{i}.ok" for i in range(24)] + ["relay-bad"]
+        for crc, trace in ((False, None), (True, None),
+                          (False, "ab12cd34")):
+            P.send_request(s, toks, crc=crc, trace=trace)
+            ftype, entries, tr = r.recv_frame_ex()
+            want = (P.T_VERIFY_RESP_TRACE if trace
+                    else P.T_VERIFY_RESP_CRC if crc
+                    else P.T_VERIFY_RESP)
+            assert ftype == want and tr == trace
+            assert [e[0] for e in entries] == [0] * 24 + [1]
+            for t, (st, payload) in zip(toks[:24], entries[:24]):
+                assert json.loads(payload) == {"sub": t}
+        P.send_ping(s)
+        ftype, _ = r.recv_frame()
+        assert ftype == P.T_PONG
+        s.close()
+        time.sleep(0.3)           # let the counter fold tick
+
+        st = gw.stats()
+        c = st["counters"]
+        assert st["frontdoor_chain"] == "native"
+        # THE exact-counting contract through the split path
+        assert c["frontdoor.lookups"] == \
+            c["frontdoor.affinity_hits"] \
+            + c["frontdoor.affinity_misses"]
+        assert c["frontdoor.lookups"] >= 75
+        assert c["frontdoor.native.relays"] > 0
+        assert c["frontdoor.native.proto_errors"] == 0
+        assert c["frontdoor.native.upstream_fails"] == 0
+        assert c["frontdoor.native.dropped_posts"] == 0
+        # native fast path only ever counts lookups == hits
+        assert c["frontdoor.native.lookups"] \
+            == c["frontdoor.native.hits"]
+        assert c.get("vcache.stale_accepts", 0) == 0
+    finally:
+        if gw is not None:
+            gw.close(deadline_s=5.0)   # closes fd too
+        w0.close(5)
+        w1.close(5)
+        telemetry.disable()
+
+
+def test_relay_splices_single_owner_frames_and_holds_seq_order():
+    """Pipelined single-token frames: single-owner plain requests
+    splice through verbatim (zero re-encode), and responses come back
+    in strict submission order even though two pools answer at
+    different speeds."""
+    w0, w1 = _two_workers()
+    gw = None
+    try:
+        fd = FrontDoor([[w0.address], [w1.address]],
+                       fallback=StubKeySet())
+        gw = _gateway(fd)
+        s, r = _connect(gw)
+        n = 40
+        toks = [f"seq-{i}.a.ok" for i in range(n)]
+        for t in toks:
+            P.send_request(s, [t])
+        for t in toks:
+            ftype, entries = r.recv_frame()
+            assert ftype == P.T_VERIFY_RESP
+            assert entries[0][0] == 0
+            assert json.loads(entries[0][1]) == {"sub": t}, \
+                "responses out of submission order"
+        s.close()
+        nc = gw.native_counters()
+        assert nc["frontdoor.native.splices"] >= n // 2
+        # every token either relayed natively or (overload gate) went
+        # through the Python slow path — none double-counted or lost
+        assert nc["frontdoor.native.relay_tokens"] \
+            + nc["frontdoor.native.slow_tokens"] == n
+    finally:
+        if gw is not None:
+            gw.close(deadline_s=5.0)
+        w0.close(5)
+        w1.close(5)
+
+
+def test_control_frames_slow_path_stats_keys_peer_shm():
+    """Control frames drain to Python and each gets EXACTLY one
+    response: STATS serves the gateway doc, KEYS fans out to every
+    pool (both workers converge on the pushed epoch), peer-fill and
+    shm-attach are refused with proper error acks."""
+    w0, w1 = _two_workers()
+    gw = None
+    try:
+        fd = FrontDoor([[w0.address], [w1.address]])
+        gw = _gateway(fd)
+        s, r = _connect(gw)
+        P.send_stats_request(s)
+        ftype, entries = r.recv_frame()
+        assert ftype == P.T_STATS_RESP
+        doc = json.loads(entries[0][1])
+        assert doc["frontdoor_chain"] == "native"
+        assert doc["frontdoor"]["routing"] == "affinity"
+
+        P.send_keys_push(s, {"keys": []}, epoch=5)
+        ftype, entries = r.recv_frame()
+        assert ftype == P.T_KEYS_ACK and entries[0][0] == 0
+        assert json.loads(entries[0][1])["epoch"] == 5
+        assert w0.key_epoch == 5 and w1.key_epoch == 5
+        assert gw.key_epoch == 5
+
+        P.send_peer_fill(s, {"op": "export", "max_entries": 10})
+        ftype, entries = r.recv_frame()
+        assert ftype == P.T_PEER_ACK and entries[0][0] == 1
+
+        P.send_shm_attach(s, "/bogus/ring")
+        ftype, entries = r.recv_frame()
+        assert ftype == P.T_SHM_ACK and entries[0][0] == 1
+
+        # still serving verifies on the same conn afterwards
+        P.send_request(s, ["after-control.ok"])
+        ftype, entries = r.recv_frame()
+        assert ftype == P.T_VERIFY_RESP and entries[0][0] == 0
+        s.close()
+        assert gw.native_counters()[
+            "frontdoor.native.dropped_posts"] == 0
+    finally:
+        if gw is not None:
+            gw.close(deadline_s=5.0)
+        w0.close(5)
+        w1.close(5)
+
+
+def test_dead_pool_upstream_fail_then_breaker_pushdown():
+    """One pool's endpoint is dead from the start: the first relay
+    that routes there fails upstream (connect refused) and the WHOLE
+    frame re-dispatches through the Python slow path — which trips the
+    breaker, the refresh thread pushes live=0 down, and later frames
+    classify as dead-pool BEFORE any relay is attempted. Zero wrong
+    verdicts, zero lost submissions throughout."""
+    w0, w1 = _two_workers()
+    gw = None
+    try:
+        # a port nothing listens on (bound then released)
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            dead_port = probe.getsockname()[1]
+        fd = FrontDoor([[w0.address], [w1.address],
+                        [("127.0.0.1", dead_port)]],
+                       fallback=StubKeySet(),
+                       client_kw={"attempt_timeout": 1.0,
+                                  "total_deadline": 5.0,
+                                  "max_rounds": 1,
+                                  "breaker_threshold": 1,
+                                  "breaker_reset_s": 60.0})
+        gw = _gateway(fd)
+        s, r = _connect(gw)
+        toks = [f"death-{i}.ok" for i in range(48)]
+        for rep in range(6):
+            P.send_request(s, toks)
+            ftype, entries = r.recv_frame()
+            assert ftype == P.T_VERIFY_RESP
+            assert len(entries) == 48, "lost submissions"
+            assert [e[0] for e in entries] == [0] * 48, \
+                f"wrong verdict with dead pool (rep {rep})"
+            time.sleep(0.12)          # let breaker → set_live settle
+        s.close()
+        c = gw.stats()["counters"]
+        assert c["frontdoor.lookups"] == \
+            c["frontdoor.affinity_hits"] \
+            + c["frontdoor.affinity_misses"]
+        # rep 1 hit the upstream-fail election; once the breaker
+        # pushed live=0 down, frames classified dead-pool at the edge
+        assert c["frontdoor.native.upstream_fails"] > 0
+        assert c.get("frontdoor.native.slow.upstream_fail", 0) > 0
+        assert c.get("frontdoor.native.slow.dead_pool", 0) > 0
+        assert c["frontdoor.reroutes"] > 0 \
+            or c["frontdoor.fallback_tokens"] > 0
+    finally:
+        if gw is not None:
+            gw.close(deadline_s=5.0)
+        w0.close(5)
+        w1.close(5)
+
+
+def test_malformed_frame_severs_connection_not_gateway():
+    w0, w1 = _two_workers()
+    gw = None
+    try:
+        fd = FrontDoor([[w0.address], [w1.address]])
+        gw = _gateway(fd)
+        s, r = _connect(gw)
+        s.sendall(b"\x00" * 64)           # bad magic
+        assert s.recv(1) == b"", "reader must sever on bad magic"
+        s.close()
+        # the gateway itself survives and keeps serving
+        s2, r2 = _connect(gw)
+        P.send_request(s2, ["survivor.ok"])
+        ftype, entries = r2.recv_frame()
+        assert ftype == P.T_VERIFY_RESP and entries[0][0] == 0
+        s2.close()
+        assert gw.native_counters()[
+            "frontdoor.native.proto_errors"] >= 1
+    finally:
+        if gw is not None:
+            gw.close(deadline_s=5.0)
+        w0.close(5)
+        w1.close(5)
+
+
+# ---------------------------------------------------------------------------
+# kill switch + worker_main wiring
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_env(monkeypatch):
+    monkeypatch.delenv("CAP_FRONTDOOR_NATIVE", raising=False)
+    assert native_frontdoor_enabled()
+    for off in ("0", "false", "no", "off"):
+        monkeypatch.setenv("CAP_FRONTDOOR_NATIVE", off)
+        assert not native_frontdoor_enabled()
+    monkeypatch.setenv("CAP_FRONTDOOR_NATIVE", "1")
+    assert native_frontdoor_enabled()
+
+
+def test_native_gate_requires_affinity_routing():
+    fd = _bare_frontdoor(2, routing="rr")
+    with pytest.raises(ValueError):
+        NativeFrontDoorServer(fd)
+    fd.close()
+
+
+def _boot_gateway_proc(pool_port, env_extra=None, chain="auto"):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "cap_tpu.fleet.worker_main",
+         "--keyset", f"frontdoor:pool=127.0.0.1:{pool_port}",
+         "--obs-port", "-1", "--frontdoor-chain", chain],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    line = p.stdout.readline().strip()
+    kv = dict(f.split("=", 1) for f in line.split()[1:])
+    return p, kv
+
+
+def test_worker_main_gateway_chain_selection():
+    """The deployable gateway: ``--frontdoor-chain auto`` runs native,
+    the CAP_FRONTDOOR_NATIVE=0 kill switch forces the python gate, and
+    both report honestly on the ready line."""
+    w0 = VerifyWorker(StubKeySet(), target_batch=64, max_wait_ms=1.0)
+    procs = []
+    try:
+        port = w0.address[1]
+        p1, kv1 = _boot_gateway_proc(port)
+        procs.append(p1)
+        assert kv1.get("frontdoor_chain") == "native", kv1
+        p2, kv2 = _boot_gateway_proc(
+            port, env_extra={"CAP_FRONTDOOR_NATIVE": "0"})
+        procs.append(p2)
+        assert kv2.get("frontdoor_chain") == "python", kv2
+        # both gates serve identical verdicts
+        for kv in (kv1, kv2):
+            s = socket.create_connection(
+                ("127.0.0.1", int(kv["port"])), timeout=10.0)
+            s.settimeout(30.0)
+            P.send_request(s, ["gate.ok", "gate.bad"])
+            ftype, entries = P.FrameReader(s).recv_frame()
+            assert [e[0] for e in entries] == [0, 1], kv
+            s.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        w0.close(5)

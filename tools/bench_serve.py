@@ -650,6 +650,195 @@ def run_frontdoor_point(n_pools: int, pool_workers: int, routing: str,
     return pt
 
 
+def _materialize_drive_tokens(tokens, zipf, pool_idx, n_out=16384):
+    """The gateway arms' SHARED drive corpus. ``cap_bench_drive``
+    samples request windows uniformly from its blob with a
+    per-connection seed, so pinning the workload across chain arms
+    means pinning the BLOB: when the Zipf mix is on, the full token
+    sequence is pre-sampled ONCE here in the parent (pinned seed over
+    the shared rank→token permutation) and every chain arm's C driver
+    replays the identical byte stream — same blob + same conn count →
+    frame-for-frame identical traffic on both router chains."""
+    if zipf is None:
+        return list(tokens)
+    import numpy as np
+
+    zs, _pool = zipf
+    perm = np.asarray(pool_idx)
+    n = len(perm)
+    w = np.arange(1, n + 1, dtype=np.float64) ** -zs
+    cdf = np.cumsum(w)
+    cdf /= cdf[-1]
+    seed = int(os.environ.get("CAP_SERVE_ZIPF_SEED", "1234"))
+    rng = np.random.RandomState(seed * 7919 + 29)
+    idx = perm[np.searchsorted(cdf, rng.random_sample(n_out))]
+    return [tokens[i] for i in idx]
+
+
+def _align_drive_tokens(drive_tokens, n_pools):
+    """Owner-align the gateway drive corpus (``CAP_FRONTDOOR_ALIGN``,
+    default 1): group the materialized sequence by owning pool — the
+    parent-side ring is bit-identical to the router's (pinned by
+    test_frontdoor_native's parity tests), so contiguous request
+    windows become single-owner. That is the ingress shape ANY
+    affinity-aware upstream tier produces (the Python FrontDoor driver
+    itself ships per-pool sub-batches), and the shape that exercises
+    the native relay's zero-copy splice path; ``=0`` leaves the Zipf
+    stream unaligned, so nearly every frame mixes owners and rides the
+    re-frame relay path instead. Both chain arms get the SAME corpus
+    either way — the A/B stays frame-identical."""
+    if os.environ.get("CAP_FRONTDOOR_ALIGN", "1") == "0":
+        return drive_tokens
+    from cap_tpu.fleet.frontdoor import ConsistentHashRing
+    from cap_tpu.serve.vcache import token_digest
+
+    ring = ConsistentHashRing(list(range(n_pools)))
+    buckets = [[] for _ in range(n_pools)]
+    for t in drive_tokens:
+        buckets[ring.primary(token_digest(t))].append(t)
+    return [t for b in buckets for t in b]
+
+
+def _gateway_stats(host, port):
+    """One CVB1 STATS round-trip against a gateway process — the
+    router-side counter scrape the gateway A/B records (frontdoor.*
+    routing counters + frontdoor.native.* relay counters)."""
+    import socket
+
+    from cap_tpu.serve import protocol as P
+
+    s = socket.create_connection((host, port), timeout=30)
+    try:
+        s.settimeout(30)
+        P.send_stats_request(s)
+        ftype, entries = P.FrameReader(s).recv_frame()
+        if ftype != P.T_STATS_RESP or entries[0][0] != 0:
+            raise RuntimeError(f"gateway stats failed: {ftype}")
+        return json.loads(entries[0][1])
+    finally:
+        s.close()
+
+
+def _spawn_gateway(keyset_spec, chain):
+    """A deployed router-tier gateway PROCESS: worker_main with a
+    ``frontdoor:`` keyset, pinned to the requested router chain
+    (``--frontdoor-chain python|native`` — no silent fallback arm
+    contamination: a chain mismatch on the ready line is an error)."""
+    import subprocess
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "cap_tpu.fleet.worker_main",
+         "--keyset", keyset_spec, "--frontdoor-chain", chain,
+         "--obs-port", "-1"],
+        stdout=subprocess.PIPE, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    line = (p.stdout.readline() or "").strip()
+    kv = dict(f.split("=", 1) for f in line.split()[1:] if "=" in f)
+    if (not line.startswith("CAP_FLEET_READY")
+            or kv.get("frontdoor_chain") != chain):
+        p.kill()
+        p.wait(timeout=30)
+        raise RuntimeError(
+            f"gateway chain={chain} did not come up: {line!r}")
+    return p, ("127.0.0.1", int(kv["port"]))
+
+
+def run_gateway_point(n_pools: int, pool_workers: int, chain: str,
+                      keyset_spec: str, drive_tokens, n_clients: int,
+                      req_tokens: int, seconds: float,
+                      max_wait_ms: float, target_batch: int,
+                      env_extra=None) -> dict:
+    """Wire-speed router-tier arm: the same pools-behind-front-door
+    topology as :func:`run_frontdoor_point`, but the router is ONE
+    deployed gateway process (worker_main ``--keyset frontdoor:``)
+    and the load is the native closed-loop C driver aimed at the
+    gateway's front socket — client cost leaves the measurement, so
+    the number is the ROUTER TIER's serve capacity, python chain vs
+    native relay chain on the frame-identical pinned workload."""
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import WorkerPool
+
+    pools = [WorkerPool(pool_workers, keyset_spec=keyset_spec,
+                        target_batch=target_batch,
+                        max_wait_ms=max_wait_ms, ping_interval=1.0,
+                        env_extra=dict(env_extra or {}))
+             for _ in range(n_pools)]
+    gw = None
+    try:
+        for i, p in enumerate(pools):
+            if not p.wait_all_ready(120.0):
+                raise RuntimeError(f"pool {i} did not come up")
+        spill = os.environ.get("CAP_SERVE_SPILL", "2.0")
+        spec = ("frontdoor:" + ";".join(
+            "pool=" + "+".join(
+                f"{h}:{pt}" for h, pt in sorted(p.endpoints().values()))
+            for p in pools) + f";spill={spill}")
+        gw, gw_addr = _spawn_gateway(spec, chain)
+        total, n_reqs = _native_drive([gw_addr], drive_tokens,
+                                      req_tokens, seconds, n_clients)
+        st = _gateway_stats(*gw_addr)
+        ctr = st.get("counters") or {}
+        lookups = ctr.get("frontdoor.lookups", 0)
+        hits = ctr.get("frontdoor.affinity_hits", 0)
+        misses = ctr.get("frontdoor.affinity_misses", 0)
+        # the r21 counting contract, enforced per point: every routed
+        # token is a lookup and lands in exactly one bucket — native
+        # fast path included (its deltas fold into the same counters)
+        if lookups != hits + misses:
+            raise RuntimeError(
+                f"front-door accounting broke: lookups={lookups} != "
+                f"hits={hits} + misses={misses}")
+        merged = telemetry.merge_snapshots(
+            [(s or {}).get("snapshot")
+             for pool in pools for s in pool.stats().values()])
+        agg_counters = merged.get("counters") or {}
+    finally:
+        if gw is not None:
+            gw.terminate()
+            try:
+                gw.wait(timeout=30)
+            except Exception:  # noqa: BLE001 - last resort
+                gw.kill()
+        for p in pools:
+            p.close()
+    native = {k[len("frontdoor.native."):]: v for k, v in ctr.items()
+              if k.startswith("frontdoor.native.")}
+    vps = total / seconds
+    return {
+        "n_pools": n_pools,
+        "pool_workers": pool_workers,
+        "gateway_chain": chain,
+        "keyset_spec": keyset_spec,
+        "clients": n_clients,
+        "req_tokens": req_tokens,
+        "driver": "native",
+        "throughput": round(vps, 1),
+        "requests": n_reqs,
+        "relay_us_per_token": (round(1e6 / vps, 3) if vps else None),
+        "frontdoor": {
+            "lookups": lookups,
+            "affinity_hits": hits,
+            "affinity_misses": misses,
+            "affinity_hit_rate": (round(hits / lookups, 4)
+                                  if lookups else None),
+            "spills": ctr.get("frontdoor.spills", 0),
+            "reroutes": ctr.get("frontdoor.reroutes", 0),
+            "fallback_tokens": ctr.get("frontdoor.fallback_tokens", 0),
+            "native_fallbacks": ctr.get("frontdoor.native_fallbacks",
+                                        0),
+        },
+        "native": native,
+        "cache": {
+            "lookups": agg_counters.get("vcache.lookups", 0),
+            "hits": agg_counters.get("vcache.hits", 0),
+            "stale_accepts": agg_counters.get("vcache.stale_accepts",
+                                              0),
+        },
+        "tokens_sent": total,
+        "drive_corpus": len(drive_tokens),
+    }
+
+
 def _mk_tenant_tokens(iss: str, kid: str, n: int = 128):
     """Stub-verifiable tokens for ONE tenant: a shared header (kid) +
     payload (iss) with n distinct trailing segments, so the batcher's
@@ -956,7 +1145,19 @@ def frontdoor_main() -> None:
     weather hits both arms equally. Headline:
     ``fleet_affinity_vps`` / ``fleet_rr_vps`` and their ratio — the
     §Round 16 affinity-vs-round-robin A/B (the per-worker verdict
-    cache is ON in both arms; only the routing policy differs)."""
+    cache is ON in both arms; only the routing policy differs).
+
+    GATEWAY-CHAIN A/B (``CAP_FRONTDOOR_CHAINS="python,native"``, the
+    r21 arms): the same pool topology behind ONE deployed worker_main
+    gateway per listed router chain, driven at the front socket by
+    the native closed-loop C driver on a frame-identical pinned
+    workload (Zipf mix pre-materialized once in the parent — see
+    :func:`_materialize_drive_tokens`). ALL arms — routing × chain —
+    interleave inside every rep. Headlines: ``fleet_native_vps`` /
+    ``fleet_gateway_python_vps``, their ratio, the native arm's
+    speedup over the in-driver ``fleet_affinity_vps`` baseline, and
+    ``frontdoor_relay_us_per_token``. Set the env to "" to skip the
+    gateway arms (routing-only legacy shape)."""
     n_pools = int(os.environ["CAP_SERVE_POOLS"])
     pool_workers = int(os.environ.get("CAP_SERVE_POOL_WORKERS", 1))
     keyset_spec = os.environ.get("CAP_SERVE_FLEET_KEYSET",
@@ -986,7 +1187,20 @@ def frontdoor_main() -> None:
 
         _, tokens = T.headline_fixtures(16384)
 
+    # r21 gateway-chain arms: one deployed router process per chain,
+    # native C drivers at the front. The drive corpus is materialized
+    # ONCE here (pinned Zipf seed) so every chain arm replays the
+    # identical byte stream.
+    chains = [c for c in os.environ.get(
+        "CAP_FRONTDOOR_CHAINS", "python,native").split(",") if c]
+    zipf = _zipf_cfg()
+    drive_tokens = _align_drive_tokens(
+        _materialize_drive_tokens(
+            tokens, zipf, _zipf_pool_indices(len(tokens), zipf)),
+        n_pools)
+
     points = []
+    gw_points = []
     for rep in range(reps):
         for routing in routings:      # interleaved: a,rr,a,rr,…
             pt = run_frontdoor_point(
@@ -1004,15 +1218,40 @@ def frontdoor_main() -> None:
                   f"vc_hit="
                   f"{pt['cache']['hits']}/{pt['cache']['lookups']}",
                   file=sys.stderr)
+        for chain in chains:          # …then gw-py,gw-native, same rep
+            pt = run_gateway_point(
+                n_pools, pool_workers, chain, keyset_spec,
+                drive_tokens, n_clients, req_tokens, seconds,
+                max_wait_ms, target_batch, env_extra=env_extra)
+            pt["rep"] = rep
+            pt["aligned"] = os.environ.get("CAP_FRONTDOOR_ALIGN",
+                                           "1") != "0"
+            gw_points.append(pt)
+            fdc = pt["frontdoor"]
+            print(f"frontdoor pools={n_pools} gateway={chain:<7} "
+                  f"rep={rep}  thr={pt['throughput']:>9.0f}/s  "
+                  f"relay={pt['relay_us_per_token']}us/tok  "
+                  f"aff_hit={fdc['affinity_hit_rate']}  "
+                  f"relays={pt['native'].get('relays', 0)} "
+                  f"splices={pt['native'].get('splices', 0)}",
+                  file=sys.stderr)
 
     def _best(routing):
         vals = [p["throughput"] for p in points
                 if p["routing"] == routing]
         return max(vals) if vals else None
 
+    def _gw_best(chain):
+        vals = [p["throughput"] for p in gw_points
+                if p["gateway_chain"] == chain]
+        return max(vals) if vals else None
+
     affinity_vps = _best("affinity")
     rr_vps = _best("rr")
-    stale = sum(p["cache"]["stale_accepts"] for p in points)
+    native_vps = _gw_best("native")
+    gw_python_vps = _gw_best("python")
+    stale = (sum(p["cache"]["stale_accepts"] for p in points)
+             + sum(p["cache"]["stale_accepts"] for p in gw_points))
     print(json.dumps({
         "metric": "fleet_affinity_verifies_per_sec",
         "value": affinity_vps,
@@ -1022,11 +1261,25 @@ def frontdoor_main() -> None:
         "affinity_speedup_vs_rr": (round(affinity_vps / rr_vps, 3)
                                    if affinity_vps and rr_vps
                                    else None),
+        # r21 router-tier headlines: the native relay gateway vs the
+        # python gateway on the identical pinned workload, plus the
+        # native arm against the in-driver routing baseline above
+        "fleet_native_vps": native_vps,
+        "fleet_gateway_python_vps": gw_python_vps,
+        "native_speedup_vs_python_gw": (
+            round(native_vps / gw_python_vps, 3)
+            if native_vps and gw_python_vps else None),
+        "native_speedup_vs_affinity": (
+            round(native_vps / affinity_vps, 3)
+            if native_vps and affinity_vps else None),
+        "frontdoor_relay_us_per_token": (
+            round(1e6 / native_vps, 3) if native_vps else None),
         "n_pools": n_pools,
         "pool_workers": pool_workers,
         "vcache_cap": env_extra.get("CAP_SERVE_VCACHE_CAP"),
         "stale_accepts_total": stale,
         "points": points,
+        "gateway_points": gw_points,
     }))
 
 

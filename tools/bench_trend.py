@@ -102,7 +102,13 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # plane on (DRR + admission; higher is better) — the
                  # r20 enforcement contract (bench_serve
                  # CAP_SERVE_FLOOD mode)
-                 "fairness_vps": True}
+                 "fairness_vps": True,
+                 # router tier at wire speed: the native relay
+                 # gateway's closed-loop rate on the pinned Zipf
+                 # multi-pool workload (higher is better) — the r21
+                 # zero-copy front-door contract (bench_serve
+                 # CAP_FRONTDOOR_CHAINS gateway arms)
+                 "fleet_native_vps": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -412,6 +418,19 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [fv[1], (21, {"serve_native_vps": 1e6})])):
         problems.append("vanished fairness_vps NOT flagged")
+    # 4e4. fleet_native_vps (r21): introducing must not flag; a drop
+    #      and a disappearance must
+    fn = [(20, {"serve_native_vps": 1e6}),
+          (21, {"serve_native_vps": 1e6, "fleet_native_vps": 2e5})]
+    if check_serve_series(fn):
+        problems.append("introducing fleet_native_vps flagged")
+    if not check_serve_series(
+            [fn[1], (22, {"serve_native_vps": 1e6,
+                          "fleet_native_vps": 1e5})]):
+        problems.append("fleet_native_vps regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [fn[1], (22, {"serve_native_vps": 1e6})])):
+        problems.append("vanished fleet_native_vps NOT flagged")
     # 4f. resident_slhdsa128s_vps (r17, BENCH series): introducing
     #     must not flag; a drop and a disappearance must
     def _pq(vals):

@@ -297,14 +297,28 @@ def _decision_rows(counters: Dict[str, Any]) -> List[str]:
 
 
 def render_frontdoor(snap: Dict[str, Any]) -> str:
-    """The front-door router-tier view (``--frontdoor FILE``, the
-    JSON of ``FrontDoor.snapshot()``): per-host affinity hit%, spill /
-    re-route counts, load, and the fleet epoch CONVERGED/SKEW state
-    across every pool — rotation health for the WHOLE fleet in one
-    block. (When a front door runs as a worker process, its
-    ``frontdoor.*`` counters also ride the ordinary scrape, so the
-    ``--watch`` generic delta view covers them with no special
+    """The front-door router-tier view (``--frontdoor FILE``): per-
+    host affinity hit%, spill / re-route counts, load, and the fleet
+    epoch CONVERGED/SKEW state across every pool — rotation health for
+    the WHOLE fleet in one block. Accepts either the JSON of
+    ``FrontDoor.snapshot()`` or a gateway process's full STATS
+    document (``NativeFrontDoorServer.stats()`` / worker STATS op —
+    detected by its embedded ``frontdoor`` sub-doc); a native-relay
+    gateway additionally gets the chain= line (relays, splices,
+    seq-reorder hold depth, fallbacks, per-reason slow-path counts).
+    (When a front door runs as a worker process, its ``frontdoor.*``
+    counters also ride the ordinary scrape, so the ``--watch`` generic
+    delta view covers ``frontdoor.native.*`` with no special
     casing.)"""
+    if isinstance(snap.get("frontdoor"), dict):
+        # gateway STATS doc: routing/pool detail lives in the
+        # embedded snapshot; the top-level counters carry the
+        # frontdoor.native.* relay slots — overlay them
+        inner = dict(snap["frontdoor"])
+        inner["chain"] = snap.get("frontdoor_chain", "python")
+        inner["counters"] = {**(inner.get("counters") or {}),
+                             **(snap.get("counters") or {})}
+        snap = inner
     c = snap.get("counters") or {}
     lookups = int(c.get("frontdoor.lookups", 0) or 0)
     hits = int(c.get("frontdoor.affinity_hits", 0) or 0)
@@ -317,6 +331,25 @@ def render_frontdoor(snap: Dict[str, Any]) -> str:
         f"fallback_tokens={c.get('frontdoor.fallback_tokens', 0)}  "
         f"keys_pushes={c.get('frontdoor.keys_pushes', 0)}"
     ]
+    nat = {k[len("frontdoor.native."):]: int(v or 0)
+           for k, v in c.items() if k.startswith("frontdoor.native.")}
+    if nat or snap.get("chain"):
+        chain = snap.get("chain") or ("native" if nat else "python")
+        lines.append(
+            f"  chain={chain}  relays={nat.get('relays', 0)}  "
+            f"relay_tokens={nat.get('relay_tokens', 0)}  "
+            f"splices={nat.get('splices', 0)}  "
+            f"seq_held_max={nat.get('seq_held_max', 0)}  "
+            f"upstream_fails={nat.get('upstream_fails', 0)}  "
+            f"native_fallbacks="
+            f"{c.get('frontdoor.native_fallbacks', 0)}")
+        slow = {k[len('slow.'):]: v for k, v in sorted(nat.items())
+                if k.startswith("slow.")}
+        if slow:
+            lines.append("  slow path: " + "  ".join(
+                f"{k}={v}" for k, v in slow.items())
+                + f"  (frames={nat.get('slow_frames', 0)} "
+                  f"tokens={nat.get('slow_tokens', 0)})")
     for pid, p in sorted((snap.get("pools") or {}).items()):
         toks = int(p.get("tokens", 0) or 0)
         p_hits = int(p.get("affinity_hits", 0) or 0)

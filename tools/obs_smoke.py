@@ -28,6 +28,14 @@ The CI guard for the observability surface (``make obs-smoke``):
    divergence from the python-chain run: obs must cost less, never
    count differently. Skipped with a notice when the native library
    cannot build on this host.
+7. NATIVE FRONT-DOOR GATE (r21): the 2-pool topology again, served
+   through the native relay gateway (``NativeFrontDoorServer``) —
+   FAIL unless the exact fleet invariant ``frontdoor.lookups ==
+   affinity_hits + affinity_misses`` holds with the C fast path's
+   deltas folded in, the raw native slots agree (``lookups == hits``
+   — the fast path only takes live primaries), relays counted, zero
+   stale accepts, and capstat renders the chain= line. Skipped with
+   a notice when the library lacks the front-door TU.
 
 Runs under JAX_PLATFORMS=cpu inside the tier-1 time budget (~15 s).
 """
@@ -486,6 +494,107 @@ def run_frontdoor_gate():
     return failures
 
 
+def run_native_frontdoor_gate():
+    """The NATIVE router-chain front-door gate (r21): the same 2-pool
+    topology as :func:`run_frontdoor_gate`, but served through
+    ``NativeFrontDoorServer`` — C readers route and relay, Python only
+    sees the slow path. A spread + repeated burst over the gateway's
+    front socket must (a) keep the EXACT fleet invariant ``lookups ==
+    affinity_hits + affinity_misses`` with the native deltas folded
+    in, (b) relay every fast-path token (``relays`` > 0 with
+    ``lookups == hits`` on the raw native slots — the fast path only
+    takes live primaries), (c) leave ``vcache.stale_accepts`` at zero
+    on every worker, and (d) render through capstat's front-door view
+    with the chain= line."""
+    import socket
+
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import WorkerPool
+    from cap_tpu.fleet.frontdoor import FrontDoor, NativeFrontDoorServer
+    from cap_tpu.serve import protocol
+    from tools import capstat
+
+    failures = []
+    pools = [WorkerPool(1, keyset_spec="stub", ping_interval=0.3)
+             for _ in range(2)]
+    gw = None
+    try:
+        for i, p in enumerate(pools):
+            if not p.wait_all_ready(30):
+                return [f"native frontdoor: pool {i} did not come up"]
+        telemetry.enable()
+        telemetry.active().reset()
+        gw = NativeFrontDoorServer(FrontDoor(pools), refresh_s=0.1)
+        toks = [f"fdnat-smoke-{i}.ok" for i in range(16)]
+        s = socket.create_connection(gw.address, timeout=10)
+        try:
+            s.settimeout(10)
+            reader = protocol.FrameReader(s)
+            for _ in range(5):
+                protocol.send_request(s, toks)
+                ftype, entries = reader.recv_frame()
+                if ftype != protocol.T_VERIFY_RESP or len(
+                        entries) != len(toks):
+                    failures.append("native frontdoor: bad verify "
+                                    f"response ({ftype})")
+                if any(st != 0 for st, _ in entries):
+                    failures.append("native frontdoor: unexpected "
+                                    "reject in a clean burst")
+            # single-token repeats: single-owner frames, the splice
+            # path, and every repeat must hit the SAME owner's vcache
+            for _ in range(10):
+                protocol.send_request(s, [toks[0]])
+                ftype, entries = reader.recv_frame()
+                if entries[0][0] != 0:
+                    failures.append("native frontdoor: repeat burst "
+                                    "rejected")
+        finally:
+            s.close()
+        stats = gw.stats()
+        c = stats.get("counters") or {}
+        lookups = c.get("frontdoor.lookups", 0)
+        hits = c.get("frontdoor.affinity_hits", 0)
+        misses = c.get("frontdoor.affinity_misses", 0)
+        if lookups <= 0:
+            failures.append("native frontdoor: zero lookups after "
+                            "the burst")
+        if lookups != hits + misses:
+            failures.append(
+                f"native frontdoor: lookups {lookups} != hits {hits} "
+                f"+ misses {misses} (accounting drift)")
+        nat_lookups = c.get("frontdoor.native.lookups", 0)
+        nat_hits = c.get("frontdoor.native.hits", 0)
+        if nat_lookups != nat_hits:
+            failures.append(
+                f"native frontdoor: fast path lookups {nat_lookups} "
+                f"!= hits {nat_hits} (the fast path only takes live "
+                "primaries)")
+        if c.get("frontdoor.native.relays", 0) <= 0:
+            failures.append("native frontdoor: zero native relays — "
+                            "everything went slow-path")
+        if c.get("frontdoor.native.proto_errors", 0):
+            failures.append("native frontdoor: protocol errors in a "
+                            "clean run")
+        for p in pools:
+            for wid, (host, port) in sorted(p.obs_endpoints().items()):
+                wc = (capstat.scrape(f"{host}:{port}")["snapshot"]
+                      or {}).get("counters") or {}
+                if wc.get("vcache.stale_accepts", 0):
+                    failures.append(
+                        f"native frontdoor: stale_accepts moved on "
+                        f"{host}:{port}")
+        rendered = capstat.render_frontdoor(stats)
+        if "chain=native" not in rendered or "relays=" not in rendered:
+            failures.append("capstat.render_frontdoor missing the "
+                            "native chain line")
+    finally:
+        if gw is not None:
+            gw.close(deadline_s=10.0)
+        for p in pools:
+            p.close()
+    return failures
+
+
 def main() -> int:
     failures, py_info = run_fleet("python")
     if py_info["chains"] != {"python"}:
@@ -543,6 +652,22 @@ def main() -> int:
     # cache integrity under affinity routing)
     failures.extend(run_frontdoor_gate())
 
+    # …and the same topology through the NATIVE router chain (r21):
+    # exact lookup accounting with the C fast path folded in, native
+    # relays counted, zero stale accepts, capstat chain line
+    fd_native_ok = False
+    try:
+        from cap_tpu.serve import native_serve
+        fd_native_ok = bool(getattr(native_serve.load(), "cap_fd_ok",
+                                    False))
+    except Exception:  # noqa: BLE001 - no compiler on this host
+        fd_native_ok = False
+    if fd_native_ok:
+        failures.extend(run_native_frontdoor_gate())
+    else:
+        print("obs-smoke NOTE: native front-door runtime unavailable "
+              "— native router gate skipped", file=sys.stderr)
+
     if failures:
         for f in failures:
             print(f"obs-smoke FAIL: {f}", file=sys.stderr)
@@ -557,7 +682,10 @@ def main() -> int:
              "AND admission parity to the python run"
              if native_ok else "")
           + ", 2-pool front door routed clean (affinity hits, exact "
-            "lookup accounting, zero stale accepts)")
+            "lookup accounting, zero stale accepts)"
+          + (", native router chain routed clean (C fast path folded "
+             "into the exact invariant, relays counted, zero stale "
+             "accepts)" if fd_native_ok else ""))
     return 0
 
 
