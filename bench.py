@@ -478,6 +478,11 @@ def main() -> None:
                               "unit": "failed_verifies",
                               "vs_baseline": 0.0}))
             return
+    # flush the occupancy plane's interval accounting (r22) into the
+    # recorder before it is read — the engine dispatched in-process
+    from cap_tpu.obs import occupancy as _occupancy
+
+    _occupancy.publish(rec)
     telemetry.disable()
     all_counters = rec.counters()
     # Stage attribution (the observability layer's per-stage p50/95/99
@@ -638,6 +643,11 @@ def main() -> None:
         # trajectory carries its own breakdown now.
         "telemetry": {"stage_latency": stage_latency,
                       "device_gauges": pad_gauges},
+        # Pipeline-occupancy rollup for the measured window (r22):
+        # busy/wall ratio of the device dispatch timeline, per-family
+        # split, dispatch count — the BENCH record now says how FULL
+        # the pipeline was while the headline was set.
+        "occupancy": _occupancy.occupancy_from_counters(all_counters),
         "bytes_per_token": round(bytes_per_token, 1),
         "link_implied_ceiling_vps": round(link_ceiling, 1)
         if link_ceiling else None,

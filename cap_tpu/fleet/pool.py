@@ -442,6 +442,8 @@ class WorkerPool:
         one recorder that had observed every worker's samples — not a
         lossy average of per-worker quantiles.
         """
+        from ..obs import occupancy as _occupancy
+
         workers = self.stats()
         merged = telemetry.merge_snapshots(
             [(s or {}).get("snapshot") for s in workers.values()])
@@ -452,6 +454,11 @@ class WorkerPool:
                 "series": telemetry.summarize_snapshot(merged),
                 "counters": merged["counters"],
                 "gauges": merged["gauges"],
+                # fleet occupancy from the EXACT merged counters:
+                # sum-busy / sum-wall = worker-weighted mean (None
+                # until some worker's engine dispatched)
+                "occupancy": _occupancy.occupancy_from_counters(
+                    merged["counters"]),
                 "queued_tokens": sum(
                     (s or {}).get("queued_tokens", 0)
                     for s in workers.values()),

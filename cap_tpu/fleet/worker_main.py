@@ -144,9 +144,15 @@ class StubKeySet:
             return default
 
     def verify_batch(self, tokens):
+        from ..obs import occupancy as _occupancy
+
         sleep_s = self._batch_s + self._token_s * len(tokens)
-        if sleep_s > 0.0:
-            time.sleep(sleep_s)      # models device occupancy (no GIL)
+        # The simulated device time is a real dispatch-level busy
+        # interval on the occupancy plane — the stubbed-device
+        # occupancy baseline (PERF.md §Round 22) comes from here.
+        with _occupancy.interval("stub"):
+            if sleep_s > 0.0:
+                time.sleep(sleep_s)  # models device occupancy (no GIL)
         return self._results(tokens)
 
     def __getattr__(self, name):
@@ -163,14 +169,22 @@ class StubKeySet:
         raise AttributeError(name)
 
     def _verify_batch_async(self, tokens):
+        from ..obs import occupancy as _occupancy
+
         done_at = time.monotonic() + self._batch_s \
             + self._token_s * len(tokens)
         results = self._results(tokens)
+        # pipeline=1 arm: the busy interval spans dispatch → collect
+        # return, so two in-flight stub batches overlap on the plane
+        # exactly like a real device's H2D/compute overlap (the union
+        # accounting never double-counts the overlap window).
+        occ_t0 = _occupancy.begin()
 
         def collect():
             remaining = done_at - time.monotonic()
             if remaining > 0.0:
                 time.sleep(remaining)   # occupancy overlaps next prep
+            _occupancy.end("stub", occ_t0)
             return results
 
         return collect
@@ -384,6 +398,7 @@ def main(argv=None) -> int:
           + (f" epoch={epoch}" if epoch is not None else "")
           + f" serve_chain={worker.serve_chain}"
           + f" transport={worker.transport}"
+          + f" tel={int(telemetry.active() is not None)}"
           + (f" frontdoor_chain={fd_chain}" if fd_chain else ""),
           flush=True)
 

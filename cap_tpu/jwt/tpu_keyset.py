@@ -34,6 +34,7 @@ from ..errors import (
     NilParameterError,
 )
 from ..obs import decision as _decision
+from ..obs import occupancy as _occupancy
 from . import algs
 from .jose import ParsedJWS, is_json_form, parse_jws
 from .jwk import JWK
@@ -209,6 +210,16 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
     covered = np.zeros(pb.n, bool)
     fns = []
 
+    def occ_fn(fam: str, fn):
+        """Each resident closure is an engine dispatch site: its
+        re-dispatch records a per-family busy interval into the
+        occupancy plane (no-op while telemetry is off, so the timed
+        bench path is untouched)."""
+        def dispatch_fn():
+            with _occupancy.interval(fam):
+                return fn()
+        return dispatch_fn
+
     def dev_put(rec):
         import jax
 
@@ -263,7 +274,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
                 return jnp.sum(verify(table, rec, hash_name,
                                       mesh=ks._mesh).astype(jnp.int32))
 
-            fns.append((len(chunk), fn))
+            fns.append((len(chunk), occ_fn("rsa", fn)))
 
     for alg_name, crv in _ES.items():
         idx = np.nonzero(pb.alg_id == alg_ids[alg_name])[0]
@@ -300,7 +311,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
                 ladder=ks._ec_ladder)
             return jnp.sum((ok_dev | deg_dev).astype(jnp.int32))
 
-        fns.append((len(idx), fn))
+        fns.append((len(idx), occ_fn("ec", fn)))
 
     idx = np.nonzero(pb.alg_id == alg_ids[algs.EdDSA])[0]
     if len(idx) > 0:
@@ -331,7 +342,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             return jnp.sum(tpued.verify_ed_packed_pending(
                 table, rec, mesh=ks._mesh).astype(jnp.int32))
 
-        fns.append((len(idx), fn))
+        fns.append((len(idx), occ_fn("ed", fn)))
 
     for pset in sorted(getattr(ks._tables, "mldsa_tables", {})):
         from ..tpu import mldsa as tpumldsa
@@ -385,7 +396,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
                     p.gamma2, p.tau, p.w1_bits)
                 return jnp.sum(ok.astype(jnp.int32))
 
-            fns.append((len(idx), fn))
+            fns.append((len(idx), occ_fn("mldsa", fn)))
             continue
         prep = tpumldsa._PreppedChunk(table, sigs, msgs,
                                       rows.astype(np.int32), pad)
@@ -419,7 +430,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
             eq = jnp.all(w1 == ed, axis=(1, 2)) & (md != 0)
             return jnp.sum(eq.astype(jnp.int32))
 
-        fns.append((len(idx), fn))
+        fns.append((len(idx), occ_fn("mldsa", fn)))
 
     for pset in sorted(getattr(ks._tables, "slhdsa_tables", {})):
         from ..tpu import slhdsa as tpuslh
@@ -461,7 +472,7 @@ def resident_dispatchers(ks: "TPUBatchKeySet", tokens: Sequence[str],
                                    *sdevs)
             return jnp.sum(ok.astype(jnp.int32))
 
-        fns.append((len(idx), fn))
+        fns.append((len(idx), occ_fn("slhdsa", fn)))
 
     if not covered.all():
         raise InvalidParameterError(
@@ -1051,6 +1062,12 @@ class TPUBatchKeySet(KeySet):
         # would overestimate the link (the sync would block briefly on
         # an already-drained wire).
         t_dispatch = time.perf_counter()
+        # Occupancy plane: the whole batch counts as ONE dispatch-level
+        # busy interval spanning dispatch start → collect end (work in
+        # flight); the per-family enqueue slices below are recorded
+        # with dispatch=False so they feed lane-share accounting
+        # without inflating device.dispatches or idle-gap records.
+        occ_t0 = _occupancy.begin()
         from .jose import normalize_batch
 
         tokens, specials = normalize_batch(tokens)
@@ -1098,27 +1115,33 @@ class TPUBatchKeySet(KeySet):
             runner(alg_name, idx)
 
         def run_rs(alg_name: str, idx: np.ndarray) -> None:
-            self._run_rsa_packed("rs", _RS[alg_name], idx, pb,
-                                 packed_parts, packed_meta, pending,
-                                 slow, results, stats, tables)
+            with _occupancy.interval("rsa", dispatch=False):
+                self._run_rsa_packed("rs", _RS[alg_name], idx, pb,
+                                     packed_parts, packed_meta, pending,
+                                     slow, results, stats, tables)
 
         def run_ps(alg_name: str, idx: np.ndarray) -> None:
             # Every PS* family rides the packed single-transfer path
             # with the device-side EMSA-PSS check (SHA-256 via
             # tpu/sha256.py, SHA-384/512 via the u32-pair engine in
             # tpu/sha512.py) — no EM bytes return to the host.
-            self._run_rsa_packed("ps", _PS[alg_name], idx, pb,
-                                 packed_parts, packed_meta,
-                                 pending, slow, results, stats, tables)
+            with _occupancy.interval("rsa", dispatch=False):
+                self._run_rsa_packed("ps", _PS[alg_name], idx, pb,
+                                     packed_parts, packed_meta,
+                                     pending, slow, results, stats,
+                                     tables)
 
         def run_es(alg_name: str, idx: np.ndarray) -> None:
-            self._run_ec_packed(alg_name, idx, pb, packed_parts,
-                                packed_meta, pending, slow, results,
-                                stats, tables)
+            with _occupancy.interval("ec", dispatch=False):
+                self._run_ec_packed(alg_name, idx, pb, packed_parts,
+                                    packed_meta, pending, slow, results,
+                                    stats, tables)
 
         def run_ed(alg_name: str, idx: np.ndarray) -> None:
-            self._run_ed_packed(idx, pb, packed_parts, packed_meta,
-                                pending, slow, results, stats, tables)
+            with _occupancy.interval("ed", dispatch=False):
+                self._run_ed_packed(idx, pb, packed_parts, packed_meta,
+                                    pending, slow, results, stats,
+                                    tables)
 
         # Post-quantum first: the deepest device programs (the
         # SLH-DSA hash forest, then the ML-DSA NTT network) go on the
@@ -1127,13 +1150,15 @@ class TPUBatchKeySet(KeySet):
         for pset in sorted(tables.slhdsa_tables):
             idx = _mldsa_alg_indices(pb, ok, pset)
             if len(idx):
-                self._run_slhdsa_packed(pset, idx, pb, pending, slow,
-                                        stats, tables)
+                with _occupancy.interval("slhdsa", dispatch=False):
+                    self._run_slhdsa_packed(pset, idx, pb, pending,
+                                            slow, stats, tables)
         for pset in sorted(tables.mldsa_tables):
             idx = _mldsa_alg_indices(pb, ok, pset)
             if len(idx):
-                self._run_mldsa_packed(pset, idx, pb, pending, slow,
-                                       stats, tables)
+                with _occupancy.interval("mldsa", dispatch=False):
+                    self._run_mldsa_packed(pset, idx, pb, pending,
+                                           slow, stats, tables)
         for a, crv in _ES.items():
             if crv in tables.ec_tables:
                 run_family(a, run_es)
@@ -1148,7 +1173,7 @@ class TPUBatchKeySet(KeySet):
         return dict(pb=pb, n=n, ok=ok, results=results, slow=slow,
                     pending=pending, packed_parts=packed_parts,
                     packed_meta=packed_meta, stats=stats,
-                    t_dispatch=t_dispatch, tables=tables,
+                    t_dispatch=t_dispatch, occ_t0=occ_t0, tables=tables,
                     special_payloads=special_payloads)
 
     def _collect_batch(self, state: dict) -> List[Any]:
@@ -1240,6 +1265,10 @@ class TPUBatchKeySet(KeySet):
                 "tpu", results, families=fams,
                 latency_s=(time.perf_counter() - t_dispatch
                            if t_dispatch is not None else None))
+        # Close the batch's dispatch-level busy interval: dispatch
+        # start → collect end is the window this batch held device
+        # work in flight (the occupancy numerator).
+        _occupancy.end("flight", state.get("occ_t0"))
         return results
 
     def _observe_wire(self, state: dict) -> None:

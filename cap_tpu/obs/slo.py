@@ -30,6 +30,15 @@ Rule kinds
     lifetime totals (documented limitation — windowed quantiles would
     need bucket-delta history).
 
+``occupancy_floor min <fraction>``
+    Device occupancy (``device.busy_us / device.wall_us`` from the r22
+    occupancy plane, reset-clamped window deltas) must stay at or
+    above the floor **while under load** — a window with zero
+    ``device.dispatches`` is idle and never burns (an idle fleet is
+    cheap, not broken). Breaches only when EVERY loaded window is
+    below the floor, same multi-window discipline as ratio rules.
+    ROADMAP #5's acceptance gate is this rule at ``min 0.9``.
+
 Any rule whose names contain the literal ``tenant.*`` is a
 **per-tenant template**: at evaluation time it expands into one
 concrete rule per observed tenant id (``tenant.<t>`` substituted
@@ -102,6 +111,11 @@ tenant_reject_ratio   ratio decision.serve.tenant.*.reject / decision.serve.tena
 # flooder breaches, quiet tenants have zero throttles and stay
 # green), and the pool autoscaler reads this burn as its shed signal.
 tenant_throttle_ratio ratio decision.serve.tenant.*.reject.throttled / decision.serve.tenant.*.tokens max 0.5 burn 1.5
+# Occupancy (r22): sustained device idling UNDER LOAD is throughput
+# left on the table. Off by default — the discrete-dispatch baseline
+# (docs/PERF.md §Round 22) sits far below ROADMAP #5's ≥90% gate until
+# continuous batching lands; uncomment (and tighten toward 0.9) then.
+#occupancy       occupancy_floor min 0.05
 """
 
 
@@ -169,6 +183,12 @@ def parse_rules(text: str) -> List[SLORule]:
                 rules.append(SLORule(name, "quantile", series=toks[2],
                                      quantile=toks[3],
                                      max_value=float(toks[5])))
+            elif kind == "occupancy_floor":
+                # <name> occupancy_floor min <fraction>
+                if toks[2] != "min":
+                    raise IndexError
+                rules.append(SLORule(name, "occupancy_floor",
+                                     max_value=float(toks[3])))
             else:
                 raise SLOError(
                     f"line {lineno}: unknown rule kind {kind!r}")
@@ -339,6 +359,23 @@ class SLOEngine:
             res["detail"] = (f"{rule.num}/{rule.den} max "
                              f"{rule.max_value:g} "
                              f"burn>{rule.burn_threshold:g}")
+        elif rule.kind == "occupancy_floor":
+            # under-load discipline: an idle window (no dispatches)
+            # never burns; deltas are reset-clamped per the r13 stance
+            loaded = []
+            for label, counters in deltas:
+                wall = max(0, counters.get("device.wall_us", 0))
+                busy = max(0, counters.get("device.busy_us", 0))
+                disp = max(0, counters.get("device.dispatches", 0))
+                if wall <= 0 or disp <= 0:
+                    res["windows"][label] = "idle"
+                    continue
+                occ = min(1.0, busy / wall)
+                res["windows"][label] = round(occ, 4)
+                loaded.append(occ < rule.max_value)
+            res["ok"] = not (loaded and all(loaded))
+            res["detail"] = (f"device.occupancy min "
+                             f"{rule.max_value:g} (under load)")
         elif rule.kind == "quantile":
             s = summary.get(rule.series)
             v = s[rule.quantile] if s else 0.0

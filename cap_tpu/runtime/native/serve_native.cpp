@@ -266,6 +266,10 @@ struct Req {
   uint8_t trace_len = 0;
   char trace[MAX_TRACE_BYTES];
   double t_recv = 0.0;
+  // reader-side enqueue stamp (steady clock == CLOCK_MONOTONIC ==
+  // Python time.monotonic() on Linux): the occupancy plane (r22)
+  // measures queue.ring_wait_s as drain-side monotonic() - t_enq.
+  double t_enq = 0.0;
   std::vector<int64_t> offs;  // entry boundaries into blob (n+1)
   std::string blob;           // concatenated entry bytes
   // telemetry plane (when attached): per-token family index (-1 =
@@ -359,6 +363,10 @@ struct Handle {
   // per-token throttle mask of the LAST drain (cap_serve_drain_thr),
   // token-aligned like last_fams; single-consumer.
   std::vector<uint8_t> last_thr;
+  // per-REQUEST ring-enqueue stamps of the LAST drain (r22 occupancy
+  // plane, cap_serve_drain_enq): one double per drained request, in
+  // drain order; single-consumer like last_thr.
+  std::vector<double> last_enq;
   std::mutex mu;  // guards the two cvs' sleep/wake protocol
   std::condition_variable cv_data;   // drain thread sleeps here
   std::condition_variable cv_space;  // producers sleep here when full
@@ -474,6 +482,7 @@ static bool handle_frame(const std::shared_ptr<Conn>& c,
       r->seq = c->assigned++;
     }
     r->t_recv = wall_now();
+    r->t_enq = mono_now();
     r->trace_len = (uint8_t)p.trace_len;
     if (p.trace_len)
       std::memcpy(r->trace, base + p.trace_off, (size_t)p.trace_len);
@@ -972,6 +981,7 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
   bool want_digests = h->digests_on.load(std::memory_order_relaxed);
   if (want_digests) h->last_digests.clear();
   h->last_thr.clear();
+  h->last_enq.clear();
   bool stop_drain = false;
   while (!stop_drain) {
     Req* r = h->carry;
@@ -1022,6 +1032,7 @@ int64_t cap_serve_drain(void* hv, int64_t min_tokens, int64_t max_tokens,
     m[5] = r->retry_ms;  // admission retry-after hint (0 = none)
     req_seq[n_reqs] = r->seq;
     req_t0[n_reqs] = r->t_recv;
+    h->last_enq.push_back(r->t_enq);
     if (r->trace_len)
       std::memcpy(trace_buf + (size_t)n_reqs * MAX_TRACE_BYTES, r->trace,
                   r->trace_len);
@@ -1374,6 +1385,32 @@ int64_t cap_serve_drain_thr(void* hv, uint8_t* out,
   int64_t n = (int64_t)h->last_thr.size();
   if (n > max_tokens) n = max_tokens;
   if (n > 0) std::memcpy(out, h->last_thr.data(), (size_t)n);
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// r22 occupancy-plane ABI. Probed as one group by the binding
+// (_OCC_SYMBOLS); a stale .so missing either symbol degrades to
+// inferred ring-wait with a counted fallback
+// (serve.native.occ_fallbacks) — never wrong numbers, just coarser.
+// ---------------------------------------------------------------------------
+
+// Layout handshake: [abi version, doubles per drained request]. The
+// binding disarms the plane on any mismatch.
+void cap_serve_layout_occ(int32_t* out) {
+  out[0] = 1;  // version
+  out[1] = 1;  // one t_enq double per request
+}
+
+// Per-REQUEST reader-side enqueue stamps (steady-clock seconds) of the
+// LAST cap_serve_drain call, in drain order — request-aligned with
+// req_seq/req_t0. Single-consumer, like the others.
+int64_t cap_serve_drain_enq(void* hv, double* out, int64_t max_reqs) {
+  Handle* h = (Handle*)hv;
+  int64_t n = (int64_t)h->last_enq.size();
+  if (n > max_reqs) n = max_reqs;
+  if (n > 0)
+    std::memcpy(out, h->last_enq.data(), (size_t)n * sizeof(double));
   return n;
 }
 

@@ -34,13 +34,14 @@ _DISPATCHER_DONE = object()
 
 class _Pending:
     __slots__ = ("tokens", "results", "event", "ts", "trace", "t0_wall",
-                 "traces", "on_done", "digests")
+                 "traces", "on_done", "digests", "handoff")
 
     def __init__(self, tokens: Sequence[str],
                  trace: Optional[str] = None,
                  traces: Optional[Sequence[str]] = None,
                  on_done=None,
-                 digests: Optional[Sequence[Optional[bytes]]] = None):
+                 digests: Optional[Sequence[Optional[bytes]]] = None,
+                 handoff: bool = False):
         self.tokens = tokens
         # Per-token sha256[:16] digests, when the submitter already
         # has them (the serve cache-consult path; the native chain's
@@ -64,6 +65,11 @@ class _Pending:
         # callback — no per-token (or per-request) callbacks anywhere.
         self.traces: Sequence[str] = traces or ()
         self.on_done = on_done
+        # Ring-chunk handoff marker: a size-triggered flush whose sole
+        # member is one handed-off chunk classifies as flush reason
+        # "handoff" (the native chain's drained-chunk shape) rather
+        # than "size".
+        self.handoff = handoff
 
 
 class AdaptiveBatcher:
@@ -134,6 +140,13 @@ class AdaptiveBatcher:
         self._queue: List[_Pending] = []
         self._queued_tokens = 0
         self._closed = False
+        # Flush-reason attribution + last-flush lifecycle (occupancy
+        # plane, docs/OBSERVABILITY.md §Occupancy plane). Written only
+        # from the dispatcher/collector threads; reads take racy-but-
+        # consistent dict copies (stats()).
+        self._flush_reasons: Dict[str, int] = {}
+        self._last_flush: Dict[str, Any] = {}
+        self._gauges_decayed = False
         # 2-deep pipeline: one batch draining in the collector while
         # the dispatcher preps/dispatches the next. TWO slots, each
         # acquired BEFORE dispatching and released when the collector
@@ -190,7 +203,8 @@ class AdaptiveBatcher:
         are ready — the caller never parks a thread per submission and
         never registers per-token callbacks."""
         return self._admit(_Pending(list(tokens), traces=traces,
-                                    on_done=on_done, digests=digests))
+                                    on_done=on_done, digests=digests,
+                                    handoff=True))
 
     def _admit(self, p: "_Pending") -> "_Pending":
         if not p.tokens:
@@ -281,6 +295,21 @@ class AdaptiveBatcher:
         return {"queued_tokens": queued,
                 "inflight_batches": self._inflight.qsize()}
 
+    def stats(self) -> Dict[str, Any]:
+        """Depth plus occupancy-plane extras: cumulative flush-reason
+        counts and the last flush's lifecycle durations. ADDITIVE —
+        the keys are absent until the first flush, so STATS frames of
+        a batcher that never flushed are byte-identical to before this
+        surface existed."""
+        out: Dict[str, Any] = self.depth()
+        reasons = dict(self._flush_reasons)
+        if reasons:
+            out["flush_reasons"] = reasons
+        last = dict(self._last_flush)
+        if last:
+            out["last_flush"] = last
+        return out
+
     def close(self, deadline_s: float = 120.0) -> None:
         with self._cv:
             self._closed = True
@@ -307,6 +336,13 @@ class AdaptiveBatcher:
     def _run_loop(self) -> None:
         while True:
             with self._cv:
+                if not self._have_pending() and not self._gauges_decayed:
+                    # Staleness fix: an emptied queue decays its depth
+                    # gauges to 0 instead of freezing the last flush's
+                    # values on the scrape surface forever.
+                    telemetry.gauge("batcher.queued_tokens", 0)
+                    telemetry.gauge("batcher.fill_ratio", 0.0)
+                    self._gauges_decayed = True
                 while not self._have_pending() and not self._closed:
                     self._cv.wait()
                 if self._closed and not self._have_pending():
@@ -320,27 +356,55 @@ class AdaptiveBatcher:
                     if remaining <= 0:
                         break
                     self._cv.wait(timeout=remaining)
+                # Flush-reason attribution, decided while the queue
+                # state that caused the flush is still visible.
+                if self._queued_tokens >= self._target:
+                    reason = "size"
+                elif self._closed:
+                    reason = "close"
+                else:
+                    reason = "timeout"
                 batch, n = self._take_batch()
                 self._queued_tokens -= n
                 if n:
                     self._cv.notify_all()   # wake admission waiters
             if not batch:
                 continue
-            self._flush(batch, n)
+            if reason == "size" and len(batch) == 1 and batch[0].handoff:
+                # One drained ring chunk alone met the size target —
+                # the native chain's characteristic flush shape.
+                reason = "handoff"
+            elif reason == "close" and n >= self._target:
+                reason = "drain"       # full batch while closing
+            self._flush(batch, n, reason)
 
-    def _flush(self, batch: List[_Pending], n: int) -> None:
+    def _flush(self, batch: List[_Pending], n: int,
+               reason: str = "size") -> None:
+        t_flush = time.monotonic()
         tokens: List[str] = []
         for p in batch:
             tokens.extend(p.tokens)
         telemetry.count("batcher.flushes")
+        telemetry.count(f"batcher.flush.{reason}")
+        self._flush_reasons[reason] = \
+            self._flush_reasons.get(reason, 0) + 1
         telemetry.observe("batcher.batch_size", float(n))
         # Depth/fill gauges at flush time: what the exposition surface
         # shows as the batcher's current operating point.
         telemetry.gauge("batcher.queued_tokens", self.depth()["queued_tokens"])
+        telemetry.gauge("batcher.fill_ratio", n / self._target)
+        self._gauges_decayed = False
         telemetry.observe("batcher.fill_ratio", n / self._target)
         now_wall = time.time()
-        telemetry.observe("batcher.fill_wait_s",
-                          time.monotonic() - batch[0].ts)
+        telemetry.observe("batcher.fill_wait_s", t_flush - batch[0].ts)
+        # Stage waterfall (docs/OBSERVABILITY.md §Occupancy plane):
+        # per-member queueing delay submit → flush start.
+        for p in batch:
+            telemetry.observe("queue.batcher_wait_s", t_flush - p.ts)
+        lf: Dict[str, Any] = {"t_wall": now_wall, "reason": reason,
+                              "batch_size": n,
+                              "batcher_wait_s": t_flush - batch[0].ts}
+        self._last_flush = lf
         # Per-request FILL span (submit -> flush start), then run the
         # flush/dispatch under the union of member traces so engine
         # spans (dispatch.<family>.*) attach to every traced request
@@ -395,6 +459,11 @@ class AdaptiveBatcher:
         dispatch = getattr(self._keyset, "verify_batch_async", None)
         if dispatch is not None:
             self._slot.acquire()          # backpressure BEFORE dispatch
+            # flush → dispatch gap: dominated by _slot.acquire, i.e.
+            # the 2-deep pipeline's backpressure on the device.
+            t_dispatch = time.monotonic()
+            telemetry.observe("queue.dispatch_gap_s", t_dispatch - t_flush)
+            lf["dispatch_gap_s"] = t_dispatch - t_flush
             try:
                 with telemetry.trace_scope(traces), \
                         telemetry.span(telemetry.SPAN_BATCHER_DISPATCH):
@@ -403,8 +472,11 @@ class AdaptiveBatcher:
                 self._slot.release()
                 self._distribute(batch, [e] * n)
                 return
-            self._inflight.put((batch, n, collect, expand))
+            self._inflight.put((batch, n, collect, expand, t_dispatch, lf))
             return
+        t_dispatch = time.monotonic()
+        telemetry.observe("queue.dispatch_gap_s", t_dispatch - t_flush)
+        lf["dispatch_gap_s"] = t_dispatch - t_flush
         try:
             with telemetry.trace_scope(traces), \
                     telemetry.span(telemetry.SPAN_BATCHER_FLUSH):
@@ -416,6 +488,9 @@ class AdaptiveBatcher:
                 results = self._expand(raw, expand)
         except Exception as e:  # noqa: BLE001 - fan the failure out
             results = [e] * n
+        exec_s = time.monotonic() - t_dispatch
+        telemetry.observe("device.exec_s", exec_s)
+        lf["exec_s"] = exec_s
         self._distribute(batch, results)
 
     @staticmethod
@@ -440,7 +515,7 @@ class AdaptiveBatcher:
             item = self._inflight.get()
             if item is _DISPATCHER_DONE:
                 return
-            batch, n_tokens, collect, expand = item
+            batch, n_tokens, collect, expand, t_dispatch, lf = item
             traces = [tid for p in batch
                       for tid in (p.traces
                                   or ((p.trace,) if p.trace else ()))]
@@ -452,6 +527,11 @@ class AdaptiveBatcher:
                 results = [e] * n_tokens
             finally:
                 self._slot.release()
+            # dispatch → collect-done: the device-execution stage of
+            # the waterfall (includes the in-flight overlap window).
+            exec_s = time.monotonic() - t_dispatch
+            telemetry.observe("device.exec_s", exec_s)
+            lf["exec_s"] = exec_s
             self._distribute(batch, results)
 
     @staticmethod

@@ -90,6 +90,12 @@ _SCHED_SYMBOLS = ("cap_serve_layout_sched", "cap_serve_set_fair",
                   "cap_drr_create", "cap_drr_set_weight",
                   "cap_drr_push", "cap_drr_pop", "cap_drr_destroy")
 
+# Occupancy-plane symbols (r22) are OPTIONAL as a group: a stale .so
+# still serves — queue.ring_wait_s just can't be measured from the
+# reader-side enqueue stamps, and every drain that wanted them counts
+# serve.native.occ_fallbacks (loud, never wrong).
+_OCC_SYMBOLS = ("cap_serve_layout_occ", "cap_serve_drain_enq")
+
 # Native relay front-door symbols (frontdoor_native.cpp, r21) are
 # OPTIONAL as a group: a stale .so degrades the front-door gate to
 # the pure-Python router with a counted fallback
@@ -215,6 +221,7 @@ def load() -> ctypes.CDLL:
         lib.cap_shm_ok = _setup_shm(lib)
         lib.cap_sched_ok = _setup_sched(lib)
         lib.cap_fd_ok = _setup_fd(lib)
+        lib.cap_occ_ok = _setup_occ(lib)
         _lib = lib
         return lib
 
@@ -259,6 +266,21 @@ def _setup_sched(lib: ctypes.CDLL) -> bool:
     lib.cap_serve_layout_sched(layout.ctypes.data_as(_i32p))
     want = (_dec.TENANT_CAP + 1, _dec.TENANT_CAP, _dec.N_TENANT, 15)
     return tuple(int(v) for v in layout) == want
+
+
+def _setup_occ(lib: ctypes.CDLL) -> bool:
+    """Type the occupancy-plane symbols and verify the per-request
+    stamp layout; False (inferred ring-wait, counted fallback) on a
+    stale .so or any layout drift."""
+    if not all(hasattr(lib, s) for s in _OCC_SYMBOLS):
+        return False
+    lib.cap_serve_layout_occ.argtypes = [_i32p]
+    lib.cap_serve_drain_enq.restype = ctypes.c_int64
+    lib.cap_serve_drain_enq.argtypes = [ctypes.c_void_p, _f64p,
+                                        ctypes.c_int64]
+    layout = np.zeros(2, np.int32)
+    lib.cap_serve_layout_occ(layout.ctypes.data_as(_i32p))
+    return tuple(int(v) for v in layout) == (1, 1)
 
 
 def _setup_fd(lib: ctypes.CDLL) -> bool:
@@ -820,6 +842,17 @@ class NativeServeChain:
             except Exception:  # noqa: BLE001 - fall back, visibly
                 telemetry.count("serve.native.obs_fallbacks")
                 self._plane = None
+        # Occupancy plane (r22): when the library carries the occ
+        # group the drain copies the reader-side enqueue stamps out
+        # next to req_t0 and queue.ring_wait_s is MEASURED (steady
+        # clock both sides). A stale .so degrades to no ring-wait
+        # histogram with a per-drain serve.native.occ_fallbacks count.
+        self._occ_native = bool(getattr(self._lib, "cap_occ_ok", False))
+        self._occ_n = 0
+        # conn ids already attributed to a tenant (r22 connection
+        # plane); bounded — a clear on overflow re-attributes at most
+        # one extra count per long-lived conn
+        self._conn_tenants_seen: set = set()
         # Tenant-fair DRR scheduling + token-bucket admission (r20):
         # armed NATIVELY (the C++ readers police, the drain pops DRR)
         # when the library carries the sched group, else the counted
@@ -887,6 +920,9 @@ class NativeServeChain:
         # admission: per-token throttle verdicts of the last drain
         # (1 = over budget — answer with pushback, never verify)
         self._thr_buf = np.zeros(max_tokens, np.uint8)
+        # occupancy: per-REQUEST reader-side enqueue stamps (steady-
+        # clock seconds) of the last drain
+        self._enq_buf = np.zeros(max_reqs, np.float64)
 
     # -- connection handoff ------------------------------------------------
 
@@ -986,6 +1022,8 @@ class NativeServeChain:
             "serve.native.protocol_errors": int(c(h, CTR_PROTO_ERR)),
             "serve.native.pongs": int(c(h, CTR_PONGS)),
             "serve.native.dropped_posts": int(c(h, CTR_DROPPED_POSTS)),
+            "serve.native.connections_closed":
+                int(c(h, CTR_CONNS_CLOSED)),
         }
         if getattr(self._lib, "cap_shm_ok", False):
             # shm-transport slots exist in this .so (additive; a stale
@@ -1076,6 +1114,13 @@ class NativeServeChain:
                 lib.cap_serve_drain_thr(
                     h, self._thr_buf.ctypes.data_as(_u8p),
                     self._max_tokens)
+            self._occ_n = 0
+            if self._occ_native:
+                self._occ_n = int(lib.cap_serve_drain_enq(
+                    h, self._enq_buf.ctypes.data_as(_f64p),
+                    self._max_reqs))
+            elif telemetry.active() is not None:
+                telemetry.count("serve.native.occ_fallbacks")
             telemetry.gauge("serve.native.ring_depth",
                             float(self.ring_depth()))
             try:
@@ -1086,6 +1131,15 @@ class NativeServeChain:
     def _process(self, n_reqs: int) -> None:
         t_drain = time.time()
         n_toks = int(self._out_counts[1])
+        rec = telemetry.active()
+        if rec is not None and self._occ_n:
+            # measured ring wait: drain-side monotonic minus the
+            # reader-side enqueue stamp (same CLOCK_MONOTONIC both
+            # sides — see serve_native.cpp Req.t_enq)
+            waits = time.monotonic() \
+                - self._enq_buf[: min(self._occ_n, n_reqs)]
+            for w in waits:
+                rec.observe("queue.ring_wait_s", max(0.0, float(w)))
         # same accounting names the Python chain counts per frame, so
         # pool.stats_merged / bench per-worker attribution are
         # chain-agnostic (control records ride in n_reqs but carry no
@@ -1151,6 +1205,25 @@ class NativeServeChain:
                     plane.fix_misses(tokens, fams, kids, tens)
             else:
                 fams = kids = tens = None
+            if tens is not None:
+                # connection plane (r22): attribute each conn to its
+                # FIRST verify frame's tenant, once — same counter the
+                # python chain's reader thread writes
+                labels = None
+                tb = 0
+                for k in range(n):
+                    nent = int(meta[k * 6 + 3])
+                    cid = int(meta[k * 6 + 1])
+                    if nent and cid not in self._conn_tenants_seen:
+                        if len(self._conn_tenants_seen) >= 1 << 20:
+                            self._conn_tenants_seen.clear()
+                        self._conn_tenants_seen.add(cid)
+                        if labels is None:
+                            labels = _decision.TENANTS.labels()
+                        label = labels.get(int(tens[tb]),
+                                           _decision.TENANT_NONE)
+                        telemetry.count(f"serve.tenant.{label}.conns")
+                    tb += nent
             traces: List[tuple] = []
             for k in range(n):
                 tl = int(meta[k * 6 + 4])

@@ -162,6 +162,16 @@ class ObsServer:
             return {}
 
     def _snapshot(self, rec) -> Dict[str, Any]:
+        if rec is not None:
+            # flush the occupancy plane's counter deltas + window
+            # gauges BEFORE the snapshot is taken, so every scrape is
+            # self-contained (r22; no-op until an engine dispatches)
+            try:
+                from ..obs import occupancy as _occupancy
+
+                _occupancy.publish(rec)
+            except Exception:  # noqa: BLE001 - never 500 a scrape
+                pass
         snap = rec.snapshot() if rec is not None else {}
         if self._snapshot_extra is not None:
             try:

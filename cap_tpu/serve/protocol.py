@@ -656,12 +656,16 @@ class FrameReader:
     client already own their sockets' read sides exclusively).
     """
 
-    __slots__ = ("_sock", "_buf", "_off")
+    __slots__ = ("_sock", "_buf", "_off", "hwm")
 
     def __init__(self, sock: socket.socket):
         self._sock = sock
         self._buf = b""
         self._off = 0
+        # buffered-bytes high-water mark (r22 connection plane): the
+        # most bytes this reader ever held at once — the per-conn
+        # memory figure ROADMAP #3's C1M ingest must bound
+        self.hwm = 0
 
     def _take(self, n: int) -> bytes:
         buf, off = self._buf, self._off
@@ -677,6 +681,8 @@ class FrameReader:
             buf = b"".join(parts)
             off = 0
             self._buf = buf
+            if len(buf) > self.hwm:
+                self.hwm = len(buf)
         self._off = off + n
         return buf[off:off + n]
 

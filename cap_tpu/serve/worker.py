@@ -17,6 +17,7 @@ from typing import Optional, Tuple
 
 from .. import telemetry
 from ..obs import decision as _decision
+from ..obs import occupancy as _occupancy
 from . import protocol
 from . import shm_ring as _shm
 from . import vcache as _vcache
@@ -229,6 +230,10 @@ class VerifyWorker:
                 host=host if uds_path is None else "127.0.0.1",
                 port=obs_port, extra=self._obs_gauges,
                 snapshot_extra=self._native_obs_snapshot)
+        # connection plane (r22): live python-chain connections (the
+        # native chain's live count derives from its own counters)
+        self._conns_live = 0
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="cap-tpu-accept")
         self._accept_thread.start()
@@ -372,6 +377,9 @@ class VerifyWorker:
         return {}
 
     def _obs_gauges(self) -> dict:
+        # flush the occupancy plane's counter deltas + window gauges
+        # into the recorder so this scrape sees device.occupancy fresh
+        _occupancy.publish()
         d = self._batcher.depth()
         out = {"batcher.queued_tokens": d["queued_tokens"],
                "batcher.inflight_batches": d["inflight_batches"],
@@ -393,6 +401,14 @@ class VerifyWorker:
                 self._native.ring_hwm(reset=True))
             out["serve.native.obs_plane"] = (
                 1.0 if self._native.obs_plane is not None else 0.0)
+        # connection plane (r22): live conns, whichever chain accepts
+        if self._native is not None:
+            nc = self._native.counters()
+            out["serve.conns_live"] = float(
+                nc.get("serve.native.connections", 0)
+                - nc.get("serve.native.connections_closed", 0))
+        else:
+            out["serve.conns_live"] = float(self._conns_live)
         epoch = self.key_epoch
         if epoch is not None:
             out["keyplane.epoch"] = float(epoch)
@@ -455,6 +471,9 @@ class VerifyWorker:
         and inflight come straight from the batcher either way.
         """
         rec = telemetry.active()
+        # occupancy counters flush into the recorder BEFORE the
+        # snapshot below, so STATS / pool merges carry them
+        _occupancy.publish(rec)
         obs = self.obs_address
         native_counters = (self._native.counters()
                            if self._native is not None else {})
@@ -471,7 +490,9 @@ class VerifyWorker:
                 {"series": plane_snap.get("series") or {}})}
         return {
             "pid": os.getpid(),
-            **self._batcher.depth(),
+            # depth plus — additively, only once flushes happened —
+            # the r22 flush-reason mix and last-flush lifecycle
+            **self._batcher.stats(),
             "key_epoch": self.key_epoch,
             "serve_chain": self.serve_chain,
             "transport": self.transport,
@@ -561,6 +582,11 @@ class VerifyWorker:
         # per-entry exact reads — the reader was the one serve stage
         # under 500k tok/s/core, docs/PERF.md r5).
         reader = protocol.FrameReader(conn)
+        with self._conns_lock:
+            self._conns_live += 1
+            live = self._conns_live
+        telemetry.gauge("serve.conns_live", float(live))
+        tenant_counted = False
         try:
             while True:
                 try:
@@ -588,10 +614,29 @@ class VerifyWorker:
                     region, consumer = shm_state
                     self._serve_shm_conn(conn, respq, region, consumer)
                     return
+                if (not tenant_counted and entries
+                        and ftype in (protocol.T_VERIFY_REQ,
+                                      protocol.T_VERIFY_REQ_CRC,
+                                      protocol.T_VERIFY_REQ_TRACE)
+                        and telemetry.active() is not None):
+                    # attribute the connection to its first verify
+                    # frame's tenant, once (r22 connection plane)
+                    tenant_counted = True
+                    label = _decision.tenant_labels(entries[:1])[0]
+                    telemetry.count(f"serve.tenant.{label}.conns")
                 if not self._dispatch_frame(ftype, entries, trace,
                                             respq, t_recv):
                     return  # protocol violation → drop the connection
         finally:
+            with self._conns_lock:
+                self._conns_live -= 1
+                live = self._conns_live
+            telemetry.gauge("serve.conns_live", float(live))
+            if reader.hwm:
+                # how deep this connection's read buffering ran —
+                # the per-conn memory item #3's C1M ingest must bound
+                telemetry.observe("serve.conn_buffered_hwm_b",
+                                  float(reader.hwm))
             respq.put(None)
             try:
                 conn.close()
@@ -852,9 +897,15 @@ class VerifyWorker:
                     # Serve-surface decision records: every verdict that
                     # leaves this worker is accounted by reason class,
                     # with the request's submit→respond latency bucket.
+                    latency_s = time.monotonic() - pending.ts
+                    # the stage-waterfall denominator: the occupancy
+                    # plane's queue.* + device.exec_s histograms must
+                    # sum to this within tolerance (docs/OBSERVABILITY
+                    # §Occupancy plane, pinned by test)
+                    telemetry.observe("serve.request_s", latency_s)
                     _decision.record_batch(
                         "serve", pending.results, tokens=pending.tokens,
-                        latency_s=time.monotonic() - pending.ts,
+                        latency_s=latency_s,
                         trace=trace)
                     protocol.send_response(sink, pending.results,
                                            crc=kind == "batch_crc",

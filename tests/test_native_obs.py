@@ -430,6 +430,62 @@ def test_obs_off_means_no_plane_and_no_decision_counters():
 
 
 @needs_tel
+def test_occupancy_counters_equal_across_chains():
+    """r22 randomized parity sweep: the same seeded frame plan driven
+    sequentially through each chain must land BIT-EQUAL occupancy
+    dispatch/interval counters — sequential blocking drives make every
+    frame exactly one flush, so N frames == N dispatches == N stub
+    intervals on both chains, timing-independent. (Flush-reason NAMES
+    legitimately differ — "timeout" python, "handoff" native — so the
+    equality set is the dispatch/interval counters; the flush EQUATION
+    is asserted per chain instead.)"""
+    from cap_tpu.obs import occupancy
+
+    rng = random.Random(22)
+    plan = [[f"occ{i}-{j}.ok" for j in range(rng.randint(1, 4))]
+            for i in range(12)]
+
+    def run(native):
+        occupancy.reset()
+        telemetry.enable(telemetry.Recorder())
+        w = VerifyWorker(StubKeySet(), serve_native=native,
+                         max_wait_ms=1.0)
+        try:
+            if native:
+                assert w.serve_chain == "native"
+            host, port = w.address
+            with VerifyClient(host, port) as cl:
+                for frame in plan:
+                    assert len(cl.verify_batch(frame)) == len(frame)
+            time.sleep(0.3)
+            st = w.stats()
+            return dict(st["counters"]), set(st["series"])
+        finally:
+            w.close(deadline_s=10)
+            telemetry.disable()
+            occupancy.reset()
+
+    py_c, _ = run(native=False)
+    nat_c, nat_series = run(native=True)
+
+    eq = ("device.dispatches", "device.stub.intervals")
+    assert {k: py_c.get(k) for k in eq} \
+        == {k: nat_c.get(k) for k in eq} \
+        == {"device.dispatches": len(plan),
+            "device.stub.intervals": len(plan)}
+    for c in (py_c, nat_c):
+        flush_sum = sum(v for k, v in c.items()
+                        if k.startswith("batcher.flush."))
+        assert flush_sum == c.get("batcher.flushes") \
+            == c.get("device.dispatches")
+        assert c.get("device.wall_us", 0) > 0
+        assert 0 <= c.get("device.busy_us", 0) <= c["device.wall_us"]
+    # native ring-wait handshake held: measured series, zero fallbacks
+    assert nat_c.get("serve.native.occ_fallbacks", 0) == 0
+    assert "queue.ring_wait_s" in nat_series
+
+
+@needs_tel
 def test_ring_hwm_gauge_resets_on_scrape():
     telemetry.enable(telemetry.Recorder())
     w = VerifyWorker(StubKeySet(), serve_native=True, max_wait_ms=1.0)

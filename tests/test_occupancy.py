@@ -1,0 +1,471 @@
+"""Pipeline occupancy & queueing-delay plane (r22, ISSUE 18).
+
+The accounting contracts under test, from docs/OBSERVABILITY.md
+§Occupancy plane:
+
+- global busy time is the UNION of recorded intervals (overlapping
+  2-deep-pipeline batches never double-count a microsecond), while
+  per-family busy is the RAW duration (lane share double-counts
+  deliberately);
+- idle gaps are observed only BETWEEN dispatch-level intervals, never
+  before the first and never for per-family enqueue slices;
+- publish() flushes counter DELTAS (mergeable, reset-clamped by
+  consumers) and sets scrape-window gauge ratios;
+- the batch lifecycle decomposes: ``sum(batcher.flush.*) ==
+  batcher.flushes == device.dispatches``, flush reasons classify
+  deterministically, and the per-stage waterfall sums to the e2e
+  request time within tolerance;
+- the connection plane (conns_live gauge, FrameReader.hwm) and the
+  ``occupancy_floor`` SLO rule ride the same counter space.
+"""
+
+import socket
+import time
+
+import pytest
+
+from cap_tpu import telemetry
+from cap_tpu.fleet.worker_main import StubKeySet
+from cap_tpu.obs import occupancy
+from cap_tpu.obs import slo
+from cap_tpu.obs.occupancy import OccAccumulator, occupancy_from_counters
+from cap_tpu.serve import protocol
+from cap_tpu.serve.batcher import AdaptiveBatcher
+from cap_tpu.serve.client import VerifyClient
+from cap_tpu.serve.worker import VerifyWorker
+
+
+@pytest.fixture
+def rec():
+    """Fresh active recorder per test; module accumulator reset so a
+    prior test's unpublished deltas can never leak in."""
+    occupancy.reset()
+    r = telemetry.Recorder()
+    telemetry.enable(r)
+    yield r
+    telemetry.disable()
+    occupancy.reset()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# OccAccumulator: the interval-accounting model
+# ---------------------------------------------------------------------------
+
+def test_totals_empty_until_first_interval():
+    acc = OccAccumulator(FakeClock())
+    assert acc.totals() == {}
+
+
+def test_union_clips_overlap_but_families_count_raw(rec):
+    clk = FakeClock()
+    acc = OccAccumulator(clk)
+    # [0, 0.10] then overlapping [0.05, 0.20]: union = 0.20s, not 0.25
+    acc.record("a", 0.00, 0.10, dispatch=True)
+    acc.record("b", 0.05, 0.20, dispatch=True)
+    # fully-contained interval adds NOTHING to the union
+    acc.record("c", 0.06, 0.08)
+    clk.t = 0.20
+    t = acc.totals()
+    assert t["device.busy_us"] == 200_000
+    assert t["device.wall_us"] == 200_000
+    assert t["device.dispatches"] == 2          # c was not a dispatch
+    # per-family raw durations double-count the overlap deliberately
+    assert t["device.a.busy_us"] == 100_000
+    assert t["device.b.busy_us"] == 150_000
+    assert t["device.c.busy_us"] == 20_000
+    assert t["device.a.intervals"] == 1
+    assert t["device.b.intervals"] == 1
+
+
+def test_idle_gap_only_between_dispatch_intervals(rec):
+    acc = OccAccumulator(FakeClock())
+    # first dispatch interval: no preceding work → no gap
+    acc.record("a", 0.0, 0.1, dispatch=True)
+    # back-to-back: no gap
+    acc.record("a", 0.1, 0.2, dispatch=True)
+    # non-dispatch slice after a bubble: NOT an idle gap (host packing)
+    acc.record("a", 0.5, 0.6)
+    # dispatch after a bubble: exactly one gap observation
+    acc.record("a", 0.9, 1.0, dispatch=True)
+    s = rec.summary().get("device.idle_gap_s")
+    assert s is not None and s["count"] == 1
+    # the observed gap: 0.9 - 0.6 = 0.3s (against the union high-water)
+    assert 0.2 <= s["total"] <= 0.4
+
+
+def test_publish_flushes_deltas_and_window_gauges(rec):
+    clk = FakeClock()
+    acc = OccAccumulator(clk)
+    acc.publish(rec)                      # nothing recorded → no keys
+    assert "device.wall_us" not in rec.counters()
+
+    # binary-exact eighths keep the integer-µs math exact
+    acc.record("stub", 0.0, 0.125, dispatch=True)
+    clk.t = 0.25
+    acc.publish(rec)
+    c = rec.counters()
+    assert c["device.busy_us"] == 125_000
+    assert c["device.wall_us"] == 250_000
+    assert c["device.dispatches"] == 1
+    assert c["device.stub.busy_us"] == 125_000
+    assert c["device.stub.intervals"] == 1
+    g = rec.gauges()
+    assert g["device.occupancy"] == pytest.approx(0.5)
+    assert g["device.stub.occupancy"] == pytest.approx(0.5)
+
+    # next window fully busy: counters accumulate, gauges show the
+    # WINDOW ratio (1.0), not the lifetime ratio (0.6)
+    acc.record("stub", 0.25, 0.375, dispatch=True)
+    clk.t = 0.375
+    acc.publish(rec)
+    c = rec.counters()
+    assert c["device.busy_us"] == 250_000
+    assert c["device.wall_us"] == 375_000
+    assert rec.gauges()["device.occupancy"] == pytest.approx(1.0)
+
+
+def test_interval_noop_and_clock_untouched_while_telemetry_off():
+    telemetry.disable()
+
+    def bomb():
+        raise AssertionError("clock read while telemetry off")
+
+    acc = OccAccumulator(bomb)
+    with acc.interval("stub"):
+        pass
+    assert acc.begin() is None
+    acc.end("stub", None)
+    assert acc.totals() == {}
+
+
+# ---------------------------------------------------------------------------
+# counter-space rollup: capstat / pool / SLO view
+# ---------------------------------------------------------------------------
+
+def test_occupancy_from_counters_lifetime_and_window():
+    cur = {"device.busy_us": 250_000, "device.wall_us": 1_000_000,
+           "device.dispatches": 10,
+           "device.stub.busy_us": 250_000, "device.stub.intervals": 10}
+    out = occupancy_from_counters(cur)
+    assert out["occupancy"] == pytest.approx(0.25)
+    assert out["dispatches"] == 10
+    assert out["families"]["stub"]["occupancy"] == pytest.approx(0.25)
+    assert out["families"]["stub"]["intervals"] == 10
+
+    prev = {"device.busy_us": 50_000, "device.wall_us": 800_000,
+            "device.dispatches": 4,
+            "device.stub.busy_us": 50_000, "device.stub.intervals": 4}
+    win = occupancy_from_counters(cur, prev)
+    assert win["busy_us"] == 200_000 and win["wall_us"] == 200_000
+    assert win["occupancy"] == pytest.approx(1.0)
+    assert win["families"]["stub"]["intervals"] == 6
+
+
+def test_occupancy_from_counters_reset_clamp_and_absent():
+    # restarted worker: cur BELOW prev clamps to zero, never negative
+    cur = {"device.busy_us": 10, "device.wall_us": 100,
+           "device.dispatches": 1}
+    prev = {"device.busy_us": 900, "device.wall_us": 1000,
+            "device.dispatches": 50}
+    out = occupancy_from_counters(cur, prev)
+    assert out["busy_us"] == 0 and out["wall_us"] == 0
+    assert out["occupancy"] == 0.0 and out["dispatches"] == 0
+    # plane never recorded → None, not a zero rollup
+    assert occupancy_from_counters({"batcher.flushes": 3}) is None
+
+
+def test_occupancy_family_parse_ignores_deeper_keys():
+    cur = {"device.wall_us": 100, "device.busy_us": 50,
+           "device.stub.busy_us": 50, "device.stub.intervals": 1,
+           "device.a.b.busy_us": 99}        # not a family key
+    out = occupancy_from_counters(cur)
+    assert set(out["families"]) == {"stub"}
+
+
+def test_merge_snapshots_adds_occupancy_sections():
+    def snap(busy, wall, n):
+        return {"v": 1, "gauges": {}, "series": {},
+                "counters": {"device.busy_us": busy,
+                             "device.wall_us": wall,
+                             "device.dispatches": n,
+                             "device.stub.busy_us": busy,
+                             "device.stub.intervals": n}}
+
+    merged = telemetry.merge_snapshots(
+        [snap(100_000, 1_000_000, 5), snap(300_000, 1_000_000, 7)])
+    c = merged["counters"]
+    assert c["device.busy_us"] == 400_000
+    assert c["device.wall_us"] == 2_000_000
+    assert c["device.dispatches"] == 12
+    # fleet view = sum-busy / sum-wall: the worker-weighted mean
+    out = occupancy_from_counters(c)
+    assert out["occupancy"] == pytest.approx(0.2)
+    assert out["families"]["stub"]["intervals"] == 12
+
+
+# ---------------------------------------------------------------------------
+# batch lifecycle: flush reasons, gauge staleness, the flush equation
+# ---------------------------------------------------------------------------
+
+def test_flush_reason_timeout_and_size(rec):
+    b = AdaptiveBatcher(StubKeySet(), target_batch=4, max_wait_ms=1.0,
+                        fair=False, dedup=False)
+    try:
+        # lone submission under target: flushes on the wait window
+        assert len(b.submit(["t0.ok"])) == 1
+        # a full batch flushes on size
+        assert len(b.submit(["s0.ok", "s1.ok", "s2.ok", "s3.ok"])) == 4
+        time.sleep(0.05)
+        c = rec.counters()
+        assert c.get("batcher.flush.timeout") == 1
+        assert c.get("batcher.flush.size") == 1
+        st = b.stats()
+        assert st["flush_reasons"] == {"timeout": 1, "size": 1}
+        assert st["last_flush"]["reason"] == "size"
+        assert st["last_flush"]["batch_size"] == 4
+        assert st["last_flush"]["batcher_wait_s"] >= 0.0
+    finally:
+        b.close(deadline_s=10)
+
+
+def test_flush_reason_close_and_handoff(rec):
+    # close: a pending under-target submission flushed by shutdown
+    b = AdaptiveBatcher(StubKeySet(), target_batch=64,
+                        max_wait_ms=10_000.0, fair=False, dedup=False)
+    p = b.submit_nowait(["c0.ok"])
+    b.close(deadline_s=10)
+    assert p.event.wait(timeout=5) and len(p.results) == 1
+    assert rec.counters().get("batcher.flush.close") == 1
+
+    # handoff: one drained ring chunk alone meeting the size target
+    done = []
+    b2 = AdaptiveBatcher(StubKeySet(), target_batch=2,
+                         max_wait_ms=10_000.0, fair=False, dedup=False)
+    try:
+        p2 = b2.submit_handoff(["h0.ok", "h1.ok"],
+                               on_done=lambda r: done.append(r))
+        assert p2.event.wait(timeout=5)
+        assert len(done) == 1 and len(done[0]) == 2
+        assert rec.counters().get("batcher.flush.handoff") == 1
+    finally:
+        b2.close(deadline_s=10)
+
+
+def test_flush_equation_sum_reasons_equals_flushes(rec):
+    b = AdaptiveBatcher(StubKeySet(), target_batch=3, max_wait_ms=1.0,
+                        fair=False, dedup=False)
+    try:
+        for i in range(5):
+            b.submit([f"e{i}.ok"])
+        b.submit(["f0.ok", "f1.ok", "f2.ok"])
+        time.sleep(0.05)
+        c = rec.counters()
+        flush_sum = sum(v for k, v in c.items()
+                        if k.startswith("batcher.flush."))
+        assert flush_sum == c.get("batcher.flushes") >= 6
+    finally:
+        b.close(deadline_s=10)
+
+
+def test_batcher_gauges_decay_to_zero_when_queue_empties(rec):
+    """The r22 staleness fix: an emptied queue must not freeze the
+    last flush's depth/fill gauges on the scrape surface forever."""
+    b = AdaptiveBatcher(StubKeySet(), target_batch=4, max_wait_ms=1.0,
+                        fair=False, dedup=False)
+    try:
+        b.submit(["g0.ok", "g1.ok", "g2.ok", "g3.ok"])
+        # flush-time gauges showed a full batch ...
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            g = rec.gauges()
+            if g.get("batcher.queued_tokens") == 0.0 \
+                    and g.get("batcher.fill_ratio") == 0.0:
+                break
+            time.sleep(0.01)
+        # ... and the idle dispatcher decayed them to exactly zero
+        g = rec.gauges()
+        assert g.get("batcher.queued_tokens") == 0.0
+        assert g.get("batcher.fill_ratio") == 0.0
+    finally:
+        b.close(deadline_s=10)
+
+
+def test_stats_additive_before_first_flush():
+    b = AdaptiveBatcher(StubKeySet(), target_batch=4096,
+                        max_wait_ms=1000.0, fair=False, dedup=False)
+    try:
+        st = b.stats()
+        assert "flush_reasons" not in st and "last_flush" not in st
+        assert st["queued_tokens"] == 0
+    finally:
+        b.close(deadline_s=10)
+
+
+# ---------------------------------------------------------------------------
+# e2e: the stage waterfall sums to the request time; conn plane
+# ---------------------------------------------------------------------------
+
+STAGES = ("queue.ring_wait_s", "queue.batcher_wait_s",
+          "queue.dispatch_gap_s", "device.exec_s")
+
+
+def test_stage_waterfall_sums_to_request_time(rec):
+    """Sequential single-frame drives against a 2ms-batch stub: the
+    per-stage means must decompose the e2e mean — generous band, this
+    runs on 1-core CI, but a MISSING stage (sum ≪ e2e) or a
+    double-counted one (sum ≫ e2e) fails."""
+    w = VerifyWorker(StubKeySet(batch_ms=2.0), max_wait_ms=1.0)
+    try:
+        host, port = w.address
+        with VerifyClient(host, port) as cl:
+            for i in range(8):
+                assert len(cl.verify_batch([f"wf{i}.ok"])) == 1
+        time.sleep(0.2)
+        st = w.stats()
+        summ = telemetry.summarize_snapshot(st["snapshot"])
+        assert "serve.request_s" in summ
+        e2e = summ["serve.request_s"]["total"] \
+            / summ["serve.request_s"]["count"]
+        stage_sum = sum(
+            summ[s]["total"] / summ[s]["count"]
+            for s in STAGES if s in summ and summ[s]["count"])
+        assert 0.2 * e2e <= stage_sum <= 2.0 * e2e
+        # exec time dominated by the 2ms stub batch → occupancy is
+        # measurably nonzero on the counter rollup
+        occ = occupancy_from_counters(st["counters"])
+        assert occ is not None and occ["dispatches"] == 8
+        assert occ["occupancy"] > 0.0
+    finally:
+        w.close(deadline_s=10)
+        telemetry.disable()
+
+
+def test_conn_plane_gauges_and_buffer_hwm(rec):
+    w = VerifyWorker(StubKeySet(), max_wait_ms=1.0)
+    try:
+        host, port = w.address
+        with VerifyClient(host, port) as cl:
+            assert len(cl.verify_batch(["conn0.ok"])) == 1
+            g = w._obs_gauges()
+            assert g.get("serve.conns_live") == 1.0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if w._obs_gauges().get("serve.conns_live") == 0.0:
+                break
+            time.sleep(0.01)
+        assert w._obs_gauges().get("serve.conns_live") == 0.0
+        c = rec.counters()
+        assert c.get("worker.connections") == 1
+        # the connection attributed to exactly one tenant label
+        assert sum(v for k, v in c.items()
+                   if k.startswith("serve.tenant.")
+                   and k.endswith(".conns")) == 1
+        # read-buffer high-water mark observed at conn teardown
+        s = rec.summary().get("serve.conn_buffered_hwm_b")
+        assert s is not None and s["count"] == 1 and s["total"] > 0
+    finally:
+        w.close(deadline_s=10)
+
+
+def test_frame_reader_tracks_buffered_hwm():
+    a, b = socket.socketpair()
+    try:
+        protocol.send_request(b, ["hwm-token.ok"])
+        r = protocol.FrameReader(a)
+        assert r.hwm == 0
+        ftype, entries = r.recv_frame()
+        assert entries == ["hwm-token.ok"]
+        # the reader buffered at least the frame's payload at once
+        assert r.hwm > len("hwm-token.ok")
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO: the occupancy_floor rule kind
+# ---------------------------------------------------------------------------
+
+def _occ_snapshot(busy, wall, dispatches):
+    return {"counters": {"device.busy_us": busy,
+                         "device.wall_us": wall,
+                         "device.dispatches": dispatches},
+            "gauges": {}, "series": {}}
+
+
+def test_occupancy_floor_parses_and_evaluates():
+    rules = slo.parse_rules("occ occupancy_floor min 0.5")
+    assert len(rules) == 1 and rules[0].kind == "occupancy_floor"
+    assert rules[0].max_value == 0.5
+
+    # under load below the floor: breach
+    res = slo.evaluate_once(_occ_snapshot(100_000, 1_000_000, 5), rules)
+    assert len(res) == 1 and not res[0]["ok"]
+    assert res[0]["windows"]["lifetime"] == pytest.approx(0.1)
+    # at/above the floor: ok
+    res = slo.evaluate_once(_occ_snapshot(600_000, 1_000_000, 5), rules)
+    assert res[0]["ok"]
+
+
+def test_occupancy_floor_idle_window_never_burns():
+    rules = slo.parse_rules("occ occupancy_floor min 0.9")
+    # zero dispatches → idle, not a breach (an idle fleet is cheap,
+    # not broken); same for a fleet with no occupancy section at all
+    res = slo.evaluate_once(_occ_snapshot(0, 1_000_000, 0), rules)
+    assert res[0]["ok"] and res[0]["windows"]["lifetime"] == "idle"
+    res = slo.evaluate_once({"counters": {}, "gauges": {},
+                             "series": {}}, rules)
+    assert res[0]["ok"]
+
+
+def test_occupancy_floor_parse_rejects_bad_syntax():
+    with pytest.raises(slo.SLOError):
+        slo.parse_rules("occ occupancy_floor max 0.5")
+
+
+def test_observability_doc_pins_occupancy_tables():
+    """docs/OBSERVABILITY.md §Occupancy plane and the plane's actual
+    metric names are the same set — neither side can drift without
+    failing here (the span-table pin's discipline, applied to r22)."""
+    import os
+
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "OBSERVABILITY.md")
+    with open(doc_path) as f:
+        doc = f.read()
+    assert "## Occupancy plane" in doc
+    for name in (
+            # counters the accumulator publishes
+            "device.busy_us", "device.wall_us", "device.dispatches",
+            "device.<fam>.busy_us", "device.<fam>.intervals",
+            # flush attribution + the handshake fallback
+            "batcher.flush.<reason>", "serve.native.occ_fallbacks",
+            # gauges
+            "device.occupancy", "device.<fam>.occupancy",
+            "serve.conns_live", "serve.tenant.<t>.conns",
+            # the stage waterfall
+            "queue.ring_wait_s", "queue.batcher_wait_s",
+            "queue.dispatch_gap_s", "device.exec_s",
+            "device.idle_gap_s", "serve.conn_buffered_hwm_b",
+            "serve.request_s"):
+        assert f"`{name}`" in doc, f"{name} missing from doc tables"
+    for reason in ("size", "timeout", "handoff", "close", "drain"):
+        assert reason in doc
+    assert "occupancy_floor min" in doc
+
+
+def test_default_rules_keep_occupancy_floor_dormant():
+    # the discrete-dispatch baseline sits far below any sane floor
+    # (docs/PERF.md §Round 22) — the default rule set must not page
+    # every stub fleet; the rule ships commented until ROADMAP #5
+    assert not any(r.kind == "occupancy_floor"
+                   for r in slo.default_rules())

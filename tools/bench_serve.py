@@ -481,6 +481,15 @@ def run_fleet_point(n_workers: int, keyset_spec: str, tokens,
         "requests": len(lats),
         "p50_ms": round(_quantile(lats, 0.50) * 1e3, 1),
         "p99_ms": round(_quantile(lats, 0.99) * 1e3, 1),
+        # pipeline-occupancy rollup (r22): fleet-wide busy/wall ratio
+        # + per-family split from the merged scrape, plus the flush
+        # trigger mix — which knob (size/timeout/handoff) actually
+        # released each engine dispatch during this point
+        "occupancy": agg.get("occupancy"),
+        "flush_reasons": {
+            k[len("batcher.flush."):]: v
+            for k, v in sorted((agg.get("counters") or {}).items())
+            if k.startswith("batcher.flush.")},
         "per_worker_tokens": served,
         "placement": {w: list(d) for w, d in
                       pool.placement_map().items()},
@@ -1391,6 +1400,13 @@ def fleet_main() -> None:
 
     zipf_cached_vps = _vc_best("on")
     zipf_uncached_vps = _vc_best("off")
+    # pipeline-occupancy headline (r22): the best point's busy/wall
+    # ratio (the workload the throughput headline describes) + its
+    # idle-gap p99 — where the microseconds waited while the headline
+    # was being set; bench_trend tracks device_occupancy
+    best_occ = best.get("occupancy") or {}
+    idle_gap = (best.get("telemetry", {}).get("stage_latency")
+                or {}).get("device.idle_gap_s") or {}
     print(json.dumps({
         "metric": "serve_fleet_verifies_per_sec",
         "value": best["throughput"],
@@ -1412,6 +1428,11 @@ def fleet_main() -> None:
         "cache_speedup_on_vs_off": (
             round(zipf_cached_vps / zipf_uncached_vps, 3)
             if zipf_cached_vps and zipf_uncached_vps else None),
+        "device_occupancy": (round(best_occ["occupancy"], 4)
+                             if best_occ else None),
+        "occupancy": best_occ or None,
+        "idle_gap_p99_s": idle_gap.get("p99"),
+        "flush_reasons": best.get("flush_reasons") or None,
         "placement_model": "single-owner-per-device",
         # Pool-side supervision attribution for the whole sweep:
         # respawn/crash/hung counters + health-ping latency quantiles.
@@ -1617,6 +1638,11 @@ def main() -> None:
 
     best = max(points, key=lambda p: p["throughput"])
     rec = telemetry.active()
+    # flush the occupancy plane (r22): the workers ran in-process, so
+    # the interval accumulator is ours — publish before reading
+    from cap_tpu.obs import occupancy as _occupancy
+
+    _occupancy.publish(rec)
     stage_latency = {
         name: {"count": int(s["count"]), "p50": round(s["p50"], 6),
                "p95": round(s["p95"], 6), "p99": round(s["p99"], 6)}
@@ -1642,6 +1668,9 @@ def main() -> None:
         # Worker-side stage attribution accumulated over the sweep
         # (batcher fill/dispatch/collect, per-family dispatch.*).
         "telemetry": {"stage_latency": stage_latency},
+        # pipeline-occupancy rollup over the whole sweep (r22):
+        # busy/wall ratio, per-family split, dispatch count
+        "occupancy": _occupancy.occupancy_from_counters(counters),
         # Decision/SLO self-description (cap_tpu.obs), serve surface.
         "decisions": obs_decision.decision_counters(counters),
         # per-tenant rollup (issuer-hash keyed), same counters

@@ -108,7 +108,13 @@ SERVE_TRACKED = {"serve_native_vps": True,
                  # multi-pool workload (higher is better) — the r21
                  # zero-copy front-door contract (bench_serve
                  # CAP_FRONTDOOR_CHAINS gateway arms)
-                 "fleet_native_vps": True}
+                 "fleet_native_vps": True,
+                 # pipeline occupancy: fraction of bench wall time the
+                 # engine spent inside dispatch intervals on the
+                 # pinned serve workload (higher is better) — the r22
+                 # queueing-delay-plane contract; a drop means the
+                 # pipeline grew bubbles even if throughput held
+                 "device_occupancy": True}
 # Rounds from this PR onward must embed decision/SLO fields.
 SELF_DESCRIBING_FROM_ROUND = 6
 
@@ -431,6 +437,19 @@ def selftest(repo: str = REPO) -> List[str]:
     if not any("disappeared" in f for f in check_serve_series(
             [fn[1], (22, {"serve_native_vps": 1e6})])):
         problems.append("vanished fleet_native_vps NOT flagged")
+    # 4e5. device_occupancy (r22): introducing must not flag; a drop
+    #      (pipeline grew bubbles) and a disappearance must
+    oc2 = [(21, {"serve_native_vps": 1e6}),
+           (22, {"serve_native_vps": 1e6, "device_occupancy": 0.4})]
+    if check_serve_series(oc2):
+        problems.append("introducing device_occupancy flagged")
+    if not check_serve_series(
+            [oc2[1], (23, {"serve_native_vps": 1e6,
+                           "device_occupancy": 0.3})]):
+        problems.append("device_occupancy regression NOT flagged")
+    if not any("disappeared" in f for f in check_serve_series(
+            [oc2[1], (23, {"serve_native_vps": 1e6})])):
+        problems.append("vanished device_occupancy NOT flagged")
     # 4f. resident_slhdsa128s_vps (r17, BENCH series): introducing
     #     must not flag; a drop and a disappearance must
     def _pq(vals):

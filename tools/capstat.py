@@ -25,6 +25,10 @@ renders the fleet in one screen:
   rules (``cap_tpu.obs.slo`` syntax; defaults when no file) against
   the merged fleet counters — **exits 2 on any breach**, so cron
   probes and CI can page on contract burn;
+- ``--occupancy``: the pipeline-occupancy view (r22) — device
+  occupancy %% overall and per family, flush-reason mix, the
+  queueing-stage waterfall against ``serve.request_s``, idle-gap p99,
+  per-worker occupancy — ROADMAP #5's denominator;
 - ``--postmortem FILE``: render a collected crash postmortem
   (``cap_tpu.obs.postmortem``) — final flight ring, stage quantiles,
   decision counters, queue depth at death.
@@ -538,6 +542,94 @@ def render_tenants(merged: Dict[str, Any],
     return "\n".join(lines)
 
 
+def render_occupancy(worker_data: Dict[str, Dict[str, Any]]) -> str:
+    """The ``--occupancy`` view (r22): per-worker device occupancy %,
+    flush-reason mix, the stage waterfall (where each microsecond of
+    ``serve.request_s`` waits), and idle-gap p99 — the measurement
+    half of ROADMAP #5's ≥90% occupancy gate, over the same mergeable
+    counters every other view renders."""
+    from cap_tpu.obs import occupancy as obs_occupancy
+
+    lines = []
+    merged = merged_snapshot(worker_data)
+    counters = {k: int(v) for k, v in
+                (merged.get("counters") or {}).items()}
+    agg = obs_occupancy.occupancy_from_counters(counters)
+    if agg is None:
+        return ("occupancy: no device.* counters in the scrape "
+                "(no engine dispatched yet, or workers predate r22)")
+    fam_mix = "  ".join(
+        f"{fam}={row['occupancy'] * 100:.1f}%" for fam, row in
+        sorted(agg["families"].items(),
+               key=lambda kv: -kv[1]["busy_us"]))
+    lines.append(
+        f"fleet occupancy {agg['occupancy'] * 100:6.2f}%  "
+        f"(busy {agg['busy_us'] / 1e3:.1f}ms / wall "
+        f"{agg['wall_us'] / 1e6:.1f}s, worker-weighted)  "
+        f"dispatches={agg['dispatches']}  {fam_mix}")
+    # flush-reason mix: every flush attributed to WHY it fired
+    flushes = counters.get("batcher.flushes", 0)
+    reasons = {k.rsplit(".", 1)[1]: v for k, v in counters.items()
+               if k.startswith("batcher.flush.")}
+    if reasons:
+        total = sum(reasons.values())
+        mix = "  ".join(f"{r}={v} ({100.0 * v / total:.0f}%)"
+                        for r, v in sorted(reasons.items(),
+                                           key=lambda kv: -kv[1]))
+        eq = "EXACT" if total == flushes else \
+            f"DRIFT({total}!={flushes})"
+        lines.append(f"  flush reasons: {mix}  [{eq} vs "
+                     f"batcher.flushes={flushes}]")
+    # stage waterfall: mean time per request in each queueing stage;
+    # their sum ≈ the end-to-end request mean (pinned by test)
+    summary = telemetry.summarize_snapshot(merged)
+    req = (summary.get("serve.request_s")
+           or summary.get("serve.native.request_s"))
+    stage_names = ["queue.ring_wait_s", "queue.batcher_wait_s",
+                   "queue.dispatch_gap_s", "device.exec_s"]
+    stages = [(n, summary[n]) for n in stage_names if n in summary]
+    if stages:
+        lines.append(f"  {'stage':<24} {'mean':>10} {'p99':>10} "
+                     f"{'count':>9}  share")
+        denom = req["mean"] if req else \
+            sum(s["mean"] for _, s in stages)
+        for name, s in stages:
+            share = s["mean"] / denom if denom > 0 else 0.0
+            bar = "#" * int(round(share * 20))
+            lines.append(
+                f"  {name:<24} {s['mean'] * 1e6:8.1f}us "
+                f"{s['p99'] * 1e6:8.1f}us {int(s['count']):>9}  "
+                f"{share * 100:5.1f}% {bar}")
+        if req is not None:
+            lines.append(
+                f"  {'serve.request_s (e2e)':<24} "
+                f"{req['mean'] * 1e6:8.1f}us "
+                f"{req['p99'] * 1e6:8.1f}us {int(req['count']):>9}")
+    gap = summary.get("device.idle_gap_s")
+    if gap is not None:
+        lines.append(
+            f"  idle gaps: {int(gap['count'])} bubbles, "
+            f"mean {gap['mean'] * 1e3:.2f}ms, p99 "
+            f"{gap['p99'] * 1e3:.2f}ms — host-prep time #5's "
+            "double-buffering closes")
+    # per-worker occupancy (each scrape's own counters)
+    if len(worker_data) > 1:
+        lines.append(f"  {'worker':<22} {'occ%':>7} {'dispatches':>11} "
+                     f"{'busy_ms':>9}")
+        for ep, data in sorted(worker_data.items()):
+            wc = {k: int(v) for k, v in
+                  ((data.get("snapshot") or {})
+                   .get("counters") or {}).items()}
+            w = obs_occupancy.occupancy_from_counters(wc)
+            if w is None:
+                lines.append(f"  {ep:<22}       -")
+                continue
+            lines.append(f"  {ep:<22} {w['occupancy'] * 100:6.2f}% "
+                         f"{w['dispatches']:>11} "
+                         f"{w['busy_us'] / 1e3:9.1f}")
+    return "\n".join(lines)
+
+
 def counter_deltas(prev: Dict[str, Any],
                    cur: Dict[str, Any]) -> Dict[str, int]:
     """Per-interval counter increases between two merged scrapes.
@@ -639,6 +731,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "vps/reject mix/p99/vcache hit%%/SLO state "
                          "over the merged scrape; --watch turns the "
                          "tokens column into a per-interval rate)")
+    ap.add_argument("--occupancy", action="store_true",
+                    help="render the pipeline-occupancy view (device "
+                         "occupancy %%, flush-reason mix, stage "
+                         "waterfall, idle-gap p99 over the merged "
+                         "scrape)")
     ap.add_argument("--tenants-top", type=int, default=20,
                     metavar="N", help="rows in the tenant ledger "
                     "(default 20, sorted by tokens)")
@@ -707,7 +804,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         else:
             if frontdoor is not None:
                 print(render_frontdoor(frontdoor))
-            if args.tenants:
+            if args.occupancy:
+                print(render_occupancy(worker_data))
+            elif args.tenants:
                 merged = merged_snapshot(worker_data, client)
                 now = time.monotonic()
                 extras: Dict[str, Any] = {}

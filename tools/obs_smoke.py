@@ -36,6 +36,18 @@ The CI guard for the observability surface (``make obs-smoke``):
    — the fast path only takes live primaries), relays counted, zero
    stale accepts, and capstat renders the chain= line. Skipped with
    a notice when the library lacks the front-door TU.
+8. OCCUPANCY GATE (r22): a deterministic sequential burst (each
+   frame is exactly one batcher flush) on BOTH serve chains — FAIL
+   if the ``device.occupancy`` gauge is missing/NaN/out-of-range on
+   any scrape, if the exact flush-reason equation
+   ``sum(batcher.flush.*) == batcher.flushes == device.dispatches``
+   drifts, if the stage waterfall (ring wait + batcher wait +
+   dispatch gap + exec) does not sum to the measured end-to-end
+   request time within the 1-core tolerance, if the native chain
+   reports ``serve.native.occ_fallbacks`` with a fresh library, or
+   if the python/native occupancy counters are not bit-equal on the
+   chain-invariant set (``device.dispatches``,
+   ``device.stub.intervals``).
 
 Runs under JAX_PLATFORMS=cpu inside the tier-1 time budget (~15 s).
 """
@@ -428,6 +440,112 @@ def run_admission_gate(serve_chain):
     return ([f"{serve_chain}: {f}" for f in failures], adm_counters)
 
 
+def run_occupancy_gate(serve_chain):
+    """The pipeline-occupancy gate (r22): drive a DETERMINISTIC
+    sequential single-token burst through a 2-worker stub fleet —
+    each frame arrives alone, so every frame is exactly one batcher
+    flush and one engine dispatch on BOTH chains. FAIL if (a) the
+    ``device.occupancy`` gauge is missing/NaN/out-of-range on any
+    worker scrape, (b) the exact flush-reason equation
+    ``sum(batcher.flush.*) == batcher.flushes == device.dispatches``
+    drifts, (c) the per-stage histograms do not sum to the measured
+    end-to-end request mean within the (generous — 1-core CI)
+    tolerance, or (d) the native chain counts ``occ_fallbacks`` with
+    a freshly built library or serves no measured ring-wait samples.
+    Returns (failures, chain-invariant occupancy counters) so main()
+    can pin python-vs-native bit-equality — flush-reason NAMES are
+    timing-dependent under load, but the dispatch/interval totals of
+    this sequential drive never are."""
+    from cap_tpu import telemetry
+    from cap_tpu.fleet import FleetClient, WorkerPool
+    from cap_tpu.fleet.worker_main import StubKeySet
+    from tools import capstat
+
+    failures = []
+    occ_counters = {}
+    pool = WorkerPool(2, keyset_spec="stub", ping_interval=0.3,
+                      serve_chain=serve_chain)
+    try:
+        if not pool.wait_all_ready(30):
+            return ([f"{serve_chain}: occupancy fleet did not come "
+                     "up"], occ_counters)
+        telemetry.enable()
+        telemetry.active().reset()
+        cl = FleetClient(pool, fallback=StubKeySet(), rr_seed=0)
+        # sequential blocking calls with DISTINCT tokens: no frame
+        # coalescing (next send waits for the previous response) and
+        # no verdict-cache short-circuit — N calls == N dispatches
+        n = 16
+        for i in range(n):
+            out = cl.verify_batch([f"occ-{serve_chain}-{i}.ok"])
+            assert len(out) == 1
+        snaps = []
+        for wid, (host, port) in sorted(pool.obs_endpoints().items()):
+            data = capstat.scrape(f"{host}:{port}")
+            snaps.append(data["snapshot"])
+            gauges = (data["snapshot"] or {}).get("gauges") or {}
+            occ = gauges.get("device.occupancy")
+            if occ is None:
+                failures.append(f"worker {wid}: device.occupancy "
+                                "gauge missing after the burst")
+            elif not (occ == occ and 0.0 <= occ <= 1.0):
+                failures.append(f"worker {wid}: device.occupancy "
+                                f"gauge out of range ({occ})")
+        merged = telemetry.merge_snapshots(snaps)
+        counters = merged.get("counters") or {}
+        dispatches = counters.get("device.dispatches", 0)
+        flushes = counters.get("batcher.flushes", 0)
+        flush_sum = sum(v for k, v in counters.items()
+                        if k.startswith("batcher.flush."))
+        if dispatches != n:
+            failures.append(f"device.dispatches {dispatches} != {n} "
+                            "sequential frames")
+        if flush_sum != flushes or flushes != dispatches:
+            failures.append(
+                f"flush-reason accounting drift: sum(batcher.flush.*) "
+                f"{flush_sum} != batcher.flushes {flushes} != "
+                f"device.dispatches {dispatches}")
+        busy = counters.get("device.busy_us", 0)
+        wall = counters.get("device.wall_us", 0)
+        if wall <= 0 or busy < 0 or busy > wall:
+            failures.append(f"occupancy counters implausible: "
+                            f"busy_us {busy} wall_us {wall}")
+        # stage waterfall: the per-stage means must sum to the e2e
+        # request mean within a generous band — a missing stage or a
+        # double-counted one lands far outside it even on a loaded
+        # 1-core CI box
+        summ = telemetry.summarize_snapshot(merged)
+        stage_sum = sum(
+            summ[s]["mean"] for s in
+            ("queue.ring_wait_s", "queue.batcher_wait_s",
+             "queue.dispatch_gap_s", "device.exec_s") if s in summ)
+        e2e_name = ("serve.native.request_s" if serve_chain == "native"
+                    else "serve.request_s")
+        e2e = (summ.get(e2e_name) or {}).get("mean", 0.0)
+        if e2e <= 0:
+            failures.append(f"no {e2e_name} samples for the "
+                            "stage-sum check")
+        elif not (0.2 * e2e <= stage_sum <= 2.0 * e2e):
+            failures.append(
+                f"stage waterfall drifted from e2e: stages sum "
+                f"{stage_sum * 1e6:.1f}us vs {e2e_name} mean "
+                f"{e2e * 1e6:.1f}us")
+        if serve_chain == "native":
+            if counters.get("serve.native.occ_fallbacks", 0):
+                failures.append(
+                    "occ_fallbacks moved with a fresh native library "
+                    "(occupancy layout handshake failed)")
+            if "queue.ring_wait_s" not in summ:
+                failures.append("native chain served no measured "
+                                "queue.ring_wait_s samples")
+        occ_counters = {k: counters.get(k, 0)
+                        for k in ("device.dispatches",
+                                  "device.stub.intervals")}
+    finally:
+        pool.close()
+    return ([f"{serve_chain}: {f}" for f in failures], occ_counters)
+
+
 def run_frontdoor_gate():
     """The 2-pool front-door gate: a repeated-token burst routed by
     digest affinity must (a) show ``frontdoor.affinity_hits`` > 0 with
@@ -612,6 +730,11 @@ def main() -> int:
     adm_failures, py_adm = run_admission_gate("python")
     failures.extend(adm_failures)
 
+    # pipeline-occupancy gate (r22, python chain): occupancy gauge
+    # live, exact flush-reason equation, stage waterfall sums to e2e
+    occ_failures, py_occ = run_occupancy_gate("python")
+    failures.extend(occ_failures)
+
     # native-chain gate: same load, native serve chain + telemetry
     # plane; decision counters must be IDENTICAL to the python run
     native_ok = False
@@ -644,6 +767,12 @@ def main() -> int:
             failures.append(
                 "native/python ADMISSION counters diverge: "
                 f"native={nat_adm} python={py_adm}")
+        nat_occ_failures, nat_occ = run_occupancy_gate("native")
+        failures.extend(nat_occ_failures)
+        if nat_occ != py_occ:
+            failures.append(
+                "native/python OCCUPANCY counters diverge: "
+                f"native={nat_occ} python={py_occ}")
     else:
         print("obs-smoke NOTE: native serve runtime unavailable — "
               "native-chain gate skipped", file=sys.stderr)
@@ -677,9 +806,11 @@ def main() -> int:
           "gate clean (hashed attribution, flood SLO breach, zero "
           "raw issuers), admission gate clean (flooder throttled "
           "with exact checked==admitted+throttled, quiet tenant "
-          "untouched, retry-after parseable)"
+          "untouched, retry-after parseable), occupancy gate clean "
+          "(gauge live, sum(flush.*) == dispatches exact, stage "
+          "waterfall sums to e2e)"
           + (", native fleet scraped clean with counter AND tenant "
-             "AND admission parity to the python run"
+             "AND admission AND occupancy parity to the python run"
              if native_ok else "")
           + ", 2-pool front door routed clean (affinity hits, exact "
             "lookup accounting, zero stale accepts)"
